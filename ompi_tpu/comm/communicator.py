@@ -1,0 +1,393 @@
+"""Communicators.
+
+Reference: ompi/communicator (8,787 LoC) — comm objects own a group, a
+context id (CID), an errhandler, attribute caching, and a per-comm
+collectives table (comm->c_coll); point-to-point dispatches through the
+PML (ompi/mpi/c/send.c.in:85 MCA_PML_CALL).
+
+Two concrete kinds:
+- ``ProcComm`` — process mode: this process *is* one rank; verbs take host
+  buffers and run over pml/btl.
+- ``XlaComm`` (ompi_tpu/parallel/mesh.py) — SPMD mesh mode: the single
+  controller holds all ranks; collectives are XLA programs over the ICI
+  mesh.
+
+CID allocation is a distributed agreement in the reference
+(comm_cid.c:61-109); here it is a MAX-allreduce over the parent
+communicator, which serves the same purpose (all members agree on a fresh
+id) in one round.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu.core import op as _op
+from ompi_tpu.core.datatype import Datatype, BYTE, INT64, from_numpy_dtype
+from ompi_tpu.core.errors import (
+    MPIError,
+    ERR_ARG,
+    ERR_COMM,
+    ERR_RANK,
+    ERR_REVOKED,
+    ERR_UNSUPPORTED_OPERATION,
+    ERRORS_ARE_FATAL,
+    Errhandler,
+)
+from ompi_tpu.core.group import Group
+from ompi_tpu.core.request import Request
+from ompi_tpu.core.status import Status
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+PROC_NULL = -2
+UNDEFINED = -32766
+
+
+def parse_buffer(buf) -> Tuple[Any, int, Datatype]:
+    """Accept ndarray | bytearray | [obj, datatype] | [obj, count, datatype]
+    (mpi4py-style buffer specs)."""
+    if isinstance(buf, (list, tuple)):
+        if len(buf) == 2:
+            obj, dt = buf
+            count = obj.size if hasattr(obj, "size") else len(obj)
+            return obj, int(count), dt
+        if len(buf) == 3:
+            obj, count, dt = buf
+            return obj, int(count), dt
+        raise MPIError(ERR_ARG, "buffer spec must be [obj, [count,] datatype]")
+    if isinstance(buf, np.ndarray):
+        if buf.dtype.names:
+            raise MPIError(ERR_ARG,
+                           "structured arrays need an explicit datatype")
+        return buf, buf.size, from_numpy_dtype(buf.dtype)
+    if isinstance(buf, (bytearray, memoryview, bytes)):
+        return buf, len(buf), BYTE
+    raise MPIError(ERR_ARG, f"cannot infer buffer spec from {type(buf)}")
+
+
+class Communicator:
+    def __init__(self, group: Group, cid: int, name: str = ""):
+        self.group = group
+        self.cid = cid
+        self.name = name or f"comm-{cid}"
+        self.errhandler: Errhandler = ERRORS_ARE_FATAL
+        self.attributes: Dict[int, Any] = {}
+        self.revoked = False  # ULFM (reference: communicator.h:360-363)
+        self.coll = None  # CollTable, set by subclasses after selection
+        self.topo = None  # topology module (cart/graph), set by topo layer
+
+    # ------------------------------------------------------------- queries
+    @property
+    def size(self) -> int:
+        return self.group.size
+
+    def Get_size(self) -> int:
+        return self.size
+
+    def Get_group(self) -> Group:
+        return self.group
+
+    def Get_name(self) -> str:
+        return self.name
+
+    def Set_name(self, name: str) -> None:
+        self.name = name
+
+    def Get_errhandler(self) -> Errhandler:
+        return self.errhandler
+
+    def Set_errhandler(self, eh: Errhandler) -> None:
+        self.errhandler = eh
+
+    def Set_attr(self, keyval: int, value: Any) -> None:
+        self.attributes[keyval] = value
+
+    def Get_attr(self, keyval: int) -> Any:
+        return self.attributes.get(keyval)
+
+    def Delete_attr(self, keyval: int) -> None:
+        self.attributes.pop(keyval, None)
+
+    def _check_usable(self) -> None:
+        if self.revoked:
+            raise MPIError(ERR_REVOKED, self.name)
+
+    def _check_root(self, root: int) -> None:
+        if not 0 <= root < self.size:
+            raise MPIError(ERR_RANK, f"root {root} out of range")
+
+
+class Intracomm(Communicator):
+    pass
+
+
+class ProcComm(Intracomm):
+    """Process-mode communicator: this process is rank ``self.rank``."""
+
+    def __init__(self, group: Group, cid: int, pml, name: str = ""):
+        super().__init__(group, cid, name)
+        self.pml = pml
+        self.rank = group.rank_of(pml.my_rank)
+        from ompi_tpu.coll.base import select_coll
+
+        self.coll = select_coll(self)
+
+    def Get_rank(self) -> int:
+        return self.rank
+
+    def _world_rank(self, comm_rank: int) -> int:
+        return self.group.world_rank(comm_rank)
+
+    # --------------------------------------------------------------- pt2pt
+    def Isend(self, buf, dest: int, tag: int = 0) -> Request:
+        self._check_usable()
+        if dest == PROC_NULL:
+            from ompi_tpu.core.request import CompletedRequest
+
+            return CompletedRequest()
+        obj, count, dt = parse_buffer(buf)
+        return self.pml.isend(obj, count, dt, self._world_rank(dest),
+                              tag, self.cid)
+
+    def Irecv(self, buf, source: int = ANY_SOURCE,
+              tag: int = ANY_TAG) -> Request:
+        self._check_usable()
+        if source == PROC_NULL:
+            from ompi_tpu.core.request import CompletedRequest
+
+            r = CompletedRequest()
+            r.status.source = PROC_NULL
+            r.status.tag = ANY_TAG
+            return r
+        obj, count, dt = parse_buffer(buf)
+        wsrc = source if source == ANY_SOURCE else self._world_rank(source)
+        req = self.pml.irecv(obj, count, dt, wsrc, tag, self.cid)
+        # report comm-rank, not world-rank, in the status
+        req.add_completion_callback(self._fix_status_source)
+        return req
+
+    def _fix_status_source(self, req) -> None:
+        if req.status.source >= 0:
+            req.status.source = self.group.rank_of(req.status.source)
+
+    def Send(self, buf, dest: int, tag: int = 0) -> None:
+        self.Isend(buf, dest, tag).Wait()
+
+    def Recv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             status: Optional[Status] = None) -> None:
+        self.Irecv(buf, source, tag).Wait(status)
+
+    def Sendrecv(self, sendbuf, dest: int, sendtag: int, recvbuf,
+                 source: int = ANY_SOURCE, recvtag: int = ANY_TAG,
+                 status: Optional[Status] = None) -> None:
+        rreq = self.Irecv(recvbuf, source, recvtag)
+        sreq = self.Isend(sendbuf, dest, sendtag)
+        sreq.Wait()
+        rreq.Wait(status)
+
+    def Probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              status: Optional[Status] = None) -> None:
+        while not self.Iprobe(source, tag, status):
+            from ompi_tpu.runtime.progress import progress
+
+            progress()
+
+    def Iprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+               status: Optional[Status] = None) -> bool:
+        self._check_usable()
+        wsrc = source if source == ANY_SOURCE else self._world_rank(source)
+        st = Status() if status is None else status
+        ok = self.pml.iprobe(wsrc, tag, self.cid, st)
+        if ok and st.source >= 0:
+            st.source = self.group.rank_of(st.source)
+        return ok
+
+    def Mprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+               status: Optional[Status] = None):
+        from ompi_tpu.runtime.progress import progress
+
+        wsrc = source if source == ANY_SOURCE else self._world_rank(source)
+        while True:
+            msg = self.pml.improbe(wsrc, tag, self.cid, status)
+            if msg is not None:
+                if status is not None and status.source >= 0:
+                    status.source = self.group.rank_of(status.source)
+                return msg
+            progress()
+
+    def Mrecv(self, buf, message, status: Optional[Status] = None) -> None:
+        obj, count, dt = parse_buffer(buf)
+        req = self.pml.mrecv(obj, count, dt, message)
+        req.add_completion_callback(self._fix_status_source)
+        req.Wait(status)
+
+    def Send_init(self, buf, dest: int, tag: int = 0):
+        from ompi_tpu.core.request import Prequest
+
+        def start(preq):
+            inner = self.Isend(buf, dest, tag)
+
+            def done(r):
+                preq.status = r.status
+                preq._set_complete(r._error)
+
+            inner.add_completion_callback(done)
+
+        return Prequest(start)
+
+    def Recv_init(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG):
+        from ompi_tpu.core.request import Prequest
+
+        def start(preq):
+            inner = self.Irecv(buf, source, tag)
+
+            def done(r):
+                preq.status = r.status
+                preq._set_complete(r._error)
+
+            inner.add_completion_callback(done)
+
+        return Prequest(start)
+
+    # ---------------------------------------------------------- collectives
+    def _coll(self, op: str):
+        self._check_usable()
+        return self.coll.get(op)
+
+    def Barrier(self) -> None:
+        self._coll("barrier")(self)
+
+    def Bcast(self, buf, root: int = 0) -> None:
+        self._check_root(root)
+        self._coll("bcast")(self, buf, root)
+
+    def Reduce(self, sendbuf, recvbuf, op: _op.Op = _op.SUM,
+               root: int = 0) -> None:
+        self._check_root(root)
+        self._coll("reduce")(self, sendbuf, recvbuf, op, root)
+
+    def Allreduce(self, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> None:
+        self._coll("allreduce")(self, sendbuf, recvbuf, op)
+
+    def Allgather(self, sendbuf, recvbuf) -> None:
+        self._coll("allgather")(self, sendbuf, recvbuf)
+
+    def Allgatherv(self, sendbuf, recvbuf, counts, displs=None) -> None:
+        self._coll("allgatherv")(self, sendbuf, recvbuf, counts, displs)
+
+    def Gather(self, sendbuf, recvbuf, root: int = 0) -> None:
+        self._check_root(root)
+        self._coll("gather")(self, sendbuf, recvbuf, root)
+
+    def Gatherv(self, sendbuf, recvbuf, counts, displs=None,
+                root: int = 0) -> None:
+        self._check_root(root)
+        self._coll("gatherv")(self, sendbuf, recvbuf, counts, displs, root)
+
+    def Scatter(self, sendbuf, recvbuf, root: int = 0) -> None:
+        self._check_root(root)
+        self._coll("scatter")(self, sendbuf, recvbuf, root)
+
+    def Scatterv(self, sendbuf, recvbuf, counts, displs=None,
+                 root: int = 0) -> None:
+        self._check_root(root)
+        self._coll("scatterv")(self, sendbuf, recvbuf, counts, displs, root)
+
+    def Alltoall(self, sendbuf, recvbuf) -> None:
+        self._coll("alltoall")(self, sendbuf, recvbuf)
+
+    def Alltoallv(self, sendbuf, recvbuf, sendcounts, sdispls,
+                  recvcounts, rdispls) -> None:
+        self._coll("alltoallv")(self, sendbuf, recvbuf, sendcounts, sdispls,
+                                recvcounts, rdispls)
+
+    def Reduce_scatter(self, sendbuf, recvbuf, recvcounts,
+                       op: _op.Op = _op.SUM) -> None:
+        self._coll("reduce_scatter")(self, sendbuf, recvbuf, recvcounts, op)
+
+    def Reduce_scatter_block(self, sendbuf, recvbuf,
+                             op: _op.Op = _op.SUM) -> None:
+        self._coll("reduce_scatter_block")(self, sendbuf, recvbuf, op)
+
+    def Scan(self, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> None:
+        self._coll("scan")(self, sendbuf, recvbuf, op)
+
+    def Exscan(self, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> None:
+        self._coll("exscan")(self, sendbuf, recvbuf, op)
+
+    # ------------------------------------------------------ comm management
+    def _alloc_cid(self) -> int:
+        """Agree on a fresh CID: MAX-allreduce of the local next-free id
+        (reference: the comm_cid.c distributed agreement)."""
+        local = np.array([_next_local_cid()], dtype=np.int64)
+        agreed = np.zeros(1, dtype=np.int64)
+        self.Allreduce(local, agreed, op=_op.MAX)
+        _bump_local_cid(int(agreed[0]))
+        return int(agreed[0])
+
+    def Dup(self) -> "ProcComm":
+        cid = self._alloc_cid()
+        return ProcComm(self.group, cid, self.pml, name=f"{self.name}-dup")
+
+    def Split(self, color: int, key: int = 0) -> Optional["ProcComm"]:
+        """MPI_Comm_split: allgather (color, key), then local group math."""
+        mine = np.array([color, key, self.rank], dtype=np.int64)
+        allv = np.zeros(3 * self.size, dtype=np.int64)
+        self.Allgather(mine, allv)
+        cid = self._alloc_cid()
+        if color == UNDEFINED:
+            return None
+        triples = allv.reshape(self.size, 3)
+        members = [t for t in triples if t[0] == color]
+        members.sort(key=lambda t: (int(t[1]), int(t[2])))
+        ranks = [self.group.world_rank(int(t[2])) for t in members]
+        return ProcComm(Group(ranks), cid, self.pml,
+                        name=f"{self.name}-split{color}")
+
+    def Create_group(self, group: Group, tag: int = 0) -> Optional["ProcComm"]:
+        cid = self._alloc_cid()
+        if group.rank_of(self.pml.my_rank) < 0:
+            return None
+        return ProcComm(group, cid, self.pml, name=f"{self.name}-sub")
+
+    def Create(self, group: Group) -> Optional["ProcComm"]:
+        return self.Create_group(group)
+
+    def Free(self) -> None:
+        self.coll = None
+
+    # ULFM surface (reference: ompi/mpiext/ftmpi MPIX_Comm_*)
+    def Revoke(self) -> None:
+        from ompi_tpu.ft.revoke import revoke_comm
+
+        revoke_comm(self)
+
+    def Shrink(self) -> "ProcComm":
+        from ompi_tpu.ft.revoke import shrink_comm
+
+        return shrink_comm(self)
+
+    def Agree(self, flag: int) -> int:
+        from ompi_tpu.ft.agreement import agree
+
+        return agree(self, flag)
+
+
+# Local CID counter (the per-process component of the CID agreement).
+_cid_lock = threading.Lock()
+_cid_next = 10
+
+
+def _next_local_cid() -> int:
+    with _cid_lock:
+        return _cid_next
+
+
+def _bump_local_cid(used: int) -> None:
+    global _cid_next
+    with _cid_lock:
+        _cid_next = max(_cid_next, used) + 1

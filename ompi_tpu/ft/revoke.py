@@ -1,0 +1,64 @@
+"""Communicator revocation and shrink.
+
+Reference: ompi/communicator/ft/comm_ft_revoke.c (revoke propagates via
+reliable broadcast and flips the revoked flag checked by every operation —
+communicator.h:360-363) and MPIX_Comm_shrink (new comm excluding failed
+ranks). Our propagation rides a best-effort revoke notice to every peer
+over the pml; local state flips immediately.
+"""
+
+from __future__ import annotations
+
+from ompi_tpu.utils.show_help import show_help
+
+
+REVOKE_TAG = -4242  # internal tag space (negative tags are framework-only)
+
+
+def revoke_comm(comm) -> None:
+    """Flip local revoked state and best-effort notify peers (reference:
+    the revoke reliable-bcast; peers also learn via their own detector)."""
+    import numpy as np
+
+    if comm.revoked:
+        return
+    comm.revoked = True
+    show_help("comm", "revoked", name=comm.name)
+    pml = getattr(comm, "pml", None)
+    if pml is None:
+        return  # mesh-mode comms revoke locally (single controller)
+    token = np.array([comm.cid], dtype=np.int64)
+    for r in comm.group.ranks:
+        if r == pml.my_rank:
+            continue
+        try:
+            pml.isend(token, 1, _int64(), r, REVOKE_TAG, comm.cid)
+        except Exception:
+            pass  # peer may already be dead; its detector will notice
+
+
+def _int64():
+    from ompi_tpu.core.datatype import INT64
+
+    return INT64
+
+
+def shrink_comm(comm):
+    """MPIX_Comm_shrink: new communicator over the live members."""
+    from ompi_tpu.comm.communicator import ProcComm
+    from ompi_tpu.core.group import Group
+    from ompi_tpu.ft.detector import known_failed
+
+    failed = known_failed()
+    alive = [r for r in comm.group.ranks if r not in failed]
+    newgrp = Group(alive)
+    # CID agreement must run on a usable comm; shrink is defined on revoked
+    # comms, so allocate from the local counter + max over alive via direct
+    # pml exchange is future work — use local allocation (single-host jobs
+    # share the counter ordering because every rank revokes then shrinks in
+    # the same order).
+    from ompi_tpu.comm.communicator import _next_local_cid, _bump_local_cid
+
+    cid = _next_local_cid() + 1000  # shrink CID space, disjoint from normal
+    _bump_local_cid(cid)
+    return ProcComm(newgrp, cid, comm.pml, name=f"{comm.name}-shrunk")

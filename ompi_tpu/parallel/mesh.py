@@ -1,0 +1,253 @@
+"""Mesh-mode communicators: MPI_COMM_WORLD projected onto a jax.Mesh.
+
+The TPU-native execution model (BASELINE.json north star): the single
+controller owns a 1-D device mesh; MPI ranks are mesh positions; a
+"distributed buffer" is a global jax.Array whose leading dim is the rank
+dim, sharded over the mesh axis. Sub-communicators (Split / Create_group)
+become ``axis_index_groups`` partitions, so *every* sub-communicator
+collective is still one XLA collective over ICI — the communicator↔mesh
+projection SURVEY.md §7 ranks as hard part 2.
+
+Reference analogs: ompi/communicator/comm.c (split/dup/group math) with the
+CID agreement replaced by driver-local allocation (single controller ⇒ no
+distributed agreement needed — the reference needs comm_cid.c:61-109 only
+because every rank allocates independently).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu.comm.communicator import Intracomm
+from ompi_tpu.core import op as _op
+from ompi_tpu.core.errors import (
+    MPIError,
+    ERR_ARG,
+    ERR_UNSUPPORTED_OPERATION,
+)
+from ompi_tpu.core.group import Group
+
+UNDEFINED = -32766
+
+_next_mesh_cid = [100]
+
+
+class XlaComm(Intracomm):
+    """A communicator (or a color-family of communicators) on a device mesh.
+
+    ``groups`` is None for the world comm, else a partition of all mesh
+    positions; collectives act within each group independently — after a
+    Split the one XlaComm object *is* every color's communicator, observed
+    from the driver.
+    """
+
+    def __init__(self, mesh, axis: str, groups: Optional[Tuple[Tuple[int, ...], ...]] = None,
+                 name: str = ""):
+        self.mesh = mesh
+        self.axis = axis
+        self.world_size = int(mesh.shape[axis])
+        if groups is not None:
+            groups = tuple(tuple(int(r) for r in g) for g in groups)
+            flat = sorted(r for g in groups for r in g)
+            if flat != list(range(self.world_size)):
+                raise MPIError(
+                    ERR_ARG,
+                    "groups must partition all mesh positions "
+                    "(pad non-members as singleton groups)",
+                )
+        self.groups = groups
+        # pos_map[global mesh position] = rank within its group;
+        # singleton_mask marks padding groups excluded from schedules.
+        pos = np.zeros(self.world_size, dtype=np.int32)
+        single = np.zeros(self.world_size, dtype=bool)
+        if groups is not None:
+            for g in groups:
+                for p, r in enumerate(g):
+                    pos[r] = p
+                    single[r] = len(g) == 1
+        else:
+            pos = np.arange(self.world_size, dtype=np.int32)
+        self.pos_map = pos
+        self.singleton_mask = single
+        cid = _next_mesh_cid[0]
+        _next_mesh_cid[0] += 1
+        super().__init__(Group(range(self.world_size)), cid,
+                         name or f"mesh-comm-{cid}")
+        self._jit_cache = {}
+        from ompi_tpu.coll.base import select_coll
+
+        self.coll = select_coll(self)
+
+    # ------------------------------------------------------------- queries
+    @property
+    def size(self) -> int:
+        """Group size: uniform across non-singleton colors (singletons are
+        padding); raises if real colors differ in size."""
+        if self.groups is None:
+            return self.world_size
+        sizes = {len(g) for g in self.groups if len(g) > 1}
+        if not sizes:
+            return 1
+        if len(sizes) != 1:
+            raise MPIError(
+                ERR_UNSUPPORTED_OPERATION,
+                "non-uniform color sizes: split into uniform colors or "
+                "query per-color via .groups",
+            )
+        return next(iter(sizes))
+
+    def Get_rank(self):
+        raise MPIError(
+            ERR_UNSUPPORTED_OPERATION,
+            "mesh-mode driver holds all ranks; use jax.lax.axis_index "
+            f"('{self.axis}') inside shard_map, or process mode for "
+            "per-rank control flow",
+        )
+
+    def _require_uniform_groups(self, what: str) -> None:
+        _ = self.size  # raises when non-uniform
+
+    # ------------------------------------------------------------ sharding
+    def sharding(self, *rest_spec):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P(self.axis, *rest_spec))
+
+    def shard(self, x):
+        """Place a [world, ...] array with the rank dim over the mesh."""
+        import jax
+
+        return jax.device_put(x, self.sharding())
+
+    # ------------------------------------------- functional collectives
+    def _slot(self, name: str):
+        self._check_usable()
+        return self.coll.get(name)
+
+    def allreduce(self, x, op: _op.Op = _op.SUM):
+        return self._slot("allreduce")(self, x, op)
+
+    def reduce(self, x, op: _op.Op = _op.SUM, root: int = 0):
+        self._check_root(root)
+        return self._slot("reduce")(self, x, op, root)
+
+    def bcast(self, x, root: int = 0):
+        self._check_root(root)
+        return self._slot("bcast")(self, x, root)
+
+    def allgather(self, x):
+        return self._slot("allgather")(self, x)
+
+    def alltoall(self, x):
+        return self._slot("alltoall")(self, x)
+
+    def reduce_scatter(self, x, op: _op.Op = _op.SUM):
+        return self._slot("reduce_scatter_block")(self, x, op)
+
+    def scan(self, x, op: _op.Op = _op.SUM):
+        return self._slot("scan")(self, x, op)
+
+    def exscan(self, x, op: _op.Op = _op.SUM):
+        return self._slot("exscan")(self, x, op)
+
+    def barrier(self) -> None:
+        self._slot("barrier")(self)
+
+    def gather(self, x, root: int = 0):
+        return self._slot("gather")(self, x, root)
+
+    def scatter(self, x, root: int = 0):
+        return self._slot("scatter")(self, x, root)
+
+    # MPI-style aliases
+    Allreduce = allreduce
+    Bcast = bcast
+    Allgather = allgather
+    Alltoall = alltoall
+    Barrier = barrier
+
+    # ------------------------------------------------------------- pt2pt
+    def permute(self, x, perm: Sequence[Tuple[int, int]]):
+        """Tag-free pt2pt: move rank-rows along (src, dst) pairs in comm
+        (group-local) ranks."""
+        if self.groups is None:
+            global_perm = tuple((int(s), int(d)) for s, d in perm)
+        else:
+            # singleton padding groups have no in-group peers to permute
+            global_perm = tuple(
+                (g[int(s)], g[int(d)])
+                for g in self.groups
+                if len(g) > 1
+                for s, d in perm
+            )
+        return self._slot_permute()(self, x, global_perm)
+
+    def _slot_permute(self):
+        # permute is not one of the 17 standard slots; fetch the xla module
+        # directly (host comms get pt2pt via pml instead).
+        from ompi_tpu.coll.xla import XlaCollComponent
+
+        mod = XlaCollComponent._module
+        if mod is None:
+            raise MPIError(ERR_UNSUPPORTED_OPERATION, "no xla coll module")
+        return mod.permute
+
+    def shift(self, x, steps: int = 1):
+        """Ring shift by `steps` within each group (MPI_Sendrecv around a
+        ring — the ring_c example's traffic pattern)."""
+        n = self.size
+        perm = tuple((i, (i + steps) % n) for i in range(n))
+        return self.permute(x, perm)
+
+    # ------------------------------------------------------ comm management
+    def Dup(self) -> "XlaComm":
+        return XlaComm(self.mesh, self.axis, self.groups,
+                       name=f"{self.name}-dup")
+
+    def Split(self, colors: Sequence[int],
+              keys: Optional[Sequence[int]] = None) -> "XlaComm":
+        """MPI_Comm_split, driver-level: `colors[i]` / `keys[i]` are rank
+        i's arguments; all colors are materialized at once as the groups
+        partition of the returned comm."""
+        if len(colors) != self.world_size:
+            raise MPIError(ERR_ARG, "need one color per mesh position")
+        keys = list(keys) if keys is not None else [0] * self.world_size
+        by_color = {}
+        for r, (c, k) in enumerate(zip(colors, keys)):
+            by_color.setdefault(c, []).append((k, r))
+        groups: List[Tuple[int, ...]] = []
+        for c, members in sorted(by_color.items(),
+                                 key=lambda kv: (kv[0] == UNDEFINED, kv[0])):
+            members.sort()
+            if c == UNDEFINED:
+                groups.extend((r,) for _, r in members)  # singleton padding
+            else:
+                groups.append(tuple(r for _, r in members))
+        return XlaComm(self.mesh, self.axis, tuple(groups),
+                       name=f"{self.name}-split")
+
+    def Create_group(self, ranks: Sequence[int]) -> "XlaComm":
+        """Sub-communicator of a rank subset; non-members are padded as
+        singleton groups (their rows are unspecified after collectives)."""
+        member = set(int(r) for r in ranks)
+        groups = [tuple(int(r) for r in ranks)]
+        groups.extend((r,) for r in range(self.world_size) if r not in member)
+        return XlaComm(self.mesh, self.axis, tuple(groups),
+                       name=f"{self.name}-sub")
+
+    def Free(self) -> None:
+        self._jit_cache.clear()
+        self.coll = None
+
+
+def mesh_world(devices=None, axis_name: str = "mpi_world") -> XlaComm:
+    """Build the mesh-mode MPI_COMM_WORLD over all (or given) devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    mesh = Mesh(np.asarray(devices), (axis_name,))
+    return XlaComm(mesh, axis_name, name="MESH_COMM_WORLD")

@@ -1,0 +1,94 @@
+"""Process-mode wireup: modex connect, transport selection, endpoint setup.
+
+Reference: the RTE/PMIx glue (ompi/runtime/ompi_rte.c:538-581 PMIx_Init,
+OPAL_MODEX_SEND/RECV macros pmix-internal.h:266,577, add_procs
+instance.c:730). Implemented in ompi_tpu.runtime.modex (the PMIx-lite KV
+store) and here (business-card exchange + btl endpoint wiring).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_ctx: Optional[dict] = None
+
+
+def init_process_mode():
+    """Bring up this rank: connect modex, publish our business card, fence,
+    wire an endpoint per peer, build MPI_COMM_WORLD."""
+    global _ctx
+    from ompi_tpu.comm.communicator import ProcComm
+    from ompi_tpu.core.group import Group
+    from ompi_tpu.pml.ob1 import Ob1Pml
+    from ompi_tpu.btl.self_btl import SelfBtl
+    from ompi_tpu.btl.tcp import TcpBtl
+    from ompi_tpu.runtime.modex import ModexClient
+    from ompi_tpu.runtime.progress import ProgressThread, register_progress
+    from ompi_tpu.mca.var import get_var
+
+    rank = int(os.environ["OMPI_TPU_RANK"])
+    size = int(os.environ["OMPI_TPU_SIZE"])
+    modex_addr = os.environ["OMPI_TPU_MODEX"]
+
+    pml = Ob1Pml(my_rank=rank)
+    modex = ModexClient(modex_addr, rank, size)
+
+    tcp = TcpBtl(pml.handle_incoming, rank)
+    # business card: how peers reach us (reference: the modex "endpoint
+    # blob" every btl publishes)
+    modex.put("btl.tcp.addr", f"{tcp.host}:{tcp.port}")
+    modex.fence()  # reference: PMIx_Fence_nb at instance.c:575-625
+
+    peers = {}
+    for r in range(size):
+        if r == rank:
+            continue
+        peers[r] = modex.get(r, "btl.tcp.addr")
+    tcp.set_peers(peers)
+
+    self_btl = SelfBtl(pml.handle_incoming)
+    pml.add_endpoint(rank, self_btl)
+    for r in range(size):
+        if r != rank:
+            pml.add_endpoint(r, tcp)
+
+    register_progress(tcp.progress)
+    pthread = None
+    if get_var("runtime", "progress_thread"):
+        pthread = ProgressThread()
+        pthread.start()
+
+    world = ProcComm(Group(range(size)), cid=0, pml=pml,
+                     name="MPI_COMM_WORLD")
+    _ctx = {
+        "modex": modex,
+        "tcp": tcp,
+        "progress_thread": pthread,
+        "world": world,
+    }
+    # second fence == the modex barrier before comm activation
+    # (ompi_mpi_init.c:451-505)
+    modex.fence()
+    return world
+
+
+def shutdown() -> None:
+    global _ctx
+    if _ctx is None:
+        return
+    try:
+        _ctx["modex"].fence()
+    except Exception:
+        pass
+    if _ctx.get("progress_thread") is not None:
+        _ctx["progress_thread"].stop()
+    try:
+        _ctx["tcp"].finalize()
+    except Exception:
+        pass
+    try:
+        _ctx["modex"].close()
+    except Exception:
+        pass
+    _ctx = None

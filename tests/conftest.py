@@ -1,0 +1,28 @@
+"""Test configuration.
+
+Collective/sharding tests run on a virtual 8-device CPU mesh (the
+deterministic fake-mesh backend SURVEY.md §4 calls for — the reference's
+accelerator/null + btl/template pattern, applied to the whole device layer).
+Must run before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+# Force CPU for tests even when the session env points at a real TPU
+# (bench.py, not the tests, exercises real hardware). jax may already be
+# imported by a sitecustomize hook, so set both the env var and the live
+# config before any backend initializes.
+_platform = os.environ.get("OMPI_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", _platform)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,141 @@
+"""Group / Op / Status / Request / Info tests (reference: test/class plus
+ompi group & op semantics)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.group import Group, IDENT, SIMILAR, UNEQUAL
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.core.request import Request, CompletedRequest, Prequest, Grequest
+from ompi_tpu.core.info import Info
+from ompi_tpu.core.errors import MPIError
+
+
+# ------------------------------------------------------------------ groups
+def test_group_basic():
+    g = Group([4, 2, 7])
+    assert g.size == 3
+    assert g.rank_of(2) == 1
+    assert g.rank_of(99) == -1
+    assert g.world_rank(2) == 7
+
+
+def test_group_set_ops():
+    a = Group([0, 1, 2, 3])
+    b = Group([2, 3, 4, 5])
+    assert a.Union(b).ranks == (0, 1, 2, 3, 4, 5)
+    assert a.Intersection(b).ranks == (2, 3)
+    assert a.Difference(b).ranks == (0, 1)
+
+
+def test_group_incl_excl():
+    g = Group([10, 20, 30, 40])
+    assert g.Incl([3, 0]).ranks == (40, 10)
+    assert g.Excl([1, 2]).ranks == (10, 40)
+
+
+def test_group_ranges():
+    g = Group(list(range(16)))
+    assert g.Range_incl([(0, 6, 2)]).ranks == (0, 2, 4, 6)
+    assert g.Range_incl([(6, 0, -2)]).ranks == (6, 4, 2, 0)
+
+
+def test_group_translate_compare():
+    a = Group([0, 1, 2])
+    b = Group([2, 1, 0])
+    assert a.Translate_ranks([0, 2], b) == [2, 0]
+    assert a.Compare(b) == SIMILAR
+    assert a.Compare(Group([0, 1, 2])) == IDENT
+    assert a.Compare(Group([5])) == UNEQUAL
+
+
+def test_group_duplicate_ranks_rejected():
+    with pytest.raises(MPIError):
+        Group([1, 1])
+
+
+# --------------------------------------------------------------------- ops
+def test_predefined_ops_numpy():
+    a = np.array([1, 5, 3])
+    b = np.array([4, 2, 3])
+    np.testing.assert_array_equal(mpi_op.SUM.np_reduce(a, b), [5, 7, 6])
+    np.testing.assert_array_equal(mpi_op.MAX.np_reduce(a, b), [4, 5, 3])
+    np.testing.assert_array_equal(mpi_op.BXOR.np_reduce(a, b), a ^ b)
+
+
+def test_minloc():
+    dt = np.dtype([("f0", np.float32), ("f1", np.int32)])
+    a = np.array([(1.0, 3), (5.0, 0)], dtype=dt)
+    b = np.array([(1.0, 1), (2.0, 7)], dtype=dt)
+    r = mpi_op.MINLOC.np_reduce(a, b)
+    assert (r["f0"][0], r["f1"][0]) == (1.0, 1)  # tie → lower index
+    assert (r["f0"][1], r["f1"][1]) == (2.0, 7)
+
+
+def test_user_op():
+    op = mpi_op.Op.Create(lambda a, b: a + 2 * b, name="a+2b")
+    np.testing.assert_array_equal(
+        op.np_reduce(np.array([1]), np.array([10])), [21]
+    )
+
+
+# ---------------------------------------------------------------- requests
+def test_completed_request():
+    r = CompletedRequest(nbytes=16, source=2, tag=9)
+    assert r.Test()
+    st = __import__("ompi_tpu.core.status", fromlist=["Status"]).Status()
+    r.Wait(st)
+    assert st.source == 2 and st.tag == 9
+    assert st.Get_count(__import__("ompi_tpu").FLOAT32) == 4
+
+
+def test_request_wait_with_async_completion():
+    import threading
+
+    r = Request()
+    threading.Timer(0.02, lambda: r._set_complete(0)).start()
+    r.Wait(timeout=5.0)
+    assert r.is_complete
+
+
+def test_waitall_waitany():
+    rs = [Request() for _ in range(3)]
+    rs[1]._set_complete(0)
+    assert Request.Waitany(rs) == 1
+    for r in rs:
+        r._set_complete(0)
+    Request.Waitall(rs)
+    assert Request.Testall(rs)
+
+
+def test_grequest():
+    r = Grequest()
+    assert not r.is_complete
+    r.Complete()
+    assert r.Test()
+
+
+def test_persistent_request():
+    fired = []
+    p = Prequest(lambda req: (fired.append(1), req._set_complete(0)))
+    assert p.is_complete  # inactive
+    p.Start()
+    p.Wait()
+    p.Start()
+    p.Wait()
+    assert len(fired) == 2
+
+
+# -------------------------------------------------------------------- info
+def test_info():
+    i = Info({"a": "1"})
+    i.Set("b", "2")
+    assert i.Get("b") == "2"
+    assert i.Get_nkeys() == 2
+    seen = []
+    i.subscribe(lambda k, v: seen.append((k, v)))
+    i.Set("c", "3")
+    assert seen == [("c", "3")]
+    d = i.Dup()
+    i.Delete("a")
+    assert d.Get("a") == "1" and i.Get("a") is None

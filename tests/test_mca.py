@@ -1,0 +1,120 @@
+"""MCA var + component system tests (reference analog: the var/framework
+machinery exercised implicitly by every reference test via MCA params)."""
+
+import os
+
+import pytest
+
+from ompi_tpu.mca import var as mca_var
+from ompi_tpu.mca.component import Component, Framework
+from ompi_tpu.mca.var import VarSource
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    saved = dict(mca_var._registry)
+    yield
+    mca_var._registry.clear()
+    mca_var._registry.update(saved)
+
+
+def test_var_default():
+    v = mca_var.register_var("testfw", "alpha", 42, help="test var", level=3)
+    assert v.value == 42
+    assert v.source == VarSource.DEFAULT
+    assert mca_var.get_var("testfw", "alpha") == 42
+
+
+def test_var_env_override(monkeypatch):
+    monkeypatch.setenv("OMPI_TPU_MCA_testfw_beta", "7")
+    v = mca_var.register_var("testfw", "beta", 1)
+    assert v.value == 7
+    assert v.source == VarSource.ENV
+
+
+def test_var_set_override():
+    mca_var.register_var("testfw", "gamma", 1.5)
+    mca_var.set_var("testfw", "gamma", 2.5)
+    assert mca_var.get_var("testfw", "gamma") == 2.5
+
+
+def test_var_bool_coercion(monkeypatch):
+    monkeypatch.setenv("OMPI_TPU_MCA_testfw_flag", "yes")
+    v = mca_var.register_var("testfw", "flag", False)
+    assert v.value is True
+
+
+def test_var_enum_validation():
+    v = mca_var.register_var(
+        "testfw", "mode", "fast", enum_values=("fast", "slow")
+    )
+    with pytest.raises(ValueError):
+        mca_var.set_var("testfw", "mode", "medium")
+
+
+def test_var_reregistration_idempotent():
+    v1 = mca_var.register_var("testfw", "idem", 3)
+    v2 = mca_var.register_var("testfw", "idem", 99)
+    assert v1 is v2
+    assert v2.value == 3
+
+
+class _Comp(Component):
+    def __init__(self, name, priority, available=True):
+        self.NAME = name
+        self.PRIORITY = priority
+        self.available = available
+
+    def query(self, **ctx):
+        return f"module-{self.NAME}" if self.available else None
+
+
+def test_priority_selection():
+    fw = Framework("selfw1")
+    fw.register(_Comp("low", 10))
+    fw.register(_Comp("high", 50))
+    name, module = fw.select_one()
+    assert name == "high"
+    assert module == "module-high"
+
+
+def test_declining_component_skipped():
+    fw = Framework("selfw2")
+    fw.register(_Comp("best", 90, available=False))
+    fw.register(_Comp("fallback", 5))
+    name, _ = fw.select_one()
+    assert name == "fallback"
+
+
+def test_select_all_ordering():
+    fw = Framework("selfw3")
+    fw.register(_Comp("a", 10))
+    fw.register(_Comp("b", 30))
+    fw.register(_Comp("c", 20))
+    mods = fw.select_all()
+    assert [n for _, n, _ in mods] == ["b", "c", "a"]
+
+
+def test_component_include_list():
+    fw = Framework("selfw4")
+    fw.register(_Comp("x", 50))
+    fw.register(_Comp("y", 10))
+    mca_var.set_var("selfw4", "selfw4", "y")
+    name, _ = fw.select_one()
+    assert name == "y"
+
+
+def test_component_exclude_list():
+    fw = Framework("selfw5")
+    fw.register(_Comp("x", 50))
+    fw.register(_Comp("y", 10))
+    mca_var.set_var("selfw5", "selfw5", "^x")
+    name, _ = fw.select_one()
+    assert name == "y"
+
+
+def test_no_component_raises():
+    fw = Framework("selfw6")
+    fw.register(_Comp("only", 10, available=False))
+    with pytest.raises(RuntimeError):
+        fw.select_one()

@@ -1,0 +1,116 @@
+"""Singleton (1-rank, no launcher) MPI semantics — reference:
+the is_singleton path of ompi_mpi_init.c:451 and coll/self."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD, COMM_SELF
+from ompi_tpu.core.status import Status
+
+
+def test_world_shape():
+    assert COMM_WORLD.Get_size() == 1
+    assert COMM_WORLD.Get_rank() == 0
+    assert ompi_tpu.Is_initialized()
+
+
+def test_send_recv_self():
+    send = np.arange(8, dtype=np.float32)
+    recv = np.zeros(8, dtype=np.float32)
+    req = COMM_WORLD.Irecv(recv, source=0, tag=7)
+    COMM_WORLD.Send(send, dest=0, tag=7)
+    st = Status()
+    req.Wait(st)
+    np.testing.assert_array_equal(send, recv)
+    assert st.Get_source() == 0 and st.Get_tag() == 7
+    assert st.Get_count(ompi_tpu.FLOAT32) == 8
+
+
+def test_unexpected_then_recv():
+    send = np.array([3.5], dtype=np.float64)
+    COMM_WORLD.Send(send, dest=0, tag=11)
+    recv = np.zeros(1, dtype=np.float64)
+    COMM_WORLD.Recv(recv, source=ompi_tpu.ANY_SOURCE, tag=ompi_tpu.ANY_TAG)
+    assert recv[0] == 3.5
+
+
+def test_probe_iprobe():
+    assert not COMM_WORLD.Iprobe(tag=99)
+    COMM_WORLD.Send(np.zeros(2, np.int32), dest=0, tag=99)
+    st = Status()
+    assert COMM_WORLD.Iprobe(tag=99, status=st)
+    assert st.Get_count(ompi_tpu.INT32) == 2
+    recv = np.zeros(2, np.int32)
+    COMM_WORLD.Recv(recv, tag=99)
+
+
+def test_sendrecv():
+    send = np.array([1, 2], np.int64)
+    recv = np.zeros(2, np.int64)
+    COMM_WORLD.Sendrecv(send, dest=0, sendtag=5, recvbuf=recv,
+                        source=0, recvtag=5)
+    np.testing.assert_array_equal(recv, send)
+
+
+def test_collectives_singleton():
+    a = np.arange(4, dtype=np.float32)
+    out = np.zeros(4, dtype=np.float32)
+    COMM_WORLD.Allreduce(a, out)
+    np.testing.assert_array_equal(out, a)
+    COMM_WORLD.Bcast(a, root=0)
+    out2 = np.zeros(4, dtype=np.float32)
+    COMM_WORLD.Allgather(a, out2)
+    np.testing.assert_array_equal(out2, a)
+    COMM_WORLD.Barrier()
+
+
+def test_comm_self():
+    assert COMM_SELF.Get_size() == 1
+    b = np.array([9], np.int32)
+    COMM_SELF.Send(b, dest=0, tag=1)
+    r = np.zeros(1, np.int32)
+    COMM_SELF.Recv(r, tag=1)
+    assert r[0] == 9
+
+
+def test_split_dup_singleton():
+    c = COMM_WORLD.Split(color=0, key=0)
+    assert c.Get_size() == 1
+    d = COMM_WORLD.Dup()
+    assert d.Get_size() == 1
+    assert d.cid != COMM_WORLD.cid
+
+
+def test_mprobe_mrecv():
+    COMM_WORLD.Send(np.array([42], np.int32), dest=0, tag=13)
+    st = Status()
+    msg = COMM_WORLD.Mprobe(tag=13, status=st)
+    r = np.zeros(1, np.int32)
+    COMM_WORLD.Mrecv(r, msg)
+    assert r[0] == 42
+
+
+def test_persistent_requests():
+    send = np.array([7.0], np.float32)
+    recv = np.zeros(1, np.float32)
+    sreq = COMM_WORLD.Send_init(send, dest=0, tag=21)
+    rreq = COMM_WORLD.Recv_init(recv, source=0, tag=21)
+    for i in range(3):
+        send[0] = i
+        rreq.Start()
+        sreq.Start()
+        sreq.Wait()
+        rreq.Wait()
+        assert recv[0] == i
+
+
+def test_datatype_send_recv_derived():
+    from ompi_tpu.core.datatype import FLOAT32
+
+    t = FLOAT32.Create_vector(2, 2, 3).Commit()
+    src = np.arange(6, dtype=np.float32)
+    dst = np.zeros(6, dtype=np.float32)
+    COMM_WORLD.Send([src, 1, t], dest=0, tag=31)
+    COMM_WORLD.Recv([dst, 1, t], source=0, tag=31)
+    np.testing.assert_array_equal(dst, [0, 1, 0, 3, 4, 0])
