@@ -1,0 +1,47 @@
+"""Ring message-passing example — the first BASELINE.json ladder config
+(reference: examples/ring_c.c — same traffic pattern, Python surface).
+
+Run:  python -m ompi_tpu.tools.mpirun -np 4 examples/ring.py
+"""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+
+
+def main() -> int:
+    rank = COMM_WORLD.Get_rank()
+    size = COMM_WORLD.Get_size()
+    nxt = (rank + 1) % size
+    prev = (rank - 1) % size
+
+    msg = np.array([10], dtype=np.int32)
+    if rank == 0:
+        print(f"Process 0 sending {int(msg[0])} to {nxt}, "
+              f"tag 201 ({size} processes in ring)", flush=True)
+        COMM_WORLD.Send(msg, dest=nxt, tag=201)
+
+    # pass the token around, decrementing at rank 0, until it hits zero
+    while True:
+        COMM_WORLD.Recv(msg, source=prev, tag=201)
+        if rank == 0:
+            msg -= 1
+            print(f"Process 0 decremented value: {int(msg[0])}", flush=True)
+        COMM_WORLD.Send(msg, dest=nxt, tag=201)
+        if msg[0] == 0 and rank != 0:
+            break
+        if rank == 0 and msg[0] == 0:
+            COMM_WORLD.Recv(msg, source=prev, tag=201)
+            break
+
+    print(f"Process {rank} exiting", flush=True)
+    COMM_WORLD.Barrier()
+    ompi_tpu.Finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,238 @@
+"""TCP transport.
+
+Reference: opal/mca/btl/tcp (5,240 LoC — libevent-driven endpoints with
+multi-link striping). Redesign: one non-blocking listener + lazy outgoing
+connections, drained by the central progress engine (selectors-based; the
+GIL releases in select so the progress thread is cheap). This is the DCN
+path of the framework — ICI bulk data rides coll/xla instead, so the TCP
+btl optimizes for control/pt2pt traffic, not peak bandwidth.
+
+Frame format: [u32 total_len][header HDR_SIZE bytes][payload]. One frame
+per pml message/fragment; TCP ordering per connection preserves MPI
+ordering per peer (the reference's per-peer seq numbers guard reordering
+across *multiple* btls; with one link per peer ordering is structural).
+"""
+
+from __future__ import annotations
+
+import errno
+import selectors
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ompi_tpu.btl.base import Btl, btl_framework
+from ompi_tpu.mca.component import Component
+from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.pml.base import HDR_SIZE
+from ompi_tpu.utils.output import get_logger
+
+register_var("btl_tcp", "eager_limit", 1 << 20,
+             help="TCP eager/rendezvous threshold in bytes", level=4)
+register_var("btl_tcp", "bind_host", "127.0.0.1",
+             help="Interface to bind/advertise (reference: btl_tcp_if_*)",
+             level=4)
+
+_LEN = struct.Struct("<I")
+
+
+class _Conn:
+    __slots__ = ("sock", "rbuf", "wlock")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.rbuf = bytearray()
+        self.wlock = threading.Lock()
+
+
+class TcpBtl(Btl):
+    NAME = "tcp"
+
+    def __init__(self, deliver: Callable[[bytes, bytes], None], my_rank: int):
+        super().__init__(deliver)
+        self.eager_limit = get_var("btl_tcp", "eager_limit")
+        self.my_rank = my_rank
+        self.log = get_logger("btl.tcp")
+        host = get_var("btl_tcp", "bind_host")
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind((host, 0))
+        self.listener.listen(64)
+        self.listener.setblocking(False)
+        self.host, self.port = self.listener.getsockname()
+        self.peers: Dict[int, str] = {}
+        self.conns: Dict[int, _Conn] = {}  # peer rank -> connection
+        self._conn_lock = threading.Lock()
+        self.sel = selectors.DefaultSelector()
+        self.sel.register(self.listener, selectors.EVENT_READ,
+                          ("accept", None))
+        self._sel_lock = threading.Lock()
+        self._closed = False
+
+    # ------------------------------------------------------------- wiring
+    def set_peers(self, peers: Dict[int, str]) -> None:
+        self.peers = dict(peers)
+
+    def _connect(self, peer: int) -> _Conn:
+        addr = self.peers[peer]
+        host, port = addr.rsplit(":", 1)
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                s = socket.create_connection((host, int(port)), timeout=30.0)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.02)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # identify ourselves so the acceptor can map conn -> rank
+        s.sendall(_LEN.pack(self.my_rank))
+        conn = _Conn(s)
+        s.setblocking(False)
+        with self._sel_lock:
+            self.sel.register(s, selectors.EVENT_READ, ("peer", conn))
+        return conn
+
+    def _get_conn(self, peer: int) -> _Conn:
+        with self._conn_lock:
+            conn = self.conns.get(peer)
+            if conn is None:
+                conn = self._connect(peer)
+                self.conns[peer] = conn
+            return conn
+
+    # --------------------------------------------------------------- send
+    def send(self, peer: int, header: bytes, payload) -> None:
+        conn = self._get_conn(peer)
+        if not isinstance(payload, (bytes, bytearray)):
+            payload = bytes(memoryview(payload))
+        frame = _LEN.pack(HDR_SIZE + len(payload)) + header + payload
+        with conn.wlock:
+            conn.sock.setblocking(True)
+            try:
+                conn.sock.sendall(frame)
+            finally:
+                conn.sock.setblocking(False)
+
+    # ----------------------------------------------------------- progress
+    def progress(self) -> int:
+        """Drain ready sockets; called from the progress engine
+        (reference: btl progress fns registered at opal_progress.c:416)."""
+        if self._closed:
+            return 0
+        try:
+            with self._sel_lock:
+                events = self.sel.select(timeout=0)
+        except OSError:
+            return 0
+        n = 0
+        for key, _ in events:
+            kind, conn = key.data
+            if kind == "accept":
+                n += self._accept()
+            else:
+                n += self._drain(conn)
+        return n
+
+    def _accept(self) -> int:
+        try:
+            s, _ = self.listener.accept()
+        except OSError:
+            return 0
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # first 4 bytes: peer rank
+        s.setblocking(True)
+        raw = b""
+        while len(raw) < 4:
+            chunk = s.recv(4 - len(raw))
+            if not chunk:
+                return 0
+            raw += chunk
+        peer = _LEN.unpack(raw)[0]
+        conn = _Conn(s)
+        s.setblocking(False)
+        with self._conn_lock:
+            # keep one canonical conn per peer for sending; both sides may
+            # connect simultaneously — every conn gets drained regardless
+            self.conns.setdefault(peer, conn)
+        with self._sel_lock:
+            self.sel.register(s, selectors.EVENT_READ, ("peer", conn))
+        return 1
+
+    def _drain(self, conn: _Conn) -> int:
+        try:
+            data = conn.sock.recv(1 << 20)
+        except socket.error as e:
+            if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                return 0
+            self._unregister(conn)
+            return 0
+        if not data:
+            self._unregister(conn)
+            return 0
+        conn.rbuf += data
+        n = 0
+        buf = conn.rbuf
+        off = 0
+        while len(buf) - off >= 4:
+            total = _LEN.unpack_from(buf, off)[0]
+            if len(buf) - off - 4 < total:
+                break
+            start = off + 4
+            hdr = bytes(buf[start : start + HDR_SIZE])
+            payload = bytes(buf[start + HDR_SIZE : start + total])
+            off += 4 + total
+            self.deliver(hdr, payload)
+            n += 1
+        if off:
+            del buf[:off]
+        return n
+
+    def _unregister(self, conn: _Conn) -> None:
+        with self._sel_lock:
+            try:
+                self.sel.unregister(conn.sock)
+            except (KeyError, ValueError):
+                pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def finalize(self) -> None:
+        self._closed = True
+        with self._sel_lock:
+            try:
+                self.sel.unregister(self.listener)
+            except (KeyError, ValueError):
+                pass
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns = list(self.conns.values())
+            self.conns.clear()
+        for conn in conns:
+            self._unregister(conn)
+        with self._sel_lock:
+            try:
+                self.sel.close()
+            except OSError:
+                pass
+
+
+class TcpBtlComponent(Component):
+    NAME = "tcp"
+    PRIORITY = 20
+
+    def query(self, deliver=None, my_rank=None, **ctx):
+        if deliver is None or my_rank is None:
+            return None
+        return TcpBtl(deliver, my_rank)
+
+
+btl_framework.register(TcpBtlComponent())

@@ -135,6 +135,7 @@ class ProcComm(Intracomm):
         from ompi_tpu.coll.base import select_coll
 
         self.coll = select_coll(self)
+        _live_comms[cid] = self
 
     def Get_rank(self) -> int:
         return self.rank
@@ -375,6 +376,20 @@ class ProcComm(Intracomm):
         from ompi_tpu.ft.agreement import agree
 
         return agree(self, flag)
+
+
+# Live communicator registry: cid -> comm, used by the ULFM revoke handler
+# to flip remote-revocation state (reference: the framework-wide comm table
+# ompi_comm_lookup uses for the same purpose).
+import weakref
+
+_live_comms: "weakref.WeakValueDictionary[int, ProcComm]" = (
+    weakref.WeakValueDictionary()
+)
+
+
+def lookup_comm(cid: int) -> Optional[ProcComm]:
+    return _live_comms.get(cid)
 
 
 # Local CID counter (the per-process component of the CID agreement).

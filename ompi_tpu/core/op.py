@@ -53,8 +53,8 @@ class Op:
         by ring/segmented schedules)."""
         if self._jax_reduce is not None:
             return self._jax_reduce(a, b)
-        import jax.numpy as jnp  # late import: core must not require jax
-
+        if not _JNP_EQUIV:  # late import: core must not require jax
+            _register_jnp_equivs()
         fn = _JNP_EQUIV.get(self.name)
         if fn is None:
             raise MPIError(ERR_OP, f"op {self.name} has no device kernel")
@@ -124,8 +124,3 @@ MAXLOC = Op("MPI_MAXLOC", _maxloc, jax_kind="gather")
 REPLACE = Op("MPI_REPLACE", lambda a, b: b, jax_kind="gather",
              commutative=False)
 NO_OP = Op("MPI_NO_OP", lambda a, b: a, jax_kind="gather", commutative=False)
-
-try:  # pre-register device kernels when jax is importable
-    _register_jnp_equivs()
-except ImportError:  # pragma: no cover
-    pass

@@ -61,6 +61,10 @@ class Ob1Pml:
         self._msgid = itertools.count(1)
         self._pending_sends: Dict[int, SendRequest] = {}  # msgid -> req
         self._active_recvs: Dict[int, RecvRequest] = {}  # msgid -> req
+        # system-message plane: tags <= SYSTEM_TAG_BASE bypass matching and
+        # dispatch to registered handlers (ULFM revoke notices, heartbeats —
+        # reference analog: the PMIx event plane + ob1's internal hdr types)
+        self.system_handlers: Dict[int, object] = {}
 
     # ------------------------------------------------------------- wiring
     def add_endpoint(self, rank: int, btl) -> None:
@@ -154,10 +158,20 @@ class Ob1Pml:
         return False
 
     # ------------------------------------------------- incoming dispatch
+    SYSTEM_TAG_BASE = -4000
+
+    def register_system_handler(self, tag: int, fn) -> None:
+        self.system_handlers[tag] = fn
+
     def handle_incoming(self, raw_hdr: bytes, payload: bytes) -> None:
         """Single entry point for every BTL's received frames (reference:
         the btl recv callbacks registered per hdr type in ob1)."""
         hdr = Header(raw_hdr)
+        if hdr.tag <= self.SYSTEM_TAG_BASE:
+            fn = self.system_handlers.get(hdr.tag)
+            if fn is not None:
+                fn(hdr, payload)
+            return
         if hdr.kind == EAGER:
             self._incoming_eager(hdr, payload)
         elif hdr.kind == RNDV_RTS:

@@ -1,0 +1,195 @@
+"""PMIx-lite modex: out-of-band key/value exchange + fences.
+
+Reference: the PMIx layer (opal/mca/pmix, OPAL_MODEX_SEND/RECV macros
+pmix-internal.h:266,577; PMIx_Fence_nb at ompi/runtime/ompi_mpi_init.c:489).
+The reference treats the PMIx server (inside prted) as external
+infrastructure; our launcher hosts the equivalent: a tiny TCP KV server
+speaking JSON lines. Ranks publish "business cards" (transport endpoints),
+fence, then read peers' cards to wire endpoints.
+
+Protocol (one JSON object per line, one TCP connection per rank):
+  {"op": "put",   "rank": r, "key": k, "val": v}   -> {"ok": true}
+  {"op": "get",   "rank": r, "key": k}             -> {"val": v} | {"missing": true}
+  {"op": "fence", "rank": r}                       -> {"ok": true}  (blocks
+       the reply until all `size` ranks have entered the fence)
+  {"op": "abort", "rank": r, "msg": m}             -> {"ok": true}  (flags
+       job abort; subsequent fences fail fast — reference: PMIx_Abort)
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ompi_tpu.utils.output import get_logger
+
+
+class ModexServer:
+    """Runs inside the launcher (reference analog: prted's PMIx server)."""
+
+    def __init__(self, size: int, host: str = "127.0.0.1"):
+        self.size = size
+        self.kv: Dict[Tuple[int, str], Any] = {}
+        self.kv_cond = threading.Condition()
+        self.fence_gen = 0
+        self.fence_count = 0
+        self.fence_cond = threading.Condition()
+        self.aborted: Optional[str] = None
+        self.log = get_logger("runtime.modex")
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, 0))
+        self.sock.listen(size + 8)
+        self.host, self.port = self.sock.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="modex-server")
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self) -> None:
+        self.sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        f = conn.makefile("rwb")
+        try:
+            for line in f:
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    break
+                resp = self._handle(msg)
+                f.write(json.dumps(resp).encode() + b"\n")
+                f.flush()
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        op = msg.get("op")
+        if op == "put":
+            with self.kv_cond:
+                self.kv[(int(msg["rank"]), msg["key"])] = msg["val"]
+                self.kv_cond.notify_all()
+            return {"ok": True}
+        if op == "get":
+            with self.kv_cond:
+                key = (int(msg["rank"]), msg["key"])
+                if key in self.kv:
+                    return {"val": self.kv[key]}
+            return {"missing": True}
+        if op == "fence":
+            with self.fence_cond:
+                gen = self.fence_gen
+                self.fence_count += 1
+                if self.fence_count >= self.size:
+                    self.fence_count = 0
+                    self.fence_gen += 1
+                    self.fence_cond.notify_all()
+                else:
+                    while (self.fence_gen == gen
+                           and self.aborted is None
+                           and not self._stop.is_set()):
+                        self.fence_cond.wait(0.5)
+            if self.aborted is not None:
+                return {"error": f"job aborted: {self.aborted}"}
+            return {"ok": True}
+        if op == "abort":
+            self.aborted = str(msg.get("msg", "unknown"))
+            with self.fence_cond:
+                self.fence_cond.notify_all()
+            return {"ok": True}
+        return {"error": f"bad op {op!r}"}
+
+    def close(self) -> None:
+        self._stop.set()
+        with self.fence_cond:
+            self.fence_cond.notify_all()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ModexClient:
+    """Per-rank connection (reference analog: PMIx_Init's server link)."""
+
+    def __init__(self, address: str, rank: int, size: int,
+                 timeout: float = 60.0):
+        host, port = address.rsplit(":", 1)
+        self.rank = rank
+        self.size = size
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self.sock = socket.create_connection((host, int(port)),
+                                                     timeout=timeout)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+        self.f = self.sock.makefile("rwb")
+
+    def _rpc(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            self.f.write(json.dumps(msg).encode() + b"\n")
+            self.f.flush()
+            line = self.f.readline()
+        if not line:
+            raise RuntimeError("modex server closed connection")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
+
+    def put(self, key: str, val: Any) -> None:
+        self._rpc({"op": "put", "rank": self.rank, "key": key, "val": val})
+
+    def get(self, rank: int, key: str, timeout: float = 30.0) -> Any:
+        deadline = time.monotonic() + timeout
+        while True:
+            resp = self._rpc({"op": "get", "rank": rank, "key": key})
+            if "val" in resp:
+                return resp["val"]
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"modex key ({rank}, {key}) never appeared")
+            time.sleep(0.01)
+
+    def fence(self) -> None:
+        """Block until every rank fences (reference: PMIx_Fence)."""
+        self._rpc({"op": "fence", "rank": self.rank})
+
+    def abort(self, msg: str) -> None:
+        try:
+            self._rpc({"op": "abort", "rank": self.rank, "msg": msg})
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,9 +1,9 @@
 """Process-mode wireup: modex connect, transport selection, endpoint setup.
 
 Reference: the RTE/PMIx glue (ompi/runtime/ompi_rte.c:538-581 PMIx_Init,
-OPAL_MODEX_SEND/RECV macros pmix-internal.h:266,577, add_procs
-instance.c:730). Implemented in ompi_tpu.runtime.modex (the PMIx-lite KV
-store) and here (business-card exchange + btl endpoint wiring).
+OPAL_MODEX_SEND/RECV macros pmix-internal.h:266,577) and the instance
+bring-up ordering of ompi/instance/instance.c:362-730 (framework opens →
+PML select → modex fence → add_procs).
 """
 
 from __future__ import annotations
@@ -18,14 +18,15 @@ def init_process_mode():
     """Bring up this rank: connect modex, publish our business card, fence,
     wire an endpoint per peer, build MPI_COMM_WORLD."""
     global _ctx
-    from ompi_tpu.comm.communicator import ProcComm
+    from ompi_tpu.btl.base import btl_framework
+    from ompi_tpu.comm.communicator import ProcComm, lookup_comm
     from ompi_tpu.core.group import Group
+    from ompi_tpu.ft import detector as ft_detector
+    from ompi_tpu.ft.revoke import REVOKE_TAG
+    from ompi_tpu.mca.var import get_var
     from ompi_tpu.pml.ob1 import Ob1Pml
-    from ompi_tpu.btl.self_btl import SelfBtl
-    from ompi_tpu.btl.tcp import TcpBtl
     from ompi_tpu.runtime.modex import ModexClient
     from ompi_tpu.runtime.progress import ProgressThread, register_progress
-    from ompi_tpu.mca.var import get_var
 
     rank = int(os.environ["OMPI_TPU_RANK"])
     size = int(os.environ["OMPI_TPU_SIZE"])
@@ -34,41 +35,66 @@ def init_process_mode():
     pml = Ob1Pml(my_rank=rank)
     modex = ModexClient(modex_addr, rank, size)
 
-    tcp = TcpBtl(pml.handle_incoming, rank)
-    # business card: how peers reach us (reference: the modex "endpoint
-    # blob" every btl publishes)
-    modex.put("btl.tcp.addr", f"{tcp.host}:{tcp.port}")
+    # btl selection (reference: mca_pml_base_select opening BTLs via bml/r2)
+    modules = btl_framework.select_all(deliver=pml.handle_incoming,
+                                      my_rank=rank)
+    by_name = {name: mod for _, name, mod in modules}
+    self_btl = by_name.get("self")
+    tcp = by_name.get("tcp")
+
+    # business card: how peers reach us (reference: the modex endpoint blob
+    # every btl publishes)
+    if tcp is not None:
+        modex.put("btl.tcp.addr", f"{tcp.host}:{tcp.port}")
     modex.fence()  # reference: PMIx_Fence_nb at instance.c:575-625
 
-    peers = {}
-    for r in range(size):
-        if r == rank:
-            continue
-        peers[r] = modex.get(r, "btl.tcp.addr")
-    tcp.set_peers(peers)
+    if tcp is not None:
+        peers = {r: modex.get(r, "btl.tcp.addr")
+                 for r in range(size) if r != rank}
+        tcp.set_peers(peers)
 
-    self_btl = SelfBtl(pml.handle_incoming)
-    pml.add_endpoint(rank, self_btl)
+    # add_procs: bind the best endpoint per peer (instance.c:730)
+    if self_btl is not None:
+        pml.add_endpoint(rank, self_btl)
     for r in range(size):
-        if r != rank:
+        if r != rank and tcp is not None:
             pml.add_endpoint(r, tcp)
 
-    register_progress(tcp.progress)
+    for _, _, mod in modules:
+        register_progress(mod.progress)
+
     pthread = None
     if get_var("runtime", "progress_thread"):
         pthread = ProgressThread()
         pthread.start()
 
+    # ULFM plane: revoke notices + heartbeat routing (reference: the PMIx
+    # error handlers + detector registered during init, instance.c:452-530)
+    def _on_revoke(hdr, payload):
+        comm = lookup_comm(hdr.cid)
+        if comm is not None:
+            comm.revoked = True
+
+    pml.register_system_handler(REVOKE_TAG, _on_revoke)
+
+    hb = None
+    if get_var("ft", "enable"):
+        hb = ft_detector.HeartbeatDetector(pml, rank, size)
+        pml.register_system_handler(
+            ft_detector.HEARTBEAT_TAG,
+            lambda hdr, payload: hb.note_heartbeat(hdr.src))
+        hb.start()
+
     world = ProcComm(Group(range(size)), cid=0, pml=pml,
                      name="MPI_COMM_WORLD")
     _ctx = {
         "modex": modex,
-        "tcp": tcp,
+        "btls": [mod for _, _, mod in modules],
         "progress_thread": pthread,
+        "detector": hb,
         "world": world,
     }
-    # second fence == the modex barrier before comm activation
-    # (ompi_mpi_init.c:451-505)
+    # the pre-activation barrier (ompi_mpi_init.c:451-505 modex barrier)
     modex.fence()
     return world
 
@@ -81,12 +107,15 @@ def shutdown() -> None:
         _ctx["modex"].fence()
     except Exception:
         pass
+    if _ctx.get("detector") is not None:
+        _ctx["detector"].stop()
     if _ctx.get("progress_thread") is not None:
         _ctx["progress_thread"].stop()
-    try:
-        _ctx["tcp"].finalize()
-    except Exception:
-        pass
+    for btl in _ctx.get("btls", []):
+        try:
+            btl.finalize()
+        except Exception:
+            pass
     try:
         _ctx["modex"].close()
     except Exception:

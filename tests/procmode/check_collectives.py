@@ -1,0 +1,108 @@
+"""Multi-rank collective correctness, run under mpirun (reference analog:
+the mpi4py CI suite exercising collectives over real ranks)."""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core import op as mpi_op
+
+
+def main() -> int:
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+
+    # allreduce SUM
+    mine = np.full(4, float(r + 1), np.float32)
+    out = np.zeros(4, np.float32)
+    COMM_WORLD.Allreduce(mine, out)
+    assert out[0] == n * (n + 1) / 2, out
+
+    # allreduce MAX
+    COMM_WORLD.Allreduce(mine, out, op=mpi_op.MAX)
+    assert out[0] == n, out
+
+    # bcast from nonzero root
+    data = np.full(3, float(r), np.float64)
+    COMM_WORLD.Bcast(data, root=n - 1)
+    assert data[0] == n - 1, data
+
+    # allgather
+    gathered = np.zeros(n * 2, np.int32)
+    COMM_WORLD.Allgather(np.array([r, r * 10], np.int32), gathered)
+    for i in range(n):
+        assert gathered[2 * i] == i and gathered[2 * i + 1] == 10 * i
+
+    # gather at root 1
+    g = np.zeros(n, np.int64) if r == 1 else np.zeros(0, np.int64)
+    COMM_WORLD.Gather(np.array([r * r], np.int64),
+                      [g, n if r == 1 else 0, ompi_tpu.INT64], root=1)
+    if r == 1:
+        assert list(g) == [i * i for i in range(n)], g
+
+    # scatter from root 0
+    if r == 0:
+        src = np.arange(n * 2, dtype=np.float32)
+    else:
+        src = np.zeros(0, np.float32)
+    part = np.zeros(2, np.float32)
+    COMM_WORLD.Scatter([src, n * 2 if r == 0 else 0, ompi_tpu.FLOAT32],
+                       part, root=0)
+    assert part[0] == 2 * r and part[1] == 2 * r + 1
+
+    # alltoall
+    send = np.array([r * 100 + i for i in range(n)], np.int32)
+    recv = np.zeros(n, np.int32)
+    COMM_WORLD.Alltoall(send, recv)
+    assert list(recv) == [i * 100 + r for i in range(n)], recv
+
+    # scan
+    sc = np.zeros(1, np.int64)
+    COMM_WORLD.Scan(np.array([r + 1], np.int64), sc)
+    assert sc[0] == (r + 1) * (r + 2) // 2, sc
+
+    # exscan
+    ex = np.zeros(1, np.int64)
+    COMM_WORLD.Exscan(np.array([r + 1], np.int64), ex)
+    if r > 0:
+        assert ex[0] == r * (r + 1) // 2, ex
+
+    # reduce_scatter_block
+    rsb_send = np.arange(n * 2, dtype=np.float32) + r
+    rsb_recv = np.zeros(2, np.float32)
+    COMM_WORLD.Reduce_scatter_block(rsb_send, rsb_recv)
+    expect0 = sum(2 * r + i for i in range(n))
+    assert rsb_recv[0] == expect0, (rsb_recv, expect0)
+
+    # split: evens/odds
+    sub = COMM_WORLD.Split(color=r % 2, key=r)
+    subout = np.zeros(1, np.float64)
+    sub.Allreduce(np.array([float(r)], np.float64), subout)
+    expect = sum(i for i in range(n) if i % 2 == r % 2)
+    assert subout[0] == expect, (subout, expect)
+
+    # dup + barrier
+    d = COMM_WORLD.Dup()
+    d.Barrier()
+
+    # rendezvous-size message (beyond the 1MB tcp eager limit)
+    big = np.arange(2_000_00, dtype=np.float64)  # 1.6 MB
+    if r == 0:
+        COMM_WORLD.Send(big, dest=(1 % n), tag=77)
+    elif r == 1:
+        got = np.zeros_like(big)
+        st = ompi_tpu.Status()
+        COMM_WORLD.Recv(got, source=0, tag=77, status=st)
+        assert st.Get_count(ompi_tpu.FLOAT64) == big.size
+        np.testing.assert_array_equal(got, big)
+
+    COMM_WORLD.Barrier()
+    ompi_tpu.Finalize()
+    print(f"rank {r}: COLLECTIVES-OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

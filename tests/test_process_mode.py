@@ -1,0 +1,53 @@
+"""Process-mode integration: real multi-rank jobs via the mpirun launcher.
+
+Reference analog: single-host multi-rank over sm/tcp/self BTLs — the
+default MTT/mpi4py CI shape (SURVEY.md §4 "Multi-node without a cluster").
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_mpi(np_, script, *args, timeout=120, mca=()):
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", str(np_)]
+    for k, v in mca:
+        cmd += ["--mca", k, str(v)]
+    cmd += [script, *args]
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)  # never inherit rank identity
+    return subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                          timeout=timeout, env=env)
+
+
+def test_ring_4_ranks():
+    """BASELINE.json ladder config #1 (reference: examples/ring_c.c)."""
+    r = run_mpi(4, "examples/ring.py")
+    assert r.returncode == 0, r.stderr
+    assert "Process 0 decremented value: 0" in r.stdout
+    assert r.stdout.count("exiting") == 4
+
+
+def test_collectives_4_ranks():
+    r = run_mpi(4, "tests/procmode/check_collectives.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("COLLECTIVES-OK") == 4
+
+
+def test_collectives_3_ranks_nonpow2():
+    r = run_mpi(3, "tests/procmode/check_collectives.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("COLLECTIVES-OK") == 3
+
+
+def test_collectives_2_ranks_no_progress_thread():
+    """Polling-only progress (reference: default opal_progress without the
+    async thread)."""
+    r = run_mpi(2, "tests/procmode/check_collectives.py",
+                mca=(("runtime_progress_thread", "0"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("COLLECTIVES-OK") == 2
