@@ -39,11 +39,14 @@ _LEN = struct.Struct("<I")
 
 
 class _Conn:
-    __slots__ = ("sock", "rbuf", "wlock")
+    __slots__ = ("sock", "rbuf", "wbuf", "wlock")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.rbuf = bytearray()
+        # pending outbound bytes (reference: btl/tcp's per-endpoint pending
+        # frag list flushed on write-ready events)
+        self.wbuf = bytearray()
         self.wlock = threading.Lock()
 
 
@@ -69,6 +72,10 @@ class TcpBtl(Btl):
         self.sel.register(self.listener, selectors.EVENT_READ,
                           ("accept", None))
         self._sel_lock = threading.Lock()
+        # single-drainer: exactly one thread runs the event loop at a time
+        # (the app thread's wait-loop and the progress thread both call
+        # progress(); concurrent drains would interleave frame parsing)
+        self._progress_lock = threading.Lock()
         self._closed = False
 
     # ------------------------------------------------------------- wiring
@@ -106,16 +113,41 @@ class TcpBtl(Btl):
 
     # --------------------------------------------------------------- send
     def send(self, peer: int, header: bytes, payload) -> None:
+        """Enqueue a frame; bytes move via non-blocking flushes (here
+        opportunistically, otherwise from progress()). Never blocks the
+        caller on a full socket — the head-to-head large-send deadlock the
+        reference's pending-frag design exists to avoid."""
         conn = self._get_conn(peer)
         if not isinstance(payload, (bytes, bytearray)):
             payload = bytes(memoryview(payload))
         frame = _LEN.pack(HDR_SIZE + len(payload)) + header + payload
         with conn.wlock:
-            conn.sock.setblocking(True)
+            conn.wbuf += frame
+            self._flush_locked(conn)
+
+    def _flush_locked(self, conn: _Conn) -> None:
+        """Push queued bytes; caller holds conn.wlock."""
+        while conn.wbuf:
             try:
-                conn.sock.sendall(frame)
-            finally:
-                conn.sock.setblocking(False)
+                sent = conn.sock.send(conn.wbuf)
+            except socket.error as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    self._want_write(conn, True)
+                    return
+                return  # drain path will notice the dead socket
+            if sent <= 0:
+                self._want_write(conn, True)
+                return
+            del conn.wbuf[:sent]
+        self._want_write(conn, False)
+
+    def _want_write(self, conn: _Conn, on: bool) -> None:
+        ev = selectors.EVENT_READ | (selectors.EVENT_WRITE if on else 0)
+        with self._sel_lock:
+            try:
+                self.sel.modify(conn.sock, ev, ("peer", conn))
+            except (KeyError, ValueError):
+                pass
 
     # ----------------------------------------------------------- progress
     def progress(self) -> int:
@@ -123,19 +155,28 @@ class TcpBtl(Btl):
         (reference: btl progress fns registered at opal_progress.c:416)."""
         if self._closed:
             return 0
-        try:
-            with self._sel_lock:
-                events = self.sel.select(timeout=0)
-        except OSError:
+        if not self._progress_lock.acquire(blocking=False):
             return 0
-        n = 0
-        for key, _ in events:
-            kind, conn = key.data
-            if kind == "accept":
-                n += self._accept()
-            else:
-                n += self._drain(conn)
-        return n
+        try:
+            try:
+                with self._sel_lock:
+                    events = self.sel.select(timeout=0)
+            except OSError:
+                return 0
+            n = 0
+            for key, mask in events:
+                kind, conn = key.data
+                if kind == "accept":
+                    n += self._accept()
+                    continue
+                if mask & selectors.EVENT_WRITE:
+                    with conn.wlock:
+                        self._flush_locked(conn)
+                if mask & selectors.EVENT_READ:
+                    n += self._drain(conn)
+            return n
+        finally:
+            self._progress_lock.release()
 
     def _accept(self) -> int:
         try:

@@ -32,6 +32,10 @@ def main(argv: List[str] | None = None) -> int:
                         help="set an MCA variable (framework_name value)")
     parser.add_argument("--timeout", type=float, default=600.0,
                         help="job wall-clock limit in seconds")
+    parser.add_argument("--with-tpu", action="store_true",
+                        help="let ranks claim TPU devices (default: ranks "
+                             "are host-only; the device path belongs to "
+                             "mesh mode / the single controller)")
     parser.add_argument("program", help="python script to run")
     parser.add_argument("args", nargs=argparse.REMAINDER)
     opts = parser.parse_args(argv)
@@ -50,6 +54,12 @@ def main(argv: List[str] | None = None) -> int:
     if prior:
         extra.append(prior)
     env_base["PYTHONPATH"] = os.pathsep.join(extra)
+    if not opts.with_tpu:
+        # A TPU chip is an exclusive grant; N rank interpreters racing to
+        # claim it deadlock at startup. Process-mode ranks are host-only
+        # unless explicitly opted in (the device path is mesh mode's).
+        env_base.pop("PALLAS_AXON_POOL_IPS", None)
+        env_base["JAX_PLATFORMS"] = "cpu"
     for var, value in opts.mca:
         env_base[f"OMPI_TPU_MCA_{var}"] = value
 
