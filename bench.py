@@ -1,0 +1,96 @@
+"""Benchmark: MPI_Allreduce through coll/xla vs raw jax.lax.psum.
+
+The BASELINE.json north star: OSU-style allreduce bus bandwidth through the
+MPI surface at >=80% of raw ``jax.lax.psum`` on the same devices — i.e. the
+framework's dispatch/compile-cache layer must not tax the collective. On a
+multi-chip mesh this measures true ICI bus bandwidth; on one chip it
+measures the same end-to-end path with the wire term degenerate (XLA
+compiles the 1-way psum to a device-local pass), which still bounds the
+framework overhead the target is about.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+value      = fraction of raw-psum throughput achieved via MPI_Allreduce
+vs_baseline= value / 0.80   (>= 1.0 means the north-star bar is met)
+"""
+
+import json
+import sys
+import time
+
+
+def _paired_times(fn_a, fn_b, args, warmup: int = 5, iters: int = 30):
+    """Interleave timings of two implementations so clock/tunnel drift
+    cancels; returns (median_a, median_b) over per-round samples."""
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn_a(*args))
+        jax.block_until_ready(fn_b(*args))
+    ta, tb = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn_a(*args))
+        t1 = time.perf_counter()
+        jax.block_until_ready(fn_b(*args))
+        t2 = time.perf_counter()
+        ta.append(t1 - t0)
+        tb.append(t2 - t1)
+    ta.sort()
+    tb.sort()
+    return ta[len(ta) // 2], tb[len(tb) // 2]
+
+
+def main() -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ompi_tpu.parallel import mesh_world
+
+    devices = jax.devices()
+    n = len(devices)
+    world = mesh_world(devices)
+
+    # 64 MB float32 per rank (the >=64MB BASELINE message size)
+    per_rank = 16 * 1024 * 1024
+    x = jnp.ones((n, per_rank), jnp.float32)
+    x = world.shard(x)
+
+    # raw path: hand-written shard_map psum, same mesh
+    mesh = world.mesh
+
+    def raw_body(b):
+        return jax.lax.psum(b, world.axis)
+
+    from ompi_tpu.parallel.axes import shard_map_compat
+
+    raw = jax.jit(shard_map_compat(raw_body, mesh, (P(world.axis),),
+                                   P(world.axis)))
+    # ours: MPI_Allreduce via coll/xla — interleaved with raw so tunnel/
+    # clock drift cancels
+    t_ours, t_raw = _paired_times(world.allreduce, raw, (x,))
+
+    nbytes = per_rank * 4
+    # allreduce bus-bandwidth convention (OSU): 2*(n-1)/n * size / time
+    bus_factor = 2.0 * (n - 1) / n if n > 1 else 1.0
+    bw_ours = bus_factor * nbytes / t_ours / 1e9
+    bw_raw = bus_factor * nbytes / t_raw / 1e9
+
+    value = bw_ours / bw_raw if bw_raw > 0 else 0.0
+    result = {
+        "metric": "allreduce_busbw_fraction_of_raw_psum "
+                  f"(64MB f32, {n} dev, ours {bw_ours:.1f} vs raw "
+                  f"{bw_raw:.1f} GB/s)",
+        "value": round(value, 4),
+        "unit": "fraction",
+        "vs_baseline": round(value / 0.80, 4),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,15 +45,7 @@ from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_UNSUPPORTED_OPERATION
 from ompi_tpu.mca.component import Component
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
-    import jax
-
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs)
-    from jax.experimental.shard_map import shard_map as sm
-
-    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+from ompi_tpu.parallel.axes import shard_map_compat as _shard_map
 
 
 def _is_bool(dtype) -> bool:
@@ -80,6 +72,16 @@ def _xor_perm(groups, bit: int) -> Tuple[Tuple[int, int], ...]:
             continue
         out.extend((g[i], g[i ^ bit]) for i in range(len(g)))
     return tuple(out)
+
+
+def cache_key(verb: str, op: Optional[_op.Op] = None, extra: Tuple = ()):
+    """Public compile-cache key layout (shared with XlaComm's fast path —
+    the per-call dispatch must be one dict hit, reference analog: the
+    pre-resolved per-comm fn table pointers of comm->c_coll)."""
+    key = (verb,)
+    if op is not None:
+        key += (op.uid,)
+    return key + tuple(extra)
 
 
 class XlaColl(CollModule):
@@ -153,7 +155,7 @@ class XlaColl(CollModule):
         import jax.numpy as jnp
         from jax import lax
 
-        key = ("allreduce", op.uid)
+        key = cache_key("allreduce", op)
 
         def build():
             axis = comm.axis
@@ -199,7 +201,7 @@ class XlaColl(CollModule):
         import jax.numpy as jnp
         from jax import lax
 
-        key = ("bcast",)
+        key = cache_key("bcast")
 
         def build():
             axis = comm.axis
@@ -229,7 +231,7 @@ class XlaColl(CollModule):
         import jax.numpy as jnp
         from jax import lax
 
-        key = ("allgather",)
+        key = cache_key("allgather")
 
         def build():
             axis = comm.axis
@@ -275,7 +277,7 @@ class XlaColl(CollModule):
                 f"alltoall expects [world, group_size={G}, ...], got "
                 f"{tuple(x.shape)}",
             )
-        key = ("alltoall",)
+        key = cache_key("alltoall")
 
         def build():
             axis = comm.axis
@@ -325,7 +327,7 @@ class XlaColl(CollModule):
                 f"reduce_scatter expects [world, group_size={G}, ...], got "
                 f"{tuple(x.shape)}",
             )
-        key = ("reduce_scatter_block", op.uid)
+        key = cache_key("reduce_scatter_block", op)
 
         def build():
             axis = comm.axis
@@ -369,7 +371,7 @@ class XlaColl(CollModule):
         import jax.numpy as jnp
         from jax import lax
 
-        key = ("scan", op.uid, exclusive)
+        key = cache_key("scan", op, (exclusive,))
 
         def build():
             axis = comm.axis
@@ -408,7 +410,7 @@ class XlaColl(CollModule):
         import jax.numpy as jnp
         from jax import lax
 
-        key = ("barrier",)
+        key = cache_key("barrier")
 
         def build():
             def body(b):
@@ -437,7 +439,7 @@ class XlaColl(CollModule):
         round-trips)."""
         from jax import lax
 
-        key = ("permute", tuple(perm))
+        key = cache_key("permute", extra=(tuple(perm),))
 
         def build():
             axis = comm.axis

@@ -127,6 +127,14 @@ class XlaComm(Intracomm):
         return self.coll.get(name)
 
     def allreduce(self, x, op: _op.Op = _op.SUM):
+        # hot path: one dict hit to the compiled executable (the per-comm
+        # fn-table pointer chase of the reference, minus everything else)
+        self._check_usable()
+        from ompi_tpu.coll.xla import cache_key
+
+        fn = self._jit_cache.get(cache_key("allreduce", op))
+        if fn is not None:
+            return fn(x)
         return self._slot("allreduce")(self, x, op)
 
     def reduce(self, x, op: _op.Op = _op.SUM, root: int = 0):
