@@ -1,0 +1,402 @@
+"""One-sided communication (RMA windows).
+
+Reference: ompi/mca/osc (25,779 LoC; fn-table contract osc.h:172-360 —
+put/get/accumulate/CAS/fetch-op + fence/PSCW/lock/flush). Per SURVEY.md §7
+the host path starts as osc/rdma-over-PML emulation: RMA verbs become
+active messages handled inside the target's progress engine (the progress
+thread gives true passive-target semantics — the target application never
+has to call MPI), applied to the window buffer under a per-window lock.
+
+Protocol (system-tag plane, OSC_TAG): payload = json-less packed header
+(win_id, verb, origin, disp, count, dtype_id, op_id, req_id) + data bytes.
+Every origin-side verb gets an ACK (with data for GET/FOP/CAS), so
+``Flush``/``Fence`` are exact: wait for all outstanding acks (reference
+analog: osc/rdma's outstanding-ops counters).
+
+Mesh mode: the single controller owns every rank's memory, so RMA is
+driver-level array update — see MeshWin below (XLA emits any transfers).
+"""
+
+from __future__ import annotations
+
+import itertools
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ompi_tpu.core import op as _op
+from ompi_tpu.core.datatype import Datatype, from_numpy_dtype
+from ompi_tpu.core.errors import MPIError, ERR_WIN, ERR_RANK, ERR_OP
+from ompi_tpu.utils.output import get_logger
+
+OSC_TAG = -4300
+
+# verbs
+_PUT, _GET, _ACC, _FOP, _CAS, _ACK, _LOCK, _UNLOCK, _LOCK_GRANT = range(9)
+
+LOCK_EXCLUSIVE = 1
+LOCK_SHARED = 2
+
+_HDR = struct.Struct("<iiiqqiii")
+# win_id, verb, origin, disp_bytes, count, dtype_code, op_code, req_id
+
+_OPS_BY_CODE = {}
+_CODE_BY_OP = {}
+for _i, _o in enumerate((_op.SUM, _op.PROD, _op.MAX, _op.MIN, _op.BAND,
+                         _op.BOR, _op.BXOR, _op.LAND, _op.LOR, _op.LXOR,
+                         _op.REPLACE, _op.NO_OP)):
+    _OPS_BY_CODE[_i] = _o
+    _CODE_BY_OP[_o.uid] = _i
+
+_DTYPES = {}
+
+
+def _dtype_code(dt: Datatype) -> int:
+    if dt.np_dtype is None:
+        raise MPIError(ERR_WIN, "RMA requires predefined datatypes (v1)")
+    code = np.dtype(dt.np_dtype).num
+    _DTYPES[code] = np.dtype(dt.np_dtype)
+    return code
+
+
+def _np_from_code(code: int) -> np.dtype:
+    dt = _DTYPES.get(code)
+    if dt is None:
+        from ompi_tpu.core.datatype import _BY_NP
+
+        for cand in _BY_NP:
+            if cand.num == code:
+                dt = cand
+                break
+        if dt is None:
+            raise MPIError(ERR_WIN, f"unknown RMA dtype code {code}")
+        _DTYPES[code] = dt
+    return dt
+
+
+_windows: Dict[int, "Win"] = {}
+_win_id_lock = threading.Lock()
+_next_win_id = [1]
+_req_ids = itertools.count(1)
+_handler_installed = False
+
+
+def _install_handler(pml) -> None:
+    global _handler_installed
+    if not _handler_installed:
+        pml.register_system_handler(OSC_TAG, _on_message)
+        _handler_installed = True
+
+
+class _Pending:
+    __slots__ = ("event", "data")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.data: Optional[bytes] = None
+
+
+_pending: Dict[int, _Pending] = {}
+
+
+def _on_message(hdr, payload: bytes) -> None:
+    """Runs inside the progress engine on the *target* (or origin for
+    ACKs) — the reference's osc callbacks registered on the btl."""
+    win_id, verb, origin, disp, count, dcode, opcode, req_id = \
+        _HDR.unpack(payload[: _HDR.size])
+    body = payload[_HDR.size:]
+    if verb == _ACK:
+        p = _pending.pop(req_id, None)
+        if p is not None:
+            p.data = body
+            p.event.set()
+        return
+    win = _windows.get(win_id)
+    if win is None:
+        return
+    win._handle(verb, origin, disp, count, dcode, opcode, req_id, body)
+
+
+class Win:
+    """MPI_Win over a ProcComm (reference: ompi/win + osc/rdma)."""
+
+    def __init__(self, buffer: Optional[np.ndarray], comm, win_id=None):
+        self.comm = comm
+        self.buf = buffer if buffer is not None else np.zeros(0, np.uint8)
+        self._bytes = self.buf.reshape(-1).view(np.uint8) if self.buf.size \
+            else np.zeros(0, np.uint8)
+        self.lock = threading.RLock()
+        self._outstanding: Dict[int, _Pending] = {}
+        self._lock_state = 0  # >0 shared count, -1 exclusive
+        self._lock_waiters = []
+        self._lock_cond = threading.Condition()
+        self.attributes: Dict[int, Any] = {}
+        # agree on the window id collectively (like a CID)
+        if win_id is None:
+            with _win_id_lock:
+                proposal = np.array([_next_win_id[0]], np.int64)
+            agreed = np.zeros(1, np.int64)
+            comm.Allreduce(proposal, agreed, op=_op.MAX)
+            win_id = int(agreed[0])
+            with _win_id_lock:
+                _next_win_id[0] = win_id + 1
+        self.win_id = win_id
+        _windows[win_id] = self
+        _install_handler(comm.pml)
+        comm.Barrier()
+
+    # ------------------------------------------------------------- plumbing
+    @staticmethod
+    def Create(buffer, comm) -> "Win":
+        return Win(buffer, comm)
+
+    @staticmethod
+    def Allocate(nbytes: int, comm) -> "Win":
+        return Win(np.zeros(nbytes, np.uint8), comm)
+
+    def Free(self) -> None:
+        self.comm.Barrier()
+        _windows.pop(self.win_id, None)
+
+    def _send(self, target: int, verb: int, disp: int, count: int,
+              dcode: int, opcode: int, req_id: int, body: bytes) -> None:
+        payload = _HDR.pack(self.win_id, verb, self.comm.rank, disp, count,
+                            dcode, opcode, req_id) + body
+        arr = np.frombuffer(payload, dtype=np.uint8)
+        from ompi_tpu.core.datatype import BYTE
+
+        self.comm.pml.isend(arr, arr.nbytes, BYTE,
+                            self.comm._world_rank(target), OSC_TAG,
+                            self.comm.cid)
+
+    def _start_op(self) -> tuple:
+        rid = next(_req_ids)
+        p = _Pending()
+        _pending[rid] = p
+        self._outstanding[rid] = p
+        return rid, p
+
+    def _wait(self, p: "_Pending", rid: int) -> bytes:
+        from ompi_tpu.runtime.progress import progress
+
+        while not p.event.is_set():
+            progress()
+        self._outstanding.pop(rid, None)
+        return p.data or b""
+
+    # --------------------------------------------------------------- verbs
+    def Put(self, origin_arr: np.ndarray, target: int,
+            target_disp: int = 0) -> None:
+        dt = from_numpy_dtype(origin_arr.dtype)
+        rid, p = self._start_op()
+        self._send(target, _PUT, target_disp * dt.size, origin_arr.size,
+                   _dtype_code(dt), 0, rid, origin_arr.tobytes())
+        self._wait(p, rid)
+
+    def Get(self, origin_arr: np.ndarray, target: int,
+            target_disp: int = 0) -> None:
+        dt = from_numpy_dtype(origin_arr.dtype)
+        rid, p = self._start_op()
+        self._send(target, _GET, target_disp * dt.size, origin_arr.size,
+                   _dtype_code(dt), 0, rid, b"")
+        data = self._wait(p, rid)
+        origin_arr.reshape(-1)[:] = np.frombuffer(
+            data, dtype=origin_arr.dtype)
+
+    def Accumulate(self, origin_arr: np.ndarray, target: int,
+                   target_disp: int = 0, op: _op.Op = _op.SUM) -> None:
+        dt = from_numpy_dtype(origin_arr.dtype)
+        code = _CODE_BY_OP.get(op.uid)
+        if code is None:
+            raise MPIError(ERR_OP, f"{op.name} not supported for RMA")
+        rid, p = self._start_op()
+        self._send(target, _ACC, target_disp * dt.size, origin_arr.size,
+                   _dtype_code(dt), code, rid, origin_arr.tobytes())
+        self._wait(p, rid)
+
+    def Fetch_and_op(self, value: np.ndarray, result: np.ndarray,
+                     target: int, target_disp: int = 0,
+                     op: _op.Op = _op.SUM) -> None:
+        dt = from_numpy_dtype(value.dtype)
+        code = _CODE_BY_OP.get(op.uid)
+        if code is None:
+            raise MPIError(ERR_OP, f"{op.name} not supported for RMA")
+        rid, p = self._start_op()
+        self._send(target, _FOP, target_disp * dt.size, 1,
+                   _dtype_code(dt), code, rid, value.tobytes())
+        data = self._wait(p, rid)
+        result.reshape(-1)[:1] = np.frombuffer(data, dtype=result.dtype)[:1]
+
+    def Compare_and_swap(self, compare: np.ndarray, origin: np.ndarray,
+                         result: np.ndarray, target: int,
+                         target_disp: int = 0) -> None:
+        dt = from_numpy_dtype(origin.dtype)
+        rid, p = self._start_op()
+        body = compare.tobytes() + origin.tobytes()
+        self._send(target, _CAS, target_disp * dt.size, 1,
+                   _dtype_code(dt), 0, rid, body)
+        data = self._wait(p, rid)
+        result.reshape(-1)[:1] = np.frombuffer(data, dtype=result.dtype)[:1]
+
+    # ------------------------------------------------------- target handler
+    def _handle(self, verb, origin, disp, count, dcode, opcode, req_id,
+                body: bytes) -> None:
+        npdt = _np_from_code(dcode) if dcode else np.dtype(np.uint8)
+        reply = b""
+        with self.lock:
+            view = self._bytes
+            if verb == _PUT:
+                view[disp: disp + len(body)] = np.frombuffer(body, np.uint8)
+            elif verb == _GET:
+                nbytes = count * npdt.itemsize
+                reply = view[disp: disp + nbytes].tobytes()
+            elif verb == _ACC:
+                op = _OPS_BY_CODE[opcode]
+                incoming = np.frombuffer(body, dtype=npdt)
+                nbytes = incoming.nbytes
+                cur = view[disp: disp + nbytes].view(npdt)
+                cur[:] = op.np_reduce(cur, incoming).astype(npdt)
+            elif verb == _FOP:
+                op = _OPS_BY_CODE[opcode]
+                incoming = np.frombuffer(body, dtype=npdt)
+                cur = view[disp: disp + npdt.itemsize].view(npdt)
+                reply = cur.tobytes()
+                cur[:] = op.np_reduce(cur, incoming).astype(npdt)
+            elif verb == _CAS:
+                half = len(body) // 2
+                compare = np.frombuffer(body[:half], dtype=npdt)
+                newval = np.frombuffer(body[half:], dtype=npdt)
+                cur = view[disp: disp + npdt.itemsize].view(npdt)
+                reply = cur.tobytes()
+                if cur[0] == compare[0]:
+                    cur[:] = newval
+        if verb == _LOCK:
+            self._grant_or_queue(origin, opcode, req_id)
+            return
+        if verb == _UNLOCK:
+            self._do_unlock()
+            ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0, 0,
+                            req_id)
+            self._reply(origin, ack)
+            return
+        ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0, 0,
+                        req_id) + reply
+        self._reply(origin, ack)
+
+    def _reply(self, origin: int, payload: bytes) -> None:
+        from ompi_tpu.core.datatype import BYTE
+
+        arr = np.frombuffer(payload, dtype=np.uint8)
+        self.comm.pml.isend(arr, arr.nbytes, BYTE,
+                            self.comm._world_rank(origin), OSC_TAG,
+                            self.comm.cid)
+
+    # ------------------------------------------------------- sync: fence
+    def Flush(self, rank: Optional[int] = None) -> None:
+        """Wait for remote completion of all outstanding ops (acks)."""
+        from ompi_tpu.runtime.progress import progress
+
+        while self._outstanding:
+            progress()
+
+    def Fence(self) -> None:
+        """Active-target epoch boundary: local flush + barrier (reference:
+        osc_rdma active_target fence)."""
+        self.Flush()
+        self.comm.Barrier()
+
+    # ----------------------------------------------- sync: passive target
+    def Lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
+        rid, p = self._start_op()
+        self._send(target, _LOCK, 0, 0, 0, lock_type, rid, b"")
+        self._wait(p, rid)
+
+    def Unlock(self, target: int) -> None:
+        self.Flush()
+        rid, p = self._start_op()
+        self._send(target, _UNLOCK, 0, 0, 0, 0, rid, b"")
+        self._wait(p, rid)
+
+    def _grant_or_queue(self, origin: int, lock_type: int,
+                        req_id: int) -> None:
+        with self._lock_cond:
+            can = (self._lock_state == 0 or
+                   (lock_type == LOCK_SHARED and self._lock_state > 0))
+            if can:
+                self._lock_state = (self._lock_state + 1
+                                    if lock_type == LOCK_SHARED else -1)
+                ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0,
+                                0, req_id)
+                self._reply(origin, ack)
+            else:
+                self._lock_waiters.append((origin, lock_type, req_id))
+
+    def _do_unlock(self) -> None:
+        with self._lock_cond:
+            if self._lock_state == -1:
+                self._lock_state = 0
+            elif self._lock_state > 0:
+                self._lock_state -= 1
+            while self._lock_waiters and self._lock_state >= 0:
+                origin, lt, rid = self._lock_waiters[0]
+                if lt == LOCK_EXCLUSIVE and self._lock_state != 0:
+                    break
+                self._lock_waiters.pop(0)
+                self._lock_state = (self._lock_state + 1
+                                    if lt == LOCK_SHARED else -1)
+                ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0,
+                                0, rid)
+                self._reply(origin, ack)
+                if lt == LOCK_EXCLUSIVE:
+                    break
+
+    # PSCW (reference: osc active target Start/Complete/Post/Wait)
+    def Post(self, group) -> None:
+        pass  # exposure epoch is implicit: handlers are always live
+
+    def Start(self, group) -> None:
+        self._access_group = group
+
+    def Complete(self) -> None:
+        self.Flush()
+        for r in getattr(self, "_access_group", self.comm.group).ranks:
+            pass  # acks already guarantee remote completion
+
+    def Wait(self) -> None:
+        pass
+
+
+class MeshWin:
+    """Mesh-mode window: driver-level RMA on a [world, n] jax array.
+
+    The single controller owns all rank memory, so Put/Get/Accumulate are
+    array updates (XLA inserts any cross-device movement) — one-sided
+    semantics come for free, which is the TPU-native answer to SURVEY.md
+    §7's 'osc over ICI is research-y' (hard part list).
+    """
+
+    def __init__(self, comm, shape_per_rank, dtype=None):
+        import jax.numpy as jnp
+
+        self.comm = comm
+        dtype = dtype or jnp.float32
+        self.array = comm.shard(
+            jnp.zeros((comm.world_size,) + tuple(shape_per_rank), dtype))
+
+    def Put(self, data, target: int) -> None:
+        self.array = self.array.at[target].set(data)
+
+    def Get(self, target: int):
+        return self.array[target]
+
+    def Accumulate(self, data, target: int, op: _op.Op = _op.SUM) -> None:
+        if op is _op.SUM:
+            self.array = self.array.at[target].add(data)
+        else:
+            self.array = self.array.at[target].set(
+                op.jax_reduce(self.array[target], data))
+
+    def Fence(self) -> None:
+        self.comm.barrier()

@@ -78,10 +78,14 @@ class RecvRequest(Request):
         self.matched = False
 
     def matches(self, hdr: Header) -> bool:
+        # ANY_TAG only matches user tags (>= 0): internal traffic
+        # (collective plane, partitioned bands) uses negative tags and
+        # must never satisfy a wildcard user receive
         return (
             hdr.cid == self.cid
             and (self.src == ANY_SOURCE or self.src == hdr.src)
-            and (self.tag == ANY_TAG or self.tag == hdr.tag)
+            and (hdr.tag >= 0 if self.tag == ANY_TAG
+                 else self.tag == hdr.tag)
         )
 
 

@@ -85,6 +85,11 @@ class Ob1Pml:
         req = SendRequest(dst, tag, cid, conv.packed_size)
         req.convertor = conv
         eager_limit = btl.eager_limit
+        # system-plane messages (osc active messages, ft notices) bypass
+        # matching, so they can never run the RTS/CTS handshake — always
+        # ship them in one frame (transports queue arbitrary frame sizes)
+        if tag <= self.SYSTEM_TAG_BASE:
+            eager_limit = None
         if eager_limit is None or conv.packed_size <= eager_limit:
             hdr = pack_header(EAGER, self.my_rank, cid, tag, next(self._seq),
                               conv.packed_size, 0, 0)

@@ -1,0 +1,205 @@
+"""One-sided (osc), MPI-IO, Sessions, partitioned pt2pt — singleton mode.
+
+Reference analogs: osc/rdma semantics tests, ompio view tests
+(test/datatype's subarray cases applied to file views), sessions examples
+(hello_sessions_c.c), part/persist."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.core.datatype import FLOAT32, INT64, BYTE
+
+
+# ------------------------------------------------------------------- osc
+def test_win_put_get_self():
+    from ompi_tpu.osc.window import Win
+
+    base = np.zeros(8, np.float32)
+    win = Win.Create(base, COMM_WORLD)
+    win.Put(np.array([1.5, 2.5], np.float32), target=0, target_disp=2)
+    win.Fence()
+    np.testing.assert_array_equal(base[2:4], [1.5, 2.5])
+    got = np.zeros(2, np.float32)
+    win.Get(got, target=0, target_disp=2)
+    np.testing.assert_array_equal(got, [1.5, 2.5])
+    win.Free()
+
+
+def test_win_accumulate_fop_cas():
+    from ompi_tpu.osc.window import Win
+
+    base = np.zeros(4, np.int64)
+    win = Win.Create(base, COMM_WORLD)
+    win.Accumulate(np.array([5, 7], np.int64), target=0, target_disp=0)
+    win.Accumulate(np.array([5, 7], np.int64), target=0, target_disp=0)
+    win.Fence()
+    np.testing.assert_array_equal(base[:2], [10, 14])
+
+    old = np.zeros(1, np.int64)
+    win.Fetch_and_op(np.array([3], np.int64), old, target=0, target_disp=0)
+    assert old[0] == 10 and base[0] == 13
+
+    res = np.zeros(1, np.int64)
+    win.Compare_and_swap(np.array([13], np.int64), np.array([99], np.int64),
+                         res, target=0, target_disp=0)
+    assert res[0] == 13 and base[0] == 99
+    # failed CAS leaves value
+    win.Compare_and_swap(np.array([1], np.int64), np.array([0], np.int64),
+                         res, target=0, target_disp=0)
+    assert res[0] == 99 and base[0] == 99
+    win.Free()
+
+
+def test_win_lock_unlock_self():
+    from ompi_tpu.osc.window import Win, LOCK_EXCLUSIVE
+
+    base = np.zeros(2, np.float32)
+    win = Win.Create(base, COMM_WORLD)
+    win.Lock(0, LOCK_EXCLUSIVE)
+    win.Put(np.array([4.0], np.float32), target=0)
+    win.Unlock(0)
+    assert base[0] == 4.0
+    win.Free()
+
+
+# -------------------------------------------------------------------- io
+def test_file_write_read_roundtrip(tmp_path):
+    from ompi_tpu.io import File, MODE_CREATE, MODE_RDWR
+
+    path = str(tmp_path / "t1.bin")
+    f = File.Open(COMM_WORLD, path, MODE_RDWR | MODE_CREATE)
+    data = np.arange(16, dtype=np.float32)
+    assert f.Write_at(0, data) == 64
+    back = np.zeros(16, np.float32)
+    assert f.Read_at(0, back) == 64
+    np.testing.assert_array_equal(back, data)
+    assert f.Get_size() == 64
+    f.Close()
+
+
+def test_file_view_vector(tmp_path):
+    """Strided file view: every rank-th block (the canonical scatter-to-
+    file pattern ompio decodes from vector filetypes)."""
+    from ompi_tpu.io import File, MODE_CREATE, MODE_RDWR
+
+    path = str(tmp_path / "t2.bin")
+    f = File.Open(COMM_WORLD, path, MODE_RDWR | MODE_CREATE)
+    # preset file with zeros
+    f.Write_at(0, np.zeros(12, np.float32))
+    # view: blocks of 2 floats every 4 floats
+    ft = FLOAT32.Create_vector(3, 2, 4).Commit()
+    f.Set_view(disp=0, etype=FLOAT32, filetype=ft)
+    f.Write_at(0, np.array([1, 2, 3, 4, 5, 6], np.float32))
+    f.Set_view()  # back to bytes
+    raw = np.zeros(12, np.float32)
+    f.Read_at(0, raw)
+    np.testing.assert_array_equal(
+        raw, [1, 2, 0, 0, 3, 4, 0, 0, 5, 6, 0, 0])
+    f.Close()
+
+
+def test_file_individual_pointer_and_seek(tmp_path):
+    from ompi_tpu.io import File, MODE_CREATE, MODE_RDWR
+
+    path = str(tmp_path / "t3.bin")
+    f = File.Open(COMM_WORLD, path, MODE_RDWR | MODE_CREATE)
+    f.Set_view(etype=FLOAT32)
+    f.Write(np.array([1.0, 2.0], np.float32))
+    f.Write(np.array([3.0], np.float32))
+    assert f.Get_position() == 3
+    f.Seek(1)
+    got = np.zeros(2, np.float32)
+    f.Read(got)
+    np.testing.assert_array_equal(got, [2.0, 3.0])
+    f.Close()
+
+
+def test_file_collective_and_shared(tmp_path):
+    from ompi_tpu.io import File, MODE_CREATE, MODE_RDWR
+
+    path = str(tmp_path / "t4.bin")
+    f = File.Open(COMM_WORLD, path, MODE_RDWR | MODE_CREATE)
+    f.Write_at_all(0, np.arange(8, dtype=np.float32))
+    back = np.zeros(8, np.float32)
+    f.Read_at_all(0, back)
+    np.testing.assert_array_equal(back, np.arange(8, dtype=np.float32))
+    # shared pointer: consecutive appends
+    f.Set_view(etype=FLOAT32)
+    f.Write_shared(np.array([100.0], np.float32))
+    f.Write_shared(np.array([200.0], np.float32))
+    first = np.zeros(2, np.float32)
+    f.Read_at(0, first)
+    np.testing.assert_array_equal(first, [100.0, 200.0])
+    f.Close()
+
+
+# --------------------------------------------------------------- sessions
+def test_session():
+    from ompi_tpu.runtime.session import Session
+
+    s = Session.Init()
+    names = [s.Get_nth_pset(i) for i in range(s.Get_num_psets())]
+    assert "mpi://WORLD" in names and "mpi://SELF" in names
+    g = s.Group_from_pset("mpi://WORLD")
+    assert g.size == COMM_WORLD.Get_size()
+    info = s.Get_pset_info("mpi://SELF")
+    assert info.Get("size") == "1"
+    comm = s.Comm_create_from_group(g, tag="test-tag")
+    assert comm.Get_size() == g.size
+    comm.Barrier()
+    s.Finalize()
+    import pytest as _p
+    from ompi_tpu.core.errors import MPIError
+
+    with _p.raises(MPIError):
+        s.Get_num_psets()
+
+
+# ------------------------------------------------------------ partitioned
+def test_partitioned_self():
+    from ompi_tpu.pml.partitioned import Psend_init, Precv_init
+
+    parts, per = 4, 3
+    src = np.arange(parts * per, dtype=np.float32)
+    dst = np.zeros(parts * per, np.float32)
+    sreq = Psend_init(COMM_WORLD, src, parts, per, FLOAT32, dest=0, tag=5)
+    rreq = Precv_init(COMM_WORLD, dst, parts, per, FLOAT32, source=0, tag=5)
+    rreq.Start()
+    sreq.Start()
+    # mark ready out of order (the point of partitioned comm)
+    for i in (2, 0, 3, 1):
+        sreq.Pready(i)
+    sreq.Wait()
+    rreq.Wait()
+    np.testing.assert_array_equal(dst, src)
+    assert rreq.Parrived(0) and rreq.Parrived(3)
+
+
+def test_any_tag_ignores_internal_bands():
+    """A wildcard user receive must not steal internal (negative-tag)
+    traffic like partition frags."""
+    from ompi_tpu.pml.partitioned import Psend_init
+
+    src = np.array([7.0, 8.0], np.float32)
+    sreq = Psend_init(COMM_WORLD, src, 2, 1, FLOAT32, dest=0, tag=1)
+    sreq.Start()
+    sreq.Pready(0)
+    # wildcard probe on user tags sees nothing
+    assert not COMM_WORLD.Iprobe(source=ompi_tpu.ANY_SOURCE,
+                                 tag=ompi_tpu.ANY_TAG)
+    # partitioned receive still completes
+    from ompi_tpu.pml.partitioned import Precv_init
+
+    dst = np.zeros(2, np.float32)
+    rreq = Precv_init(COMM_WORLD, dst, 2, 1, FLOAT32, source=0, tag=1)
+    rreq.Start()
+    sreq.Pready(1)
+    rreq.Wait()
+    sreq.Wait()
+    np.testing.assert_array_equal(dst, src)
