@@ -39,15 +39,19 @@ _LEN = struct.Struct("<I")
 
 
 class _Conn:
-    __slots__ = ("sock", "rbuf", "wbuf", "wlock")
+    __slots__ = ("sock", "rbuf", "wbuf", "wlock", "peer", "dead")
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, peer: Optional[int] = None):
         self.sock = sock
         self.rbuf = bytearray()
         # pending outbound bytes (reference: btl/tcp's per-endpoint pending
         # frag list flushed on write-ready events)
         self.wbuf = bytearray()
-        self.wlock = threading.Lock()
+        # RLock: _conn_failed runs both under wlock (from _flush_locked)
+        # and without it (from _drain's read-error path)
+        self.wlock = threading.RLock()
+        self.peer = peer
+        self.dead: Optional[OSError] = None
 
 
 class TcpBtl(Btl):
@@ -97,7 +101,7 @@ class TcpBtl(Btl):
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # identify ourselves so the acceptor can map conn -> rank
         s.sendall(_LEN.pack(self.my_rank))
-        conn = _Conn(s)
+        conn = _Conn(s, peer)
         s.setblocking(False)
         with self._sel_lock:
             self.sel.register(s, selectors.EVENT_READ, ("peer", conn))
@@ -122,6 +126,15 @@ class TcpBtl(Btl):
             payload = bytes(memoryview(payload))
         frame = _LEN.pack(HDR_SIZE + len(payload)) + header + payload
         with conn.wlock:
+            # dead-check under wlock: _conn_failed flips dead/clears wbuf
+            # under the same lock, so a frame can't slip past the check
+            # into a cleared buffer
+            if conn.dead is not None:
+                from ompi_tpu.core.errors import MPIError, ERR_OTHER
+
+                raise MPIError(
+                    ERR_OTHER,
+                    f"connection to rank {peer} is dead: {conn.dead}")
             conn.wbuf += frame
             self._flush_locked(conn)
 
@@ -134,12 +147,33 @@ class TcpBtl(Btl):
                 if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
                     self._want_write(conn, True)
                     return
-                return  # drain path will notice the dead socket
+                # Fatal send error: queued (and eagerly-completed) bytes are
+                # lost. Surface it — mark the conn dead, tell the failure
+                # detector, fail future sends to this peer (ADVICE r1).
+                self._conn_failed(conn, e)
+                return
             if sent <= 0:
                 self._want_write(conn, True)
                 return
             del conn.wbuf[:sent]
         self._want_write(conn, False)
+
+    def _conn_failed(self, conn: _Conn, err: OSError) -> None:
+        """A connection died under queued traffic: drop it, surface the
+        loss (reference: btl/tcp endpoint error → pml error callback; here
+        the ULFM detector is the propagation plane)."""
+        with conn.wlock:
+            conn.dead = err
+            conn.wbuf.clear()
+        self.log.error("i/o with rank %s failed: %s", conn.peer, err)
+        self._unregister(conn)
+        # The dead conn stays in self.conns: bytes already queued (and
+        # eagerly completed) were lost, so silently reconnecting would hide
+        # a hole in the message stream — subsequent sends raise instead.
+        if conn.peer is not None:
+            from ompi_tpu.ft.detector import mark_failed
+
+            mark_failed(conn.peer)
 
     def _want_write(self, conn: _Conn, on: bool) -> None:
         ev = selectors.EVENT_READ | (selectors.EVENT_WRITE if on else 0)
@@ -193,7 +227,7 @@ class TcpBtl(Btl):
                 return 0
             raw += chunk
         peer = _LEN.unpack(raw)[0]
-        conn = _Conn(s)
+        conn = _Conn(s, peer)
         s.setblocking(False)
         with self._conn_lock:
             # keep one canonical conn per peer for sending; both sides may
@@ -209,9 +243,15 @@ class TcpBtl(Btl):
         except socket.error as e:
             if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
                 return 0
-            self._unregister(conn)
+            self._conn_failed(conn, e)
             return 0
         if not data:
+            # EOF: could be a peer crash OR a clean peer Finalize — mark the
+            # conn dead so later sends raise instead of vanishing, but leave
+            # failure *detection* to the heartbeat detector (a clean
+            # shutdown must not raise ULFM failure events).
+            if conn.dead is None:
+                conn.dead = ConnectionResetError("closed by peer")
             self._unregister(conn)
             return 0
         conn.rbuf += data
@@ -226,7 +266,14 @@ class TcpBtl(Btl):
             hdr = bytes(buf[start : start + HDR_SIZE])
             payload = bytes(buf[start + HDR_SIZE : start + total])
             off += 4 + total
-            self.deliver(hdr, payload)
+            # A frame handler may itself send (ob1 replies with CTS/DATA
+            # from inside deliver); if that send hits a dead peer the
+            # MPIError must not escape — it would skip the rbuf trim below
+            # (re-delivering frames) and kill the progress thread.
+            try:
+                self.deliver(hdr, payload)
+            except Exception:
+                self.log.exception("frame handler failed (frame dropped)")
             n += 1
         if off:
             del buf[:off]

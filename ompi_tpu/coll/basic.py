@@ -82,6 +82,15 @@ def _typed_view(raw: np.ndarray, dt: Datatype) -> np.ndarray:
                    "reduction on heterogeneous derived datatype")
 
 
+def _np_reduce_typed(op: _op.Op, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """op.np_reduce with the operand dtype restored: logical ufuncs
+    (np.logical_and/or/xor) return bool arrays, but MPI keeps the integer
+    type (reference: op kernels are typed per dtype) — without the cast the
+    byte-view downstream shrinks to 1 byte/element and unpack truncates."""
+    out = op.np_reduce(a, b)
+    return out.astype(a.dtype) if out.dtype != a.dtype else out
+
+
 class BasicColl(CollModule):
     # -------------------------------------------------------------- barrier
     def barrier(self, comm) -> None:
@@ -150,7 +159,7 @@ class BasicColl(CollModule):
             q.Wait()
         acc = _typed_view(contributions[0].copy(), dt)
         for i in range(1, n):
-            acc = op.np_reduce(acc, _typed_view(contributions[i], dt))
+            acc = _np_reduce_typed(op, acc, _typed_view(contributions[i], dt))
         robj, rcount, rdt = parse_buffer(recvbuf)
         cv_unpack(np.ascontiguousarray(acc).view(np.uint8), robj, rcount, rdt)
 
@@ -362,8 +371,8 @@ class BasicColl(CollModule):
         if r > 0:
             rb, rq = _irecv(comm, packed.nbytes, r - 1, TAG_SCAN)
             rq.Wait()
-            acc = op.np_reduce(_typed_view(rb, dt),
-                               _typed_view(packed.copy(), dt))
+            acc = _np_reduce_typed(op, _typed_view(rb, dt),
+                                   _typed_view(packed.copy(), dt))
         else:
             acc = _typed_view(packed.copy(), dt)
         acc_bytes = np.ascontiguousarray(acc).view(np.uint8)
@@ -387,8 +396,8 @@ class BasicColl(CollModule):
                 nxt = packed
             else:
                 nxt = np.ascontiguousarray(
-                    op.np_reduce(_typed_view(prefix.copy(), dt),
-                                 _typed_view(packed, dt))).view(np.uint8)
+                    _np_reduce_typed(op, _typed_view(prefix.copy(), dt),
+                                     _typed_view(packed, dt))).view(np.uint8)
             _isend(comm, nxt, r + 1, TAG_SCAN).Wait()
         if prefix is not None:
             robj, rcount, rdt = parse_buffer(recvbuf)

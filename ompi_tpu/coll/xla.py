@@ -26,10 +26,14 @@ Singleton groups (the padding for non-members of Create_group and
 MPI_UNDEFINED colors) are masked out of every schedule and keep their own
 data — which is also the correct MPI semantics for 1-member comms.
 
-MPI_Op → device computation: SUM/MAX/MIN lower natively; every other op
-(PROD, logical/bitwise, MINLOC/MAXLOC, user fns) uses its jax-traceable
-elementwise combine inside the schedule (reference analog: op/avx SIMD
-kernels become VPU vector code emitted by XLA).
+MPI_Op → device computation: SUM/MAX/MIN lower natively; PROD,
+logical/bitwise and jax-traceable user fns use their elementwise combine
+inside the schedule (reference analog: op/avx SIMD kernels become VPU
+vector code emitted by XLA). MINLOC/MAXLOC are host-path only: their
+operands are structured (value, index) record arrays, which XLA has no
+dtype for — mesh-mode reductions with them raise
+ERR_UNSUPPORTED_OPERATION up front (use the host comm path, or carry the
+index as a second array and two reductions).
 """
 
 from __future__ import annotations
@@ -50,6 +54,21 @@ from ompi_tpu.parallel.axes import shard_map_compat as _shard_map
 
 def _is_bool(dtype) -> bool:
     return np.dtype(dtype) == np.bool_
+
+
+_HOST_ONLY_OPS = frozenset(("MPI_MINLOC", "MPI_MAXLOC"))
+
+
+def _check_device_op(op: _op.Op) -> None:
+    """Fail loc-pair ops before trace time with an actionable message
+    (ADVICE r1: they have no _JNP_EQUIV entry and their structured-dtype
+    operands cannot become jax arrays anyway)."""
+    if op.name in _HOST_ONLY_OPS:
+        raise MPIError(
+            ERR_UNSUPPORTED_OPERATION,
+            f"{op.name} has no device lowering: structured (value, index) "
+            "records are not an XLA dtype. Run it on a host-path comm, or "
+            "reduce values and indices as two arrays.")
 
 
 # --------------------------------------------------------------- schedules
@@ -155,6 +174,7 @@ class XlaColl(CollModule):
         import jax.numpy as jnp
         from jax import lax
 
+        _check_device_op(op)
         key = cache_key("allreduce", op)
 
         def build():
@@ -327,6 +347,7 @@ class XlaColl(CollModule):
                 f"reduce_scatter expects [world, group_size={G}, ...], got "
                 f"{tuple(x.shape)}",
             )
+        _check_device_op(op)
         key = cache_key("reduce_scatter_block", op)
 
         def build():
@@ -371,6 +392,7 @@ class XlaColl(CollModule):
         import jax.numpy as jnp
         from jax import lax
 
+        _check_device_op(op)
         key = cache_key("scan", op, (exclusive,))
 
         def build():

@@ -104,6 +104,11 @@ _pending: Dict[int, _Pending] = {}
 def _on_message(hdr, payload: bytes) -> None:
     """Runs inside the progress engine on the *target* (or origin for
     ACKs) — the reference's osc callbacks registered on the btl."""
+    # BTLs deliver bytes-like frames; the self BTL short-circuits the
+    # PML's zero-copy pack views (ndarrays) straight through. Normalize
+    # here so every downstream slice/truthiness sees plain bytes.
+    if not isinstance(payload, (bytes, bytearray)):
+        payload = bytes(payload)
     win_id, verb, origin, disp, count, dcode, opcode, req_id = \
         _HDR.unpack(payload[: _HDR.size])
     body = payload[_HDR.size:]
@@ -184,7 +189,7 @@ class Win:
         while not p.event.is_set():
             progress()
         self._outstanding.pop(rid, None)
-        return p.data or b""
+        return b"" if p.data is None else p.data
 
     # --------------------------------------------------------------- verbs
     def Put(self, origin_arr: np.ndarray, target: int,

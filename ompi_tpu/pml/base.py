@@ -78,9 +78,12 @@ class RecvRequest(Request):
         self.matched = False
 
     def matches(self, hdr: Header) -> bool:
-        # ANY_TAG only matches user tags (>= 0): internal traffic
-        # (collective plane, partitioned bands) uses negative tags and
-        # must never satisfy a wildcard user receive
+        # ANY_TAG only matches user tags (>= 0): system-plane traffic
+        # (osc/ft notices) uses negative tags and must never satisfy a
+        # wildcard user receive. Collective and partitioned traffic is
+        # isolated by dedicated CID planes instead (COLL_CID_BIT in
+        # coll/basic.py, PART_CID_BIT in pml/partitioned.py) — both guards
+        # are load-bearing; don't collapse one into the other.
         return (
             hdr.cid == self.cid
             and (self.src == ANY_SOURCE or self.src == hdr.src)

@@ -222,7 +222,14 @@ class Ob1Pml:
             self._active_recvs[recv_id] = req
             cts = pack_header(RNDV_CTS, self.my_rank, hdr.cid, hdr.tag, 0,
                               hdr.nbytes, hdr.msgid, recv_id)
-            self._btl_for(hdr.src).send(hdr.src, cts, b"")
+            try:
+                self._btl_for(hdr.src).send(hdr.src, cts, b"")
+            except MPIError as e:
+                # dead transport: fail the receive instead of leaving it
+                # matched-but-incomplete (Wait would spin forever)
+                del self._active_recvs[recv_id]
+                req.status._nbytes = 0
+                req._set_complete(e.code)
 
     def _incoming_rts(self, hdr: Header) -> None:
         with self.engine.lock:
@@ -241,12 +248,20 @@ class Ob1Pml:
         frag_size = get_var("pml", "frag_size")
         btl = self._btl_for(hdr.src)
         offset = 0
-        while conv.remaining > 0:
-            frag = conv.pack_frag(frag_size)
-            dhdr = pack_header(RNDV_DATA, self.my_rank, sreq.cid, sreq.tag,
-                               0, sreq.nbytes, offset, hdr.msgid)
-            btl.send(hdr.src, dhdr, frag)
-            offset += frag.nbytes
+        try:
+            while conv.remaining > 0:
+                frag = conv.pack_frag(frag_size)
+                dhdr = pack_header(RNDV_DATA, self.my_rank, sreq.cid,
+                                   sreq.tag, 0, sreq.nbytes, offset,
+                                   hdr.msgid)
+                btl.send(hdr.src, dhdr, frag)
+                offset += frag.nbytes
+        except MPIError as e:
+            # transport died mid-rendezvous: fail the send request so the
+            # sender's Wait surfaces the loss instead of spinning
+            sreq.status._nbytes = offset
+            sreq._set_complete(e.code)
+            return
         sreq.status._nbytes = sreq.nbytes
         sreq._set_complete(0)
 

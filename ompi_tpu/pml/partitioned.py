@@ -17,16 +17,28 @@ from typing import List, Optional
 
 import numpy as np
 
+from ompi_tpu.comm.communicator import PROC_NULL
 from ompi_tpu.core.datatype import Datatype
 from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_PENDING
 from ompi_tpu.core.request import Request
 
-# partition traffic rides user-tag space shifted into a reserved band
-_PART_TAG_BASE = -3000
+# Partition traffic rides its own CID plane (like the collective plane's
+# COLL_CID_BIT in coll/basic.py) so it can use non-negative composite tags
+# that (a) never collide with user traffic on the base cid, (b) never cross
+# into the system-tag band (tags <= Ob1Pml.SYSTEM_TAG_BASE bypass matching
+# entirely — the round-1 deadlock), and (c) are invisible to ANY_TAG
+# wildcard receives by cid mismatch alone.
+PART_CID_BIT = 1 << 29
+_MAX_PARTITIONS = 1 << 20
 
 
 def _part_tag(user_tag: int, partition: int) -> int:
-    return _PART_TAG_BASE - user_tag * 1024 - partition
+    if user_tag < 0 or user_tag >= (1 << 20):
+        raise MPIError(ERR_ARG,
+                       f"partitioned tag {user_tag} outside [0, 2^20)")
+    tag = user_tag * _MAX_PARTITIONS + partition
+    assert tag >= 0, "partition tag escaped the non-negative plane"
+    return tag
 
 
 class PartitionedRequest(Request):
@@ -42,6 +54,12 @@ class PartitionedRequest(Request):
         self.datatype = datatype
         self.peer = peer
         self.tag = tag
+        if partitions > _MAX_PARTITIONS:
+            raise MPIError(ERR_ARG,
+                           f"partitions {partitions} > {_MAX_PARTITIONS}")
+        _part_tag(tag, partitions - 1)  # validate the band eagerly: a
+        # lazy raise inside Start() would leave an activated request
+        # permanently incomplete (Wait would hang)
         self.is_send = send
         self.persistent = True
         self._complete.set()  # inactive
@@ -54,6 +72,11 @@ class PartitionedRequest(Request):
 
     # ----------------------------------------------------------- lifecycle
     def Start(self) -> "PartitionedRequest":
+        self.comm._check_usable()  # raw-pml path below skips the Comm
+        # wrapper's revoked-comm guard; enforce it here
+        if self.peer == PROC_NULL:
+            self._set_complete(0)
+            return self
         self._complete.clear()
         with self._lock:
             self._inner = [None] * self.partitions
@@ -61,9 +84,11 @@ class PartitionedRequest(Request):
             # post all partition receives up front (reference: persist
             # posts the persistent recv at Start)
             for i in range(self.partitions):
-                req = self.comm.Irecv(
-                    [self._partition_view(i), self.count, self.datatype],
-                    source=self.peer, tag=_part_tag(self.tag, i))
+                req = self.comm.pml.irecv(
+                    self._partition_view(i), self.count, self.datatype,
+                    self.comm._world_rank(self.peer),
+                    _part_tag(self.tag, i),
+                    self.comm.cid | PART_CID_BIT)
                 with self._lock:
                     self._inner[i] = req
                 req.add_completion_callback(lambda r: self._maybe_done())
@@ -75,9 +100,14 @@ class PartitionedRequest(Request):
             raise MPIError(ERR_ARG, "Pready on a receive request")
         if not 0 <= partition < self.partitions:
             raise MPIError(ERR_ARG, f"partition {partition}")
-        req = self.comm.Isend(
-            [self._partition_view(partition), self.count, self.datatype],
-            dest=self.peer, tag=_part_tag(self.tag, partition))
+        self.comm._check_usable()
+        if self.peer == PROC_NULL:
+            return
+        req = self.comm.pml.isend(
+            self._partition_view(partition), self.count, self.datatype,
+            self.comm._world_rank(self.peer),
+            _part_tag(self.tag, partition),
+            self.comm.cid | PART_CID_BIT)
         with self._lock:
             self._inner[partition] = req
         req.add_completion_callback(lambda r: self._maybe_done())
@@ -88,6 +118,8 @@ class PartitionedRequest(Request):
 
     def Parrived(self, partition: int) -> bool:
         """Receiver polls one partition (reference: part.h Parrived)."""
+        if self.peer == PROC_NULL:
+            return self.is_complete
         from ompi_tpu.runtime.progress import progress
 
         progress()

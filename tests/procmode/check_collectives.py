@@ -24,6 +24,16 @@ def main() -> int:
     COMM_WORLD.Allreduce(mine, out, op=mpi_op.MAX)
     assert out[0] == n, out
 
+    # allreduce LAND/LOR on int32 — np.logical_* return bool arrays; the
+    # host reduction must cast back to the operand dtype (ADVICE r1) or
+    # the byte-view unpack truncates
+    lbuf = np.array([r + 1, 0, 5], np.int32)  # all-true, all-false, all-true
+    lout = np.zeros(3, np.int32)
+    COMM_WORLD.Allreduce(lbuf, lout, op=mpi_op.LAND)
+    assert list(lout) == [1, 0, 1], lout
+    COMM_WORLD.Allreduce(np.array([r, 0, 2], np.int32), lout, op=mpi_op.LOR)
+    assert list(lout) == [1 if n > 1 else 0, 0, 1], lout
+
     # bcast from nonzero root
     data = np.full(3, float(r), np.float64)
     COMM_WORLD.Bcast(data, root=n - 1)
