@@ -38,6 +38,20 @@ COLL_OPS = (
     # neighborhood collectives (reference: coll.h neighbor_* slots)
     "neighbor_allgather",
     "neighbor_alltoall",
+    # nonblocking variants (reference: coll.h pairs every blocking slot
+    # with an i-slot in the same table; coll/libnbc provides them)
+    "ibarrier",
+    "ibcast",
+    "ireduce",
+    "iallreduce",
+    "iallgather",
+    "iallgatherv",
+    "ialltoall",
+    "igather",
+    "iscatter",
+    "ireduce_scatter_block",
+    "iscan",
+    "iexscan",
 )
 
 

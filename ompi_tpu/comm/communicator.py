@@ -320,6 +320,52 @@ class ProcComm(Intracomm):
     def Exscan(self, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> None:
         self._coll("exscan")(self, sendbuf, recvbuf, op)
 
+    # ------------------------------------------------ nonblocking collectives
+    # Reference: the MPI_I* surface (coll/libnbc); every verb returns a
+    # Request progressed by the engine — overlap communication with compute.
+    def Ibarrier(self) -> Request:
+        return self._coll("ibarrier")(self)
+
+    def Ibcast(self, buf, root: int = 0) -> Request:
+        self._check_root(root)
+        return self._coll("ibcast")(self, buf, root)
+
+    def Ireduce(self, sendbuf, recvbuf, op: _op.Op = _op.SUM,
+                root: int = 0) -> Request:
+        self._check_root(root)
+        return self._coll("ireduce")(self, sendbuf, recvbuf, op, root)
+
+    def Iallreduce(self, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> Request:
+        return self._coll("iallreduce")(self, sendbuf, recvbuf, op)
+
+    def Iallgather(self, sendbuf, recvbuf) -> Request:
+        return self._coll("iallgather")(self, sendbuf, recvbuf)
+
+    def Iallgatherv(self, sendbuf, recvbuf, counts, displs=None) -> Request:
+        return self._coll("iallgatherv")(self, sendbuf, recvbuf, counts,
+                                         displs)
+
+    def Ialltoall(self, sendbuf, recvbuf) -> Request:
+        return self._coll("ialltoall")(self, sendbuf, recvbuf)
+
+    def Igather(self, sendbuf, recvbuf, root: int = 0) -> Request:
+        self._check_root(root)
+        return self._coll("igather")(self, sendbuf, recvbuf, root)
+
+    def Iscatter(self, sendbuf, recvbuf, root: int = 0) -> Request:
+        self._check_root(root)
+        return self._coll("iscatter")(self, sendbuf, recvbuf, root)
+
+    def Ireduce_scatter_block(self, sendbuf, recvbuf,
+                              op: _op.Op = _op.SUM) -> Request:
+        return self._coll("ireduce_scatter_block")(self, sendbuf, recvbuf, op)
+
+    def Iscan(self, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> Request:
+        return self._coll("iscan")(self, sendbuf, recvbuf, op)
+
+    def Iexscan(self, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> Request:
+        return self._coll("iexscan")(self, sendbuf, recvbuf, op)
+
     # ------------------------------------------------------ comm management
     def _alloc_cid(self) -> int:
         """Agree on a fresh CID: MAX-allreduce of the local next-free id

@@ -176,6 +176,44 @@ class XlaComm(Intracomm):
     Alltoall = alltoall
     Barrier = barrier
 
+    # ------------------------------------ nonblocking collectives (MPI_I*)
+    # jax dispatch is already asynchronous: the jitted executable is
+    # enqueued and control returns before the collective completes on
+    # device. The I* variants surface that as a Request whose ``result``
+    # holds the output array — Wait() blocks on device readiness
+    # (reference: coll/libnbc round schedules; here the "schedule" is the
+    # XLA program and ICI does the progression).
+    def _ireq(self, result):
+        from ompi_tpu.coll.sched import JaxRequest
+
+        return JaxRequest(result)
+
+    def iallreduce(self, x, op: _op.Op = _op.SUM):
+        return self._ireq(self.allreduce(x, op))
+
+    def ibcast(self, x, root: int = 0):
+        return self._ireq(self.bcast(x, root))
+
+    def ireduce(self, x, op: _op.Op = _op.SUM, root: int = 0):
+        return self._ireq(self.reduce(x, op, root))
+
+    def iallgather(self, x):
+        return self._ireq(self.allgather(x))
+
+    def ialltoall(self, x):
+        return self._ireq(self.alltoall(x))
+
+    def ireduce_scatter(self, x, op: _op.Op = _op.SUM):
+        return self._ireq(self.reduce_scatter(x, op))
+
+    def ibarrier(self):
+        # the barrier collective itself is the dispatched executable; by
+        # the time dispatch returns the round is enqueued on every shard
+        from ompi_tpu.core.request import CompletedRequest
+
+        self.barrier()
+        return CompletedRequest()
+
     # ------------------------------------------------------------- pt2pt
     def permute(self, x, perm: Sequence[Tuple[int, int]]):
         """Tag-free pt2pt: move rank-rows along (src, dst) pairs in comm

@@ -1,0 +1,64 @@
+"""Forced-algorithm sweep for the tuned decision layer: every allreduce/
+allgather algorithm must agree (reference analog: coll_tuned forced-algo
+MCA vars + the coll_base algorithm matrix tests)."""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.mca.var import set_var
+
+
+def main() -> int:
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+
+    counts = (1, 7, 1024, 40000)  # spans rd/ring/segmented thresholds
+    for algo in ("linear", "recursive_doubling", "ring", "ring_segmented"):
+        set_var("coll_tuned", "allreduce_algorithm", algo)
+        for count in counts:
+            mine = (np.arange(count, dtype=np.float64) + r + 1)
+            out = np.zeros(count, np.float64)
+            COMM_WORLD.Allreduce(mine, out)
+            expect = (np.arange(count, dtype=np.float64) * n
+                      + n * (n + 1) / 2)
+            np.testing.assert_allclose(out, expect, err_msg=f"{algo}/{count}")
+            # MAX too (different op kind through the same schedule)
+            COMM_WORLD.Allreduce(mine, out, op=mpi_op.MAX)
+            np.testing.assert_allclose(
+                out, np.arange(count, dtype=np.float64) + n,
+                err_msg=f"{algo}-max/{count}")
+    set_var("coll_tuned", "allreduce_algorithm", "auto")
+
+    for algo in ("ring", "bruck"):
+        set_var("coll_tuned", "allgather_algorithm", algo)
+        for count in (1, 3, 500):
+            mine = np.arange(count, dtype=np.int32) + r * 1000
+            out = np.zeros(n * count, np.int32)
+            COMM_WORLD.Allgather(mine, out)
+            for i in range(n):
+                np.testing.assert_array_equal(
+                    out[i * count:(i + 1) * count],
+                    np.arange(count, dtype=np.int32) + i * 1000,
+                    err_msg=f"{algo}/{count}")
+    set_var("coll_tuned", "allgather_algorithm", "auto")
+
+    # binomial reduce at every root
+    for root in range(n):
+        out = np.zeros(3, np.int64)
+        COMM_WORLD.Reduce(np.array([r, r * 2, 1], np.int64), out, root=root)
+        if r == root:
+            s = n * (n - 1) // 2
+            assert list(out) == [s, 2 * s, n], out
+
+    COMM_WORLD.Barrier()
+    ompi_tpu.Finalize()
+    print(f"rank {r}: TUNED-OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

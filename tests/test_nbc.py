@@ -1,0 +1,79 @@
+"""Nonblocking collectives: multi-rank process mode + mesh-mode I*.
+
+Reference: ompi/mca/coll/libnbc round schedules; mesh path wraps async jax
+dispatch in Requests."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.core.request import Request
+from ompi_tpu.parallel import mesh_world
+from tests.test_process_mode import run_mpi
+
+W = 8
+
+
+@pytest.fixture(scope="module")
+def world():
+    assert jax.device_count() >= W
+    return mesh_world(jax.devices()[:W])
+
+
+# ------------------------------------------------------------ process mode
+@pytest.mark.parametrize("np_", [2, 3, 4])
+def test_nbc_procmode(np_):
+    r = run_mpi(np_, "tests/procmode/check_nbc.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("NBC-OK") == np_
+
+
+def test_tuned_algorithms_4_ranks():
+    r = run_mpi(4, "tests/procmode/check_tuned.py", timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("TUNED-OK") == 4
+
+
+def test_tuned_algorithms_3_ranks_nonpow2():
+    r = run_mpi(3, "tests/procmode/check_tuned.py", timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("TUNED-OK") == 3
+
+
+# ---------------------------------------------------------------- mesh mode
+def _ranked():
+    base = np.arange(4, dtype=np.float32)
+    return np.stack([base + r for r in range(W)])
+
+
+def test_mesh_iallreduce(world):
+    x = world.shard(_ranked())
+    req = world.iallreduce(x)
+    req.Wait()
+    np.testing.assert_allclose(np.asarray(req.result),
+                               np.stack([_ranked().sum(0)] * W))
+
+
+def test_mesh_i_overlap_waitall(world):
+    x = world.shard(_ranked())
+    xr = world.shard(np.stack([np.arange(W, dtype=np.float32) + r
+                               for r in range(W)]))
+    reqs = [world.iallreduce(x), world.iallgather(x),
+            world.ireduce_scatter(xr)]
+    Request.Waitall(reqs)
+    np.testing.assert_allclose(np.asarray(reqs[0].result),
+                               np.stack([_ranked().sum(0)] * W))
+    ag = np.asarray(reqs[1].result)
+    assert ag.shape == (W, W, 4)
+    np.testing.assert_allclose(ag[0], _ranked())
+
+
+def test_mesh_ibcast_test_polls(world):
+    x = world.shard(_ranked())
+    req = world.ibcast(x, root=2)
+    while not req.Test():
+        pass
+    np.testing.assert_allclose(np.asarray(req.result),
+                               np.stack([_ranked()[2]] * W))
