@@ -95,6 +95,7 @@ PROC_NULL = -2
 ROOT = -3
 UNDEFINED = -32766
 
+from ompi_tpu.accelerator import DeviceBuffer
 from ompi_tpu.comm.communicator import Communicator, Intracomm
 from ompi_tpu.runtime.state import (
     Init,

@@ -32,7 +32,11 @@ from ompi_tpu.core.datatype import BYTE
 from ompi_tpu.core.errors import MPIError
 from ompi_tpu.core.request import Request
 
-NBC_CID_BIT = 1 << 29
+# Distinct CID plane per traffic class: COLL_CID_BIT = 1<<30 (coll/basic),
+# PART_CID_BIT = 1<<29 (pml/partitioned) — NBC takes 1<<28 so overlapping
+# nonblocking schedules, partitioned transfers, and blocking collectives on
+# the same communicator can never cross-match.
+NBC_CID_BIT = 1 << 28
 
 
 class Round:
@@ -116,6 +120,17 @@ class NbcRequest(Request):
             except MPIError as e:
                 self._set_complete(e.code)
                 return
+            except Exception:
+                # Rounds >= 2 run inside completion callbacks on the
+                # progress thread; an escaped exception would kill it and
+                # leave Wait() spinning forever. Fail the request instead.
+                from ompi_tpu.core.errors import ERR_INTERN
+                from ompi_tpu.utils.output import get_logger
+
+                get_logger("coll.nbc").warning(
+                    "schedule raised", exc_info=True)
+                self._set_complete(ERR_INTERN)
+                return
             first = False
             reqs, bufs = _issue(self._comm, rnd, self._tag, self._cid)
             if not reqs:
@@ -181,8 +196,18 @@ class JaxRequest(Request):
 
     def Wait(self, status=None, timeout=None):
         import jax
+        import time
 
-        jax.block_until_ready(self.result)
+        if timeout is None:
+            jax.block_until_ready(self.result)
+        else:
+            deadline = time.monotonic() + timeout
+            while not self.is_complete:
+                if time.monotonic() > deadline:
+                    from ompi_tpu.core.errors import ERR_PENDING
+
+                    raise MPIError(ERR_PENDING, "Wait timed out")
+                time.sleep(0.001)
         if not self._complete.is_set():
             self._set_complete(0)
         self._finish(status)

@@ -49,15 +49,19 @@ UNDEFINED = -32766
 
 def parse_buffer(buf) -> Tuple[Any, int, Datatype]:
     """Accept ndarray | bytearray | [obj, datatype] | [obj, count, datatype]
-    (mpi4py-style buffer specs)."""
+    (mpi4py-style buffer specs) | jax.Array (send side, staged through
+    host) | accelerator.DeviceBuffer (recv side, functional device
+    update). Reference: the accelerator-buffer checks in every binding
+    (pml_ob1_accelerator.c; coll/accelerator wrapper)."""
     if isinstance(buf, (list, tuple)):
         if len(buf) == 2:
             obj, dt = buf
+            obj = _stage_device(obj)
             count = obj.size if hasattr(obj, "size") else len(obj)
             return obj, int(count), dt
         if len(buf) == 3:
             obj, count, dt = buf
-            return obj, int(count), dt
+            return _stage_device(obj), int(count), dt
         raise MPIError(ERR_ARG, "buffer spec must be [obj, [count,] datatype]")
     if isinstance(buf, np.ndarray):
         if buf.dtype.names:
@@ -66,7 +70,28 @@ def parse_buffer(buf) -> Tuple[Any, int, Datatype]:
         return buf, buf.size, from_numpy_dtype(buf.dtype)
     if isinstance(buf, (bytearray, memoryview, bytes)):
         return buf, len(buf), BYTE
+    staged = _stage_device(buf)
+    if staged is not buf:
+        return staged, staged.size, from_numpy_dtype(staged.dtype)
     raise MPIError(ERR_ARG, f"cannot infer buffer spec from {type(buf)}")
+
+
+def _stage_device(obj):
+    """Resolve device buffers for the host data path. Raw device arrays
+    DTOH-stage to a READ-ONLY ndarray (they are immutable, so a recv into
+    the staging copy must fail loudly); DeviceBuffer holders hand out
+    their mutable staging array and conservatively invalidate the cached
+    device view — we cannot tell read from write uses here, and a stale
+    cache would be a correctness bug while an extra HTOD upload is only
+    a cost."""
+    from ompi_tpu.accelerator import DeviceBuffer, is_device_buffer, stage_to_host
+
+    if isinstance(obj, DeviceBuffer):
+        obj._mark_dirty()
+        return obj.host
+    if is_device_buffer(obj):
+        return stage_to_host(obj)
+    return obj
 
 
 class Communicator:

@@ -26,22 +26,31 @@ class Request:
         self._complete = threading.Event()
         self._error: int = 0
         self._on_complete: List[Callable[["Request"], None]] = []
+        self._cb_lock = threading.Lock()
         self.persistent = False
 
     # ------------------------------------------------------------ completion
     def _set_complete(self, error: int = 0) -> None:
         self._error = error
         self.status.error = error
-        self._complete.set()
-        for cb in self._on_complete:
+        # Flip the flag and snapshot callbacks under the registration lock:
+        # a registration racing on another thread either lands in the
+        # snapshot or observes the flag and self-fires — never lost
+        # (reference: the sync-object CAS of request.h:451).
+        with self._cb_lock:
+            self._complete.set()
+            cbs = list(self._on_complete)
+            self._on_complete.clear()
+        for cb in cbs:
             cb(self)
         _completion_cond_notify()
 
     def add_completion_callback(self, cb: Callable[["Request"], None]) -> None:
-        if self._complete.is_set():
-            cb(self)
-        else:
-            self._on_complete.append(cb)
+        with self._cb_lock:
+            if not self._complete.is_set():
+                self._on_complete.append(cb)
+                return
+        cb(self)
 
     @property
     def is_complete(self) -> bool:

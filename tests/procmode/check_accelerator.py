@@ -1,0 +1,48 @@
+"""Device buffers over the process-mode data path (reference analog:
+GPU-aware MPI through the accelerator framework + pml staging,
+pml_ob1_accelerator.c) — jax arrays sent/allreduced between real ranks."""
+
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.accelerator import DeviceBuffer, is_device_buffer
+
+
+def main() -> int:
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+
+    # pt2pt: device send buffer, DeviceBuffer recv
+    if r == 0:
+        COMM_WORLD.Send(jnp.arange(5, dtype=jnp.float32) * 3, dest=1, tag=1)
+    elif r == 1:
+        out = DeviceBuffer((5,), jnp.float32)
+        COMM_WORLD.Recv(out, source=0, tag=1)
+        arr = out.array
+        assert is_device_buffer(arr)
+        np.testing.assert_array_equal(np.asarray(arr),
+                                      np.arange(5, dtype=np.float32) * 3)
+
+    # allreduce with device buffers on every rank, bf16 (the TPU dtype)
+    send = jnp.full((4,), float(r + 1), dtype=jnp.bfloat16)
+    out = DeviceBuffer((4,), jnp.bfloat16)
+    COMM_WORLD.Allreduce(send, out)
+    expect = n * (n + 1) / 2
+    assert float(np.asarray(out.array)[0]) == expect, np.asarray(out.array)
+
+    # bcast of a staged device array via DeviceBuffer on all ranks
+    db = DeviceBuffer(jnp.arange(3, dtype=jnp.int32) + 10) if r == 0 \
+        else DeviceBuffer((3,), jnp.int32)
+    COMM_WORLD.Bcast(db, root=0)
+    np.testing.assert_array_equal(np.asarray(db.array), [10, 11, 12])
+
+    print(f"ACCEL-OK rank {r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

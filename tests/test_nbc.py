@@ -77,3 +77,32 @@ def test_mesh_ibcast_test_polls(world):
         pass
     np.testing.assert_allclose(np.asarray(req.result),
                                np.stack([_ranked()[2]] * W))
+
+
+def test_nbc_and_partitioned_planes_disjoint():
+    """Regression (r2 review): NBC_CID_BIT must not collide with
+    PART_CID_BIT — an in-flight partitioned transfer and a nonblocking
+    collective on the same comm must never cross-match."""
+    import numpy as np
+    from ompi_tpu import COMM_WORLD
+    from ompi_tpu.coll.sched import NBC_CID_BIT
+    from ompi_tpu.core.datatype import FLOAT32
+    from ompi_tpu.pml.partitioned import PART_CID_BIT, Psend_init, Precv_init
+    from ompi_tpu.coll.basic import COLL_CID_BIT
+
+    assert len({NBC_CID_BIT, PART_CID_BIT, COLL_CID_BIT}) == 3
+
+    src = np.arange(4, dtype=np.float32)
+    dst = np.zeros(4, dtype=np.float32)
+    sreq = Psend_init(COMM_WORLD, src, 2, 2, FLOAT32, dest=0, tag=0)
+    rreq = Precv_init(COMM_WORLD, dst, 2, 2, FLOAT32, source=0, tag=0)
+    rreq.Start()
+    sreq.Start()
+    # overlap a nonblocking collective with partitions still pending
+    ib = COMM_WORLD.Ibarrier()
+    sreq.Pready(0)
+    sreq.Pready(1)
+    ib.Wait()
+    sreq.Wait()
+    rreq.Wait()
+    np.testing.assert_array_equal(dst, src)
