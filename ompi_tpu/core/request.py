@@ -13,8 +13,15 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
+import os
+
 from ompi_tpu.core.errors import MPIError, ERR_REQUEST, ERR_PENDING
 from ompi_tpu.core.status import Status
+
+# Wait-loop policy: on a multicore host blocking waits spin hot (the
+# reference busy-polls in ompi_request_wait_completion); on a single core
+# spinning just burns the peer's timeslice, so yield immediately.
+_MULTICORE = (os.cpu_count() or 1) > 1
 
 
 class Request:
@@ -68,19 +75,30 @@ class Request:
         """Block until complete, driving progress (reference: request.h:451
         hot loop over opal_progress)."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        spins = 0
+        idle_since = None
         while not self._complete.is_set():
             made_progress = _progress_once()
             if self._complete.is_set():
                 break
             if deadline is not None and time.monotonic() > deadline:
                 raise MPIError(ERR_PENDING, "Wait timed out")
-            # Back off to the condition variable when polling is idle
-            # (reference: the every-8th-call libevent yield in
-            # opal_progress.c:216-230).
-            spins = 0 if made_progress else spins + 1
-            if spins > 8:
+            if made_progress:
+                idle_since = None
+                continue
+            # Busy-poll while recently active (blocking MPI waits spin —
+            # the reference never sleeps in ompi_request_wait_completion);
+            # only after ~2ms of continuous idleness back off to the
+            # condition variable so oversubscribed ranks don't thrash.
+            now = time.monotonic()
+            if idle_since is None:
+                idle_since = now
+            idle = now - idle_since
+            if idle >= 0.002:
                 _completion_cond_wait(0.001)
+            elif _MULTICORE and idle < 0.0003:
+                pass  # pure spin: yields cost ~100us under load
+            else:
+                time.sleep(0)  # single core: hand the CPU to the peer
         self._finish(status)
 
     def _finish(self, status: Optional[Status]) -> None:
@@ -113,12 +131,21 @@ class Request:
                 status: Optional[Status] = None) -> int:
         if not requests:
             return -1
+        idle_since = None
         while True:
             for i, r in enumerate(requests):
                 if r.is_complete:
                     r._finish(status)
                     return i
-            if not _progress_once():
+            if _progress_once():
+                idle_since = None
+                continue
+            now = time.monotonic()
+            if idle_since is None:
+                idle_since = now
+            if now - idle_since < 0.002:
+                time.sleep(0)
+            else:
                 _completion_cond_wait(0.001)
 
     @staticmethod

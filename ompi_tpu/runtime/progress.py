@@ -78,14 +78,29 @@ class ProgressThread:
         self._thread.start()
 
     def _run(self) -> None:
+        import time
+
         idle = 0
         while not self._stop.is_set():
-            if progress() > 0:
+            try:
+                made = progress()
+            except Exception:
+                # a transport bug must not silently kill async progress
+                from ompi_tpu.utils.output import get_logger
+
+                get_logger("runtime.progress").exception(
+                    "progress callback raised")
+                made = 0
+            if made > 0:
                 idle = 0
-            else:
+            elif idle < 1000:
+                # stay hot but yield the GIL between polls, so incoming
+                # traffic sees microsecond wake latency while app threads
+                # still run (reference: async progress threads busy-poll)
                 idle += 1
-                if idle > 16:
-                    self._stop.wait(self.interval)
+                time.sleep(0)
+            else:
+                self._stop.wait(self.interval)
 
     def stop(self) -> None:
         self._stop.set()

@@ -49,6 +49,7 @@ _log = get_logger("runtime")
 
 # import side effect: register built-in components
 import ompi_tpu.btl.self_btl  # noqa: F401,E402
+import ompi_tpu.btl.sm  # noqa: F401,E402
 import ompi_tpu.btl.tcp  # noqa: F401,E402
 import ompi_tpu.coll.self_coll  # noqa: F401,E402
 import ompi_tpu.coll.basic  # noqa: F401,E402

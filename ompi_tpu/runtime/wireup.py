@@ -37,27 +37,59 @@ def init_process_mode():
 
     # btl selection (reference: mca_pml_base_select opening BTLs via bml/r2)
     modules = btl_framework.select_all(deliver=pml.handle_incoming,
-                                      my_rank=rank)
+                                      my_rank=rank, n_ranks=size)
     by_name = {name: mod for _, name, mod in modules}
     self_btl = by_name.get("self")
+    sm = by_name.get("sm")
     tcp = by_name.get("tcp")
 
     # business card: how peers reach us (reference: the modex endpoint blob
     # every btl publishes)
     if tcp is not None:
         modex.put("btl.tcp.addr", f"{tcp.host}:{tcp.port}")
+    my_node = None
+    if sm is not None:
+        from ompi_tpu.btl.sm import node_id
+
+        my_node = node_id()
+        modex.put("btl.sm.seg", sm.seg_path)
+        modex.put("btl.sm.node", my_node)
     modex.fence()  # reference: PMIx_Fence_nb at instance.c:575-625
 
     if tcp is not None:
         peers = {r: modex.get(r, "btl.tcp.addr")
                  for r in range(size) if r != rank}
         tcp.set_peers(peers)
+    sm_peers = {}
+    if sm is not None:
+        for r in range(size):
+            if r == rank:
+                continue
+            try:
+                # post-fence, a missing card will never appear: don't wait
+                if modex.get(r, "btl.sm.node", timeout=0.0) != my_node:
+                    continue
+                seg = modex.get(r, "btl.sm.seg", timeout=0.0)
+                # boot_id matches across containers that share a kernel
+                # but not /dev/shm — only bind sm if the segment is
+                # actually reachable; otherwise fall through to tcp
+                if os.path.exists(seg):
+                    sm_peers[r] = seg
+            except Exception:
+                pass  # peer has no sm card (e.g. excluded via --mca btl)
+        sm.set_peers(sm_peers)
 
-    # add_procs: bind the best endpoint per peer (instance.c:730)
+    # add_procs: bind the best endpoint per peer, ordered by component
+    # priority + locality — the bml/r2 endpoint ordering (instance.c:730):
+    # self (loopback) > sm (same node) > tcp.
     if self_btl is not None:
         pml.add_endpoint(rank, self_btl)
     for r in range(size):
-        if r != rank and tcp is not None:
+        if r == rank:
+            continue
+        if r in sm_peers:
+            pml.add_endpoint(r, sm)
+        elif tcp is not None:
             pml.add_endpoint(r, tcp)
 
     for _, _, mod in modules:

@@ -1,0 +1,88 @@
+"""Shared-memory transport correctness under mpirun (reference analog:
+single-host MTT runs over `--mca btl sm,self`)."""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.core.status import Status
+
+
+def main() -> int:
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+
+    # every non-self endpoint must actually be the sm transport
+    for peer, btl in COMM_WORLD.pml.endpoints.items():
+        want = "self" if peer == r else "sm"
+        assert btl.NAME == want, (peer, btl.NAME)
+
+    # eager pt2pt ring
+    token = np.array([r], np.int64)
+    nxt, prv = (r + 1) % n, (r - 1) % n
+    if r == 0:
+        COMM_WORLD.Send(token, dest=nxt, tag=0)
+        COMM_WORLD.Recv(token, source=prv, tag=0)
+        assert token[0] == sum(range(n)), token
+    else:
+        COMM_WORLD.Recv(token, source=prv, tag=0)
+        token[0] += r
+        COMM_WORLD.Send(token, dest=nxt, tag=0)
+
+    # rendezvous path: 2 MB messages exceed the sm eager limit (64 KB)
+    big = np.arange(1 << 19, dtype=np.float32) * (r + 1)  # 2 MiB
+    if r == 0:
+        out = np.zeros_like(big)
+        st = Status()
+        COMM_WORLD.Recv(out, source=1, tag=9, status=st)
+        np.testing.assert_array_equal(out, np.arange(1 << 19,
+                                                     dtype=np.float32) * 2)
+        assert st.Get_count(ompi_tpu.FLOAT32) == 1 << 19
+    elif r == 1:
+        COMM_WORLD.Send(big, dest=0, tag=9)
+
+    # collectives over sm
+    acc = np.zeros(8, np.float64)
+    COMM_WORLD.Allreduce(np.full(8, float(r + 1)), acc, op=mpi_op.SUM)
+    assert acc[0] == n * (n + 1) / 2, acc
+    gathered = np.zeros(n, np.int32)
+    COMM_WORLD.Allgather(np.array([r], np.int32), gathered)
+    np.testing.assert_array_equal(gathered, np.arange(n))
+
+    # backpressure: many outstanding sends larger than one ring can hold
+    msgs = 16
+    chunk = np.full(1 << 16, float(r), np.float32)  # 256 KB each, 4 MB total
+    reqs = [COMM_WORLD.Isend(chunk, dest=nxt, tag=100 + i)
+            for i in range(msgs)]
+    outs = [np.zeros_like(chunk) for _ in range(msgs)]
+    rreqs = [COMM_WORLD.Irecv(outs[i], source=prv, tag=100 + i)
+             for i in range(msgs)]
+    ompi_tpu.Request.Waitall(reqs + rreqs)
+    for o in outs:
+        assert o[0] == float(prv), (o[0], prv)
+
+    # one-sided over sm with a payload larger than the ring (4 MB default):
+    # the system-tag plane ships single frames, exercising the overflow
+    # spill path (regression: r2 review — used to raise/hang)
+    if n >= 2:
+        from ompi_tpu.osc.window import Win
+
+        base = np.zeros(6 << 20 >> 3, np.float64)  # 6 MB window
+        win = Win.Create(base, COMM_WORLD)
+        win.Fence()
+        if r == 0:
+            win.Put(np.full(base.size, 7.0), target=1)
+        win.Fence()
+        if r == 1:
+            assert base[0] == 7.0 and base[-1] == 7.0, base[:2]
+        win.Free()
+
+    print(f"SM-OK rank {r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
