@@ -454,6 +454,82 @@ class XlaColl(CollModule):
         comm's mesh axis; XLA emits the transfers."""
         return comm.shard(x)
 
+    # ---------------------------------------------- neighborhood collectives
+    # Reference: the coll.h neighbor_* slots. On a mesh, a cart topology's
+    # neighbor exchange is exactly what the ICI torus is wired for: one
+    # collective-permute per direction, wraparound links for periodic dims,
+    # zero-fill standing in for MPI_PROC_NULL's undefined blocks.
+    def _cart_in_perms(self, comm):
+        """Per neighbor slot k: ppermute pairs (src -> me) for every rank
+        whose k-th in-neighbor exists."""
+        from ompi_tpu.topo import CartTopo
+
+        t = comm.topo
+        if not isinstance(t, CartTopo) or comm.groups is not None:
+            raise MPIError(
+                ERR_UNSUPPORTED_OPERATION,
+                "mesh neighbor collectives need a cartesian topology over "
+                "the whole mesh axis (graph topologies ride the host path)")
+        nbrs = [t.neighbors(me) for me in range(comm.world_size)]
+        perms = []
+        for k in range(2 * t.ndims):
+            pairs = [(nbrs[me][k], me) for me in range(comm.world_size)
+                     if nbrs[me][k] >= 0]
+            perms.append(tuple(pairs))
+        return perms
+
+    def neighbor_allgather(self, comm, x):
+        """[W, ...] -> [W, K, ...]: slot k carries the k-th neighbor's row
+        (cart order: per dim, negative then positive peer)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        perms = self._cart_in_perms(comm)
+        key = cache_key("neighbor_allgather")
+
+        def build():
+            axis = comm.axis
+
+            def body(b):
+                outs = [lax.ppermute(b[0], axis, p) for p in perms]
+                return jnp.stack(outs, axis=0)[None]
+
+            return self._wrap(comm, body)
+
+        return self._cached(comm, key, build)(x)
+
+    def neighbor_alltoall(self, comm, x):
+        """[W, K, ...] -> [W, K, ...]: block k goes to neighbor k; recv
+        block k arrives from neighbor k (who sent its opposite-direction
+        block along the same edge)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        perms = self._cart_in_perms(comm)
+        K = len(perms)
+        if x.ndim < 2 or x.shape[1] != K:
+            raise MPIError(
+                ERR_ARG,
+                f"neighbor_alltoall expects [world, {K}, ...], got "
+                f"{tuple(x.shape)}")
+        key = cache_key("neighbor_alltoall")
+
+        def build():
+            axis = comm.axis
+
+            def body(b):
+                blocks = b[0]  # [K, ...]
+                outs = []
+                for k in range(K):
+                    d, parity = divmod(k, 2)
+                    opp = 2 * d + (1 - parity)
+                    outs.append(lax.ppermute(blocks[opp], axis, perms[k]))
+                return jnp.stack(outs, axis=0)[None]
+
+            return self._wrap(comm, body)
+
+        return self._cached(comm, key, build)(x)
+
     # ------------------------------------------------------------- pt2pt
     def permute(self, comm, x, perm: Tuple[Tuple[int, int], ...]):
         """Collective permute along GLOBAL mesh ranks — the mesh-native

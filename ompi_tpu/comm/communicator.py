@@ -432,6 +432,82 @@ class ProcComm(Intracomm):
     def Free(self) -> None:
         self.coll = None
 
+    # ------------------------------------------------------------ topology
+    # Reference: ompi/mca/topo + the MPI cart/graph surface
+    # (topo_base_cart_*.c); constructors return a NEW communicator
+    # carrying the topology, like MPI_Cart_create.
+    def Create_cart(self, dims, periods=None, reorder=False):
+        from ompi_tpu.topo import cart_create_proc
+
+        return cart_create_proc(self, dims, periods, reorder)
+
+    def Create_graph(self, index, edges, reorder=False):
+        from ompi_tpu.topo import graph_create_proc
+
+        return graph_create_proc(self, index, edges, reorder)
+
+    def Create_dist_graph_adjacent(self, sources, destinations,
+                                   reorder=False):
+        from ompi_tpu.topo import dist_graph_adjacent_proc
+
+        return dist_graph_adjacent_proc(self, sources, destinations, reorder)
+
+    def Get_topology(self) -> int:
+        from ompi_tpu.topo import UNDEFINED as TOPO_UNDEFINED
+
+        return self.topo.kind if self.topo is not None else TOPO_UNDEFINED
+
+    def _cart(self):
+        from ompi_tpu.topo import CartTopo
+
+        if not isinstance(self.topo, CartTopo):
+            from ompi_tpu.core.errors import ERR_TOPOLOGY
+
+            raise MPIError(ERR_TOPOLOGY, "communicator has no cartesian "
+                                         "topology")
+        return self.topo
+
+    def Get_dim(self) -> int:
+        return self._cart().ndims
+
+    def Get_topo(self):
+        t = self._cart()
+        return t.dims, t.periods, t.coords(self.rank)
+
+    def Get_cart_rank(self, coords) -> int:
+        return self._cart().rank(coords)
+
+    def Get_coords(self, rank: Optional[int] = None):
+        return self._cart().coords(self.rank if rank is None else rank)
+
+    def Shift(self, direction: int, disp: int = 1) -> Tuple[int, int]:
+        """(source, dest) of a cart shift for THIS rank (MPI_Cart_shift)."""
+        return self._cart().shift(self.rank, direction, disp)
+
+    def Sub(self, remain_dims):
+        """MPI_Cart_sub: split into sub-cart comms over the kept dims."""
+        from ompi_tpu.topo import attach_sub_cart
+
+        t = self._cart()
+        colors, keys = t.sub_colors(remain_dims)
+        sub = self.Split(colors[self.rank], keys[self.rank])
+        if sub is not None:
+            attach_sub_cart(sub, t, remain_dims)
+        return sub
+
+    def Get_neighbors(self, rank: Optional[int] = None):
+        from ompi_tpu.topo import in_out_neighbors
+
+        srcs, _ = in_out_neighbors(
+            self.topo, self.rank if rank is None else rank)
+        return srcs
+
+    def Neighbor_allgather(self, sendbuf, recvbuf) -> None:
+        self._coll("neighbor_allgather")(self, sendbuf, recvbuf)
+
+    def Neighbor_alltoall(self, sendbuf, recvbuf) -> None:
+        self._coll("neighbor_alltoall")(self, sendbuf, recvbuf)
+
     # ULFM surface (reference: ompi/mpiext/ftmpi MPIX_Comm_*)
     def Revoke(self) -> None:
         from ompi_tpu.ft.revoke import revoke_comm

@@ -247,6 +247,92 @@ class XlaComm(Intracomm):
         perm = tuple((i, (i + steps) % n) for i in range(n))
         return self.permute(x, perm)
 
+    # ------------------------------------------------------------ topology
+    # Reference: ompi/mca/topo projected TPU-native — cart coordinates are
+    # a row-major reshape of the mesh axis, shifts are collective-permute
+    # rings riding the ICI torus (periodic dims = wraparound links).
+    def Create_cart(self, dims, periods=None, reorder=False) -> "XlaComm":
+        from ompi_tpu.topo import CartTopo
+
+        topo = CartTopo(dims, periods if periods is not None
+                        else [False] * len(dims))
+        if self.groups is not None:
+            raise MPIError(ERR_UNSUPPORTED_OPERATION,
+                           "create the cart from the whole-axis comm")
+        if topo.size != self.world_size:
+            raise MPIError(
+                ERR_ARG,
+                f"mesh cart must cover the whole axis: prod(dims)="
+                f"{topo.size} != {self.world_size} devices")
+        new = XlaComm(self.mesh, self.axis, None,
+                      name=f"{self.name}-cart")
+        new.topo = topo
+        from ompi_tpu.topo import _reselect_coll
+
+        _reselect_coll(new)
+        return new
+
+    def _cart(self):
+        from ompi_tpu.topo import CartTopo
+
+        if not isinstance(self.topo, CartTopo):
+            from ompi_tpu.core.errors import ERR_TOPOLOGY
+
+            raise MPIError(ERR_TOPOLOGY, "communicator has no cartesian "
+                                         "topology")
+        return self.topo
+
+    def Get_dim(self) -> int:
+        return self._cart().ndims
+
+    def Get_topo(self):
+        t = self._cart()
+        return t.dims, t.periods
+
+    def Get_cart_rank(self, coords) -> int:
+        return self._cart().rank(coords)
+
+    def Get_coords(self, rank: int):
+        return self._cart().coords(rank)
+
+    def cart_shift(self, x, direction: int, disp: int = 1):
+        """Data-level MPI_Cart_shift: every rank-row moves `disp` steps
+        along `direction`; rows shifted in from non-periodic edges are
+        zero (the ppermute boundary semantics standing in for
+        MPI_PROC_NULL's undefined buffer)."""
+        if self.groups is not None:
+            raise MPIError(ERR_UNSUPPORTED_OPERATION,
+                           "cart topologies cover the whole mesh axis")
+        t = self._cart()
+        pairs = []
+        for r in range(self.world_size):
+            _, dst = t.shift(r, direction, disp)
+            if dst >= 0:
+                pairs.append((r, dst))
+        return self.permute(x, tuple(pairs))
+
+    def Sub(self, remain_dims) -> "XlaComm":
+        """MPI_Cart_sub: one Split materializing every sub-cart color."""
+        from ompi_tpu.topo import attach_sub_cart
+
+        t = self._cart()
+        colors, keys = t.sub_colors(remain_dims)
+        sub = self.Split(colors, keys)
+        attach_sub_cart(sub, t, remain_dims)
+        return sub
+
+    def neighbor_allgather(self, x):
+        """[W, ...] -> [W, K, ...]: slot k holds the k-th cart neighbor's
+        row (zeros off non-periodic edges)."""
+        return self._slot("neighbor_allgather")(self, x)
+
+    def neighbor_alltoall(self, x):
+        """[W, K, ...] -> [W, K, ...]: block k goes to neighbor k."""
+        return self._slot("neighbor_alltoall")(self, x)
+
+    Neighbor_allgather = neighbor_allgather
+    Neighbor_alltoall = neighbor_alltoall
+
     # ------------------------------------------------------ comm management
     def Dup(self) -> "XlaComm":
         return XlaComm(self.mesh, self.axis, self.groups,

@@ -55,6 +55,7 @@ import ompi_tpu.coll.self_coll  # noqa: F401,E402
 import ompi_tpu.coll.basic  # noqa: F401,E402
 import ompi_tpu.coll.tuned  # noqa: F401,E402
 import ompi_tpu.coll.nbc  # noqa: F401,E402
+import ompi_tpu.coll.neighbor  # noqa: F401,E402
 
 
 def Init(required: int = THREAD_MULTIPLE) -> int:

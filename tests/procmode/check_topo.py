@@ -1,0 +1,105 @@
+"""Cartesian topology + neighborhood collectives at real ranks
+(reference analog: the cart tests of the mpi4py CI suite)."""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+
+PROC_NULL = -2
+
+
+def main() -> int:
+    n = COMM_WORLD.Get_size()
+    assert n == 4, "run with -np 4"
+
+    # 2x2 cart, x periodic, y not
+    cart = COMM_WORLD.Create_cart([2, 2], periods=[True, False])
+    r = cart.Get_rank()
+    cx, cy = cart.Get_coords()
+    assert cart.Get_cart_rank([cx, cy]) == r
+    assert cart.Get_dim() == 2
+    dims, periods, coords = cart.Get_topo()
+    assert dims == [2, 2] and periods == [True, False] and coords == [cx, cy]
+
+    # shift along periodic dim 0 always has both peers
+    src, dst = cart.Shift(0, 1)
+    assert src >= 0 and dst >= 0
+    assert cart.Get_coords(dst)[0] == (cx + 1) % 2
+    # non-periodic dim 1: edges get PROC_NULL
+    src1, dst1 = cart.Shift(1, 1)
+    assert (dst1 == PROC_NULL) == (cy == 1)
+    assert (src1 == PROC_NULL) == (cy == 0)
+
+    # halo exchange via Sendrecv along dim 0 (the classic cart pattern)
+    mine = np.array([float(r)], np.float64)
+    halo = np.zeros(1, np.float64)
+    cart.Sendrecv(mine, dst, 7, halo, src, 7)
+    assert halo[0] == float(src), (halo, src)
+
+    # neighbor_allgather: K=4 slots (dim0 -,+, dim1 -,+)
+    recv = np.full(4, -1.0, np.float64)
+    cart.Neighbor_allgather(mine, recv)
+    nbrs = cart.Get_neighbors()
+    for k, nb in enumerate(nbrs):
+        if nb != PROC_NULL:
+            assert recv[k] == float(nb), (k, nb, recv)
+        else:
+            assert recv[k] == -1.0  # untouched per MPI-3 7.6
+
+    # neighbor_alltoall: distinct block per neighbor
+    sendblocks = np.array([10 * r + k for k in range(4)], np.float64)
+    recvblocks = np.full(4, -1.0, np.float64)
+    cart.Neighbor_alltoall(sendblocks, recvblocks)
+    for k, nb in enumerate(nbrs):
+        if nb == PROC_NULL:
+            assert recvblocks[k] == -1.0
+        else:
+            d, parity = divmod(k, 2)
+            opp = 2 * d + (1 - parity)
+            assert recvblocks[k] == 10 * nb + opp, (k, nb, recvblocks)
+
+    # Cart_sub: keep dim 1 -> two 1-D comms of size 2
+    sub = cart.Sub([False, True])
+    assert sub.Get_size() == 2
+    assert sub.Get_topo()[0] == [2]
+    tot = np.zeros(1, np.float64)
+    sub.Allreduce(mine, tot)
+    # members of my row: same cx
+    row_sum = sum(cart.Get_cart_rank([cx, y]) for y in range(2))
+    assert tot[0] == row_sum, (tot, row_sum)
+
+    # graph topology: ring graph 0-1-2-3
+    index = [2, 4, 6, 8]
+    edges = [3, 1, 0, 2, 1, 3, 2, 0]
+    g = COMM_WORLD.Create_graph(index, edges)
+    gr = g.Get_rank()
+    gout = np.full(2, -1.0, np.float64)
+    g.Neighbor_allgather(np.array([float(gr)], np.float64), gout)
+    want = [(gr - 1) % 4, (gr + 1) % 4]
+    assert sorted(gout) == sorted(float(w) for w in want), (gout, want)
+
+    # dist-graph adjacent: each rank's neighbors are (r-1, r+1) mod n,
+    # with r+1 listed twice to exercise the duplicated-edge FIFO rule
+    wr = COMM_WORLD.Get_rank()
+    nxt, prv = (wr + 1) % n, (wr - 1) % n
+    dg = COMM_WORLD.Create_dist_graph_adjacent(
+        sources=[prv, nxt, nxt], destinations=[nxt, prv, prv])
+    dgout = np.full(3, -1.0, np.float64)
+    dg.Neighbor_alltoall(
+        np.array([wr * 100 + 0, wr * 100 + 1, wr * 100 + 2], np.float64),
+        dgout)
+    # my sources slot 0 = prv (its block 0 targeted nxt=me);
+    # slots 1, 2 = nxt (its blocks 1 then 2 target prv=me, FIFO order)
+    assert dgout[0] == prv * 100 + 0, dgout
+    assert dgout[1] == nxt * 100 + 1, dgout
+    assert dgout[2] == nxt * 100 + 2, dgout
+
+    print(f"TOPO-OK rank {COMM_WORLD.Get_rank()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

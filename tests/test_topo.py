@@ -1,0 +1,145 @@
+"""Topology layer: cart/graph math, mesh-mode cart + neighbor collectives,
+and process-mode integration.
+
+Reference: ompi/mca/topo base cart math (topo_base_cart_*.c),
+MPI_Dims_create (dims_create.c.in), neighbor collective semantics
+(coll.h:545-620, MPI-3 §7.6).
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.core.errors import MPIError
+from ompi_tpu.topo import CartTopo, Dims_create, GraphTopo, PROC_NULL
+from tests.test_process_mode import run_mpi
+
+
+# ------------------------------------------------------------- unit: math
+def test_dims_create():
+    assert Dims_create(8, 3) == [2, 2, 2]
+    assert Dims_create(12, 2) == [4, 3]
+    assert Dims_create(6, 2, [3, 0]) == [3, 2]
+    assert Dims_create(7, 1) == [7]
+    assert Dims_create(1, 2) == [1, 1]
+    with pytest.raises(MPIError):
+        Dims_create(7, 2, [2, 0])  # 7 not divisible by 2
+
+
+def test_cart_rank_coords_roundtrip():
+    t = CartTopo([2, 3, 4], [False, True, False])
+    for r in range(t.size):
+        assert t.rank(t.coords(r)) == r
+    assert t.coords(0) == [0, 0, 0]
+    assert t.coords(t.size - 1) == [1, 2, 3]
+    # periodic wrap in dim 1
+    assert t.rank([0, 3, 0]) == t.rank([0, 0, 0])
+    with pytest.raises(MPIError):
+        t.rank([2, 0, 0])  # out of range, non-periodic
+
+
+def test_cart_shift():
+    t = CartTopo([4], [True])
+    assert t.shift(0, 0, 1) == (3, 1)
+    t2 = CartTopo([4], [False])
+    assert t2.shift(0, 0, 1) == (PROC_NULL, 1)
+    assert t2.shift(3, 0, 1) == (2, PROC_NULL)
+    assert t2.shift(1, 0, 2) == (PROC_NULL, 3)
+
+
+def test_cart_neighbors_order():
+    t = CartTopo([2, 2], [True, True])
+    # rank 0 = (0,0): dim0 -/+ -> (1,0)=2 both; dim1 -/+ -> (0,1)=1 both
+    assert t.neighbors(0) == [2, 2, 1, 1]
+
+
+def test_graph_neighbors():
+    g = GraphTopo([2, 4, 6], [1, 2, 0, 2, 0, 1])  # triangle
+    assert g.neighbors(0) == [1, 2]
+    assert g.neighbors(2) == [0, 1]
+
+
+# ------------------------------------------------------- mesh-mode (8 dev)
+@pytest.fixture(scope="module")
+def world():
+    from ompi_tpu.parallel import mesh_world
+
+    return mesh_world()
+
+
+@pytest.fixture(scope="module")
+def cart24(world):
+    return world.Create_cart([2, 4], periods=[True, True])
+
+
+def test_mesh_cart_create(world, cart24):
+    assert cart24.Get_dim() == 2
+    assert cart24.Get_topo() == ([2, 4], [True, True])
+    assert cart24.Get_cart_rank([1, 2]) == 6
+    assert cart24.Get_coords(6) == [1, 2]
+    with pytest.raises(MPIError):
+        world.Create_cart([3, 3])  # doesn't cover the axis
+
+
+def test_mesh_cart_shift_data(cart24):
+    x = cart24.shard(np.arange(8, dtype=np.float32)[:, None])
+    y = np.asarray(cart24.cart_shift(x, 1, 1))  # +1 along dim1 (periodic)
+    t = cart24._cart()
+    for r in range(8):
+        src, _ = t.shift(r, 1, 1)
+        assert y[r, 0] == float(src)
+
+
+def test_mesh_cart_shift_nonperiodic_zero_fill(world):
+    cart = world.Create_cart([8], periods=[False])
+    x = cart.shard(np.arange(8, dtype=np.float32)[:, None] + 1)
+    y = np.asarray(cart.cart_shift(x, 0, 1))
+    assert y[0, 0] == 0.0  # nothing shifts into the edge
+    np.testing.assert_array_equal(y[1:, 0], np.arange(1, 8) + 0.0)
+
+
+def test_mesh_neighbor_allgather_halo(cart24):
+    """The cart halo exchange on the 8-device mesh (VERDICT r1 item 6
+    done-criterion)."""
+    x = cart24.shard(np.arange(8, dtype=np.float32)[:, None])
+    out = np.asarray(cart24.neighbor_allgather(x))  # [8, 4, 1]
+    t = cart24._cart()
+    for r in range(8):
+        for k, nb in enumerate(t.neighbors(r)):
+            assert out[r, k, 0] == float(nb), (r, k)
+
+
+def test_mesh_neighbor_alltoall(cart24):
+    t = cart24._cart()
+    x = np.zeros((8, 4, 1), np.float32)
+    for r in range(8):
+        for k in range(4):
+            x[r, k, 0] = 10 * r + k
+    out = np.asarray(cart24.neighbor_alltoall(cart24.shard(x)))
+    for r in range(8):
+        for k, nb in enumerate(t.neighbors(r)):
+            d, parity = divmod(k, 2)
+            opp = 2 * d + (1 - parity)
+            assert out[r, k, 0] == 10 * nb + opp, (r, k)
+
+
+def test_mesh_cart_sub(world):
+    cart = world.Create_cart([2, 4], periods=[False, False])
+    sub = cart.Sub([False, True])  # 2 rows of 4
+    assert sub.size == 4
+    x = sub.shard(np.ones((8, 1), np.float32))
+    out = np.asarray(sub.allreduce(x))
+    np.testing.assert_array_equal(out[:, 0], np.full(8, 4.0))
+
+
+def test_mesh_neighbor_needs_cart(world):
+    x = world.shard(np.arange(8, dtype=np.float32)[:, None])
+    with pytest.raises(MPIError):
+        world.neighbor_allgather(x)
+
+
+# ------------------------------------------------------------ process mode
+def test_topo_procmode_4_ranks():
+    r = run_mpi(4, "tests/procmode/check_topo.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("TOPO-OK") == 4
