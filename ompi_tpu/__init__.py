@@ -97,6 +97,8 @@ UNDEFINED = -32766
 
 from ompi_tpu.accelerator import DeviceBuffer
 from ompi_tpu.comm.communicator import Communicator, Intracomm
+from ompi_tpu.comm.intercomm import Intercomm, Intercomm_create
+from ompi_tpu.runtime.dpm import Comm_get_parent
 from ompi_tpu.runtime.state import (
     Init,
     Finalize,

@@ -65,9 +65,13 @@ class SmBtl(Btl):
     NAME = "sm"
 
     def __init__(self, deliver: Callable[[bytes, bytes], None],
-                 my_rank: int, n_ranks: int):
+                 my_rank: int, n_ranks: int,
+                 local_rank: Optional[int] = None):
         super().__init__(deliver)
-        self.my_rank = my_rank
+        self.my_rank = my_rank            # universe rank (identity)
+        # ring index inside same-job peers' segments (job-local; dynamic
+        # processes from other jobs ride tcp instead — see wireup)
+        self.local_rank = my_rank if local_rank is None else local_rank
         self.n_ranks = n_ranks
         self.eager_limit = get_var("btl_sm", "eager_limit")
         self.ring_bytes = int(get_var("btl_sm", "ring_bytes"))
@@ -119,9 +123,9 @@ class SmBtl(Btl):
         finally:
             os.close(fd)
         magic, nranks, ring_bytes = _SEG_HDR.unpack_from(mm, 0)
-        if magic != _SEG_MAGIC or self.my_rank >= nranks:
+        if magic != _SEG_MAGIC or self.local_rank >= nranks:
             raise RuntimeError(f"bad sm segment {path}")
-        ring = SmRing(mm, 64 + self.my_rank * ring_bytes, ring_bytes,
+        ring = SmRing(mm, 64 + self.local_rank * ring_bytes, ring_bytes,
                       use_native=self.use_native)
         self._out[peer] = (mm, ring)
         return ring
@@ -250,11 +254,12 @@ class SmBtlComponent(Component):
     NAME = "sm"
     PRIORITY = 30  # above tcp (20): same-host peers prefer shared memory
 
-    def query(self, deliver=None, my_rank=None, n_ranks=None, **ctx):
+    def query(self, deliver=None, my_rank=None, n_ranks=None,
+              local_rank=None, **ctx):
         if deliver is None or my_rank is None or n_ranks is None:
             return None
         try:
-            return SmBtl(deliver, my_rank, n_ranks)
+            return SmBtl(deliver, my_rank, n_ranks, local_rank)
         except OSError:
             return None
 

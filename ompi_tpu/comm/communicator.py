@@ -141,6 +141,28 @@ class Communicator:
         if self.revoked:
             raise MPIError(ERR_REVOKED, self.name)
 
+    # --------------------------------------------- topology (shared core)
+    # Reference: ompi/mca/topo base accessors; the rank-specific pieces
+    # (Get_coords/Shift/Sub) live on the concrete comm kinds.
+    def Get_topology(self) -> int:
+        return self.topo.kind if self.topo is not None else UNDEFINED
+
+    def _cart(self):
+        from ompi_tpu.topo import CartTopo
+
+        if not isinstance(self.topo, CartTopo):
+            from ompi_tpu.core.errors import ERR_TOPOLOGY
+
+            raise MPIError(ERR_TOPOLOGY, "communicator has no cartesian "
+                                         "topology")
+        return self.topo
+
+    def Get_dim(self) -> int:
+        return self._cart().ndims
+
+    def Get_cart_rank(self, coords) -> int:
+        return self._cart().rank(coords)
+
     def _check_root(self, root: int) -> None:
         if not 0 <= root < self.size:
             raise MPIError(ERR_RANK, f"root {root} out of range")
@@ -452,30 +474,9 @@ class ProcComm(Intracomm):
 
         return dist_graph_adjacent_proc(self, sources, destinations, reorder)
 
-    def Get_topology(self) -> int:
-        from ompi_tpu.topo import UNDEFINED as TOPO_UNDEFINED
-
-        return self.topo.kind if self.topo is not None else TOPO_UNDEFINED
-
-    def _cart(self):
-        from ompi_tpu.topo import CartTopo
-
-        if not isinstance(self.topo, CartTopo):
-            from ompi_tpu.core.errors import ERR_TOPOLOGY
-
-            raise MPIError(ERR_TOPOLOGY, "communicator has no cartesian "
-                                         "topology")
-        return self.topo
-
-    def Get_dim(self) -> int:
-        return self._cart().ndims
-
     def Get_topo(self):
         t = self._cart()
         return t.dims, t.periods, t.coords(self.rank)
-
-    def Get_cart_rank(self, coords) -> int:
-        return self._cart().rank(coords)
 
     def Get_coords(self, rank: Optional[int] = None):
         return self._cart().coords(self.rank if rank is None else rank)
@@ -507,6 +508,26 @@ class ProcComm(Intracomm):
 
     def Neighbor_alltoall(self, sendbuf, recvbuf) -> None:
         self._coll("neighbor_alltoall")(self, sendbuf, recvbuf)
+
+    # -------------------------------------------------- dynamic processes
+    def Spawn(self, command: str, args=(), maxprocs: int = 1,
+              root: int = 0, info=None):
+        """MPI_Comm_spawn: launch a child job, return the intercomm to it
+        (reference: ompi/dpm/dpm.c)."""
+        from ompi_tpu.runtime.dpm import spawn
+
+        return spawn(self, command, args, maxprocs, root, info)
+
+    def Create_intercomm(self, local_leader: int, peer_comm,
+                         remote_leader: int, tag: int = 0):
+        """MPI_Intercomm_create (reference: comm.c:1655)."""
+        from ompi_tpu.comm.intercomm import Intercomm_create
+
+        return Intercomm_create(self, local_leader, peer_comm,
+                                remote_leader, tag)
+
+    def Is_inter(self) -> bool:
+        return False
 
     # ULFM surface (reference: ompi/mpiext/ftmpi MPIX_Comm_*)
     def Revoke(self) -> None:

@@ -1,0 +1,315 @@
+"""Intercommunicators.
+
+Reference: ompi_intercomm_create (ompi/communicator/comm.c:1655) — two
+intracomm groups bridged by a leader pair; pt2pt addresses the REMOTE
+group; collectives follow the rooted/inter semantics implemented by
+mca/coll/inter (local reduce → leader exchange → local bcast).
+
+TPU-native note: intercomms exist for the host/DCN control plane
+(coupled apps, spawn). Device bulk data between jobs still rides the
+mesh path within each job; the intercomm moves host buffers over the
+pml exactly like the reference's OOB-bridged inter traffic.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.comm.communicator import (
+    ANY_SOURCE,
+    ANY_TAG,
+    PROC_NULL,
+    Communicator,
+    ProcComm,
+    _bump_local_cid,
+    _next_local_cid,
+    parse_buffer,
+)
+from ompi_tpu.core import op as _op
+from ompi_tpu.core.datatype import BYTE, INT64
+from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_RANK
+from ompi_tpu.core.group import Group
+from ompi_tpu.core.request import Request
+from ompi_tpu.core.status import Status
+
+ROOT = -3
+
+# Leader-handshake plane: its own CID bit so intercomm bootstrap traffic
+# (which predates the agreed CID) can never cross-match user traffic.
+DPM_CID_BIT = 1 << 27
+
+_TAG_XCHG = 0  # handshake messages ride (DPM_CID_BIT | tag) with seq'd tags
+
+
+def _leader_exchange(pml, peer: int, tag: int, payload: bytes,
+                     cid: int = DPM_CID_BIT) -> bytes:
+    """Symmetric sendrecv of a variable-size blob with a cross-world
+    leader (length prefix + body; per-peer FIFO keeps them paired).
+    Tags must be NON-NEGATIVE: the DPM plane shares the pml with the
+    system-tag band (<= -4000), so negative tags are reserved."""
+    hdr = struct.pack("<Q", len(payload))
+    rlen = np.zeros(8, np.uint8)
+    rl_req = pml.irecv(rlen, 8, BYTE, peer, tag, cid)
+    pml.isend(np.frombuffer(hdr, np.uint8), 8, BYTE, peer, tag, cid).Wait()
+    rl_req.Wait()
+    n = struct.unpack("<Q", rlen.tobytes())[0]
+    body = np.zeros(max(n, 1), np.uint8)
+    rb_req = pml.irecv(body, n, BYTE, peer, tag, cid)
+    pml.isend(np.frombuffer(payload, np.uint8), len(payload), BYTE,
+              peer, tag, cid).Wait()
+    rb_req.Wait()
+    return body[:n].tobytes()
+
+
+class Intercomm(Communicator):
+    """Two groups, one communication context. ``group`` is the LOCAL
+    group (universe ranks); ``remote_ranks[i]`` is remote rank i's
+    universe rank."""
+
+    def __init__(self, local_comm: ProcComm, remote_ranks: Sequence[int],
+                 cid: int, name: str = ""):
+        super().__init__(local_comm.group, cid, name or f"intercomm-{cid}")
+        self.local_comm = local_comm
+        self.remote_ranks = [int(r) for r in remote_ranks]
+        self.pml = local_comm.pml
+        self.rank = local_comm.rank
+
+    # ------------------------------------------------------------- queries
+    def Get_rank(self) -> int:
+        return self.rank
+
+    def Is_inter(self) -> bool:
+        return True
+
+    def Get_remote_size(self) -> int:
+        return len(self.remote_ranks)
+
+    def Get_remote_group(self) -> Group:
+        return Group(self.remote_ranks)
+
+    # --------------------------------------------------------------- pt2pt
+    # dest/source are REMOTE-group ranks (MPI inter semantics)
+    def _remote_urank(self, r: int) -> int:
+        if not 0 <= r < len(self.remote_ranks):
+            raise MPIError(ERR_RANK, f"remote rank {r} out of range")
+        return self.remote_ranks[r]
+
+    def Isend(self, buf, dest: int, tag: int = 0) -> Request:
+        self._check_usable()
+        if dest == PROC_NULL:
+            from ompi_tpu.core.request import CompletedRequest
+
+            return CompletedRequest()
+        obj, count, dt = parse_buffer(buf)
+        return self.pml.isend(obj, count, dt, self._remote_urank(dest),
+                              tag, self.cid)
+
+    def Irecv(self, buf, source: int = ANY_SOURCE,
+              tag: int = ANY_TAG) -> Request:
+        self._check_usable()
+        if source == PROC_NULL:
+            from ompi_tpu.core.request import CompletedRequest
+
+            return CompletedRequest()
+        obj, count, dt = parse_buffer(buf)
+        wsrc = (ANY_SOURCE if source == ANY_SOURCE
+                else self._remote_urank(source))
+        req = self.pml.irecv(obj, count, dt, wsrc, tag, self.cid)
+        req.add_completion_callback(self._fix_status_source)
+        return req
+
+    def _fix_status_source(self, req) -> None:
+        if req.status.source >= 0:
+            try:
+                req.status.source = self.remote_ranks.index(
+                    req.status.source)
+            except ValueError:
+                pass
+
+    def Send(self, buf, dest: int, tag: int = 0) -> None:
+        self.Isend(buf, dest, tag).Wait()
+
+    def Recv(self, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             status: Optional[Status] = None) -> None:
+        self.Irecv(buf, source, tag).Wait(status)
+
+    # --------------------------------------------- inter collectives
+    # Reference: mca/coll/inter — rooted ops bridge through the leader
+    # pair; "all" ops are local-reduce -> leader exchange -> local bcast,
+    # and per MPI inter semantics each side receives the REMOTE group's
+    # contribution. Leader traffic rides the DPM plane scoped by the
+    # intercomm's cid (DPM_CID_BIT | cid) so concurrent collectives on
+    # different intercomms between the same leader pair never
+    # cross-match.
+    _TAG_COLL = 80
+
+    def _coll_cid(self) -> int:
+        return DPM_CID_BIT | self.cid
+
+    def _is_leader(self) -> bool:
+        return self.rank == 0
+
+    def _remote_leader(self) -> int:
+        return self.remote_ranks[0]
+
+    def Barrier(self) -> None:
+        self.local_comm.Barrier()
+        if self._is_leader():
+            _leader_exchange(self.pml, self._remote_leader(),
+                             self._TAG_COLL, b"B", cid=self._coll_cid())
+        self.local_comm.Barrier()
+
+    def Bcast(self, buf, root) -> None:
+        """root group: the root passes ROOT, others PROC_NULL; receiving
+        group passes the root's rank WITHIN THE REMOTE GROUP."""
+        if root == PROC_NULL:
+            return
+        obj, count, dt = parse_buffer(buf)
+        if root == ROOT:
+            packed = np.asarray(obj).reshape(-1).view(np.uint8)
+            self.pml.isend(packed, packed.nbytes, BYTE,
+                           self._remote_leader(), self._TAG_COLL,
+                           self._coll_cid()).Wait()
+            return
+        if self._is_leader():
+            view = np.asarray(obj).reshape(-1).view(np.uint8)
+            self.pml.irecv(view, view.nbytes, BYTE,
+                           self._remote_urank(root), self._TAG_COLL,
+                           self._coll_cid()).Wait()
+        self.local_comm.Bcast(buf, root=0)
+
+    def Allreduce(self, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> None:
+        """Each side receives the reduction of the REMOTE group's data
+        (MPI-3 §5.2.2)."""
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        local_red = np.zeros_like(np.asarray(sobj))
+        self.local_comm.Reduce(sendbuf, local_red, op=op, root=0)
+        if self._is_leader():
+            mine = local_red.reshape(-1).view(np.uint8)
+            theirs = _leader_exchange(self.pml, self._remote_leader(),
+                                      self._TAG_COLL, mine.tobytes(),
+                                      cid=self._coll_cid())
+            out = np.frombuffer(theirs, dtype=local_red.dtype).reshape(
+                local_red.shape)
+            np.asarray(robj).reshape(-1)[:] = out.reshape(-1)
+        self.local_comm.Bcast(recvbuf, root=0)
+
+    def Allgather(self, sendbuf, recvbuf) -> None:
+        """recvbuf gets the REMOTE group's concatenated contributions."""
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        n = self.local_comm.size
+        flat = np.asarray(sobj).reshape(-1)
+        gathered = np.zeros(n * flat.size, flat.dtype)
+        self.local_comm.Gather(flat, gathered, root=0)
+        if self._is_leader():
+            theirs = _leader_exchange(
+                self.pml, self._remote_leader(), self._TAG_COLL,
+                gathered.view(np.uint8).tobytes(), cid=self._coll_cid())
+            out = np.frombuffer(theirs, dtype=flat.dtype)
+            rv = np.asarray(robj).reshape(-1)
+            if out.size != rv.size:
+                raise MPIError(ERR_ARG,
+                               f"recvbuf size {rv.size} != remote total "
+                               f"{out.size}")
+            rv[:] = out
+        self.local_comm.Bcast(recvbuf, root=0)
+
+    # ------------------------------------------------------------- merge
+    def Merge(self, high: bool = False) -> ProcComm:
+        """MPI_Intercomm_merge: one intracomm over both groups; the
+        `high` side's ranks follow the low side's (comm.c
+        ompi_intercomm_merge)."""
+        local = [self.group.world_rank(i) for i in range(self.size)]
+        # agree on a fresh cid across BOTH sides
+        lnext = np.array([_next_local_cid()], np.int64)
+        lmax = np.zeros(1, np.int64)
+        self.local_comm.Allreduce(lnext, lmax, op=_op.MAX)
+        if self._is_leader():
+            theirs = _leader_exchange(
+                self.pml, self._remote_leader(), self._TAG_COLL + 1,
+                json.dumps({"cid": int(lmax[0]), "high": bool(high)})
+                .encode(), cid=self._coll_cid())
+            rinfo = json.loads(theirs)
+            if rinfo["high"] == bool(high):
+                raise MPIError(ERR_ARG,
+                               "Merge: both sides passed the same `high`")
+            blob = json.dumps(
+                {"cid": max(int(lmax[0]), int(rinfo["cid"]))}).encode()
+        else:
+            blob = b""
+        blob_arr = np.zeros(64, np.uint8)
+        if self._is_leader():
+            blob_arr[: len(blob)] = np.frombuffer(blob, np.uint8)
+        self.local_comm.Bcast(blob_arr, root=0)
+        cid = int(json.loads(bytes(blob_arr).rstrip(b"\0").decode())["cid"])
+        _bump_local_cid(cid)
+        merged = (self.remote_ranks + local) if high else \
+            (local + self.remote_ranks)
+        return ProcComm(Group(merged), cid, self.pml,
+                        name=f"{self.name}-merged")
+
+    def Free(self) -> None:
+        pass
+
+
+def intercomm_create(local_comm: ProcComm, local_leader: int,
+                     remote_leader_urank: int, tag: int = 0) -> Intercomm:
+    """Build an intercomm from a local intracomm and the UNIVERSE rank of
+    the remote side's leader (the dpm/spawn entry point; the MPI-surface
+    Intercomm_create with a peer_comm resolves remote_leader through it
+    first — comm.c:1655)."""
+    pml = local_comm.pml
+    # local CID ceiling (every member must be clear of the agreed cid)
+    lnext = np.array([_next_local_cid()], np.int64)
+    lmax = np.zeros(1, np.int64)
+    local_comm.Allreduce(lnext, lmax, op=_op.MAX)
+    payload = b""
+    exchange_err = None
+    if local_comm.rank == local_leader:
+        try:
+            my_ranks = [local_comm.group.world_rank(i)
+                        for i in range(local_comm.size)]
+            blob = json.dumps({"ranks": my_ranks,
+                               "cid": int(lmax[0])}).encode()
+            theirs = json.loads(_leader_exchange(
+                pml, remote_leader_urank, 1000 + tag, blob))
+            cid = max(int(lmax[0]), int(theirs["cid"]))
+            payload = json.dumps(
+                {"remote": theirs["ranks"], "cid": cid}).encode()
+        except Exception as e:
+            exchange_err = e
+    # leader bcasts (remote group, cid) — or a failure marker, so a dead
+    # remote leader cannot strand the non-leaders in this Bcast
+    size_arr = np.array(
+        [-1 if exchange_err is not None else len(payload)], np.int64)
+    local_comm.Bcast(size_arr, root=local_leader)
+    if int(size_arr[0]) < 0:
+        if exchange_err is not None:
+            raise exchange_err
+        raise MPIError(ERR_ARG,
+                       "intercomm handshake failed at the local leader")
+    buf = np.zeros(max(int(size_arr[0]), 1), np.uint8)
+    if local_comm.rank == local_leader:
+        buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+    local_comm.Bcast(buf, root=local_leader)
+    info = json.loads(buf.tobytes()[: int(size_arr[0])].decode())
+    _bump_local_cid(int(info["cid"]))
+    return Intercomm(local_comm, info["remote"], int(info["cid"]))
+
+
+def Intercomm_create(local_comm: ProcComm, local_leader: int,
+                     peer_comm: Optional[ProcComm], remote_leader: int,
+                     tag: int = 0) -> Intercomm:
+    """The MPI-surface constructor: peer_comm/remote_leader are
+    significant ONLY at the local leader (MPI-3 §6.6.2) — non-leaders
+    may pass placeholders."""
+    urank = -1
+    if local_comm.rank == local_leader:
+        urank = peer_comm._world_rank(remote_leader)
+    return intercomm_create(local_comm, local_leader, urank, tag)

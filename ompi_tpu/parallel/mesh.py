@@ -272,25 +272,12 @@ class XlaComm(Intracomm):
         _reselect_coll(new)
         return new
 
-    def _cart(self):
-        from ompi_tpu.topo import CartTopo
-
-        if not isinstance(self.topo, CartTopo):
-            from ompi_tpu.core.errors import ERR_TOPOLOGY
-
-            raise MPIError(ERR_TOPOLOGY, "communicator has no cartesian "
-                                         "topology")
-        return self.topo
-
-    def Get_dim(self) -> int:
-        return self._cart().ndims
-
     def Get_topo(self):
+        """(dims, periods, None): the driver holds every rank, so there
+        is no calling-process coords entry (same 3-tuple arity as the
+        host path)."""
         t = self._cart()
-        return t.dims, t.periods
-
-    def Get_cart_rank(self, coords) -> int:
-        return self._cart().rank(coords)
+        return t.dims, t.periods, None
 
     def Get_coords(self, rank: int):
         return self._cart().coords(rank)

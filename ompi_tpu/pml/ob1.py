@@ -71,8 +71,17 @@ class Ob1Pml:
         """BML add_procs analog: bind the best transport for a peer."""
         self.endpoints[rank] = btl
 
+    # Lazy endpoint resolution for peers outside the initial add_procs
+    # set (spawned jobs, connect/accept) — set by wireup (reference:
+    # ob1's add_procs called again from dpm for dynamic processes).
+    endpoint_resolver = None
+
     def _btl_for(self, rank: int):
         btl = self.endpoints.get(rank)
+        if btl is None and self.endpoint_resolver is not None:
+            btl = self.endpoint_resolver(rank)
+            if btl is not None:
+                self.endpoints[rank] = btl
         if btl is None:
             raise MPIError(ERR_RANK, f"no endpoint for rank {rank}")
         return btl

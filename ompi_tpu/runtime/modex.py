@@ -10,8 +10,13 @@ fence, then read peers' cards to wire endpoints.
 Protocol (one JSON object per line, one TCP connection per rank):
   {"op": "put",   "rank": r, "key": k, "val": v}   -> {"ok": true}
   {"op": "get",   "rank": r, "key": k}             -> {"val": v} | {"missing": true}
-  {"op": "fence", "rank": r}                       -> {"ok": true}  (blocks
-       the reply until all `size` ranks have entered the fence)
+  {"op": "fence", "rank": r, "job": j}             -> {"ok": true}  (blocks
+       the reply until all ranks of job j have entered the fence)
+  {"op": "spawn", "nprocs": k}                     -> {"job": j, "base": b}
+       (dynamic processes: allocates a new job of k universe ranks
+       starting at b — reference: PMIx_Spawn inside MPI_Comm_spawn,
+       dpm.c; ranks are "universe ranks" so one flat namespace covers
+       every job's keys and transport endpoints)
   {"op": "abort", "rank": r, "msg": m}             -> {"ok": true}  (flags
        job abort; subsequent fences fail fast — reference: PMIx_Abort)
 """
@@ -34,8 +39,12 @@ class ModexServer:
         self.size = size
         self.kv: Dict[Tuple[int, str], Any] = {}
         self.kv_cond = threading.Condition()
-        self.fence_gen = 0
-        self.fence_count = 0
+        # per-job fence domains; job 0 is the initial world
+        self.jobs: Dict[int, Dict[str, int]] = {
+            0: {"size": size, "gen": 0, "count": 0}
+        }
+        self.next_job = 1
+        self.next_base = size
         self.fence_cond = threading.Condition()
         self.aborted: Optional[str] = None
         self.log = get_logger("runtime.modex")
@@ -99,21 +108,36 @@ class ModexServer:
                     return {"val": self.kv[key]}
             return {"missing": True}
         if op == "fence":
+            jid = int(msg.get("job", 0))
             with self.fence_cond:
-                gen = self.fence_gen
-                self.fence_count += 1
-                if self.fence_count >= self.size:
-                    self.fence_count = 0
-                    self.fence_gen += 1
+                job = self.jobs.get(jid)
+                if job is None:
+                    return {"error": f"unknown job {jid}"}
+                gen = job["gen"]
+                job["count"] += 1
+                if job["count"] >= job["size"]:
+                    job["count"] = 0
+                    job["gen"] += 1
                     self.fence_cond.notify_all()
                 else:
-                    while (self.fence_gen == gen
+                    while (job["gen"] == gen
                            and self.aborted is None
                            and not self._stop.is_set()):
                         self.fence_cond.wait(0.5)
             if self.aborted is not None:
                 return {"error": f"job aborted: {self.aborted}"}
             return {"ok": True}
+        if op == "spawn":
+            k = int(msg["nprocs"])
+            if k <= 0:
+                return {"error": f"bad nprocs {k}"}
+            with self.fence_cond:
+                jid = self.next_job
+                self.next_job += 1
+                base = self.next_base
+                self.next_base += k
+                self.jobs[jid] = {"size": k, "gen": 0, "count": 0}
+            return {"job": jid, "base": base}
         if op == "abort":
             self.aborted = str(msg.get("msg", "unknown"))
             with self.fence_cond:
@@ -135,10 +159,11 @@ class ModexClient:
     """Per-rank connection (reference analog: PMIx_Init's server link)."""
 
     def __init__(self, address: str, rank: int, size: int,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, job: int = 0):
         host, port = address.rsplit(":", 1)
-        self.rank = rank
+        self.rank = rank  # universe rank
         self.size = size
+        self.job = job
         self.timeout = timeout
         self._lock = threading.Lock()
         deadline = time.monotonic() + timeout
@@ -179,8 +204,15 @@ class ModexClient:
             time.sleep(0.01)
 
     def fence(self) -> None:
-        """Block until every rank fences (reference: PMIx_Fence)."""
-        self._rpc({"op": "fence", "rank": self.rank})
+        """Block until every rank of MY JOB fences (reference:
+        PMIx_Fence over the job's nspace)."""
+        self._rpc({"op": "fence", "rank": self.rank, "job": self.job})
+
+    def spawn(self, nprocs: int) -> Tuple[int, int]:
+        """Allocate a new job of `nprocs` universe ranks; returns
+        (job id, universe base rank) — reference: PMIx_Spawn."""
+        resp = self._rpc({"op": "spawn", "nprocs": nprocs})
+        return int(resp["job"]), int(resp["base"])
 
     def abort(self, msg: str) -> None:
         try:

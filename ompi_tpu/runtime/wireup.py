@@ -31,13 +31,20 @@ def init_process_mode():
     rank = int(os.environ["OMPI_TPU_RANK"])
     size = int(os.environ["OMPI_TPU_SIZE"])
     modex_addr = os.environ["OMPI_TPU_MODEX"]
+    # dynamic-process support (reference: PMIx nspace + job-level rank):
+    # spawned jobs live at a universe-rank offset so every transport
+    # endpoint and modex key stays in one flat namespace
+    base = int(os.environ.get("OMPI_TPU_BASE", "0"))
+    job = int(os.environ.get("OMPI_TPU_JOB", "0"))
+    urank = base + rank
 
-    pml = Ob1Pml(my_rank=rank)
-    modex = ModexClient(modex_addr, rank, size)
+    pml = Ob1Pml(my_rank=urank)
+    modex = ModexClient(modex_addr, urank, size, job=job)
 
     # btl selection (reference: mca_pml_base_select opening BTLs via bml/r2)
     modules = btl_framework.select_all(deliver=pml.handle_incoming,
-                                      my_rank=rank, n_ranks=size)
+                                      my_rank=urank, n_ranks=size,
+                                      local_rank=rank)
     by_name = {name: mod for _, name, mod in modules}
     self_btl = by_name.get("self")
     sm = by_name.get("sm")
@@ -56,14 +63,15 @@ def init_process_mode():
         modex.put("btl.sm.node", my_node)
     modex.fence()  # reference: PMIx_Fence_nb at instance.c:575-625
 
+    job_peers = [base + i for i in range(size)]  # universe ranks of my job
     if tcp is not None:
         peers = {r: modex.get(r, "btl.tcp.addr")
-                 for r in range(size) if r != rank}
+                 for r in job_peers if r != urank}
         tcp.set_peers(peers)
     sm_peers = {}
     if sm is not None:
-        for r in range(size):
-            if r == rank:
+        for r in job_peers:
+            if r == urank:
                 continue
             try:
                 # post-fence, a missing card will never appear: don't wait
@@ -83,14 +91,27 @@ def init_process_mode():
     # priority + locality — the bml/r2 endpoint ordering (instance.c:730):
     # self (loopback) > sm (same node) > tcp.
     if self_btl is not None:
-        pml.add_endpoint(rank, self_btl)
-    for r in range(size):
-        if r == rank:
+        pml.add_endpoint(urank, self_btl)
+    for r in job_peers:
+        if r == urank:
             continue
         if r in sm_peers:
             pml.add_endpoint(r, sm)
         elif tcp is not None:
             pml.add_endpoint(r, tcp)
+
+    # Cross-job endpoints (intercomm/spawn traffic) wire lazily: first
+    # send/recv to an unknown universe rank resolves its card from the
+    # modex and binds tcp (sm ring indices are job-scoped — dynamic
+    # processes ride the DCN path, reference: dpm over OOB channels).
+    def _resolve_endpoint(r: int):
+        if tcp is None:
+            return None
+        addr = modex.get(r, "btl.tcp.addr", timeout=30.0)
+        tcp.peers[r] = addr
+        return tcp
+
+    pml.endpoint_resolver = _resolve_endpoint
 
     for _, _, mod in modules:
         register_progress(mod.progress)
@@ -110,14 +131,17 @@ def init_process_mode():
     pml.register_system_handler(REVOKE_TAG, _on_revoke)
 
     hb = None
-    if get_var("ft", "enable"):
+    if get_var("ft", "enable") and job == 0:
+        # the heartbeat ring runs over job-0 world ranks; spawned jobs
+        # rely on their parent's detector (reference: per-job PMIx
+        # event registration)
         hb = ft_detector.HeartbeatDetector(pml, rank, size)
         pml.register_system_handler(
             ft_detector.HEARTBEAT_TAG,
             lambda hdr, payload: hb.note_heartbeat(hdr.src))
         hb.start()
 
-    world = ProcComm(Group(range(size)), cid=0, pml=pml,
+    world = ProcComm(Group(job_peers), cid=0, pml=pml,
                      name="MPI_COMM_WORLD")
     _ctx = {
         "modex": modex,
@@ -125,9 +149,18 @@ def init_process_mode():
         "progress_thread": pthread,
         "detector": hb,
         "world": world,
+        "job": job,
+        "base": base,
+        "size": size,
+        "spawned": [],
     }
     # the pre-activation barrier (ompi_mpi_init.c:451-505 modex barrier)
     modex.fence()
+    # spawned jobs bridge back to their parent during init (reference:
+    # ompi_dpm_dyn_init called from ompi_mpi_init)
+    from ompi_tpu.runtime.dpm import connect_parent_if_spawned
+
+    connect_parent_if_spawned(world)
     return world
 
 
@@ -135,6 +168,12 @@ def shutdown() -> None:
     global _ctx
     if _ctx is None:
         return
+    # reap spawned children first: their Finalize needs the modex alive
+    for p in _ctx.get("spawned", ()):
+        try:
+            p.wait(timeout=60)
+        except Exception:
+            p.kill()
     try:
         _ctx["modex"].fence()
     except Exception:

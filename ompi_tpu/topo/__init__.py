@@ -19,10 +19,9 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_TOPOLOGY
-from ompi_tpu.comm.communicator import PROC_NULL
+from ompi_tpu.comm.communicator import PROC_NULL, UNDEFINED
 
 # MPI topology type constants (reference: mpi.h MPI_CART/MPI_GRAPH/...)
-UNDEFINED = -32766
 CART = 1
 GRAPH = 2
 DIST_GRAPH = 3

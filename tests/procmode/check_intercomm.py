@@ -1,0 +1,77 @@
+"""Intercommunicators within one world: split, bridge, collectives,
+merge (reference analog: the intercomm tests of the mpi4py CI suite)."""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD, ROOT, PROC_NULL
+from ompi_tpu.core import op as mpi_op
+
+
+def main() -> int:
+    n = COMM_WORLD.Get_size()
+    r = COMM_WORLD.Get_rank()
+    assert n == 4, "run with -np 4"
+
+    # split into {0,1} and {2,3}, bridge via leaders 0 and 2
+    side = r // 2
+    local = COMM_WORLD.Split(side, r)
+    inter = local.Create_intercomm(0, COMM_WORLD, 2 if side == 0 else 0,
+                                   tag=7)
+    assert inter.Is_inter()
+    assert inter.Get_remote_size() == 2
+    assert inter.Get_rank() == local.Get_rank()
+
+    # pt2pt addresses the remote group
+    lr = local.Get_rank()
+    out = np.zeros(1, np.int64)
+    inter.Send(np.array([100 * side + lr], np.int64), dest=lr, tag=1)
+    inter.Recv(out, source=lr, tag=1)
+    assert out[0] == 100 * (1 - side) + lr, out
+
+    # barrier
+    inter.Barrier()
+
+    # bcast: world rank 0 is the root (its group passes ROOT/PROC_NULL,
+    # the other group passes the root's remote rank = 0)
+    data = np.zeros(3, np.float64)
+    if side == 0:
+        if lr == 0:
+            data[:] = [1.5, 2.5, 3.5]
+            inter.Bcast(data, root=ROOT)
+        else:
+            inter.Bcast(data, root=PROC_NULL)
+        assert data[0] == 1.5 if lr == 0 else True
+    else:
+        inter.Bcast(data, root=0)
+        np.testing.assert_array_equal(data, [1.5, 2.5, 3.5])
+
+    # allreduce: each side receives the REMOTE side's sum
+    mine = np.full(2, float(r + 1), np.float64)
+    red = np.zeros(2, np.float64)
+    inter.Allreduce(mine, red, op=mpi_op.SUM)
+    remote_sum = {0: 3 + 4, 1: 1 + 2}[side]  # sum of (r+1) over remote
+    assert red[0] == remote_sum, (red, remote_sum)
+
+    # allgather: remote group's contributions
+    ag = np.zeros(2, np.int64)
+    inter.Allgather(np.array([r * 10], np.int64), ag)
+    want = [20, 30] if side == 0 else [0, 10]
+    np.testing.assert_array_equal(ag, want)
+
+    # merge: low side (side 0 passes high=False) ranks first
+    merged = inter.Merge(high=(side == 1))
+    assert merged.Get_size() == 4
+    tot = np.zeros(1, np.int64)
+    merged.Allreduce(np.array([r], np.int64), tot)
+    assert tot[0] == 6, tot
+    assert merged.Get_rank() == r  # low group 0,1 then high 2,3
+
+    print(f"INTER-OK rank {r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

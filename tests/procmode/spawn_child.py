@@ -1,0 +1,43 @@
+"""Spawn child: bridges back to the parent job via Comm_get_parent."""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD, Comm_get_parent
+from ompi_tpu.core import op as mpi_op
+
+
+def main() -> int:
+    r = COMM_WORLD.Get_rank()
+    assert COMM_WORLD.Get_size() == 2
+
+    parent = Comm_get_parent()
+    assert parent is not None
+    n_parents = parent.Get_remote_size()
+
+    if r == 0:
+        parent.Send(np.array([1000 + r], np.int64), dest=0, tag=5)
+        got = np.zeros(1, np.int64)
+        parent.Recv(got, source=0, tag=6)
+        assert got[0] == 42, got
+
+    # children see the parents' sum
+    red = np.zeros(1, np.float64)
+    parent.Allreduce(np.full(1, 1000.0 + r), red)
+    want = sum(range(1, n_parents + 1))
+    assert red[0] == want, (red, want)
+
+    # merge (children are the high side)
+    merged = parent.Merge(high=True)
+    tot = np.zeros(1, np.float64)
+    merged.Allreduce(np.full(1, 1.0), tot)
+    assert tot[0] == n_parents + 2, tot
+
+    print(f"SPAWN-CHILD-OK rank {r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,22 @@
+"""Intercommunicators + dynamic processes.
+
+Reference: ompi_intercomm_create (comm.c:1655), coll/inter,
+ompi/dpm/dpm.c MPI_Comm_spawn.
+"""
+
+from tests.test_process_mode import run_mpi
+
+
+def test_intercomm_4_ranks():
+    r = run_mpi(4, "tests/procmode/check_intercomm.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("INTER-OK") == 4
+
+
+def test_spawn_merge_allreduce():
+    """Parent spawns 2 children, bridges, merges, allreduces across the
+    merged world (VERDICT r1 item 7 done-criterion)."""
+    r = run_mpi(2, "tests/procmode/spawn_parent.py", timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("SPAWN-PARENT-OK") == 2
+    assert r.stdout.count("SPAWN-CHILD-OK") == 2

@@ -74,7 +74,7 @@ def cart24(world):
 
 def test_mesh_cart_create(world, cart24):
     assert cart24.Get_dim() == 2
-    assert cart24.Get_topo() == ([2, 4], [True, True])
+    assert cart24.Get_topo() == ([2, 4], [True, True], None)
     assert cart24.Get_cart_rank([1, 2]) == 6
     assert cart24.Get_coords(6) == [1, 2]
     with pytest.raises(MPIError):
