@@ -40,6 +40,7 @@ from ompi_tpu.core.errors import (
 from ompi_tpu.core.group import Group
 from ompi_tpu.core.request import Request
 from ompi_tpu.core.status import Status
+from ompi_tpu.runtime import spc
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -198,8 +199,9 @@ class ProcComm(Intracomm):
 
             return CompletedRequest()
         obj, count, dt = parse_buffer(buf)
-        return self.pml.isend(obj, count, dt, self._world_rank(dest),
-                              tag, self.cid)
+        wdest = self._world_rank(dest)
+        spc.record_bytes("send", count * dt.size)
+        return self.pml.isend(obj, count, dt, wdest, tag, self.cid)
 
     def Irecv(self, buf, source: int = ANY_SOURCE,
               tag: int = ANY_TAG) -> Request:
@@ -221,6 +223,7 @@ class ProcComm(Intracomm):
     def _fix_status_source(self, req) -> None:
         if req.status.source >= 0:
             req.status.source = self.group.rank_of(req.status.source)
+        spc.record_bytes("recv", req.status._nbytes)
 
     def Send(self, buf, dest: int, tag: int = 0) -> None:
         self.Isend(buf, dest, tag).Wait()
@@ -304,6 +307,11 @@ class ProcComm(Intracomm):
     # ---------------------------------------------------------- collectives
     def _coll(self, op: str):
         self._check_usable()
+        # SPC_RECORD analog: one counter bump per collective invocation
+        # (reference: the SPC_RECORD(OMPI_SPC_ALLREDUCE) in every binding,
+        # allreduce.c.in:44); library-internal collectives are suppressed
+        # at their call sites so counters reflect user activity
+        spc.record(op)
         return self.coll.get(op)
 
     def Barrier(self) -> None:
@@ -419,7 +427,8 @@ class ProcComm(Intracomm):
         (reference: the comm_cid.c distributed agreement)."""
         local = np.array([_next_local_cid()], dtype=np.int64)
         agreed = np.zeros(1, dtype=np.int64)
-        self.Allreduce(local, agreed, op=_op.MAX)
+        with spc.suppressed():
+            self.Allreduce(local, agreed, op=_op.MAX)
         _bump_local_cid(int(agreed[0]))
         return int(agreed[0])
 
@@ -431,7 +440,8 @@ class ProcComm(Intracomm):
         """MPI_Comm_split: allgather (color, key), then local group math."""
         mine = np.array([color, key, self.rank], dtype=np.int64)
         allv = np.zeros(3 * self.size, dtype=np.int64)
-        self.Allgather(mine, allv)
+        with spc.suppressed():
+            self.Allgather(mine, allv)
         cid = self._alloc_cid()
         if color == UNDEFINED:
             return None

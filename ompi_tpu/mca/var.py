@@ -177,8 +177,60 @@ def all_vars() -> Dict[str, Var]:
     return dict(_registry)
 
 
+# ---------------------------------------------------------------- pvars
+# Performance variables (reference: opal/mca/base/mca_base_pvar.c — the
+# MPI_T pvar backend). A pvar is a named read handle onto live state;
+# registration binds a zero-arg reader.
+@dataclasses.dataclass
+class Pvar:
+    framework: str
+    name: str
+    reader: Callable[[], Any]
+    help: str = ""
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.framework}_{self.name}"
+
+    @property
+    def value(self) -> Any:
+        return self.reader()
+
+
+_pvar_registry: Dict[str, Pvar] = {}
+
+
+def register_pvar(framework: str, name: str, reader: Callable[[], Any],
+                  help: str = "") -> Pvar:
+    with _lock:
+        key = f"{framework}_{name}"
+        pv = _pvar_registry.get(key)
+        if pv is None:
+            pv = Pvar(framework, name, reader, help)
+            _pvar_registry[key] = pv
+        return pv
+
+
+def all_pvars() -> Dict[str, Pvar]:
+    # SPC counters surface as pvars lazily: every recorded counter gets a
+    # read handle (reference: ompi_spc.c:318 registering each SPC as an
+    # MPI_T pvar)
+    from ompi_tpu.runtime import spc
+
+    with _lock:
+        out = dict(_pvar_registry)
+    for cname in spc.snapshot():
+        key = f"spc_{cname}"
+        if key not in out:
+            out[key] = Pvar("spc", cname,
+                            (lambda n=cname: spc.get(n)),
+                            help="SPC counter")
+    return out
+
+
 def _reset_for_testing() -> None:
     global _file_params
     with _lock:
         _registry.clear()
+        _pvar_registry.clear()
         _file_params = None

@@ -29,6 +29,7 @@ import numpy as np
 from ompi_tpu.core import op as _op
 from ompi_tpu.core.datatype import Datatype, from_numpy_dtype
 from ompi_tpu.core.errors import MPIError, ERR_WIN, ERR_RANK, ERR_OP
+from ompi_tpu.runtime import spc
 from ompi_tpu.utils.output import get_logger
 
 OSC_TAG = -4300
@@ -150,7 +151,8 @@ class Win:
         self.win_id = win_id
         _windows[win_id] = self
         _install_handler(comm.pml)
-        comm.Barrier()
+        with spc.suppressed():
+            comm.Barrier()
 
     # ------------------------------------------------------------- plumbing
     @staticmethod
@@ -162,7 +164,8 @@ class Win:
         return Win(np.zeros(nbytes, np.uint8), comm)
 
     def Free(self) -> None:
-        self.comm.Barrier()
+        with spc.suppressed():
+            self.comm.Barrier()
         _windows.pop(self.win_id, None)
 
     def _send(self, target: int, verb: int, disp: int, count: int,
@@ -194,6 +197,7 @@ class Win:
     # --------------------------------------------------------------- verbs
     def Put(self, origin_arr: np.ndarray, target: int,
             target_disp: int = 0) -> None:
+        spc.record_bytes("rma_put", origin_arr.nbytes)
         dt = from_numpy_dtype(origin_arr.dtype)
         rid, p = self._start_op()
         self._send(target, _PUT, target_disp * dt.size, origin_arr.size,
@@ -202,6 +206,7 @@ class Win:
 
     def Get(self, origin_arr: np.ndarray, target: int,
             target_disp: int = 0) -> None:
+        spc.record_bytes("rma_get", origin_arr.nbytes)
         dt = from_numpy_dtype(origin_arr.dtype)
         rid, p = self._start_op()
         self._send(target, _GET, target_disp * dt.size, origin_arr.size,
@@ -216,6 +221,7 @@ class Win:
         code = _CODE_BY_OP.get(op.uid)
         if code is None:
             raise MPIError(ERR_OP, f"{op.name} not supported for RMA")
+        spc.record_bytes("rma_accumulate", origin_arr.nbytes)
         rid, p = self._start_op()
         self._send(target, _ACC, target_disp * dt.size, origin_arr.size,
                    _dtype_code(dt), code, rid, origin_arr.tobytes())
@@ -310,7 +316,8 @@ class Win:
         """Active-target epoch boundary: local flush + barrier (reference:
         osc_rdma active_target fence)."""
         self.Flush()
-        self.comm.Barrier()
+        with spc.suppressed():
+            self.comm.Barrier()
 
     # ----------------------------------------------- sync: passive target
     def Lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:

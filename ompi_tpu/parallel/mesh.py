@@ -28,6 +28,7 @@ from ompi_tpu.core.errors import (
     ERR_UNSUPPORTED_OPERATION,
 )
 from ompi_tpu.core.group import Group
+from ompi_tpu.runtime import spc
 
 UNDEFINED = -32766
 
@@ -124,6 +125,7 @@ class XlaComm(Intracomm):
     # ------------------------------------------- functional collectives
     def _slot(self, name: str):
         self._check_usable()
+        spc.record(name)  # allreduce records in its own fast path instead
         return self.coll.get(name)
 
     def allreduce(self, x, op: _op.Op = _op.SUM):
@@ -132,10 +134,11 @@ class XlaComm(Intracomm):
         self._check_usable()
         from ompi_tpu.coll.xla import cache_key
 
+        spc.record("allreduce")
         fn = self._jit_cache.get(cache_key("allreduce", op))
         if fn is not None:
             return fn(x)
-        return self._slot("allreduce")(self, x, op)
+        return self.coll.get("allreduce")(self, x, op)
 
     def reduce(self, x, op: _op.Op = _op.SUM, root: int = 0):
         self._check_root(root)

@@ -65,6 +65,16 @@ class Ob1Pml:
         # dispatch to registered handlers (ULFM revoke notices, heartbeats —
         # reference analog: the PMIx event plane + ob1's internal hdr types)
         self.system_handlers: Dict[int, object] = {}
+        # live queue-depth pvars (reference: ob1's MPI_T pvars for the
+        # unexpected/posted match queues)
+        from ompi_tpu.mca.var import register_pvar
+
+        register_pvar("pml", "unexpected_queue_length",
+                      lambda: len(self.engine.unexpected),
+                      help="Unexpected-message queue depth")
+        register_pvar("pml", "posted_recv_queue_length",
+                      lambda: len(self.engine.posted),
+                      help="Posted-receive queue depth")
 
     # ------------------------------------------------------------- wiring
     def add_endpoint(self, rank: int, btl) -> None:

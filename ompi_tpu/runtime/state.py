@@ -56,6 +56,7 @@ import ompi_tpu.coll.basic  # noqa: F401,E402
 import ompi_tpu.coll.tuned  # noqa: F401,E402
 import ompi_tpu.coll.nbc  # noqa: F401,E402
 import ompi_tpu.coll.neighbor  # noqa: F401,E402
+import ompi_tpu.hook.comm_method  # noqa: F401,E402
 
 
 def Init(required: int = THREAD_MULTIPLE) -> int:
@@ -107,7 +108,10 @@ def Finalize() -> None:
         run_hooks("finalize_top")
         if _world is not None:
             try:
-                _world.Barrier()
+                from ompi_tpu.runtime import spc
+
+                with spc.suppressed():
+                    _world.Barrier()
             except Exception:
                 pass
             from ompi_tpu.runtime import wireup

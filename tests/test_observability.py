@@ -1,0 +1,134 @@
+"""Observability: SPC counters, pvars, the info CLI, comm_method hook.
+
+Reference: ompi/runtime/ompi_spc.c (counters + MPI_T pvar export),
+opal/mca/base/mca_base_pvar.c, ompi/tools/ompi_info,
+ompi/mca/hook/comm_method.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.runtime import spc
+from tests.test_process_mode import REPO, run_mpi
+
+
+def test_spc_records_collectives_and_bytes():
+    spc.reset()
+    before = spc.get("allreduce")
+    out = np.zeros(4, np.float32)
+    COMM_WORLD.Allreduce(np.ones(4, np.float32), out)
+    assert spc.get("allreduce") == before + 1
+
+    COMM_WORLD.Send(np.zeros(8, np.float64), dest=0, tag=50)
+    got = np.zeros(8, np.float64)
+    COMM_WORLD.Recv(got, source=0, tag=50)
+    assert spc.get("send_count") >= 1
+    assert spc.get("send_bytes") >= 64
+    assert spc.get("recv_bytes") >= 64
+
+
+def test_spc_timer_and_dump(capsys):
+    spc.reset()
+    with spc.timer("unit_test_op"):
+        pass
+    snap = spc.snapshot()
+    assert "unit_test_op_time_us" in snap
+    spc.dump(file=sys.stdout)
+    assert "unit_test_op_time_us" in capsys.readouterr().out
+
+
+def test_spc_disable():
+    from ompi_tpu.mca.var import set_var
+
+    spc.reset()
+    set_var("spc", "enable", False)  # must take effect immediately
+    try:
+        spc.record("should_not_appear")
+        assert spc.get("should_not_appear") == 0
+    finally:
+        set_var("spc", "enable", True)
+    spc.record("reappears")
+    assert spc.get("reappears") == 1
+    # internal-traffic suppression (library calls must not pollute
+    # user-facing counters)
+    with spc.suppressed():
+        spc.record("internal_only")
+    assert spc.get("internal_only") == 0
+
+
+def test_pvars_surface_spc_counters():
+    from ompi_tpu.mca.var import all_pvars
+
+    spc.reset()
+    out = np.zeros(1, np.float32)
+    COMM_WORLD.Allreduce(np.ones(1, np.float32), out)
+    pvars = all_pvars()
+    assert "spc_allreduce" in pvars
+    assert pvars["spc_allreduce"].value >= 1
+
+
+def test_info_cli():
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.info", "--all"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "frameworks / components" in out
+    # every framework with its components
+    for frag in ("btl", "coll", "accelerator",
+                 "xla (priority 100)", "sm (priority 30)",
+                 "tcp (priority 20)", "tpu (priority 50)"):
+        assert frag in out, frag
+    # vars with metadata
+    assert "btl_sm_ring_bytes" in out
+    assert "source default" in out
+    assert "performance variables" in out
+
+
+def test_info_cli_param_filter():
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.info", "--param", "spc",
+         "--level", "9"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    assert "spc_enable" in r.stdout
+    assert "btl_sm_ring_bytes" not in r.stdout
+
+
+def test_comm_method_hook_procmode():
+    r = run_mpi(2, "examples/ring.py", mca=(("hook_comm_method", "1"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "comm_method rank 0:" in r.stderr
+    assert "sm" in r.stderr or "tcp" in r.stderr
+
+
+def test_internal_collectives_not_counted():
+    """Dup/Split CID agreement and window barriers are library-internal
+    traffic; counters must reflect user activity only (r2 review)."""
+    spc.reset()
+    dup = COMM_WORLD.Dup()
+    assert spc.get("allreduce") == 0  # CID agreement suppressed
+    dup.Free()
+
+
+def test_failed_send_not_counted():
+    spc.reset()
+    with pytest.raises(ompi_tpu.MPIError):
+        COMM_WORLD.Send(np.zeros(2, np.float32), dest=5)
+    assert spc.get("send_count") == 0
+
+
+def test_registered_pvars():
+    from ompi_tpu.mca.var import all_pvars
+
+    pv = all_pvars()
+    assert "pml_unexpected_queue_length" in pv
+    assert pv["pml_unexpected_queue_length"].value >= 0
