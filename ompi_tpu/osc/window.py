@@ -35,7 +35,8 @@ from ompi_tpu.utils.output import get_logger
 OSC_TAG = -4300
 
 # verbs
-_PUT, _GET, _ACC, _FOP, _CAS, _ACK, _LOCK, _UNLOCK, _LOCK_GRANT = range(9)
+(_PUT, _GET, _ACC, _FOP, _CAS, _ACK, _LOCK, _UNLOCK, _LOCK_GRANT,
+ _POST, _COMPLETE) = range(11)
 
 LOCK_EXCLUSIVE = 1
 LOCK_SHARED = 2
@@ -92,11 +93,13 @@ def _install_handler(pml) -> None:
 
 
 class _Pending:
-    __slots__ = ("event", "data")
+    __slots__ = ("event", "data", "callback", "error")
 
     def __init__(self):
         self.event = threading.Event()
         self.data: Optional[bytes] = None
+        self.callback = None  # set before the op is sent (no ack race)
+        self.error = 0
 
 
 _pending: Dict[int, _Pending] = {}
@@ -117,7 +120,10 @@ def _on_message(hdr, payload: bytes) -> None:
         p = _pending.pop(req_id, None)
         if p is not None:
             p.data = body
+            p.error = opcode  # target-side error rides the opcode field
             p.event.set()
+            if p.callback is not None:
+                p.callback(p)
         return
     win = _windows.get(win_id)
     if win is None:
@@ -125,8 +131,44 @@ def _on_message(hdr, payload: bytes) -> None:
     win._handle(verb, origin, disp, count, dcode, opcode, req_id, body)
 
 
+from ompi_tpu.core.request import Request
+
+
+class OscRequest(Request):
+    """Request-based RMA completion (reference: the Rput/Rget request
+    variants of osc.h and osc/rdma's request objects). Completes when the
+    target's ack arrives; Rget-style ops unpack their reply into the
+    origin buffer first."""
+
+    def __init__(self, win: "Win", rid: int, on_data=None,
+                 fire_and_forget: bool = False):
+        super().__init__()
+        self._win = win
+        self._rid = rid
+        self._on_data = on_data
+        self._fire_and_forget = fire_and_forget
+
+    def _on_ack(self, p: _Pending) -> None:
+        if not p.error and self._on_data is not None:
+            self._on_data(b"" if p.data is None else p.data)
+        self._win._outstanding.pop(self._rid, None)
+        if p.error and self._fire_and_forget:
+            # fire-and-forget Put/Accumulate errors surface at the next
+            # synchronization (MPI: errors attach to the epoch); waited
+            # requests raise from their own Wait instead
+            self._win._epoch_error = p.error
+        self._set_complete(p.error)
+
+
 class Win:
-    """MPI_Win over a ProcComm (reference: ompi/win + osc/rdma)."""
+    """MPI_Win over a ProcComm (reference: ompi/win + osc/rdma).
+
+    Completion model (reference: osc/rdma outstanding-ops counters,
+    osc_rdma_comm.c:838): Put/Accumulate complete LOCALLY at return (the
+    payload is copied out), remotely at Flush/Fence/Unlock/Complete —
+    true one-sided overlap. Get/Fetch_and_op/Compare_and_swap block for
+    their reply; the R-variants (Rput/Rget/Raccumulate) return Requests.
+    """
 
     def __init__(self, buffer: Optional[np.ndarray], comm, win_id=None):
         self.comm = comm
@@ -134,17 +176,27 @@ class Win:
         self._bytes = self.buf.reshape(-1).view(np.uint8) if self.buf.size \
             else np.zeros(0, np.uint8)
         self.lock = threading.RLock()
-        self._outstanding: Dict[int, _Pending] = {}
+        self._outstanding: Dict[int, tuple] = {}  # rid -> (pending, target)
         self._lock_state = 0  # >0 shared count, -1 exclusive
         self._lock_waiters = []
         self._lock_cond = threading.Condition()
         self.attributes: Dict[int, Any] = {}
+        # PSCW epoch state (reference: osc active target pscw)
+        self._pscw_cond = threading.Condition()
+        self._posts_received: set = set()
+        self._completes_received: set = set()
+        self._access_group = None
+        # dynamic-window regions: base -> flat uint8 view
+        self.dynamic = False
+        self._regions: Dict[int, np.ndarray] = {}
+        self._next_attach_base = 1 << 20
         # agree on the window id collectively (like a CID)
         if win_id is None:
             with _win_id_lock:
                 proposal = np.array([_next_win_id[0]], np.int64)
             agreed = np.zeros(1, np.int64)
-            comm.Allreduce(proposal, agreed, op=_op.MAX)
+            with spc.suppressed():
+                comm.Allreduce(proposal, agreed, op=_op.MAX)
             win_id = int(agreed[0])
             with _win_id_lock:
                 _next_win_id[0] = win_id + 1
@@ -163,7 +215,60 @@ class Win:
     def Allocate(nbytes: int, comm) -> "Win":
         return Win(np.zeros(nbytes, np.uint8), comm)
 
+    @staticmethod
+    def Create_dynamic(comm) -> "Win":
+        """MPI_Win_create_dynamic: no initial memory; ranks Attach/Detach
+        regions later (reference: osc/rdma dynamic windows,
+        osc_rdma_dynamic.c)."""
+        win = Win(None, comm)
+        win.dynamic = True
+        return win
+
+    def Attach(self, arr: np.ndarray) -> int:
+        """Expose `arr` in this window; returns its base displacement —
+        the analog of the attached region's address, which peers use as
+        target_disp (real MPI apps exchange attached addresses the same
+        way)."""
+        if not self.dynamic:
+            raise MPIError(ERR_WIN, "Attach requires a dynamic window")
+        if not arr.flags.c_contiguous:
+            # reshape(-1) of a non-contiguous array COPIES: peers would
+            # RMA into a detached buffer while the caller's memory never
+            # changes
+            raise MPIError(ERR_WIN, "Attach requires a C-contiguous array")
+        with self.lock:
+            base = self._next_attach_base
+            view = arr.reshape(-1).view(np.uint8)
+            self._next_attach_base = base + ((view.nbytes + 4095) & ~4095) \
+                + 4096
+            self._regions[base] = view
+        return base
+
+    def Detach(self, base_or_arr) -> None:
+        with self.lock:
+            if isinstance(base_or_arr, (int, np.integer)):
+                self._regions.pop(int(base_or_arr), None)
+                return
+            tgt = base_or_arr.reshape(-1).view(np.uint8)
+            for b, v in list(self._regions.items()):
+                if v.base is tgt.base or v is tgt:
+                    del self._regions[b]
+                    return
+
+    def _resolve(self, disp: int, nbytes: int) -> tuple:
+        """(flat view, local offset) for a target displacement."""
+        if not self.dynamic:
+            return self._bytes, disp
+        for base, view in self._regions.items():
+            if base <= disp and disp + nbytes <= base + view.nbytes:
+                return view, disp - base
+        raise MPIError(ERR_WIN,
+                       f"displacement {disp} not in any attached region")
+
     def Free(self) -> None:
+        # flush before the barrier: Put is asynchronous now, and a frame
+        # still in flight when the target pops its window would vanish
+        self.Flush()
         with spc.suppressed():
             self.comm.Barrier()
         _windows.pop(self.win_id, None)
@@ -179,41 +284,68 @@ class Win:
                             self.comm._world_rank(target), OSC_TAG,
                             self.comm.cid)
 
-    def _start_op(self) -> tuple:
+    def _post_op(self, target: int, verb: int, disp: int, count: int,
+                 dcode: int, opcode: int, body: bytes, on_data=None,
+                 fire_and_forget: bool = False) -> OscRequest:
+        """Issue one RMA op; returns the request that completes on ack.
+        The pending callback is armed BEFORE the send so a synchronous
+        self-BTL ack can't race past registration."""
         rid = next(_req_ids)
         p = _Pending()
+        req = OscRequest(self, rid, on_data, fire_and_forget)
+        p.callback = req._on_ack
         _pending[rid] = p
-        self._outstanding[rid] = p
-        return rid, p
-
-    def _wait(self, p: "_Pending", rid: int) -> bytes:
-        from ompi_tpu.runtime.progress import progress
-
-        while not p.event.is_set():
-            progress()
-        self._outstanding.pop(rid, None)
-        return b"" if p.data is None else p.data
+        self._outstanding[rid] = (p, target)
+        self._send(target, verb, disp, count, dcode, opcode, rid, body)
+        return req
 
     # --------------------------------------------------------------- verbs
+    # Put/Accumulate complete locally at return (payload copied); their
+    # R-variants expose the remote-completion request.
+    def Rput(self, origin_arr: np.ndarray, target: int,
+             target_disp: int = 0) -> OscRequest:
+        spc.record_bytes("rma_put", origin_arr.nbytes)
+        dt = from_numpy_dtype(origin_arr.dtype)
+        return self._post_op(target, _PUT, target_disp * dt.size,
+                             origin_arr.size, _dtype_code(dt), 0,
+                             origin_arr.tobytes())
+
     def Put(self, origin_arr: np.ndarray, target: int,
             target_disp: int = 0) -> None:
         spc.record_bytes("rma_put", origin_arr.nbytes)
         dt = from_numpy_dtype(origin_arr.dtype)
-        rid, p = self._start_op()
-        self._send(target, _PUT, target_disp * dt.size, origin_arr.size,
-                   _dtype_code(dt), 0, rid, origin_arr.tobytes())
-        self._wait(p, rid)
+        self._post_op(target, _PUT, target_disp * dt.size,
+                      origin_arr.size, _dtype_code(dt), 0,
+                      origin_arr.tobytes(), fire_and_forget=True)
+
+    def Rget(self, origin_arr: np.ndarray, target: int,
+             target_disp: int = 0) -> OscRequest:
+        spc.record_bytes("rma_get", origin_arr.nbytes)
+        dt = from_numpy_dtype(origin_arr.dtype)
+
+        def land(data: bytes) -> None:
+            origin_arr.reshape(-1)[:] = np.frombuffer(
+                data, dtype=origin_arr.dtype)
+
+        return self._post_op(target, _GET, target_disp * dt.size,
+                             origin_arr.size, _dtype_code(dt), 0, b"",
+                             on_data=land)
 
     def Get(self, origin_arr: np.ndarray, target: int,
             target_disp: int = 0) -> None:
-        spc.record_bytes("rma_get", origin_arr.nbytes)
+        self.Rget(origin_arr, target, target_disp).Wait()
+
+    def Raccumulate(self, origin_arr: np.ndarray, target: int,
+                    target_disp: int = 0,
+                    op: _op.Op = _op.SUM) -> OscRequest:
         dt = from_numpy_dtype(origin_arr.dtype)
-        rid, p = self._start_op()
-        self._send(target, _GET, target_disp * dt.size, origin_arr.size,
-                   _dtype_code(dt), 0, rid, b"")
-        data = self._wait(p, rid)
-        origin_arr.reshape(-1)[:] = np.frombuffer(
-            data, dtype=origin_arr.dtype)
+        code = _CODE_BY_OP.get(op.uid)
+        if code is None:
+            raise MPIError(ERR_OP, f"{op.name} not supported for RMA")
+        spc.record_bytes("rma_accumulate", origin_arr.nbytes)
+        return self._post_op(target, _ACC, target_disp * dt.size,
+                             origin_arr.size, _dtype_code(dt), code,
+                             origin_arr.tobytes())
 
     def Accumulate(self, origin_arr: np.ndarray, target: int,
                    target_disp: int = 0, op: _op.Op = _op.SUM) -> None:
@@ -222,10 +354,9 @@ class Win:
         if code is None:
             raise MPIError(ERR_OP, f"{op.name} not supported for RMA")
         spc.record_bytes("rma_accumulate", origin_arr.nbytes)
-        rid, p = self._start_op()
-        self._send(target, _ACC, target_disp * dt.size, origin_arr.size,
-                   _dtype_code(dt), code, rid, origin_arr.tobytes())
-        self._wait(p, rid)
+        self._post_op(target, _ACC, target_disp * dt.size,
+                      origin_arr.size, _dtype_code(dt), code,
+                      origin_arr.tobytes(), fire_and_forget=True)
 
     def Fetch_and_op(self, value: np.ndarray, result: np.ndarray,
                      target: int, target_disp: int = 0,
@@ -234,55 +365,31 @@ class Win:
         code = _CODE_BY_OP.get(op.uid)
         if code is None:
             raise MPIError(ERR_OP, f"{op.name} not supported for RMA")
-        rid, p = self._start_op()
-        self._send(target, _FOP, target_disp * dt.size, 1,
-                   _dtype_code(dt), code, rid, value.tobytes())
-        data = self._wait(p, rid)
-        result.reshape(-1)[:1] = np.frombuffer(data, dtype=result.dtype)[:1]
+
+        def land(data: bytes) -> None:
+            result.reshape(-1)[:1] = np.frombuffer(
+                data, dtype=result.dtype)[:1]
+
+        self._post_op(target, _FOP, target_disp * dt.size, 1,
+                      _dtype_code(dt), code, value.tobytes(),
+                      on_data=land).Wait()
 
     def Compare_and_swap(self, compare: np.ndarray, origin: np.ndarray,
                          result: np.ndarray, target: int,
                          target_disp: int = 0) -> None:
         dt = from_numpy_dtype(origin.dtype)
-        rid, p = self._start_op()
         body = compare.tobytes() + origin.tobytes()
-        self._send(target, _CAS, target_disp * dt.size, 1,
-                   _dtype_code(dt), 0, rid, body)
-        data = self._wait(p, rid)
-        result.reshape(-1)[:1] = np.frombuffer(data, dtype=result.dtype)[:1]
+
+        def land(data: bytes) -> None:
+            result.reshape(-1)[:1] = np.frombuffer(
+                data, dtype=result.dtype)[:1]
+
+        self._post_op(target, _CAS, target_disp * dt.size, 1,
+                      _dtype_code(dt), 0, body, on_data=land).Wait()
 
     # ------------------------------------------------------- target handler
     def _handle(self, verb, origin, disp, count, dcode, opcode, req_id,
                 body: bytes) -> None:
-        npdt = _np_from_code(dcode) if dcode else np.dtype(np.uint8)
-        reply = b""
-        with self.lock:
-            view = self._bytes
-            if verb == _PUT:
-                view[disp: disp + len(body)] = np.frombuffer(body, np.uint8)
-            elif verb == _GET:
-                nbytes = count * npdt.itemsize
-                reply = view[disp: disp + nbytes].tobytes()
-            elif verb == _ACC:
-                op = _OPS_BY_CODE[opcode]
-                incoming = np.frombuffer(body, dtype=npdt)
-                nbytes = incoming.nbytes
-                cur = view[disp: disp + nbytes].view(npdt)
-                cur[:] = op.np_reduce(cur, incoming).astype(npdt)
-            elif verb == _FOP:
-                op = _OPS_BY_CODE[opcode]
-                incoming = np.frombuffer(body, dtype=npdt)
-                cur = view[disp: disp + npdt.itemsize].view(npdt)
-                reply = cur.tobytes()
-                cur[:] = op.np_reduce(cur, incoming).astype(npdt)
-            elif verb == _CAS:
-                half = len(body) // 2
-                compare = np.frombuffer(body[:half], dtype=npdt)
-                newval = np.frombuffer(body[half:], dtype=npdt)
-                cur = view[disp: disp + npdt.itemsize].view(npdt)
-                reply = cur.tobytes()
-                if cur[0] == compare[0]:
-                    cur[:] = newval
         if verb == _LOCK:
             self._grant_or_queue(origin, opcode, req_id)
             return
@@ -292,9 +399,65 @@ class Win:
                             req_id)
             self._reply(origin, ack)
             return
+        if verb == _POST:
+            with self._pscw_cond:
+                self._posts_received.add(origin)
+                self._pscw_cond.notify_all()
+            return
+        if verb == _COMPLETE:
+            with self._pscw_cond:
+                self._completes_received.add(origin)
+                self._pscw_cond.notify_all()
+            return
+        npdt = _np_from_code(dcode) if dcode else np.dtype(np.uint8)
+        try:
+            reply = self._apply(verb, disp, count, npdt, opcode, body)
+        except MPIError as e:
+            # a bad target displacement must fail the ORIGIN's request,
+            # not silently drop the frame and hang its Flush
+            ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0,
+                            e.code, req_id)
+            self._reply(origin, ack)
+            return
         ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0, 0,
                         req_id) + reply
         self._reply(origin, ack)
+
+    def _apply(self, verb, disp, count, npdt, opcode,
+               body: bytes) -> bytes:
+        reply = b""
+        with self.lock:
+            if verb == _PUT:
+                view, off = self._resolve(disp, len(body))
+                view[off: off + len(body)] = np.frombuffer(body, np.uint8)
+            elif verb == _GET:
+                nbytes = count * npdt.itemsize
+                view, off = self._resolve(disp, nbytes)
+                reply = view[off: off + nbytes].tobytes()
+            elif verb == _ACC:
+                op = _OPS_BY_CODE[opcode]
+                incoming = np.frombuffer(body, dtype=npdt)
+                nbytes = incoming.nbytes
+                view, off = self._resolve(disp, nbytes)
+                cur = view[off: off + nbytes].view(npdt)
+                cur[:] = op.np_reduce(cur, incoming).astype(npdt)
+            elif verb == _FOP:
+                op = _OPS_BY_CODE[opcode]
+                incoming = np.frombuffer(body, dtype=npdt)
+                view, off = self._resolve(disp, npdt.itemsize)
+                cur = view[off: off + npdt.itemsize].view(npdt)
+                reply = cur.tobytes()
+                cur[:] = op.np_reduce(cur, incoming).astype(npdt)
+            elif verb == _CAS:
+                half = len(body) // 2
+                compare = np.frombuffer(body[:half], dtype=npdt)
+                newval = np.frombuffer(body[half:], dtype=npdt)
+                view, off = self._resolve(disp, npdt.itemsize)
+                cur = view[off: off + npdt.itemsize].view(npdt)
+                reply = cur.tobytes()
+                if cur[0] == compare[0]:
+                    cur[:] = newval
+        return reply
 
     def _reply(self, origin: int, payload: bytes) -> None:
         from ompi_tpu.core.datatype import BYTE
@@ -306,11 +469,32 @@ class Win:
 
     # ------------------------------------------------------- sync: fence
     def Flush(self, rank: Optional[int] = None) -> None:
-        """Wait for remote completion of all outstanding ops (acks)."""
+        """Wait for remote completion: all outstanding acks, or only
+        those targeting `rank` (reference: osc/rdma's per-peer
+        outstanding-ops counters, osc_rdma_comm.c:838)."""
         from ompi_tpu.runtime.progress import progress
 
-        while self._outstanding:
+        def pending() -> bool:
+            if rank is None:
+                return bool(self._outstanding)
+            return any(t == rank
+                       for _, t in list(self._outstanding.values()))
+
+        while pending():
             progress()
+        err = getattr(self, "_epoch_error", 0)
+        if err:
+            self._epoch_error = 0
+            raise MPIError(err, "RMA operation failed at the target")
+
+    def Flush_all(self) -> None:
+        self.Flush()
+
+    def Flush_local(self, rank: Optional[int] = None) -> None:
+        # local completion is immediate in this model: payloads are
+        # copied at issue time (reference: the rdma pipeline's local
+        # completion callbacks fire at bounce-buffer copy)
+        pass
 
     def Fence(self) -> None:
         """Active-target epoch boundary: local flush + barrier (reference:
@@ -321,15 +505,19 @@ class Win:
 
     # ----------------------------------------------- sync: passive target
     def Lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
-        rid, p = self._start_op()
-        self._send(target, _LOCK, 0, 0, 0, lock_type, rid, b"")
-        self._wait(p, rid)
+        self._post_op(target, _LOCK, 0, 0, 0, lock_type, b"").Wait()
 
     def Unlock(self, target: int) -> None:
-        self.Flush()
-        rid, p = self._start_op()
-        self._send(target, _UNLOCK, 0, 0, 0, 0, rid, b"")
-        self._wait(p, rid)
+        self.Flush(target)
+        self._post_op(target, _UNLOCK, 0, 0, 0, 0, b"").Wait()
+
+    def Lock_all(self) -> None:
+        for r in range(self.comm.size):
+            self.Lock(r, LOCK_SHARED)
+
+    def Unlock_all(self) -> None:
+        for r in range(self.comm.size):
+            self.Unlock(r)
 
     def _grant_or_queue(self, origin: int, lock_type: int,
                         req_id: int) -> None:
@@ -364,20 +552,66 @@ class Win:
                 if lt == LOCK_EXCLUSIVE:
                     break
 
-    # PSCW (reference: osc active target Start/Complete/Post/Wait)
+    # PSCW (reference: osc active-target Start/Complete/Post/Wait —
+    # osc_rdma_active_target.c). Real epoch protocol: Post notifies each
+    # origin; Start blocks for the matching Posts; Complete flushes then
+    # notifies each target; Wait blocks for all Completes.
+    def _comm_ranks(self, group) -> list:
+        return [self.comm.group.rank_of(w) for w in group.ranks]
+
     def Post(self, group) -> None:
-        pass  # exposure epoch is implicit: handlers are always live
+        """Open an exposure epoch to `group` (origins)."""
+        self._post_group = self._comm_ranks(group)
+        for r in self._post_group:
+            self._send(r, _POST, 0, 0, 0, 0, 0, b"")
 
     def Start(self, group) -> None:
-        self._access_group = group
+        """Open an access epoch to `group` (targets); blocks until every
+        target's Post notice arrives (MPI allows Start to block)."""
+        from ompi_tpu.runtime.progress import progress
+
+        self._access_group = self._comm_ranks(group)
+        want = set(self._access_group)
+        while True:
+            with self._pscw_cond:
+                if want.issubset(self._posts_received):
+                    self._posts_received -= want
+                    return
+            progress()
 
     def Complete(self) -> None:
+        """End the access epoch: remote-complete every op, then notify
+        the targets."""
+        if self._access_group is None:
+            raise MPIError(ERR_WIN, "Complete without Start")
         self.Flush()
-        for r in getattr(self, "_access_group", self.comm.group).ranks:
-            pass  # acks already guarantee remote completion
+        for r in self._access_group:
+            self._send(r, _COMPLETE, 0, 0, 0, 0, 0, b"")
+        self._access_group = None
 
     def Wait(self) -> None:
-        pass
+        """End the exposure epoch: block until every origin Completed."""
+        from ompi_tpu.runtime.progress import progress
+
+        want = set(getattr(self, "_post_group", []))
+        while True:
+            with self._pscw_cond:
+                if want.issubset(self._completes_received):
+                    self._completes_received -= want
+                    return
+            progress()
+
+    def Test(self) -> bool:
+        """Nonblocking Wait (MPI_Win_test)."""
+        from ompi_tpu.runtime.progress import progress
+
+        progress()
+        want = set(getattr(self, "_post_group", []))
+        with self._pscw_cond:
+            if want.issubset(self._completes_received):
+                self._completes_received -= want
+                return True
+        return False
 
 
 class MeshWin:

@@ -203,3 +203,78 @@ def test_any_tag_ignores_internal_bands():
     rreq.Wait()
     sreq.Wait()
     np.testing.assert_array_equal(dst, src)
+
+
+# ---------------------- r2: real one-sided completion ----------------------
+def test_rput_rget_requests_self():
+    from ompi_tpu.osc.window import Win
+
+    base = np.zeros(8, np.float64)
+    win = Win.Create(base, COMM_WORLD)
+    req = win.Rput(np.full(4, 3.25), target=0, target_disp=2)
+    req.Wait()
+    win.Flush()
+    np.testing.assert_array_equal(base[2:6], [3.25] * 4)
+    out = np.zeros(4, np.float64)
+    win.Rget(out, target=0, target_disp=2).Wait()
+    np.testing.assert_array_equal(out, [3.25] * 4)
+    win.Free()
+
+
+def test_put_overlap_then_flush_self():
+    from ompi_tpu.osc.window import Win
+
+    base = np.zeros(32, np.float32)
+    win = Win.Create(base, COMM_WORLD)
+    for i in range(8):
+        win.Put(np.full(4, float(i), np.float32), target=0,
+                target_disp=4 * i)
+    win.Flush()
+    for i in range(8):
+        assert base[4 * i] == float(i)
+    win.Free()
+
+
+def test_pscw_self():
+    from ompi_tpu.core.group import Group
+    from ompi_tpu.osc.window import Win
+
+    base = np.zeros(4, np.int64)
+    win = Win.Create(base, COMM_WORLD)
+    g = Group([0])
+    win.Post(g)
+    win.Start(g)
+    win.Put(np.array([1, 2, 3, 4], np.int64), target=0)
+    win.Complete()
+    win.Wait()
+    np.testing.assert_array_equal(base, [1, 2, 3, 4])
+    win.Free()
+
+
+def test_dynamic_window_self():
+    from ompi_tpu.osc.window import Win
+    from ompi_tpu.core.errors import MPIError
+
+    win = Win.Create_dynamic(COMM_WORLD)
+    a = np.zeros(4, np.float64)
+    b = np.zeros(2, np.float64)
+    da = win.Attach(a)
+    db = win.Attach(b)
+    win.Put(np.full(4, 1.5), target=0, target_disp=da // 8)
+    win.Put(np.full(2, 2.5), target=0, target_disp=db // 8)
+    win.Flush()
+    np.testing.assert_array_equal(a, [1.5] * 4)
+    np.testing.assert_array_equal(b, [2.5] * 2)
+    win.Detach(da)
+    with pytest.raises(MPIError):
+        win.Put(np.ones(1), target=0, target_disp=da // 8)
+        win.Flush()
+    win.Free()
+
+
+def test_rma_procmode():
+    from tests.test_process_mode import run_mpi
+
+    r = run_mpi(2, "tests/procmode/check_rma.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("RMA-OK") == 2
