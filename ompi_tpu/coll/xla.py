@@ -29,11 +29,11 @@ data — which is also the correct MPI semantics for 1-member comms.
 MPI_Op → device computation: SUM/MAX/MIN lower natively; PROD,
 logical/bitwise and jax-traceable user fns use their elementwise combine
 inside the schedule (reference analog: op/avx SIMD kernels become VPU
-vector code emitted by XLA). MINLOC/MAXLOC are host-path only: their
-operands are structured (value, index) record arrays, which XLA has no
-dtype for — mesh-mode reductions with them raise
-ERR_UNSUPPORTED_OPERATION up front (use the host comm path, or carry the
-index as a second array and two reductions).
+vector code emitted by XLA). MINLOC/MAXLOC reduce (value, index) PAIR
+arrays on device — trailing dim of 2, values in [..., 0], indices in
+[..., 1] — since XLA has no structured record dtype; the host path keeps
+the record-array layout (reference analog: op/avx's 2-wide pair kernels
+over MPI_FLOAT_INT and friends).
 """
 
 from __future__ import annotations
@@ -56,19 +56,19 @@ def _is_bool(dtype) -> bool:
     return np.dtype(dtype) == np.bool_
 
 
-_HOST_ONLY_OPS = frozenset(("MPI_MINLOC", "MPI_MAXLOC"))
-
-
-def _check_device_op(op: _op.Op) -> None:
-    """Fail loc-pair ops before trace time with an actionable message
-    (ADVICE r1: they have no _JNP_EQUIV entry and their structured-dtype
-    operands cannot become jax arrays anyway)."""
-    if op.name in _HOST_ONLY_OPS:
-        raise MPIError(
-            ERR_UNSUPPORTED_OPERATION,
-            f"{op.name} has no device lowering: structured (value, index) "
-            "records are not an XLA dtype. Run it on a host-path comm, or "
-            "reduce values and indices as two arrays.")
+def _check_device_op(op: _op.Op, x=None) -> None:
+    """Validate the op's device lowering before trace time. MINLOC/MAXLOC
+    reduce (value, index) pairs: the host path carries them as structured
+    record arrays (no XLA dtype), so the device layout is a trailing dim
+    of 2 — ``x[..., 0]`` values, ``x[..., 1]`` indices (reference analog:
+    the 2-wide pair kernels of op/avx)."""
+    if op.name in _op.PAIR_OPS:
+        if x is None or x.ndim < 1 or x.shape[-1] != 2:
+            raise MPIError(
+                ERR_UNSUPPORTED_OPERATION,
+                f"device {op.name} reduces pair arrays: shape [..., 2] "
+                "with (value, index) in the last dim (structured record "
+                "dtypes have no XLA representation)")
 
 
 # --------------------------------------------------------------- schedules
@@ -130,24 +130,47 @@ class XlaColl(CollModule):
 
         return jnp.asarray(comm.pos_map), jnp.asarray(comm.singleton_mask)
 
+    @staticmethod
+    def _group_sizes(comm):
+        """Per-mesh-position group size as a jnp constant."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        gs = np.ones(comm.world_size, dtype=np.int32)
+        if comm.groups is not None:
+            for g in comm.groups:
+                for r in g:
+                    gs[r] = len(g)
+        else:
+            gs[:] = comm.world_size
+        return jnp.asarray(gs)
+
     # ------------------------------------------- grouped allreduce schedule
     def _grouped_allreduce_body(self, comm, op: _op.Op):
         """Build body(block)->block implementing in-group allreduce via
-        ppermute rounds (recursive doubling or ring rotation)."""
+        ppermute rounds. Uniform power-of-two colors take recursive
+        doubling; everything else (including NON-UNIFORM color sizes —
+        the reference supports arbitrary Splits, comm.c) takes a masked
+        ring: rounds = max group size - 1, and each rank stops
+        accumulating after its own group's size-1 rounds while values
+        keep rotating harmlessly around the smaller rings."""
         import jax.numpy as jnp
         from jax import lax
 
         groups = comm.groups
-        G = comm.size
         axis = comm.axis
         pos_map, single = self._masks(comm)
+        sizes = {len(g) for g in groups if len(g) > 1}
+        max_g = max(sizes) if sizes else 1
+        uniform = len(sizes) <= 1
 
-        pow2 = G >= 2 and (G & (G - 1)) == 0
+        pow2 = uniform and max_g >= 2 and (max_g & (max_g - 1)) == 0
         if pow2:
             perms = [_xor_perm(groups, 1 << k)
-                     for k in range(int(math.log2(G)))]
+                     for k in range(int(math.log2(max_g)))]
         else:
-            perms = [_shift_perm(groups, 1)] * max(G - 1, 0)
+            perms = [_shift_perm(groups, 1)] * max(max_g - 1, 0)
+        gsize = self._group_sizes(comm)
 
         def body(b_in):
             idx = lax.axis_index(axis)
@@ -159,11 +182,13 @@ class XlaColl(CollModule):
                     other = lax.ppermute(acc, axis, perm)
                     acc = op.jax_reduce(acc, other)
             else:
-                # reference: coll_base_allreduce.c:345 ring
+                # reference: coll_base_allreduce.c:345 ring, with a
+                # per-rank round mask for non-uniform group sizes
                 cur = b
-                for perm in perms:
+                for d, perm in enumerate(perms):
                     cur = lax.ppermute(cur, axis, perm)
-                    acc = op.jax_reduce(acc, cur)
+                    nxt = op.jax_reduce(acc, cur)
+                    acc = jnp.where(d < gsize[idx] - 1, nxt, acc)
             out = jnp.where(single[idx], b, acc.astype(b.dtype))
             return out.astype(b_in.dtype)
 
@@ -174,7 +199,7 @@ class XlaColl(CollModule):
         import jax.numpy as jnp
         from jax import lax
 
-        _check_device_op(op)
+        _check_device_op(op, x)
         key = cache_key("allreduce", op)
 
         def build():
@@ -347,7 +372,7 @@ class XlaColl(CollModule):
                 f"reduce_scatter expects [world, group_size={G}, ...], got "
                 f"{tuple(x.shape)}",
             )
-        _check_device_op(op)
+        _check_device_op(op, x)
         key = cache_key("reduce_scatter_block", op)
 
         def build():
@@ -392,17 +417,19 @@ class XlaColl(CollModule):
         import jax.numpy as jnp
         from jax import lax
 
-        _check_device_op(op)
+        _check_device_op(op, x)
         key = cache_key("scan", op, (exclusive,))
 
         def build():
             axis = comm.axis
-            G = comm.size
             pos_map, single = self._masks(comm)
             groups = comm.groups
             if groups is None:
                 groups = (tuple(range(comm.world_size)),)
-            rounds = max(int(math.ceil(math.log2(max(G, 1)))), 0)
+            # rounds sized by the LARGEST group; the pos >= d mask is
+            # group-local, so non-uniform colors just idle early
+            max_g = max((len(g) for g in groups), default=1)
+            rounds = max(int(math.ceil(math.log2(max(max_g, 1)))), 0)
 
             def body(b):
                 idx = lax.axis_index(axis)
@@ -445,14 +472,53 @@ class XlaColl(CollModule):
 
     # --------------------------------------------- layout ("root") movers
     def gather(self, comm, x, root: int = 0):
-        """Driver-level gather: the controller already holds the global
-        [W, ...] array — this is the identity on data, kept for parity."""
-        return x
+        """[W, ...] -> [W, G, ...]: the root's row holds its group's
+        stacked contributions. MPI defines only the root row; returning
+        the gather on every row is the same legal strengthening as
+        reduce->allreduce (free on a mesh under XLA's schedules)."""
+        return self.allgather(comm, x)
 
     def scatter(self, comm, x, root: int = 0):
-        """Driver-level scatter: (re)shard a [W, ...] array across the
-        comm's mesh axis; XLA emits the transfers."""
-        return comm.shard(x)
+        """[W, G, ...] -> [W, ...]: group rank p receives ROOT's chunk p
+        (real MPI_Scatter semantics — the r1 reshard stub ignored the
+        root's data)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        G = comm.size
+        if x.ndim < 2 or x.shape[1] != G:
+            raise MPIError(
+                ERR_ARG,
+                f"scatter expects [world, group_size={G}, ...], got "
+                f"{tuple(x.shape)}")
+        key = cache_key("scatter")
+
+        def build():
+            axis = comm.axis
+            pos_map, single = self._masks(comm)
+
+            def body(b, r):
+                idx = lax.axis_index(axis)
+                pos = pos_map[idx]
+                chunks = b[0]  # [G, ...]
+                v = chunks.astype(jnp.int32) if _is_bool(chunks.dtype) \
+                    else chunks
+                contrib = jnp.where(pos == r, v, jnp.zeros_like(v))
+                if comm.groups is None:
+                    full = lax.psum(contrib, axis)
+                else:
+                    full = self._grouped_allreduce_body(comm, _op.SUM)(
+                        contrib[None])[0]
+                out = lax.dynamic_index_in_dim(full, pos, axis=0,
+                                               keepdims=False)
+                own = lax.dynamic_index_in_dim(v, pos, axis=0,
+                                               keepdims=False)
+                return jnp.where(single[idx], own,
+                                 out).astype(chunks.dtype)[None]
+
+            return self._wrap(comm, body, rooted=True)
+
+        return self._cached(comm, key, build)(x, jnp.int32(root))
 
     # ---------------------------------------------- neighborhood collectives
     # Reference: the coll.h neighbor_* slots. On a mesh, a cart topology's

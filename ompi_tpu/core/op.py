@@ -87,13 +87,42 @@ def _maxloc(a, b):
     return out
 
 
+def _minloc_jax(a, b):
+    """Device MINLOC: operands are pair arrays with a trailing dim of 2
+    holding (value, index) — the XLA-representable layout replacing the
+    host path's structured dtype (reference: the MPI pair types
+    ompi_datatype FLOAT_INT etc., reduced by op/avx's 2-wide kernels)."""
+    import jax.numpy as jnp
+
+    av, ai = a[..., 0], a[..., 1]
+    bv, bi = b[..., 0], b[..., 1]
+    take_a = (av < bv) | ((av == bv) & (ai <= bi))
+    return jnp.stack([jnp.where(take_a, av, bv),
+                      jnp.where(take_a, ai, bi)], axis=-1)
+
+
+def _maxloc_jax(a, b):
+    import jax.numpy as jnp
+
+    av, ai = a[..., 0], a[..., 1]
+    bv, bi = b[..., 0], b[..., 1]
+    take_a = (av > bv) | ((av == bv) & (ai <= bi))
+    return jnp.stack([jnp.where(take_a, av, bv),
+                      jnp.where(take_a, ai, bi)], axis=-1)
+
+
 _JNP_EQUIV = {}
+
+# ops whose device operands are (value, index) pair arrays ([..., 2])
+PAIR_OPS = ("MPI_MINLOC", "MPI_MAXLOC")
 
 
 def _register_jnp_equivs():
     import jax.numpy as jnp
 
     _JNP_EQUIV.update({
+        "MPI_MINLOC": _minloc_jax,
+        "MPI_MAXLOC": _maxloc_jax,
         "MPI_SUM": jnp.add,
         "MPI_PROD": jnp.multiply,
         "MPI_MAX": jnp.maximum,

@@ -151,12 +151,14 @@ class OscRequest(Request):
     def _on_ack(self, p: _Pending) -> None:
         if not p.error and self._on_data is not None:
             self._on_data(b"" if p.data is None else p.data)
-        self._win._outstanding.pop(self._rid, None)
         if p.error and self._fire_and_forget:
             # fire-and-forget Put/Accumulate errors surface at the next
             # synchronization (MPI: errors attach to the epoch); waited
-            # requests raise from their own Wait instead
+            # requests raise from their own Wait instead. Record BEFORE
+            # popping _outstanding: Flush polls that dict from another
+            # thread and must not observe drained-but-unpoisoned state.
             self._win._epoch_error = p.error
+        self._win._outstanding.pop(self._rid, None)
         self._set_complete(p.error)
 
 
@@ -256,8 +258,17 @@ class Win:
                     return
 
     def _resolve(self, disp: int, nbytes: int) -> tuple:
-        """(flat view, local offset) for a target displacement."""
+        """(flat view, local offset) for a target displacement; bounds
+        violations raise so the origin gets an error ack instead of a
+        dropped frame (static windows included — numpy would otherwise
+        raise a bare ValueError on writes and silently CLAMP reads,
+        hanging the origin's unpack)."""
         if not self.dynamic:
+            if disp < 0 or disp + nbytes > self._bytes.nbytes:
+                raise MPIError(
+                    ERR_WIN,
+                    f"displacement [{disp}, {disp + nbytes}) outside the "
+                    f"{self._bytes.nbytes}-byte window")
             return self._bytes, disp
         for base, view in self._regions.items():
             if base <= disp and disp + nbytes <= base + view.nbytes:
@@ -412,11 +423,12 @@ class Win:
         npdt = _np_from_code(dcode) if dcode else np.dtype(np.uint8)
         try:
             reply = self._apply(verb, disp, count, npdt, opcode, body)
-        except MPIError as e:
-            # a bad target displacement must fail the ORIGIN's request,
-            # not silently drop the frame and hang its Flush
+        except Exception as e:
+            # ANY target-side failure must fail the ORIGIN's request, not
+            # silently drop the frame and hang its Flush
+            code = e.code if isinstance(e, MPIError) else ERR_WIN
             ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0,
-                            e.code, req_id)
+                            code, req_id)
             self._reply(origin, ack)
             return
         ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0, 0,

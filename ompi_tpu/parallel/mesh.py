@@ -25,6 +25,7 @@ from ompi_tpu.core import op as _op
 from ompi_tpu.core.errors import (
     MPIError,
     ERR_ARG,
+    ERR_RANK,
     ERR_UNSUPPORTED_OPERATION,
 )
 from ompi_tpu.core.group import Group
@@ -110,6 +111,18 @@ class XlaComm(Intracomm):
     def _require_uniform_groups(self, what: str) -> None:
         _ = self.size  # raises when non-uniform
 
+    def _check_root(self, root: int) -> None:
+        # root bounds must not force uniform sizes (rooted ops on
+        # non-uniform splits are fine: the root is a group-local
+        # position; groups smaller than root+1 have no such member and
+        # their rows are unspecified, matching singleton-padding rules)
+        if self.groups is None:
+            limit = self.world_size
+        else:
+            limit = max((len(g) for g in self.groups), default=1)
+        if not 0 <= root < limit:
+            raise MPIError(ERR_RANK, f"root {root} out of range")
+
     # ------------------------------------------------------------ sharding
     def sharding(self, *rest_spec):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -135,6 +148,12 @@ class XlaComm(Intracomm):
         from ompi_tpu.coll.xla import cache_key
 
         spc.record("allreduce")
+        if op.name in _op.PAIR_OPS:
+            # the cached executable retraces per shape, so the pair-layout
+            # contract must hold on every call, not just the first
+            from ompi_tpu.coll.xla import _check_device_op
+
+            _check_device_op(op, x)
         fn = self._jit_cache.get(cache_key("allreduce", op))
         if fn is not None:
             return fn(x)
@@ -167,9 +186,11 @@ class XlaComm(Intracomm):
         self._slot("barrier")(self)
 
     def gather(self, x, root: int = 0):
+        self._check_root(root)
         return self._slot("gather")(self, x, root)
 
     def scatter(self, x, root: int = 0):
+        self._check_root(root)
         return self._slot("scatter")(self, x, root)
 
     # MPI-style aliases

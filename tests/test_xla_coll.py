@@ -255,3 +255,61 @@ def test_ulfm_surface_singleton():
 
     with _pytest.raises(MPIError):
         d.Barrier()
+
+
+# ------------------- r2: pair ops, non-uniform splits, real movers -------
+def test_device_minloc_maxloc(world):
+    """MINLOC/MAXLOC lower to device pair reductions ([..., 2] layout),
+    replacing the r1 host-only restriction (reference: op/avx pair
+    kernels over MPI_FLOAT_INT)."""
+    vals = np.array([5., 3., 7., 3., 9., 1., 4., 1.])
+    pairs = np.stack([vals, np.arange(8.)], axis=-1)[:, None, :]
+    out = np.asarray(world.allreduce(world.shard(pairs), op=mpi_op.MINLOC))
+    np.testing.assert_array_equal(out[0, 0], [1.0, 5.0])
+    out = np.asarray(world.allreduce(world.shard(pairs), op=mpi_op.MAXLOC))
+    np.testing.assert_array_equal(out[0, 0], [9.0, 4.0])
+
+
+def test_device_pair_op_needs_pair_layout(world):
+    from ompi_tpu.core.errors import MPIError
+
+    with pytest.raises(MPIError):
+        world.allreduce(world.shard(np.zeros((8, 3))), op=mpi_op.MINLOC)
+
+
+def test_nonuniform_split_allreduce_bcast_scan(world):
+    """Arbitrary Split shapes (the reference supports any color layout,
+    comm.c) — r1 raised ERR_UNSUPPORTED for mixed group sizes."""
+    sub = world.Split([0, 0, 0, 1, 1, 2, 3, 3])
+    x = sub.shard(np.arange(8, dtype=np.float32)[:, None] + 1)
+    out = np.asarray(sub.allreduce(x))
+    np.testing.assert_array_equal(out[:, 0], [6, 6, 6, 9, 9, 6, 15, 15])
+    out = np.asarray(sub.bcast(x, root=0))
+    np.testing.assert_array_equal(out[:, 0], [1, 1, 1, 4, 4, 6, 7, 7])
+    out = np.asarray(sub.scan(x))
+    np.testing.assert_array_equal(out[:, 0], [1, 3, 6, 4, 9, 6, 7, 15])
+
+
+def test_scatter_real_semantics(world):
+    """Group rank p receives ROOT's chunk p (the r1 stub just resharded
+    the input, ignoring the root)."""
+    chunks = np.zeros((8, 8, 1), np.float32)
+    chunks[2] = np.arange(8)[:, None] * 10.0
+    out = np.asarray(world.scatter(world.shard(chunks), root=2))
+    np.testing.assert_array_equal(out[:, 0], np.arange(8) * 10.0)
+
+
+def test_scatter_grouped(world):
+    sub = world.Split([0, 0, 0, 0, 1, 1, 1, 1])
+    chunks = np.zeros((8, 4, 1), np.float32)
+    chunks[1] = np.arange(4)[:, None] + 100  # root 1 of group 0
+    chunks[5] = np.arange(4)[:, None] + 200  # root 1 of group 1
+    out = np.asarray(sub.scatter(sub.shard(chunks), root=1))
+    np.testing.assert_array_equal(out[:4, 0], np.arange(4) + 100)
+    np.testing.assert_array_equal(out[4:, 0], np.arange(4) + 200)
+
+
+def test_gather_root_rows(world):
+    x = world.shard(np.arange(8, dtype=np.float32)[:, None])
+    out = np.asarray(world.gather(x, root=0))
+    np.testing.assert_array_equal(out[0, :, 0], np.arange(8))
