@@ -1,22 +1,39 @@
-"""Benchmark: MPI_Allreduce through coll/xla vs raw jax.lax.psum.
+"""Benchmark suite: the BASELINE.json ladder on whatever devices exist.
 
-The BASELINE.json north star: OSU-style allreduce bus bandwidth through the
-MPI surface at >=80% of raw ``jax.lax.psum`` on the same devices — i.e. the
-framework's dispatch/compile-cache layer must not tax the collective. On a
-multi-chip mesh this measures true ICI bus bandwidth; on one chip it
-measures the same end-to-end path with the wire term degenerate (XLA
-compiles the 1-way psum to a device-local pass), which still bounds the
-framework overhead the target is about.
+Headline (ONE JSON line on stdout, driver contract):
+  allreduce bus-bandwidth through MPI_Allreduce/coll/xla as a fraction of
+  raw ``jax.lax.psum`` at 64MB f32 — the north star asks >= 0.80.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-value      = fraction of raw-psum throughput achieved via MPI_Allreduce
-vs_baseline= value / 0.80   (>= 1.0 means the north-star bar is met)
+Detail (stderr + BENCH_DETAIL.json):
+  - allreduce size sweep 1KB..64MB, ours vs raw psum (ladder #2)
+  - bcast / allgather / alltoall vs their raw lax counterparts
+    (ladders #3-#4)
+  - single-chip flagship-transformer train-step MFU (model-level number
+    the collective ratios exist to protect)
+
+On a multi-chip mesh the ratios measure true ICI traffic; on one chip
+the wire term is degenerate and the same numbers bound the framework's
+dispatch/compile-cache overhead, which is precisely the MPI-layer tax
+the >=80% target constrains.
 """
 
 import json
 import sys
 import time
+
+
+def _timed(fn, args, warmup=3, iters=15):
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
 
 
 def _paired_times(fn_a, fn_b, args, warmup: int = 5, iters: int = 30):
@@ -41,12 +58,148 @@ def _paired_times(fn_a, fn_b, args, warmup: int = 5, iters: int = 30):
     return ta[len(ta) // 2], tb[len(tb) // 2]
 
 
-def main() -> int:
+def _raw(world, body):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ompi_tpu.parallel.axes import shard_map_compat
+
+    return jax.jit(shard_map_compat(body, world.mesh, (P(world.axis),),
+                                    P(world.axis)))
+
+
+def bench_allreduce_sweep(world, n):
+    """Ladder #2: 1KB-64MB f32 allreduce, ours vs raw psum."""
+    import jax
+    import jax.numpy as jnp
+
+    def raw_body(b):
+        return jax.lax.psum(b, world.axis)
+
+    raw = _raw(world, raw_body)
+    bus = 2.0 * (n - 1) / n if n > 1 else 1.0
+    out = []
+    for nbytes in (1 << 10, 1 << 15, 1 << 20, 1 << 24, 1 << 26):
+        per_rank = max(nbytes // 4, 1)
+        x = world.shard(jnp.ones((n, per_rank), jnp.float32))
+        t_ours, t_raw = _paired_times(world.allreduce, raw, (x,))
+        out.append({
+            "bytes": per_rank * 4,
+            "ours_gbps": round(bus * per_rank * 4 / t_ours / 1e9, 3),
+            "raw_gbps": round(bus * per_rank * 4 / t_raw / 1e9, 3),
+            "fraction": round(t_raw / t_ours, 4),
+        })
+    return out
+
+
+def bench_verbs(world, n):
+    """Ladders #3-#4: bcast/allgather/alltoall vs raw lax counterparts
+    at 16MB per rank."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    per_rank = 4 * 1024 * 1024  # 16 MB f32
+    res = {}
+
+    x = world.shard(jnp.ones((n, per_rank), jnp.float32))
+    raw_bc = _raw(world, lambda b: jax.lax.psum(
+        jnp.where(lax.axis_index(world.axis) == 0, b, jnp.zeros_like(b)),
+        world.axis))
+    t_ours, t_raw = _paired_times(lambda a: world.bcast(a, 0), raw_bc, (x,))
+    res["bcast_16MB"] = {"ours_s": round(t_ours, 5), "raw_s": round(t_raw, 5),
+                         "fraction": round(t_raw / t_ours, 4)}
+
+    small = world.shard(jnp.ones((n, max(per_rank // n, 1)), jnp.float32))
+    raw_ag = _raw(world, lambda b: lax.all_gather(b[0], world.axis)[None])
+    t_ours, t_raw = _paired_times(world.allgather, raw_ag, (small,))
+    res["allgather_16MB_total"] = {
+        "ours_s": round(t_ours, 5), "raw_s": round(t_raw, 5),
+        "fraction": round(t_raw / t_ours, 4)}
+
+    chunks = world.shard(
+        jnp.ones((n, n, max(per_rank // n, 1)), jnp.float32))
+    raw_a2a = _raw(world, lambda b: lax.all_to_all(
+        b[0], world.axis, split_axis=0, concat_axis=0, tiled=False)[None])
+    t_ours, t_raw = _paired_times(world.alltoall, raw_a2a, (chunks,))
+    res["alltoall_16MB_total"] = {
+        "ours_s": round(t_ours, 5), "raw_s": round(t_raw, 5),
+        "fraction": round(t_raw / t_ours, 4)}
+    return res
+
+
+# Peak dense bf16 FLOP/s per chip (public specs; the scaling-book table).
+_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def bench_mfu():
+    """Single-chip train-step MFU on the flagship transformer
+    (VERDICT r1: 'no single-chip model-step MFU at all')."""
     import numpy as np
 
     import jax
     import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
+
+    from ompi_tpu.models import transformer as tfm
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu")
+    peak = next((v for k, v in _PEAK_FLOPS.items()
+                 if kind.lower().startswith(k.lower())), None)
+
+    on_tpu = peak is not None
+    cfg = tfm.Config(vocab=32768, d_model=1024, n_heads=16,
+                     n_layers=8, d_ff=4096, seq_len=1024) if on_tpu else \
+        tfm.Config(vocab=1024, d_model=128, n_heads=8, n_layers=2,
+                   d_ff=512, seq_len=128)
+    batch = 32 if on_tpu else 2
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1),
+                ("dp", "sp", "tp"))
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(
+        0, cfg.vocab, size=(batch, cfg.seq_len)).astype(np.int32))
+    tgts = jnp.asarray(np.roll(np.asarray(toks), -1, axis=1))
+    step, place = tfm.make_train_step(mesh, cfg)
+    p, t, g = place(params, toks, tgts)
+
+    def run(p, t, g):
+        loss, newp = step(p, t, g)
+        return newp
+
+    t_step = _timed(run, (p, t, g), warmup=2, iters=8)
+
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    tokens = batch * cfg.seq_len
+    # training FLOPs: 6*N per token (fwd 2N + bwd 4N) + attention
+    # 12*L*T*D per token (the scaling-book estimate)
+    flops = 6.0 * n_params * tokens \
+        + 12.0 * cfg.n_layers * cfg.seq_len * cfg.d_model * tokens
+    out = {
+        "device": kind,
+        "params_M": round(n_params / 1e6, 1),
+        "step_s": round(t_step, 4),
+        "tokens_per_s": round(tokens / t_step, 1),
+        "tflops_per_s": round(flops / t_step / 1e12, 2),
+    }
+    if peak:
+        out["mfu"] = round(flops / t_step / peak, 4)
+    return out
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
 
     from ompi_tpu.parallel import mesh_world
 
@@ -54,36 +207,27 @@ def main() -> int:
     n = len(devices)
     world = mesh_world(devices)
 
-    # 64 MB float32 per rank (the >=64MB BASELINE message size)
-    per_rank = 16 * 1024 * 1024
-    x = jnp.ones((n, per_rank), jnp.float32)
-    x = world.shard(x)
+    detail = {
+        "devices": [getattr(d, "device_kind", str(d)) for d in devices],
+        "allreduce_sweep": bench_allreduce_sweep(world, n),
+        "verbs": bench_verbs(world, n),
+        "model_step": bench_mfu(),
+    }
+    print(json.dumps(detail, indent=1), file=sys.stderr)
+    try:
+        with open("BENCH_DETAIL.json", "w") as f:
+            json.dump(detail, f, indent=1)
+    except OSError:
+        pass
 
-    # raw path: hand-written shard_map psum, same mesh
-    mesh = world.mesh
-
-    def raw_body(b):
-        return jax.lax.psum(b, world.axis)
-
-    from ompi_tpu.parallel.axes import shard_map_compat
-
-    raw = jax.jit(shard_map_compat(raw_body, mesh, (P(world.axis),),
-                                   P(world.axis)))
-    # ours: MPI_Allreduce via coll/xla — interleaved with raw so tunnel/
-    # clock drift cancels
-    t_ours, t_raw = _paired_times(world.allreduce, raw, (x,))
-
-    nbytes = per_rank * 4
-    # allreduce bus-bandwidth convention (OSU): 2*(n-1)/n * size / time
-    bus_factor = 2.0 * (n - 1) / n if n > 1 else 1.0
-    bw_ours = bus_factor * nbytes / t_ours / 1e9
-    bw_raw = bus_factor * nbytes / t_raw / 1e9
-
-    value = bw_ours / bw_raw if bw_raw > 0 else 0.0
+    # headline: the north-star 64MB allreduce fraction
+    top = detail["allreduce_sweep"][-1]
+    value = top["fraction"]
     result = {
         "metric": "allreduce_busbw_fraction_of_raw_psum "
-                  f"(64MB f32, {n} dev, ours {bw_ours:.1f} vs raw "
-                  f"{bw_raw:.1f} GB/s)",
+                  f"(64MB f32, {n} dev, ours {top['ours_gbps']} vs raw "
+                  f"{top['raw_gbps']} GB/s; "
+                  f"mfu={detail['model_step'].get('mfu', 'n/a')})",
         "value": round(value, 4),
         "unit": "fraction",
         "vs_baseline": round(value / 0.80, 4),

@@ -27,32 +27,82 @@ from typing import Optional
 import numpy as np
 
 
-def _block_attend(q, k, v, mask, sm_scale):
-    """One Q-block × KV-block partial attention.
+def _block_attend(q, k, v, keep_full, keep_tri, sm_scale, mxu_dtype,
+                  chunk: int):
+    """One Q-block × KV-block partial attention, CHUNKED over the KV dim
+    (flash-style): peak memory is O(Tq·chunk) instead of O(Tq·Tk), and
+    with ``mxu_dtype=bfloat16`` both matmuls run at MXU rate with f32
+    accumulation. Masks come from iota comparisons — the Tq×Tk boolean
+    never materializes.
 
-    q: [B, Tq, H, D], k/v: [B, Tk, H, D], mask: [Tq, Tk] bool (True=keep).
-    Returns (numerator [B, Tq, H, D], row_max [B, H, Tq], row_sum [B, H, Tq]).
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; keep_full / keep_tri: traced
+    scalars selecting the block relation (full attend / causal triangle /
+    neither). Returns (numerator [B, Tq, H, D], row_max [B, H, Tq],
+    row_sum [B, H, Tq]).
     """
     import jax.numpy as jnp
+    from jax import lax
 
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
-    s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
-    m = jnp.max(s, axis=-1)  # [B, H, Tq]
-    # guard fully-masked rows (all -inf): exp(-inf - -inf) -> use -inf max
-    safe_m = jnp.where(jnp.isneginf(m), 0.0, m)
-    p = jnp.exp(s - safe_m[..., None])
-    p = jnp.where(mask[None, None, :, :], p, 0.0)
-    num = jnp.einsum("bhqk,bkhd->bqhd", p, v)
-    den = jnp.sum(p, axis=-1)  # [B, H, Tq]
-    return num, m, den
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    chunk = min(chunk, Tk)
+    while Tk % chunk:
+        chunk //= 2  # Tk is a shard of a power-of-two-ish seq; stay exact
+    n_chunks = Tk // chunk
+    md = mxu_dtype or jnp.float32
+    qm = q.astype(md)
+    rows = jnp.arange(Tq)[:, None]  # global row index within the block
+
+    def body(carry, c):
+        acc, m, den = carry
+        k_c = lax.dynamic_slice_in_dim(k, c * chunk, chunk, 1).astype(md)
+        v_c = lax.dynamic_slice_in_dim(v, c * chunk, chunk, 1).astype(md)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qm, k_c,
+                       preferred_element_type=jnp.float32) * sm_scale
+        cols = c * chunk + jnp.arange(chunk)[None, :]
+        keep = keep_full | (keep_tri & (cols <= rows))  # [Tq, chunk]
+        s = jnp.where(keep[None, None], s, -jnp.inf)
+        m_p = jnp.max(s, axis=-1)  # [B, H, Tq]
+        m_new = jnp.maximum(m, m_p)
+        safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe[..., None])
+        p = jnp.where(keep[None, None], p, 0.0)
+        num_p = jnp.einsum("bhqk,bkhd->bqhd", p.astype(md), v_c,
+                           preferred_element_type=jnp.float32)
+        alpha = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - safe))
+        acc = acc * _bhq_to_bqh1(alpha) + num_p
+        den = den * alpha + jnp.sum(p, axis=-1)
+        return (acc, m_new, den), None
+
+    # seed the carry from a varying zero: inside shard_map the scan's
+    # carry type must match the body output, which varies over the ring
+    # axis (it depends on q) — a plain zeros() literal would be typed
+    # unvarying and reject
+    vzero = q[0, 0, 0, 0].astype(jnp.float32) * 0.0
+    acc0 = jnp.zeros((B, Tq, H, D), jnp.float32) + vzero
+    m0 = jnp.full((B, H, Tq), -jnp.inf, jnp.float32) + vzero
+    den0 = jnp.zeros((B, H, Tq), jnp.float32) + vzero
+    import jax
+
+    # checkpoint the chunk body: backward re-scores the tile instead of
+    # storing every chunk's probability matrix (the flash-backward
+    # recompute — without this, scan AD keeps O(n_chunks · Tq · chunk)
+    # residuals and training uses MORE memory than dense attention)
+    (acc, m, den), _ = lax.scan(jax.checkpoint(body), (acc0, m0, den0),
+                                jnp.arange(n_chunks))
+    return acc, m, den
 
 
 def ring_attention(q, k, v, axis_name: str, sp_size: int,
-                   sm_scale: Optional[float] = None, causal: bool = True):
+                   sm_scale: Optional[float] = None, causal: bool = True,
+                   mxu_dtype=None, chunk: int = 512):
     """Sequence-parallel attention inside shard_map.
 
     q, k, v: local shards [B, S/sp, H, D] on each device of the ``axis_name``
     ring (sp_size devices). Returns the local output shard [B, S/sp, H, D].
+    ``mxu_dtype=jnp.bfloat16`` runs both attention matmuls at MXU rate
+    with f32 accumulation (None = exact f32 math); ``chunk`` bounds the
+    KV tile each flash step scores against.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -69,23 +119,20 @@ def ring_attention(q, k, v, axis_name: str, sp_size: int,
     den = jnp.zeros((B, H, T), dtype=jnp.float32)        # running denom
 
     kv = (k, v)
-    tri = jnp.tril(jnp.ones((T, T), dtype=bool))
-    full = jnp.ones((T, T), dtype=bool)
 
     for step in range(sp_size):
         kv_idx = (my - step) % sp_size  # whose block we hold this step
         k_blk, v_blk = kv
         if causal:
-            # select the per-step mask by the (traced) block relation
+            # traced block relation: full attend / causal triangle / none
             keep_full = kv_idx < my
             keep_tri = kv_idx == my
-            mask = jnp.where(keep_tri, tri, jnp.where(keep_full, full,
-                                                      jnp.zeros_like(full)))
         else:
-            mask = full
+            keep_full = jnp.bool_(True)
+            keep_tri = jnp.bool_(False)
         num_p, m_p, den_p = _block_attend(
-            q.astype(jnp.float32), k_blk.astype(jnp.float32),
-            v_blk.astype(jnp.float32), mask, sm_scale)
+            q, k_blk, v_blk, keep_full, keep_tri, sm_scale, mxu_dtype,
+            chunk)
         # merge partial into running accumulators (log-sum-exp rescaling)
         m_new = jnp.maximum(m, m_p)
         safe = lambda x: jnp.where(jnp.isneginf(x), 0.0, x)
