@@ -1,0 +1,262 @@
+"""coll/han — hierarchical two-level collectives.
+
+Reference: ompi/mca/coll/han (10,517 LoC) — splits each collective into
+an intra-node phase over the fast local transport and an inter-node
+phase between per-node leaders, with sub-communicators built lazily on
+first use (coll_han_subcomms.c).
+
+TPU-native mapping: "node" = the set of peers reached over self/sm (the
+ICI/fast domain analog on the host path); the leader ("up") phase rides
+tcp (the DCN analog). Mesh-mode comms don't take this component: within
+a slice XLA already owns the hierarchical ICI schedule, and the
+multi-slice DCN split belongs to the launcher topology (future work,
+like the reference's han+accelerator stacking).
+
+Decision rule (reference: coll_han component query): at least two
+nodes AND at least one node with two or more ranks — otherwise the
+two-level split degenerates and the flat algorithms win.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ompi_tpu.coll.base import CollModule, coll_framework
+from ompi_tpu.comm.communicator import UNDEFINED
+from ompi_tpu.core import op as _op
+from ompi_tpu.mca.component import Component
+from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.runtime import spc
+
+import threading
+
+# guard: while han builds its own sub-communicators, their coll
+# selection must not pick han again (under fake topologies the
+# round-robin map could otherwise recurse a level per Split)
+_building = threading.local()
+
+register_var("coll_han", "fake_nodes", 0,
+             help="Pretend the comm spans N nodes (round-robin by rank) — "
+                  "the single-host test hook for the hierarchy "
+                  "(reference analog: han's topology override vars)",
+             level=7)
+
+
+class HanColl(CollModule):
+    """Two-level allreduce/bcast/reduce/barrier over lazily-built
+    (low, up) sub-communicators."""
+
+    def __init__(self, node_of: List[int]):
+        # full node map, identical on every member (from the modex or
+        # the fake-topology var) — per-rank heuristics would make the
+        # selection inconsistent across members and deadlock the first
+        # collective
+        self._node_of = node_of
+        self._low = None
+        self._up = None       # leaders comm (None on non-leaders)
+        self._built = False
+        # precomputed topology maps (the node map is immutable)
+        leaders = sorted(min(r for r, n in enumerate(node_of) if n == node)
+                         for node in set(node_of))
+        self._up_rank_of_node = {node_of[ld]: i
+                                 for i, ld in enumerate(leaders)}
+        members: dict = {}
+        for r, n in enumerate(node_of):
+            members.setdefault(n, []).append(r)
+        self._low_rank = {r: members[n].index(r)
+                          for r, n in enumerate(node_of)}
+
+    # ------------------------------------------------------------ subcomms
+    def _subcomms(self, comm):
+        """Build (low, up) on first use (reference:
+        coll_han_subcomms.c lazy creation inside the first collective —
+        legal because the first collective is the same on every
+        member)."""
+        if not self._built:
+            _building.active = True
+            try:
+                with spc.suppressed():
+                    node = self._node_of[comm.rank]
+                    low = comm.Split(node, comm.rank)
+                    is_leader = low.Get_rank() == 0
+                    up = comm.Split(0 if is_leader else UNDEFINED,
+                                    comm.rank)
+            finally:
+                _building.active = False
+            self._low, self._up = low, up
+            self._built = True
+        return self._low, self._up
+
+    def _up_root(self, comm, root_node: int) -> int:
+        """The up-comm rank of root_node's leader (leaders ordered by
+        comm rank; each node's leader is its lowest comm rank)."""
+        return self._up_rank_of_node[root_node]
+
+    # ---------------------------------------------------------- collectives
+    @staticmethod
+    def _flat():
+        """Flat fallback for re-entrant calls: the Splits inside
+        _subcomms run parent-comm collectives (Allgather + the CID
+        agreement's Allreduce) that dispatch back into han's own slots —
+        without this delegation the first collective deadlocks on
+        itself."""
+        from ompi_tpu.coll.basic import BasicColl
+
+        return BasicColl()
+
+    def allreduce(self, comm, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> None:
+        """low reduce -> leaders allreduce -> low bcast (the han
+        'simple' allreduce schedule). Non-commutative ops take the flat
+        path: the hierarchical split regroups contributions out of rank
+        order (reference: han checks ompi_op_is_commute and falls
+        back)."""
+        if getattr(_building, "active", False) or not op.commutative:
+            return self._flat().allreduce(comm, sendbuf, recvbuf, op)
+        from ompi_tpu.comm.communicator import parse_buffer
+
+        low, up = self._subcomms(comm)
+        with spc.suppressed():
+            low.Reduce(sendbuf, recvbuf, op=op, root=0)
+            if up is not None:
+                robj, rcount, rdt = parse_buffer(recvbuf)
+                tmp = np.array(np.asarray(robj), copy=True)
+                up.Allreduce([tmp, rcount, rdt], recvbuf, op=op)
+            low.Bcast(recvbuf, root=0)
+
+    # coll-plane tag for the leader->root hand-off in rooted reduce
+    _TAG_REDUCE_HANDOFF = -70
+
+    def reduce(self, comm, sendbuf, recvbuf, op: _op.Op = _op.SUM,
+               root: int = 0) -> None:
+        """Rooted two-level reduce honoring the MPI contract (recvbuf
+        significant ONLY at root — reference: han's reduce schedule with
+        a leader->root hand-off when the root isn't its node's
+        leader)."""
+        if getattr(_building, "active", False) or not op.commutative:
+            return self._flat().reduce(comm, sendbuf, recvbuf, op, root)
+        from ompi_tpu.coll.basic import COLL_CID_BIT
+        from ompi_tpu.comm.communicator import parse_buffer
+        from ompi_tpu.core.datatype import BYTE
+
+        low, up = self._subcomms(comm)
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        tmp = np.zeros(scount * sdt.size, np.uint8)
+        tview = [tmp, scount, sdt]
+        with spc.suppressed():
+            low.Reduce(sendbuf, tview, op=op, root=0)
+            root_up = self._up_rank_of_node[self._node_of[root]]
+            if up is not None:
+                tmp2 = np.zeros_like(tmp)
+                up.Reduce(tview, [tmp2, scount, sdt], op=op, root=root_up)
+                tmp = tmp2
+        # hand the result from the root-node leader to the root
+        leader_is_root = (self._low_rank[root] == 0)
+        cid = comm.cid | COLL_CID_BIT
+        if comm.rank == root:
+            robj, rcount, rdt = parse_buffer(recvbuf)
+            if leader_is_root and up is not None:
+                np.asarray(robj).reshape(-1).view(np.uint8)[
+                    : scount * sdt.size] = tmp
+            else:
+                comm.pml.irecv(robj, rcount, rdt,
+                               comm._world_rank(
+                                   min(r for r, n in
+                                       enumerate(self._node_of)
+                                       if n == self._node_of[root])),
+                               self._TAG_REDUCE_HANDOFF, cid).Wait()
+        if (up is not None and self._up_rank_of_node.get(
+                self._node_of[comm.rank]) == root_up
+                and self._low_rank[comm.rank] == 0
+                and not (leader_is_root and comm.rank == root)):
+            if self._node_of[comm.rank] == self._node_of[root]:
+                comm.pml.isend(tmp, scount, sdt,
+                               comm._world_rank(root),
+                               self._TAG_REDUCE_HANDOFF, cid).Wait()
+
+    def bcast(self, comm, buf, root: int = 0) -> None:
+        if getattr(_building, "active", False):
+            return self._flat().bcast(comm, buf, root)
+        low, up = self._subcomms(comm)  # completes self._node_of
+        root_node = self._node_of[root]
+        my_node = self._node_of[comm.rank]
+        with spc.suppressed():
+            if my_node == root_node:
+                # distribute within the root's node first so its leader
+                # holds the data for the up phase
+                low.Bcast(buf, root=self._low_rank_of(comm, root))
+            if up is not None:
+                up.Bcast(buf, root=self._up_root(comm, root_node))
+            if my_node != root_node:
+                low.Bcast(buf, root=0)
+
+    def _low_rank_of(self, comm, root: int) -> int:
+        node = self._node_of[root]
+        members = sorted(r for r in range(comm.size)
+                         if self._node_of[r] == node)
+        return members.index(root)
+
+    def barrier(self, comm) -> None:
+        if getattr(_building, "active", False):
+            return self._flat().barrier(comm)
+        low, up = self._subcomms(comm)
+        with spc.suppressed():
+            low.Barrier()
+            if up is not None:
+                up.Barrier()
+            low.Barrier()
+
+
+class HanCollComponent(Component):
+    NAME = "han"
+    PRIORITY = 45  # above tuned/basic; below xla/self
+
+    def query(self, comm=None, **ctx: Any) -> Optional[HanColl]:
+        from ompi_tpu.comm.communicator import ProcComm
+
+        if getattr(_building, "active", False):
+            return None  # never stack han inside its own subcomms
+        if not isinstance(comm, ProcComm) or comm.size < 3:
+            return None
+        fake = int(get_var("coll_han", "fake_nodes"))
+        if fake > 1:
+            if fake >= comm.size:
+                return None  # no node would hold 2+ ranks
+            return HanColl([r % fake for r in range(comm.size)])
+        node_of = self._modex_node_map(comm)
+        if node_of is None:
+            return None
+        n_nodes = len(set(node_of))
+        biggest = max(node_of.count(n) for n in set(node_of))
+        if n_nodes >= 2 and biggest >= 2:
+            return HanColl(node_of)
+        return None
+
+    @staticmethod
+    def _modex_node_map(comm) -> Optional[List[int]]:
+        """Node id per comm rank from the modex locality cards — the
+        SAME key/value store on every member, so the selection decision
+        (and the map) is consistent everywhere. Per-rank endpoint
+        heuristics are not: lazily-wired cross-job endpoints differ
+        between members (found the hard way — a mixed han/flat selection
+        deadlocks the first collective)."""
+        from ompi_tpu.runtime import wireup
+
+        ctx = wireup._ctx
+        if ctx is None:
+            return None
+        modex = ctx["modex"]
+        raw = []
+        for r in range(comm.size):
+            w = comm._world_rank(r)
+            try:
+                # post-fence, a missing card never appears: don't wait
+                raw.append(str(modex.get(w, "btl.sm.node", timeout=0.0)))
+            except Exception:
+                raw.append(f"solo-{w}")  # no sm: its own node
+        first: dict = {}
+        return [first.setdefault(sid, r) for r, sid in enumerate(raw)]
+
+
+coll_framework.register(HanCollComponent())

@@ -1,0 +1,51 @@
+"""Hierarchical (han) collectives under a fake 2-node topology
+(reference analog: coll/han's two-level schedules; single-host CI uses
+the fake-nodes hook the way the reference's han tests override
+topology)."""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core import op as mpi_op
+
+
+def main() -> int:
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+
+    # han must own the two-level slots under the fake topology
+    for slot in ("allreduce", "bcast", "barrier", "reduce"):
+        assert COMM_WORLD.coll.providers[slot] == "han", (
+            slot, COMM_WORLD.coll.providers[slot])
+
+    out = np.zeros(4, np.float64)
+    COMM_WORLD.Allreduce(np.full(4, float(r + 1)), out)
+    assert out[0] == n * (n + 1) / 2, out
+
+    COMM_WORLD.Allreduce(np.full(4, float(r + 1)), out, op=mpi_op.MAX)
+    assert out[0] == n, out
+
+    # bcast from every root (crosses node boundaries both ways)
+    for root in range(n):
+        data = np.full(3, float(r * 100), np.float64)
+        if r == root:
+            data[:] = [root + 0.5, -1.0, 7.0]
+        COMM_WORLD.Bcast(data, root=root)
+        np.testing.assert_array_equal(data, [root + 0.5, -1.0, 7.0])
+
+    COMM_WORLD.Barrier()
+
+    red = np.zeros(2, np.float64)
+    COMM_WORLD.Reduce(np.full(2, 2.0), red, op=mpi_op.SUM, root=1)
+    if r == 1:
+        assert red[0] == 2.0 * n, red
+
+    print(f"HAN-OK rank {r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Hierarchical collectives (reference: ompi/mca/coll/han)."""
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from tests.test_process_mode import run_mpi
+
+
+def test_han_not_selected_single_node():
+    """All-local comms must keep the flat algorithms (the han query
+    declines, reference: coll_han component query)."""
+    assert COMM_WORLD.coll.providers["allreduce"] != "han"
+
+
+def test_han_fake_2_nodes_4_ranks():
+    r = run_mpi(4, "tests/procmode/check_han.py",
+                mca=(("coll_han_fake_nodes", "2"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("HAN-OK") == 4
+
+
+def test_han_fake_2_nodes_5_ranks_uneven():
+    """Uneven node sizes (3+2) exercise the leader math off the
+    power-of-two path."""
+    r = run_mpi(5, "tests/procmode/check_han.py",
+                mca=(("coll_han_fake_nodes", "2"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("HAN-OK") == 5
+
+
+def test_han_fake_3_nodes_6_ranks():
+    r = run_mpi(6, "tests/procmode/check_han.py",
+                mca=(("coll_han_fake_nodes", "3"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("HAN-OK") == 6
