@@ -37,6 +37,9 @@ import threading
 # round-robin map could otherwise recurse a level per Split)
 _building = threading.local()
 
+# per-process cache of universe-rank -> node identity (world-static)
+_node_sid_cache: dict = {}
+
 register_var("coll_han", "fake_nodes", 0,
              help="Pretend the comm spans N nodes (round-robin by rank) — "
                   "the single-host test hook for the hierarchy "
@@ -62,6 +65,7 @@ class HanColl(CollModule):
                          for node in set(node_of))
         self._up_rank_of_node = {node_of[ld]: i
                                  for i, ld in enumerate(leaders)}
+        self._leader_of_node = {node_of[ld]: ld for ld in leaders}
         members: dict = {}
         for r, n in enumerate(node_of):
             members.setdefault(n, []).append(r)
@@ -134,14 +138,20 @@ class HanColl(CollModule):
         significant ONLY at root — reference: han's reduce schedule with
         a leader->root hand-off when the root isn't its node's
         leader)."""
-        if getattr(_building, "active", False) or not op.commutative:
+        if getattr(_building, "active", False) or not op.commutative \
+                or sendbuf is None:
+            # flat path for non-commutative ops and MPI_IN_PLACE (the
+            # staging below needs a real send descriptor)
             return self._flat().reduce(comm, sendbuf, recvbuf, op, root)
         from ompi_tpu.coll.basic import COLL_CID_BIT
         from ompi_tpu.comm.communicator import parse_buffer
-        from ompi_tpu.core.datatype import BYTE
 
         low, up = self._subcomms(comm)
         sobj, scount, sdt = parse_buffer(sendbuf)
+        if not sdt.is_contiguous:
+            # the packed staging buffer below is not a valid unpacked
+            # buffer for derived datatypes (extent > size) — flat path
+            return self._flat().reduce(comm, sendbuf, recvbuf, op, root)
         tmp = np.zeros(scount * sdt.size, np.uint8)
         tview = [tmp, scount, sdt]
         with spc.suppressed():
@@ -160,20 +170,14 @@ class HanColl(CollModule):
                 np.asarray(robj).reshape(-1).view(np.uint8)[
                     : scount * sdt.size] = tmp
             else:
+                leader = self._leader_of_node[self._node_of[root]]
                 comm.pml.irecv(robj, rcount, rdt,
-                               comm._world_rank(
-                                   min(r for r, n in
-                                       enumerate(self._node_of)
-                                       if n == self._node_of[root])),
+                               comm._world_rank(leader),
                                self._TAG_REDUCE_HANDOFF, cid).Wait()
-        if (up is not None and self._up_rank_of_node.get(
-                self._node_of[comm.rank]) == root_up
-                and self._low_rank[comm.rank] == 0
-                and not (leader_is_root and comm.rank == root)):
-            if self._node_of[comm.rank] == self._node_of[root]:
-                comm.pml.isend(tmp, scount, sdt,
-                               comm._world_rank(root),
-                               self._TAG_REDUCE_HANDOFF, cid).Wait()
+        if (not leader_is_root
+                and comm.rank == self._leader_of_node[self._node_of[root]]):
+            comm.pml.isend(tmp, scount, sdt, comm._world_rank(root),
+                           self._TAG_REDUCE_HANDOFF, cid).Wait()
 
     def bcast(self, comm, buf, root: int = 0) -> None:
         if getattr(_building, "active", False):
@@ -192,10 +196,7 @@ class HanColl(CollModule):
                 low.Bcast(buf, root=0)
 
     def _low_rank_of(self, comm, root: int) -> int:
-        node = self._node_of[root]
-        members = sorted(r for r in range(comm.size)
-                         if self._node_of[r] == node)
-        return members.index(root)
+        return self._low_rank[root]
 
     def barrier(self, comm) -> None:
         if getattr(_building, "active", False):
@@ -250,11 +251,15 @@ class HanCollComponent(Component):
         raw = []
         for r in range(comm.size):
             w = comm._world_rank(r)
-            try:
-                # post-fence, a missing card never appears: don't wait
-                raw.append(str(modex.get(w, "btl.sm.node", timeout=0.0)))
-            except Exception:
-                raw.append(f"solo-{w}")  # no sm: its own node
+            sid = _node_sid_cache.get(w)
+            if sid is None:
+                try:
+                    # post-fence, a missing card never appears: don't wait
+                    sid = str(modex.get(w, "btl.sm.node", timeout=0.0))
+                except Exception:
+                    sid = f"solo-{w}"  # no sm: its own node
+                _node_sid_cache[w] = sid
+            raw.append(sid)
         first: dict = {}
         return [first.setdefault(sid, r) for r, sid in enumerate(raw)]
 

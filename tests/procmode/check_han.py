@@ -43,6 +43,15 @@ def main() -> int:
     if r == 1:
         assert red[0] == 2.0 * n, red
 
+    # root that is NOT its node's leader (fake round-robin: rank 3's
+    # node is {1, 3}, leader 1) exercises the leader->root hand-off
+    if n >= 4:
+        red2 = np.zeros(2, np.float64)
+        COMM_WORLD.Reduce(np.full(2, float(r)), red2, op=mpi_op.SUM,
+                          root=3)
+        if r == 3:
+            assert red2[0] == sum(range(n)), red2
+
     print(f"HAN-OK rank {r}")
     return 0
 

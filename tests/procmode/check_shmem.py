@@ -1,0 +1,75 @@
+"""OpenSHMEM layer at real PEs (reference analog: the oshmem examples —
+hello_oshmem, ring put/get, atomics, reductions)."""
+
+import sys
+
+import numpy as np
+
+from ompi_tpu import shmem
+
+
+def main() -> int:
+    shmem.init()
+    me = shmem.my_pe()
+    n = shmem.n_pes()
+
+    a = shmem.zeros(8, np.float64)
+    b = shmem.zeros(4, np.int64)
+    ctr = shmem.zeros(1, np.int64)
+    shmem.barrier_all()
+
+    # ring put: write my id into my right neighbor's a[0:2]
+    nxt = (me + 1) % n
+    shmem.put(a, np.full(2, float(me)), pe=nxt)
+    shmem.barrier_all()
+    prv = (me - 1) % n
+    assert a.local[0] == float(prv), (a.local[0], prv)
+
+    # get from neighbor
+    got = shmem.get(a, 2, pe=nxt)
+    assert got[0] == float(me), got  # what I wrote there
+
+    # scalar p/g
+    shmem.p(b, me * 10 + 1, pe=nxt, offset=2)
+    shmem.barrier_all()
+    assert b.local[2] == prv * 10 + 1
+    assert shmem.g(b, pe=nxt, offset=2) == me * 10 + 1
+
+    # atomics: everyone increments PE 0's counter
+    old = shmem.atomic_fetch_add(ctr, 1, pe=0)
+    assert 0 <= old < n
+    shmem.barrier_all()
+    if me == 0:
+        assert ctr.local[0] == n, ctr.local
+    # compare-swap: only one PE wins the 0 -> 999 race on PE 0's b[0]
+    won = shmem.atomic_compare_swap(b, 0, 999, pe=0)
+    shmem.barrier_all()
+    if me == 0:
+        assert b.local[0] == 999
+
+    # collectives
+    src = shmem.zeros(3, np.float64)
+    dst = shmem.zeros(3, np.float64)
+    src.local[:] = me + 1
+    shmem.barrier_all()
+    shmem.sum_to_all(dst, src)
+    assert dst.local[0] == n * (n + 1) / 2, dst.local
+
+    bc = shmem.zeros(2, np.float64)
+    if me == n - 1:
+        bc.local[:] = [3.5, 4.5]
+    shmem.barrier_all()
+    shmem.broadcast(bc, root=n - 1)
+    np.testing.assert_array_equal(bc.local, [3.5, 4.5])
+
+    coll = shmem.collect(src)
+    np.testing.assert_array_equal(
+        coll, np.repeat(np.arange(1, n + 1, dtype=np.float64), 3))
+
+    shmem.finalize()
+    print(f"SHMEM-OK pe {me}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+"""OpenSHMEM layer (reference: oshmem/ — spml put/get over the osc
+engine, memheap symmetric allocation, scoll delegating to MPI coll)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import shmem
+from tests.test_process_mode import run_mpi
+
+
+def test_shmem_singleton_roundtrip():
+    shmem.init()
+    assert shmem.n_pes() == 1 and shmem.my_pe() == 0
+    a = shmem.zeros(4, np.float64)
+    shmem.put(a, [1.0, 2.0, 3.0, 4.0], pe=0)
+    shmem.quiet()
+    np.testing.assert_array_equal(a.local, [1, 2, 3, 4])
+    np.testing.assert_array_equal(shmem.get(a, 4, pe=0), [1, 2, 3, 4])
+    assert shmem.atomic_fetch_add(a, 10.0, pe=0) == 1.0
+    assert a.local[0] == 11.0
+    assert shmem.atomic_compare_swap(a, 11.0, 99.0, pe=0) == 11.0
+    assert a.local[0] == 99.0
+    shmem.barrier_all()
+
+
+def test_shmem_symmetric_offsets_and_heap_guard():
+    shmem.init()
+    x = shmem.zeros(2, np.int64)
+    y = shmem.zeros(2, np.int64)
+    assert y.off > x.off and y.off % 16 == 0
+    from ompi_tpu.core.errors import MPIError
+
+    with pytest.raises(MPIError):
+        shmem.zeros(1 << 30, np.float64)  # heap exhausted
+
+
+def test_shmem_procmode_4_pes():
+    r = run_mpi(4, "tests/procmode/check_shmem.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("SHMEM-OK") == 4
+
+
+def test_shmem_procmode_3_pes():
+    r = run_mpi(3, "tests/procmode/check_shmem.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("SHMEM-OK") == 3
