@@ -1,0 +1,114 @@
+"""ompi_tpu — a TPU-native MPI framework.
+
+A brand-new framework with the capabilities of Open MPI (see SURVEY.md), built
+idiomatically for TPUs: collectives lower to XLA collective HLO (psum,
+all_gather, ppermute, all_to_all) executed over the ICI mesh via the ``coll/xla``
+component; tag-matched point-to-point traffic runs over host/DCN transports
+(tcp, shm) behind an ob1-style matching engine; launch/wireup is a PMIx-style
+modex; device buffers are first-class via the ``accelerator/tpu`` component.
+
+Two execution modes are first-class:
+
+- **SPMD mesh mode** (single controller): ``MPI_COMM_WORLD`` projects onto a
+  ``jax.sharding.Mesh``; sub-communicators become ``axis_index_groups``;
+  collectives are traced/jitted XLA programs. This is the TPU-performance path
+  (reference analog: the north-star ``coll/xla`` component of BASELINE.json).
+- **Process mode** (multi-controller): one OS process per rank launched by
+  ``ompi_tpu.tools.mpirun``; wireup via a PMIx-lite modex server; transports
+  selected by the MCA machinery (reference analog: opal/mca/btl + pml/ob1).
+
+The public surface mirrors mpi4py-style MPI naming so reference users can map
+concepts 1:1 (reference: ompi/mpi/c/*.c.in generated bindings).
+"""
+
+from ompi_tpu.version import __version__
+
+# Core constants and handle types (reference: ompi/include/mpi.h.in)
+from ompi_tpu.core.errors import (
+    MPIError,
+    SUCCESS,
+    ERR_ARG,
+    ERR_BUFFER,
+    ERR_COMM,
+    ERR_COUNT,
+    ERR_INTERN,
+    ERR_OP,
+    ERR_PENDING,
+    ERR_PROC_FAILED,
+    ERR_RANK,
+    ERR_REVOKED,
+    ERR_TAG,
+    ERR_TRUNCATE,
+    ERR_TYPE,
+    ERR_UNSUPPORTED_OPERATION,
+)
+from ompi_tpu.core.datatype import (
+    Datatype,
+    BYTE,
+    CHAR,
+    INT8,
+    INT16,
+    INT32,
+    INT64,
+    UINT8,
+    UINT16,
+    UINT32,
+    UINT64,
+    FLOAT16,
+    BFLOAT16,
+    FLOAT32,
+    FLOAT64,
+    COMPLEX64,
+    COMPLEX128,
+    BOOL,
+    INT,
+    LONG,
+    FLOAT,
+    DOUBLE,
+)
+from ompi_tpu.core.op import (
+    Op,
+    MAX,
+    MIN,
+    SUM,
+    PROD,
+    LAND,
+    BAND,
+    LOR,
+    BOR,
+    LXOR,
+    BXOR,
+    MINLOC,
+    MAXLOC,
+    NO_OP,
+    REPLACE,
+)
+from ompi_tpu.core.group import Group
+from ompi_tpu.core.status import Status
+from ompi_tpu.core.request import Request
+from ompi_tpu.core.info import Info
+
+# Wildcards / sentinels (reference: mpi.h.in MPI_ANY_SOURCE etc.)
+ANY_SOURCE = -1
+ANY_TAG = -1
+PROC_NULL = -2
+ROOT = -3
+UNDEFINED = -32766
+
+from ompi_tpu.accelerator import DeviceBuffer
+from ompi_tpu.comm.communicator import Communicator, Intracomm
+from ompi_tpu.comm.intercomm import Intercomm, Intercomm_create
+from ompi_tpu.runtime.dpm import Comm_get_parent
+from ompi_tpu.runtime.state import (
+    Init,
+    Finalize,
+    Is_initialized,
+    Is_finalized,
+    init,
+    finalize,
+    get_world,
+    COMM_WORLD,
+    COMM_SELF,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

@@ -1,0 +1,35 @@
+"""Accelerator framework — device buffers as first-class MPI buffers.
+
+Reference: opal/mca/accelerator (framework accelerator.h:671-712;
+components cuda/rocm/ze/null). Here: ``tpu`` (jax/PJRT-backed) and
+``null`` (host stub, the test fake).
+
+Integration points (reference analogs):
+- ``parse_buffer`` (comm/communicator.py) calls ``is_device_buffer`` on
+  every verb, staging device send buffers through host — the
+  coll/accelerator + pml_ob1_accelerator.c staging pattern.
+- Receive-side device results use :class:`DeviceBuffer` (functional
+  update instead of in-place device writes — jax.Arrays are immutable).
+- Mesh-mode comms (parallel/mesh.py XlaComm) bypass staging entirely:
+  device buffers stay on device and collectives lower to XLA HLO, which
+  is the whole point of the TPU-native design.
+"""
+
+from ompi_tpu.accelerator.base import (
+    AcceleratorModule,
+    DeviceBuffer,
+    accelerator_framework,
+    get_module,
+    is_device_buffer,
+    stage_to_host,
+)
+from ompi_tpu.accelerator import tpu as _tpu  # registers tpu + null
+
+__all__ = [
+    "AcceleratorModule",
+    "DeviceBuffer",
+    "accelerator_framework",
+    "get_module",
+    "is_device_buffer",
+    "stage_to_host",
+]

@@ -1,0 +1,209 @@
+"""Accelerator framework — device-memory abstraction.
+
+Reference: opal/mca/accelerator/accelerator.h:671-712 — the module
+function table every accelerator component (cuda/rocm/ze/null) implements:
+check_addr, mem_alloc/release, mem_copy (sync+async), get_address_range,
+IPC handles, host_register, get_device, device_can_access_peer,
+get_buffer_id, num_devices, get_mem_bw.
+
+TPU-native redesign: TPUs expose no raw device pointers — device memory is
+opaque ``jax.Array`` buffers owned by the runtime. So ``check_addr`` is a
+type/registry membership test rather than an address-range lookup, copies
+are ``device_put``/``np.asarray`` (which ride PJRT's async streams), and
+"IPC" is serialization through host memory (single-controller mesh mode
+makes true cross-process device IPC unnecessary: every device is already
+addressable from the one controller).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.mca.component import framework
+
+accelerator_framework = framework(
+    "accelerator", "Device memory abstraction (TPU/HBM buffers)"
+)
+
+
+class AcceleratorModule:
+    """The module contract (reference: mca_accelerator_base_module_t).
+
+    Flags mirror the reference's transfer-type enum
+    (MCA_ACCELERATOR_TRANSFER_{HTOD,DTOH,DTOD}).
+    """
+
+    NAME = "base"
+
+    # --- identity / discovery ------------------------------------------
+    def check_addr(self, obj: Any) -> bool:
+        """Is ``obj`` device memory? (reference: accelerator.h:176 —
+        flags out-param collapsed into the bool; TPU buffers are always
+        "unified-addressing false, device true")."""
+        raise NotImplementedError
+
+    def num_devices(self) -> int:
+        """reference: accelerator.h:647"""
+        raise NotImplementedError
+
+    def get_device(self, obj: Any) -> int:
+        """Device ordinal owning the buffer (reference: get_device)."""
+        raise NotImplementedError
+
+    def get_buffer_id(self, obj: Any) -> int:
+        """Stable id for a device buffer (reference: get_buffer_id, used
+        by the rcache to detect buffer reuse)."""
+        raise NotImplementedError
+
+    def device_can_access_peer(self, dev_a: int, dev_b: int) -> bool:
+        """reference: device_can_access_peer — on TPU, every chip in the
+        slice is ICI-reachable."""
+        raise NotImplementedError
+
+    def get_mem_bw(self, device: int = 0) -> float:
+        """HBM bandwidth estimate in GB/s (reference: accelerator.h:657,
+        used by coll decision layers to weigh staging costs)."""
+        raise NotImplementedError
+
+    # --- alloc / copy ---------------------------------------------------
+    def mem_alloc(self, nbytes: int, device: int = 0) -> Any:
+        """Allocate an uninitialized device buffer of ``nbytes`` bytes
+        (reference: mem_alloc, accelerator.h:364)."""
+        raise NotImplementedError
+
+    def mem_release(self, obj: Any) -> None:
+        """reference: mem_release — jax buffers are GC-owned; explicit
+        release is delete()."""
+        raise NotImplementedError
+
+    def mem_copy_to_host(self, obj: Any) -> np.ndarray:
+        """DTOH copy; blocks until the device value is materialized
+        (reference: mem_copy with MCA_ACCELERATOR_TRANSFER_DTOH)."""
+        raise NotImplementedError
+
+    def mem_copy_to_device(self, host: np.ndarray,
+                           device: Optional[int] = None) -> Any:
+        """HTOD copy; async under PJRT, completion on first use
+        (reference: mem_copy_async HTOD)."""
+        raise NotImplementedError
+
+    def synchronize(self, obj: Any = None) -> None:
+        """Fence outstanding async work on a buffer (or all work when
+        obj is None). Reference analog: stream/event synchronize
+        (accelerator.h:189-258); PJRT's equivalent is
+        block_until_ready."""
+        raise NotImplementedError
+
+    # --- IPC ------------------------------------------------------------
+    def get_ipc_handle(self, obj: Any) -> bytes:
+        """Serialize a device buffer so another process can reconstruct
+        it (reference: get_ipc_handle, accelerator.h:447). TPU has no
+        cross-process device handles; the bytes carry dtype/shape/data
+        through host memory."""
+        raise NotImplementedError
+
+    def open_ipc_handle(self, handle: bytes) -> Any:
+        """Reconstruct a device buffer from a handle (reference:
+        open_ipc_handle)."""
+        raise NotImplementedError
+
+    # --- host registration ---------------------------------------------
+    def host_register(self, host: np.ndarray) -> None:
+        """Pin host memory for faster DMA (reference: host_register).
+        PJRT manages its own staging; no-op by default."""
+
+    def host_unregister(self, host: np.ndarray) -> None:
+        pass
+
+
+class DeviceBuffer:
+    """Receive-side holder for device data.
+
+    jax.Arrays are immutable, so MPI's "recv into this buffer" contract
+    cannot mutate one in place. A DeviceBuffer owns a mutable host staging
+    array that the PML/collective writes into, and exposes the result as a
+    fresh device array — the functional-update idiom XLA expects instead
+    of the reference's in-place device writes (accelerator mem_copy DTOD).
+
+    Usage::
+
+        out = DeviceBuffer((4,), jnp.float32)
+        comm.Allreduce(jax_send_array, out)
+        result = out.array        # jax.Array on device
+    """
+
+    def __init__(self, shape_or_array, dtype=None, device: Optional[int] = None):
+        if dtype is None and hasattr(shape_or_array, "dtype"):
+            # wrap an existing array (device or host) as initial contents
+            init = np.asarray(shape_or_array)
+            self.host = np.array(init)  # mutable copy
+        else:
+            shape = (shape_or_array if isinstance(shape_or_array, tuple)
+                     else (int(shape_or_array),))
+            self.host = np.zeros(shape, dtype=np.dtype(dtype))
+        self.device = device
+        self._cache: Tuple[int, Any] = (-1, None)
+        self._version = 0
+
+    def _mark_dirty(self) -> None:
+        self._version += 1
+
+    @property
+    def array(self):
+        """The current contents as a device array (cached per version)."""
+        ver, arr = self._cache
+        if ver != self._version or arr is None:
+            mod = get_module()
+            arr = mod.mem_copy_to_device(self.host, self.device)
+            self._cache = (self._version, arr)
+        return arr
+
+    def __array__(self, dtype=None):
+        return self.host if dtype is None else self.host.astype(dtype)
+
+
+# ----------------------------------------------------------------- selection
+_selected: Optional[AcceleratorModule] = None
+
+
+def get_module() -> AcceleratorModule:
+    """The process-wide accelerator module (reference:
+    opal_accelerator_base_module singleton selected at init —
+    accelerator_base_select.c)."""
+    global _selected
+    if _selected is None:
+        _, _selected = accelerator_framework.select_one()
+    return _selected
+
+
+def _reset_selection() -> None:
+    """Test hook: force re-selection (e.g. after changing the MCA var)."""
+    global _selected
+    _selected = None
+
+
+def is_device_buffer(obj: Any) -> bool:
+    """Cheap global check used by parse_buffer on every verb. Avoids
+    selecting/initializing a backend for plain host buffers."""
+    # Fast structural test first: all jax Arrays have these; plain
+    # ndarrays/bytearrays do not.
+    if isinstance(obj, (np.ndarray, bytes, bytearray, memoryview)):
+        return False
+    if not hasattr(obj, "addressable_shards") and not hasattr(obj, "device_buffer"):
+        # covers jax.Array across versions without importing jax here
+        if type(obj).__module__.split(".")[0] not in ("jax", "jaxlib"):
+            return False
+    return get_module().check_addr(obj)
+
+
+def stage_to_host(obj: Any) -> np.ndarray:
+    """DTOH-stage a device buffer for the host data path, returning a
+    READ-ONLY ndarray: writes into the staging copy would be silently
+    lost (the device array is immutable), so attempting one must fail
+    loudly. Receive-side device data goes through DeviceBuffer instead."""
+    host = get_module().mem_copy_to_host(obj)
+    host = np.ascontiguousarray(host)
+    host.flags.writeable = False
+    return host

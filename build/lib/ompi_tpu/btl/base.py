@@ -1,0 +1,41 @@
+"""BTL — Byte Transfer Layer contract.
+
+Reference: opal/mca/btl/btl.h:1170+ (mca_btl_base_module_t) — the raw
+transport function table with eager/rendezvous limits. Our contract is a
+slim frame interface: a BTL moves (header, payload) frames to a peer and
+hands received frames to the PML's ``handle_incoming``. RDMA verbs
+(put/get/atomics) are intentionally absent on the host path: device bulk
+data rides the ICI/XLA path (coll/xla, osc over mesh) — the TPU-native
+answer to the reference's RDMA pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ompi_tpu.mca.component import Component, framework
+
+btl_framework = framework(
+    "btl", "Byte transfer layer (host/DCN transports)"
+)
+
+
+class Btl:
+    """Transport module. eager_limit=None means the transport has no
+    rendezvous threshold (loopback/shm can move any size in one frame)."""
+
+    NAME = "base"
+    eager_limit: Optional[int] = 65536
+
+    def __init__(self, deliver: Callable[[bytes, bytes], None]):
+        # deliver(header_bytes, payload) — the PML's handle_incoming.
+        self.deliver = deliver
+
+    def send(self, peer: int, header: bytes, payload) -> None:
+        raise NotImplementedError
+
+    def progress(self) -> int:
+        return 0
+
+    def finalize(self) -> None:
+        pass

@@ -1,0 +1,326 @@
+"""TCP transport.
+
+Reference: opal/mca/btl/tcp (5,240 LoC — libevent-driven endpoints with
+multi-link striping). Redesign: one non-blocking listener + lazy outgoing
+connections, drained by the central progress engine (selectors-based; the
+GIL releases in select so the progress thread is cheap). This is the DCN
+path of the framework — ICI bulk data rides coll/xla instead, so the TCP
+btl optimizes for control/pt2pt traffic, not peak bandwidth.
+
+Frame format: [u32 total_len][header HDR_SIZE bytes][payload]. One frame
+per pml message/fragment; TCP ordering per connection preserves MPI
+ordering per peer (the reference's per-peer seq numbers guard reordering
+across *multiple* btls; with one link per peer ordering is structural).
+"""
+
+from __future__ import annotations
+
+import errno
+import selectors
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ompi_tpu.btl.base import Btl, btl_framework
+from ompi_tpu.mca.component import Component
+from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.pml.base import HDR_SIZE
+from ompi_tpu.utils.output import get_logger
+
+register_var("btl_tcp", "eager_limit", 1 << 20,
+             help="TCP eager/rendezvous threshold in bytes", level=4)
+register_var("btl_tcp", "bind_host", "127.0.0.1",
+             help="Interface to bind/advertise (reference: btl_tcp_if_*)",
+             level=4)
+
+_LEN = struct.Struct("<I")
+
+
+class _Conn:
+    __slots__ = ("sock", "rbuf", "wbuf", "wlock", "peer", "dead")
+
+    def __init__(self, sock: socket.socket, peer: Optional[int] = None):
+        self.sock = sock
+        self.rbuf = bytearray()
+        # pending outbound bytes (reference: btl/tcp's per-endpoint pending
+        # frag list flushed on write-ready events)
+        self.wbuf = bytearray()
+        # RLock: _conn_failed runs both under wlock (from _flush_locked)
+        # and without it (from _drain's read-error path)
+        self.wlock = threading.RLock()
+        self.peer = peer
+        self.dead: Optional[OSError] = None
+
+
+class TcpBtl(Btl):
+    NAME = "tcp"
+
+    def __init__(self, deliver: Callable[[bytes, bytes], None], my_rank: int):
+        super().__init__(deliver)
+        self.eager_limit = get_var("btl_tcp", "eager_limit")
+        self.my_rank = my_rank
+        self.log = get_logger("btl.tcp")
+        host = get_var("btl_tcp", "bind_host")
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind((host, 0))
+        self.listener.listen(64)
+        self.listener.setblocking(False)
+        self.host, self.port = self.listener.getsockname()
+        self.peers: Dict[int, str] = {}
+        self.conns: Dict[int, _Conn] = {}  # peer rank -> connection
+        self._conn_lock = threading.Lock()
+        self.sel = selectors.DefaultSelector()
+        self.sel.register(self.listener, selectors.EVENT_READ,
+                          ("accept", None))
+        self._sel_lock = threading.Lock()
+        # single-drainer: exactly one thread runs the event loop at a time
+        # (the app thread's wait-loop and the progress thread both call
+        # progress(); concurrent drains would interleave frame parsing)
+        self._progress_lock = threading.Lock()
+        self._closed = False
+
+    # ------------------------------------------------------------- wiring
+    def set_peers(self, peers: Dict[int, str]) -> None:
+        self.peers = dict(peers)
+
+    def _connect(self, peer: int) -> _Conn:
+        addr = self.peers[peer]
+        host, port = addr.rsplit(":", 1)
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                s = socket.create_connection((host, int(port)), timeout=30.0)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.02)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # identify ourselves so the acceptor can map conn -> rank
+        s.sendall(_LEN.pack(self.my_rank))
+        conn = _Conn(s, peer)
+        s.setblocking(False)
+        with self._sel_lock:
+            self.sel.register(s, selectors.EVENT_READ, ("peer", conn))
+        return conn
+
+    def _get_conn(self, peer: int) -> _Conn:
+        with self._conn_lock:
+            conn = self.conns.get(peer)
+            if conn is None:
+                conn = self._connect(peer)
+                self.conns[peer] = conn
+            return conn
+
+    # --------------------------------------------------------------- send
+    def send(self, peer: int, header: bytes, payload) -> None:
+        """Enqueue a frame; bytes move via non-blocking flushes (here
+        opportunistically, otherwise from progress()). Never blocks the
+        caller on a full socket — the head-to-head large-send deadlock the
+        reference's pending-frag design exists to avoid."""
+        conn = self._get_conn(peer)
+        if not isinstance(payload, (bytes, bytearray)):
+            payload = bytes(memoryview(payload))
+        frame = _LEN.pack(HDR_SIZE + len(payload)) + header + payload
+        with conn.wlock:
+            # dead-check under wlock: _conn_failed flips dead/clears wbuf
+            # under the same lock, so a frame can't slip past the check
+            # into a cleared buffer
+            if conn.dead is not None:
+                from ompi_tpu.core.errors import MPIError, ERR_OTHER
+
+                raise MPIError(
+                    ERR_OTHER,
+                    f"connection to rank {peer} is dead: {conn.dead}")
+            conn.wbuf += frame
+            self._flush_locked(conn)
+
+    def _flush_locked(self, conn: _Conn) -> None:
+        """Push queued bytes; caller holds conn.wlock."""
+        while conn.wbuf:
+            try:
+                sent = conn.sock.send(conn.wbuf)
+            except socket.error as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    self._want_write(conn, True)
+                    return
+                # Fatal send error: queued (and eagerly-completed) bytes are
+                # lost. Surface it — mark the conn dead, tell the failure
+                # detector, fail future sends to this peer (ADVICE r1).
+                self._conn_failed(conn, e)
+                return
+            if sent <= 0:
+                self._want_write(conn, True)
+                return
+            del conn.wbuf[:sent]
+        self._want_write(conn, False)
+
+    def _conn_failed(self, conn: _Conn, err: OSError) -> None:
+        """A connection died under queued traffic: drop it, surface the
+        loss (reference: btl/tcp endpoint error → pml error callback; here
+        the ULFM detector is the propagation plane)."""
+        with conn.wlock:
+            conn.dead = err
+            conn.wbuf.clear()
+        self.log.error("i/o with rank %s failed: %s", conn.peer, err)
+        self._unregister(conn)
+        # The dead conn stays in self.conns: bytes already queued (and
+        # eagerly completed) were lost, so silently reconnecting would hide
+        # a hole in the message stream — subsequent sends raise instead.
+        if conn.peer is not None:
+            from ompi_tpu.ft.detector import mark_failed
+
+            mark_failed(conn.peer)
+
+    def _want_write(self, conn: _Conn, on: bool) -> None:
+        ev = selectors.EVENT_READ | (selectors.EVENT_WRITE if on else 0)
+        with self._sel_lock:
+            try:
+                self.sel.modify(conn.sock, ev, ("peer", conn))
+            except (KeyError, ValueError):
+                pass
+
+    # ----------------------------------------------------------- progress
+    def progress(self) -> int:
+        """Drain ready sockets; called from the progress engine
+        (reference: btl progress fns registered at opal_progress.c:416)."""
+        if self._closed:
+            return 0
+        if not self._progress_lock.acquire(blocking=False):
+            return 0
+        try:
+            try:
+                with self._sel_lock:
+                    events = self.sel.select(timeout=0)
+            except OSError:
+                return 0
+            n = 0
+            for key, mask in events:
+                kind, conn = key.data
+                if kind == "accept":
+                    n += self._accept()
+                    continue
+                if mask & selectors.EVENT_WRITE:
+                    with conn.wlock:
+                        self._flush_locked(conn)
+                if mask & selectors.EVENT_READ:
+                    n += self._drain(conn)
+            return n
+        finally:
+            self._progress_lock.release()
+
+    def _accept(self) -> int:
+        try:
+            s, _ = self.listener.accept()
+        except OSError:
+            return 0
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # first 4 bytes: peer rank
+        s.setblocking(True)
+        raw = b""
+        while len(raw) < 4:
+            chunk = s.recv(4 - len(raw))
+            if not chunk:
+                return 0
+            raw += chunk
+        peer = _LEN.unpack(raw)[0]
+        conn = _Conn(s, peer)
+        s.setblocking(False)
+        with self._conn_lock:
+            # keep one canonical conn per peer for sending; both sides may
+            # connect simultaneously — every conn gets drained regardless
+            self.conns.setdefault(peer, conn)
+        with self._sel_lock:
+            self.sel.register(s, selectors.EVENT_READ, ("peer", conn))
+        return 1
+
+    def _drain(self, conn: _Conn) -> int:
+        try:
+            data = conn.sock.recv(1 << 20)
+        except socket.error as e:
+            if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                return 0
+            self._conn_failed(conn, e)
+            return 0
+        if not data:
+            # EOF: could be a peer crash OR a clean peer Finalize — mark the
+            # conn dead so later sends raise instead of vanishing, but leave
+            # failure *detection* to the heartbeat detector (a clean
+            # shutdown must not raise ULFM failure events).
+            if conn.dead is None:
+                conn.dead = ConnectionResetError("closed by peer")
+            self._unregister(conn)
+            return 0
+        conn.rbuf += data
+        n = 0
+        buf = conn.rbuf
+        off = 0
+        while len(buf) - off >= 4:
+            total = _LEN.unpack_from(buf, off)[0]
+            if len(buf) - off - 4 < total:
+                break
+            start = off + 4
+            hdr = bytes(buf[start : start + HDR_SIZE])
+            payload = bytes(buf[start + HDR_SIZE : start + total])
+            off += 4 + total
+            # A frame handler may itself send (ob1 replies with CTS/DATA
+            # from inside deliver); if that send hits a dead peer the
+            # MPIError must not escape — it would skip the rbuf trim below
+            # (re-delivering frames) and kill the progress thread.
+            try:
+                self.deliver(hdr, payload)
+            except Exception:
+                self.log.exception("frame handler failed (frame dropped)")
+            n += 1
+        if off:
+            del buf[:off]
+        return n
+
+    def _unregister(self, conn: _Conn) -> None:
+        with self._sel_lock:
+            try:
+                self.sel.unregister(conn.sock)
+            except (KeyError, ValueError):
+                pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def finalize(self) -> None:
+        self._closed = True
+        with self._sel_lock:
+            try:
+                self.sel.unregister(self.listener)
+            except (KeyError, ValueError):
+                pass
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            conns = list(self.conns.values())
+            self.conns.clear()
+        for conn in conns:
+            self._unregister(conn)
+        with self._sel_lock:
+            try:
+                self.sel.close()
+            except OSError:
+                pass
+
+
+class TcpBtlComponent(Component):
+    NAME = "tcp"
+    PRIORITY = 20
+
+    def query(self, deliver=None, my_rank=None, **ctx):
+        if deliver is None or my_rank is None:
+            return None
+        return TcpBtl(deliver, my_rank)
+
+
+btl_framework.register(TcpBtlComponent())

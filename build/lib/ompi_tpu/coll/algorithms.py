@@ -1,0 +1,405 @@
+"""Host collective algorithm library, expressed as round schedules.
+
+Reference: ompi/mca/coll/base — allreduce {recursive doubling
+coll_base_allreduce.c:134, ring :345, segmented ring :622}, binomial
+bcast/reduce (coll_base_bcast.c, coll_base_reduce.c), bruck allgather
+(coll_base_allgather.c), pairwise alltoall (coll_base_alltoall.c),
+dissemination barrier. Every function is a generator yielding
+``sched.Round`` objects (see coll/sched.py); the same definition backs the
+blocking tuned path and the nonblocking MPI_I* path.
+
+All algorithms are datatype-agnostic: payloads travel as convertor-packed
+bytes; reductions view packed streams with the datatype's element dtype
+(homogeneous or value/index pair typemaps, as in coll/basic).
+
+Reduction-bearing schedules (recursive doubling, ring, binomial reduce)
+require a commutative op — the decision layer (coll/tuned.py) routes
+non-commutative ops to the rank-ordered linear algorithms, matching the
+reference's decision rules.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ompi_tpu.coll.basic import _np_reduce_typed, _typed_view
+from ompi_tpu.coll.sched import Round
+from ompi_tpu.comm.communicator import parse_buffer
+from ompi_tpu.core import op as _op
+from ompi_tpu.core.convertor import pack as cv_pack, unpack as cv_unpack
+from ompi_tpu.core.datatype import Datatype
+
+
+def _packed(buf):
+    obj, count, dt = parse_buffer(buf)
+    return np.ascontiguousarray(cv_pack(obj, count, dt)), count, dt
+
+
+def _bytes(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a).view(np.uint8)
+
+
+def _unpack_into(data: np.ndarray, buf) -> None:
+    obj, count, dt = parse_buffer(buf)
+    cv_unpack(_bytes(data), obj, count, dt)
+
+
+# ----------------------------------------------------------------- barrier
+def barrier_dissemination(comm):
+    """ceil(log2 n) zero-byte rounds (coll/base dissemination)."""
+    n, r = comm.size, comm.rank
+    token = np.zeros(0, dtype=np.uint8)
+    d = 1
+    while d < n:
+        yield Round(sends=[(token, (r + d) % n)], recvs=[(0, (r - d) % n)])
+        d <<= 1
+
+
+# ------------------------------------------------------------------- bcast
+def bcast_binomial(comm, buf, root: int):
+    """Binomial tree (coll_base_bcast.c binomial)."""
+    n, r = comm.size, comm.rank
+    obj, count, dt = parse_buffer(buf)
+    nbytes = count * dt.size
+    vrank = (r - root) % n
+    data: Optional[np.ndarray] = None
+    if vrank == 0:
+        data = np.ascontiguousarray(cv_pack(obj, count, dt))
+    else:
+        mask = 1
+        while not (vrank & mask):
+            mask <<= 1
+        src = (vrank - mask + root) % n
+        bufs = yield Round(recvs=[(nbytes, src)])
+        data = bufs[0]
+        # children live below the bit that connected us to our parent
+        mask >>= 1
+    if vrank == 0:
+        mask = 1
+        while mask < n:
+            mask <<= 1
+        mask >>= 1
+    sends = []
+    while mask > 0:
+        if vrank + mask < n and not (vrank & mask):
+            sends.append((data, (vrank + mask + root) % n))
+        mask >>= 1
+    if sends:
+        yield Round(sends=sends)
+    if vrank != 0:
+        cv_unpack(data, obj, count, dt)
+
+
+# ------------------------------------------------------------------ reduce
+def reduce_linear(comm, sendbuf, recvbuf, op: _op.Op, root: int):
+    """Rank-ordered linear fan-in — correct for non-commutative ops
+    (coll/basic linear reduce)."""
+    n, r = comm.size, comm.rank
+    packed, _, dt = _packed(recvbuf if sendbuf is None else sendbuf)
+    if r != root:
+        yield Round(sends=[(packed, root)])
+        return
+    others = [i for i in range(n) if i != root]
+    bufs = yield Round(recvs=[(packed.nbytes, i) for i in others])
+    parts: List[np.ndarray] = [None] * n  # type: ignore[list-item]
+    parts[root] = packed
+    for i, b in zip(others, bufs):
+        parts[i] = b
+    acc = _typed_view(parts[0].copy(), dt)
+    for i in range(1, n):
+        acc = _np_reduce_typed(op, acc, _typed_view(parts[i], dt))
+    _unpack_into(acc, recvbuf)
+
+
+def reduce_binomial(comm, sendbuf, recvbuf, op: _op.Op, root: int):
+    """Binomial fan-in for commutative ops (coll_base_reduce.c binomial):
+    log2 n depth instead of the linear O(n) fan-in at the root."""
+    n, r = comm.size, comm.rank
+    packed, _, dt = _packed(recvbuf if sendbuf is None else sendbuf)
+    nb = packed.nbytes
+    vrank = (r - root) % n
+    children = []
+    mask = 1
+    while mask < n:
+        if vrank & mask:
+            break
+        if vrank + mask < n:
+            children.append((vrank + mask + root) % n)
+        mask <<= 1
+    acc = _typed_view(packed.copy(), dt)
+    if children:
+        bufs = yield Round(recvs=[(nb, c) for c in children])
+        for b in bufs:
+            acc = _np_reduce_typed(op, acc, _typed_view(b, dt))
+    if vrank != 0:
+        parent = (vrank - mask + root) % n
+        yield Round(sends=[(_bytes(acc), parent)])
+        return
+    _unpack_into(acc, recvbuf)  # vrank 0 == root
+
+
+# --------------------------------------------------------------- allreduce
+def allreduce_recursive_doubling(comm, sendbuf, recvbuf, op: _op.Op):
+    """Recursive doubling with the non-power-of-two fold-in pre/post phase
+    (coll_base_allreduce.c:134)."""
+    n, r = comm.size, comm.rank
+    packed, _, dt = _packed(recvbuf if sendbuf is None else sendbuf)
+    nb = packed.nbytes
+    acc = _typed_view(packed.copy(), dt)
+    if n == 1:
+        _unpack_into(acc, recvbuf)
+        return
+    pow2 = 1 << (n.bit_length() - 1)
+    if pow2 > n:
+        pow2 >>= 1
+    rem = n - pow2
+    # pre: the first 2*rem ranks fold pairwise so pow2 ranks remain
+    if r < 2 * rem:
+        if r % 2 == 0:
+            yield Round(sends=[(_bytes(acc), r + 1)])
+            newrank = -1
+        else:
+            bufs = yield Round(recvs=[(nb, r - 1)])
+            acc = _np_reduce_typed(op, acc, _typed_view(bufs[0], dt))
+            newrank = r // 2
+    else:
+        newrank = r - rem
+    if newrank >= 0:
+        mask = 1
+        while mask < pow2:
+            pn = newrank ^ mask
+            partner = pn * 2 + 1 if pn < rem else pn + rem
+            bufs = yield Round(sends=[(_bytes(acc), partner)],
+                               recvs=[(nb, partner)])
+            acc = _np_reduce_typed(op, acc, _typed_view(bufs[0], dt))
+            mask <<= 1
+    # post: hand results back to the folded-out even ranks
+    if r < 2 * rem:
+        if r % 2 == 1:
+            yield Round(sends=[(_bytes(acc), r - 1)])
+        else:
+            bufs = yield Round(recvs=[(nb, r + 1)])
+            acc = _typed_view(bufs[0], dt)
+    _unpack_into(acc, recvbuf)
+
+
+def allreduce_ring(comm, sendbuf, recvbuf, op: _op.Op, nseg: int = 1):
+    """Ring allreduce: reduce-scatter ring + allgather ring
+    (coll_base_allreduce.c:345); with ``nseg > 1`` the element space is
+    split into segments whose rings run pipelined — segment s executes its
+    step t in global round s + t, so communication of one segment overlaps
+    reduction of the next (the segmented ring of :622)."""
+    n, r = comm.size, comm.rank
+    packed, _, dt = _packed(recvbuf if sendbuf is None else sendbuf)
+    typed = _typed_view(packed.copy(), dt)
+    if n == 1:
+        _unpack_into(typed, recvbuf)
+        return
+    total = typed.size
+    nseg = max(1, min(int(nseg), max(1, total // n)))
+    bounds = [total * s // nseg for s in range(nseg + 1)]
+    segs = []  # (padded flat array of n*k elements, k, orig_len, offset)
+    for s in range(nseg):
+        a, b = bounds[s], bounds[s + 1]
+        ln = b - a
+        k = max(1, -(-ln // n))
+        arr = np.zeros(n * k, dtype=typed.dtype)
+        arr[:ln] = typed[a:b]
+        segs.append([arr, k, ln, a])
+    steps = 2 * n - 2
+    left, right = (r - 1) % n, (r + 1) % n
+    for g in range(steps + nseg - 1):
+        sends, recvs, meta = [], [], []
+        for s, (arr, k, ln, off) in enumerate(segs):
+            t = g - s
+            if not (0 <= t < steps):
+                continue
+            isz = arr.itemsize
+            if t < n - 1:  # reduce-scatter phase
+                sb, rb = (r - t) % n, (r - t - 1) % n
+                kind = "rs"
+            else:          # allgather phase
+                ag = t - (n - 1)
+                sb, rb = (r + 1 - ag) % n, (r - ag) % n
+                kind = "ag"
+            sends.append((_bytes(arr[sb * k:(sb + 1) * k]), right))
+            recvs.append((k * isz, left))
+            meta.append((s, kind, rb))
+        bufs = yield Round(sends=sends, recvs=recvs)
+        for (s, kind, rb), b in zip(meta, bufs):
+            arr, k, ln, off = segs[s]
+            got = b.view(arr.dtype)
+            blk = arr[rb * k:(rb + 1) * k]
+            if kind == "rs":
+                arr[rb * k:(rb + 1) * k] = _np_reduce_typed(op, blk, got)
+            else:
+                arr[rb * k:(rb + 1) * k] = got
+    out = np.empty(total, dtype=typed.dtype)
+    for arr, k, ln, off in segs:
+        out[off:off + ln] = arr[:ln]
+    _unpack_into(out, recvbuf)
+
+
+# --------------------------------------------------------------- allgather
+def allgather_ring(comm, sendbuf, recvbuf):
+    """n-1 rounds, each forwarding the block received last round
+    (coll_base_allgather.c ring)."""
+    n, r = comm.size, comm.rank
+    block, _, _ = _packed(sendbuf)
+    nb = block.nbytes
+    out = np.empty(n * nb, dtype=np.uint8)
+    out[r * nb:(r + 1) * nb] = block
+    cur = block
+    for d in range(1, n):
+        bufs = yield Round(sends=[(cur, (r + 1) % n)],
+                           recvs=[(nb, (r - 1) % n)])
+        cur = bufs[0]
+        src = (r - d) % n
+        out[src * nb:(src + 1) * nb] = cur
+    _unpack_into(out, recvbuf)
+
+
+def allgather_bruck(comm, sendbuf, recvbuf):
+    """Bruck: ceil(log2 n) rounds of doubling block trains
+    (coll_base_allgather.c bruck) — latency-optimal for small messages."""
+    n, r = comm.size, comm.rank
+    block, _, _ = _packed(sendbuf)
+    nb = block.nbytes
+    acc: List[np.ndarray] = [block]  # acc[i] = block of rank (r+i) % n
+    dist = 1
+    while dist < n:
+        cnt = min(dist, n - dist)
+        send_data = _bytes(np.concatenate([np.frombuffer(b, np.uint8)
+                                           for b in acc[:cnt]])
+                           if cnt > 1 else acc[0])
+        bufs = yield Round(sends=[(send_data, (r - dist) % n)],
+                           recvs=[(cnt * nb, (r + dist) % n)])
+        got = bufs[0]
+        acc.extend(got[i * nb:(i + 1) * nb] for i in range(cnt))
+        dist <<= 1
+    out = np.empty(n * nb, dtype=np.uint8)
+    for i in range(n):
+        src = (r + i) % n
+        out[src * nb:(src + 1) * nb] = acc[i]
+    _unpack_into(out, recvbuf)
+
+
+def allgatherv_ring(comm, sendbuf, recvbuf, counts, displs):
+    n, r = comm.size, comm.rank
+    block, _, _ = _packed(sendbuf)
+    robj, rcount, rdt = parse_buffer(recvbuf)
+    counts = list(counts)
+    if displs is None:
+        displs = np.cumsum([0] + counts[:-1]).tolist()
+    esz = rdt.size
+    out = np.zeros(rcount * esz, dtype=np.uint8)
+    out[displs[r] * esz:displs[r] * esz + block.nbytes] = block
+    cur = block
+    for d in range(1, n):
+        src = (r - d) % n
+        bufs = yield Round(sends=[(cur, (r + 1) % n)],
+                           recvs=[(counts[src] * esz, (r - 1) % n)])
+        cur = bufs[0]
+        out[displs[src] * esz:displs[src] * esz + cur.nbytes] = cur
+    cv_unpack(out, robj, rcount, rdt)
+
+
+# ---------------------------------------------------------------- alltoall
+def alltoall_pairwise(comm, sendbuf, recvbuf):
+    """n-1 pairwise exchange rounds (coll_base_alltoall.c pairwise)."""
+    n, r = comm.size, comm.rank
+    packed, _, _ = _packed(sendbuf)
+    robj, rcount, rdt = parse_buffer(recvbuf)
+    nb = packed.nbytes // n
+    out = np.empty(rcount * rdt.size, dtype=np.uint8)
+    out[r * nb:(r + 1) * nb] = packed[r * nb:(r + 1) * nb]
+    for d in range(1, n):
+        dst, src = (r + d) % n, (r - d) % n
+        chunk = np.ascontiguousarray(packed[dst * nb:(dst + 1) * nb])
+        bufs = yield Round(sends=[(chunk, dst)], recvs=[(nb, src)])
+        out[src * nb:(src + 1) * nb] = bufs[0]
+    cv_unpack(out, robj, rcount, rdt)
+
+
+# ----------------------------------------------------------- gather/scatter
+def gather_linear(comm, sendbuf, recvbuf, root: int):
+    n, r = comm.size, comm.rank
+    block, _, _ = _packed(sendbuf)
+    if r != root:
+        yield Round(sends=[(block, root)])
+        return
+    nb = block.nbytes
+    others = [i for i in range(n) if i != root]
+    bufs = yield Round(recvs=[(nb, i) for i in others])
+    out = np.empty(n * nb, dtype=np.uint8)
+    out[root * nb:(root + 1) * nb] = block
+    for i, b in zip(others, bufs):
+        out[i * nb:(i + 1) * nb] = b
+    _unpack_into(out, recvbuf)
+
+
+def scatter_linear(comm, sendbuf, recvbuf, root: int):
+    n, r = comm.size, comm.rank
+    robj, rcount, rdt = parse_buffer(recvbuf)
+    nb = rcount * rdt.size
+    if r == root:
+        packed, _, _ = _packed(sendbuf)
+        sends = []
+        for i in range(n):
+            chunk = np.ascontiguousarray(packed[i * nb:(i + 1) * nb])
+            if i == root:
+                cv_unpack(chunk, robj, rcount, rdt)
+            else:
+                sends.append((chunk, i))
+        if sends:
+            yield Round(sends=sends)
+    else:
+        bufs = yield Round(recvs=[(nb, root)])
+        cv_unpack(bufs[0], robj, rcount, rdt)
+
+
+# -------------------------------------------------------------- scan family
+def scan_linear(comm, sendbuf, recvbuf, op: _op.Op):
+    n, r = comm.size, comm.rank
+    packed, _, dt = _packed(recvbuf if sendbuf is None else sendbuf)
+    if r > 0:
+        bufs = yield Round(recvs=[(packed.nbytes, r - 1)])
+        acc = _np_reduce_typed(op, _typed_view(bufs[0], dt),
+                               _typed_view(packed.copy(), dt))
+    else:
+        acc = _typed_view(packed.copy(), dt)
+    if r < n - 1:
+        yield Round(sends=[(_bytes(acc), r + 1)])
+    _unpack_into(acc, recvbuf)
+
+
+def exscan_linear(comm, sendbuf, recvbuf, op: _op.Op):
+    n, r = comm.size, comm.rank
+    packed, _, dt = _packed(recvbuf if sendbuf is None else sendbuf)
+    prefix: Optional[np.ndarray] = None
+    if r > 0:
+        bufs = yield Round(recvs=[(packed.nbytes, r - 1)])
+        prefix = bufs[0]
+    if r < n - 1:
+        if prefix is None:
+            nxt = packed
+        else:
+            nxt = _bytes(_np_reduce_typed(op, _typed_view(prefix.copy(), dt),
+                                          _typed_view(packed, dt)))
+        yield Round(sends=[(nxt, r + 1)])
+    if prefix is not None:
+        _unpack_into(np.frombuffer(prefix, np.uint8), recvbuf)
+
+
+# --------------------------------------------------------- compound schedules
+def reduce_scatter_block_sched(comm, sendbuf, recvbuf, op: _op.Op):
+    """reduce + scatter composition, as one schedule."""
+    robj, rcount, rdt = parse_buffer(recvbuf)
+    n = comm.size
+    tmp_obj = np.empty(rcount * n * max(rdt.extent, 1), dtype=np.uint8)
+    tmp = [tmp_obj, rcount * n, rdt]
+    alg = reduce_binomial if op.commutative else reduce_linear
+    yield from alg(comm, sendbuf, tmp, op, 0)
+    yield from scatter_linear(comm, tmp, recvbuf, 0)

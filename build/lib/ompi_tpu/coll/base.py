@@ -1,0 +1,95 @@
+"""Collectives framework: per-communicator function table + selection.
+
+Reference: ompi/mca/coll (13,883 LoC base) — every component queries per
+communicator and the highest-priority module wins *per function slot*
+(coll_base_comm_select.c:216, priority sort :358). Identical model here:
+``select_coll(comm)`` queries every registered component and fills a
+``CollTable`` one slot at a time from the priority-ordered module list.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ompi_tpu.mca.component import framework
+
+coll_framework = framework("coll", "Collective operations")
+
+# The 17-op surface (reference: coll.h:545-620, blocking slots; nonblocking
+# variants share the table via the I-prefix dispatch in the communicator).
+COLL_OPS = (
+    "allgather",
+    "allgatherv",
+    "allreduce",
+    "alltoall",
+    "alltoallv",
+    "alltoallw",
+    "barrier",
+    "bcast",
+    "exscan",
+    "gather",
+    "gatherv",
+    "reduce",
+    "reduce_scatter",
+    "reduce_scatter_block",
+    "scan",
+    "scatter",
+    "scatterv",
+    # neighborhood collectives (reference: coll.h neighbor_* slots)
+    "neighbor_allgather",
+    "neighbor_alltoall",
+    # nonblocking variants (reference: coll.h pairs every blocking slot
+    # with an i-slot in the same table; coll/libnbc provides them)
+    "ibarrier",
+    "ibcast",
+    "ireduce",
+    "iallreduce",
+    "iallgather",
+    "iallgatherv",
+    "ialltoall",
+    "igather",
+    "iscatter",
+    "ireduce_scatter_block",
+    "iscan",
+    "iexscan",
+)
+
+
+class CollModule:
+    """Base collectives module: components subclass and implement the slots
+    they can serve for the queried communicator."""
+
+    def enable(self, comm) -> None:
+        pass
+
+
+class CollTable:
+    """Per-communicator function table (reference: comm->c_coll)."""
+
+    def __init__(self):
+        self.slots = {}
+        self.providers = {}  # op -> component name, for introspection
+
+    def get(self, op: str):
+        fn = self.slots.get(op)
+        if fn is None:
+            raise NotImplementedError(
+                f"no collective module provides '{op}' for this communicator"
+            )
+        return fn
+
+
+def select_coll(comm) -> CollTable:
+    """Build the per-comm table: highest priority module wins each slot."""
+    table = CollTable()
+    modules = coll_framework.select_all(comm=comm)  # priority-descending
+    for prio, name, module in modules:
+        module.enable(comm)
+        for op in COLL_OPS:
+            if op in table.slots:
+                continue
+            fn = getattr(module, op, None)
+            if fn is not None:
+                table.slots[op] = fn
+                table.providers[op] = name
+    return table

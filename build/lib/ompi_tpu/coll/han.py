@@ -256,11 +256,9 @@ class HanCollComponent(Component):
                 try:
                     # post-fence, a missing card never appears: don't wait
                     sid = str(modex.get(w, "btl.sm.node", timeout=0.0))
-                    _node_sid_cache[w] = sid  # only cache real cards:
-                    # a transient miss must not freeze a wrong identity
-                    # for the life of the process
                 except Exception:
-                    sid = f"solo-{w}"  # no sm: its own node (uncached)
+                    sid = f"solo-{w}"  # no sm: its own node
+                _node_sid_cache[w] = sid
             raw.append(sid)
         first: dict = {}
         return [first.setdefault(sid, r) for r, sid in enumerate(raw)]

@@ -1,0 +1,117 @@
+"""coll/nbc — nonblocking collectives for process-mode communicators.
+
+Reference: ompi/mca/coll/libnbc (12,429 LoC) — every MPI_I* collective is a
+round-based schedule progressed by opal_progress. Here each I* slot builds
+the same generator algorithm the blocking tuned path uses (coll/
+algorithms.py) and hands it to ``sched.NbcRequest``, which advances rounds
+from request completion callbacks — i.e. from the progress engine/thread,
+exactly the libnbc model. Overlapping schedules on one communicator are
+isolated by the NBC CID plane + per-comm sequence tags (sched.py).
+
+Algorithm choice mirrors coll/tuned's decision rules where a choice
+exists (commutativity gates the reduction trees).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ompi_tpu.coll.base import CollModule, coll_framework
+from ompi_tpu.coll import algorithms as alg
+import ompi_tpu.coll.tuned  # noqa: F401  (registers the threshold vars)
+from ompi_tpu.coll.sched import NbcRequest
+from ompi_tpu.core import op as _op
+from ompi_tpu.core.request import Request
+from ompi_tpu.mca.component import Component
+from ompi_tpu.mca.var import get_var
+
+
+class NbcColl(CollModule):
+    # ------------------------------------------------------------ no-data ops
+    def ibarrier(self, comm) -> Request:
+        return NbcRequest(comm, alg.barrier_dissemination(comm))
+
+    # ------------------------------------------------------------- rooted ops
+    def ibcast(self, comm, buf, root: int) -> Request:
+        return NbcRequest(comm, alg.bcast_binomial(comm, buf, root))
+
+    def ireduce(self, comm, sendbuf, recvbuf, op: _op.Op,
+                root: int) -> Request:
+        a = (alg.reduce_binomial if op.commutative and comm.size > 2
+             else alg.reduce_linear)
+        return NbcRequest(comm, a(comm, sendbuf, recvbuf, op, root))
+
+    def igather(self, comm, sendbuf, recvbuf, root: int) -> Request:
+        return NbcRequest(comm, alg.gather_linear(comm, sendbuf, recvbuf,
+                                                  root))
+
+    def iscatter(self, comm, sendbuf, recvbuf, root: int) -> Request:
+        return NbcRequest(comm, alg.scatter_linear(comm, sendbuf, recvbuf,
+                                                   root))
+
+    # --------------------------------------------------------------- all-ops
+    def iallreduce(self, comm, sendbuf, recvbuf, op: _op.Op) -> Request:
+        if not op.commutative:
+            gen = self._allreduce_linear(comm, sendbuf, recvbuf, op)
+        elif (comm.size > 1 and self._nbytes(recvbuf)
+                > get_var("coll_tuned", "allreduce_small_msg")):
+            gen = alg.allreduce_ring(comm, sendbuf, recvbuf, op)
+        else:
+            gen = alg.allreduce_recursive_doubling(comm, sendbuf, recvbuf, op)
+        return NbcRequest(comm, gen)
+
+    @staticmethod
+    def _allreduce_linear(comm, sendbuf, recvbuf, op):
+        yield from alg.reduce_linear(comm, sendbuf, recvbuf, op, 0)
+        yield from alg.bcast_binomial(comm, recvbuf, 0)
+
+    def iallgather(self, comm, sendbuf, recvbuf) -> Request:
+        total = self._nbytes(recvbuf)
+        a = (alg.allgather_bruck
+             if total <= get_var("coll_tuned", "allgather_small_msg")
+             and comm.size > 1 else alg.allgather_ring)
+        return NbcRequest(comm, a(comm, sendbuf, recvbuf))
+
+    def iallgatherv(self, comm, sendbuf, recvbuf, counts, displs) -> Request:
+        return NbcRequest(comm, alg.allgatherv_ring(comm, sendbuf, recvbuf,
+                                                    counts, displs))
+
+    def ialltoall(self, comm, sendbuf, recvbuf) -> Request:
+        return NbcRequest(comm, alg.alltoall_pairwise(comm, sendbuf, recvbuf))
+
+    def ireduce_scatter_block(self, comm, sendbuf, recvbuf,
+                              op: _op.Op) -> Request:
+        return NbcRequest(comm, alg.reduce_scatter_block_sched(
+            comm, sendbuf, recvbuf, op))
+
+    def iscan(self, comm, sendbuf, recvbuf, op: _op.Op) -> Request:
+        return NbcRequest(comm, alg.scan_linear(comm, sendbuf, recvbuf, op))
+
+    def iexscan(self, comm, sendbuf, recvbuf, op: _op.Op) -> Request:
+        return NbcRequest(comm, alg.exscan_linear(comm, sendbuf, recvbuf, op))
+
+    @staticmethod
+    def _nbytes(buf) -> int:
+        from ompi_tpu.comm.communicator import parse_buffer
+
+        obj, count, dt = parse_buffer(buf)
+        return count * dt.size
+
+
+class NbcCollComponent(Component):
+    NAME = "nbc"
+    PRIORITY = 20  # only provider of i* slots; between basic and tuned
+
+    _module: Optional[NbcColl] = None
+
+    def query(self, comm=None, **ctx):
+        from ompi_tpu.comm.communicator import ProcComm
+
+        if isinstance(comm, ProcComm):
+            if NbcCollComponent._module is None:
+                NbcCollComponent._module = NbcColl()
+            return NbcCollComponent._module
+        return None
+
+
+coll_framework.register(NbcCollComponent())

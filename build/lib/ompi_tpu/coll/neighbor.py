@@ -1,0 +1,166 @@
+"""Neighborhood collectives over process topologies (host path).
+
+Reference: the neighbor_* slots of coll.h:545-620, provided by
+mca/coll/basic's neighbor implementations (coll_basic_neighbor_*.c) —
+linear isend/irecv over the topology's neighbor lists. Same shape here:
+one irecv per in-neighbor, one isend per out-neighbor, Waitall.
+PROC_NULL neighbors (non-periodic cart edges) skip both the send and the
+receive, leaving the corresponding recv block untouched (MPI-3 §7.6).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ompi_tpu.coll.base import CollModule, coll_framework
+from ompi_tpu.comm.communicator import PROC_NULL
+from ompi_tpu.core.errors import MPIError, ERR_ARG
+from ompi_tpu.mca.component import Component
+
+# Tag band for neighborhood traffic, inside the collective CID plane.
+# Cart/graph topologies use per-slot tags, pairing each edge via the
+# globally-known peer adjacency; dist-graph adjacency is local-only, so
+# it uses ONE tag and relies on per-peer FIFO ordering — which is exactly
+# MPI's rule for duplicated edges (blocks from a repeated in-neighbor are
+# filled in the order the peer sent them).
+TAG_NEIGHBOR = -60
+
+
+def _slot_tags(comm, srcs, dsts):
+    """(recv_tag(slot), send_tag(slot, dst)) per the topology kind."""
+    from ompi_tpu.topo import DistGraphTopo
+
+    if isinstance(comm.topo, DistGraphTopo):
+        return (lambda slot: TAG_NEIGHBOR,
+                lambda slot, dst: TAG_NEIGHBOR)
+    return (lambda slot: TAG_NEIGHBOR - slot,
+            lambda slot, dst: TAG_NEIGHBOR - _peer_slot(
+                comm.topo, comm.rank, slot, dst))
+
+
+def _coll_cid(comm) -> int:
+    from ompi_tpu.coll.basic import COLL_CID_BIT
+
+    return comm.cid | COLL_CID_BIT
+
+
+class NeighborColl(CollModule):
+    """Provides neighbor_* slots for comms that carry a topology."""
+
+    def neighbor_allgather(self, comm, sendbuf, recvbuf) -> None:
+        """Each rank sends its whole sendbuf to every out-neighbor and
+        collects one block per in-neighbor into recvbuf (reference:
+        coll_basic_neighbor_allgather.c)."""
+        from ompi_tpu.comm.communicator import parse_buffer
+        from ompi_tpu.core.request import Request
+        from ompi_tpu.topo import in_out_neighbors
+
+        srcs, dsts = in_out_neighbors(comm.topo, comm.rank)
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        if srcs and rcount % len(srcs):
+            raise MPIError(ERR_ARG,
+                           f"recvbuf not divisible into {len(srcs)} blocks")
+        block = rcount // len(srcs) if srcs else 0
+        rview = np.asarray(robj).reshape(-1)
+        reqs = []
+        cid = _coll_cid(comm)
+        rtag, stag = _slot_tags(comm, srcs, dsts)
+        for slot, src in enumerate(srcs):
+            if src == PROC_NULL:
+                continue
+            part = rview[slot * block : (slot + 1) * block]
+            reqs.append(comm.pml.irecv(part, block, rdt,
+                                       comm._world_rank(src),
+                                       rtag(slot), cid))
+        for slot, dst in enumerate(dsts):
+            if dst == PROC_NULL:
+                continue
+            reqs.append(comm.pml.isend(sobj, scount, sdt,
+                                       comm._world_rank(dst),
+                                       stag(slot, dst), cid))
+        Request.Waitall(reqs)
+
+    def neighbor_alltoall(self, comm, sendbuf, recvbuf) -> None:
+        """Distinct block per neighbor: sendbuf block j to out-neighbor j,
+        recvbuf block j from in-neighbor j (reference:
+        coll_basic_neighbor_alltoall.c)."""
+        from ompi_tpu.comm.communicator import parse_buffer
+        from ompi_tpu.core.request import Request
+        from ompi_tpu.topo import in_out_neighbors
+
+        srcs, dsts = in_out_neighbors(comm.topo, comm.rank)
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        if dsts and scount % len(dsts):
+            raise MPIError(ERR_ARG, "sendbuf not divisible into blocks")
+        if srcs and rcount % len(srcs):
+            raise MPIError(ERR_ARG, "recvbuf not divisible into blocks")
+        sblock = scount // len(dsts) if dsts else 0
+        rblock = rcount // len(srcs) if srcs else 0
+        sview = np.asarray(sobj).reshape(-1)
+        rview = np.asarray(robj).reshape(-1)
+        reqs = []
+        cid = _coll_cid(comm)
+        rtag, stag = _slot_tags(comm, srcs, dsts)
+        for slot, src in enumerate(srcs):
+            if src == PROC_NULL:
+                continue
+            part = rview[slot * rblock : (slot + 1) * rblock]
+            reqs.append(comm.pml.irecv(part, rblock, rdt,
+                                       comm._world_rank(src),
+                                       rtag(slot), cid))
+        for slot, dst in enumerate(dsts):
+            if dst == PROC_NULL:
+                continue
+            part = sview[slot * sblock : (slot + 1) * sblock]
+            reqs.append(comm.pml.isend(part, sblock, sdt,
+                                       comm._world_rank(dst),
+                                       stag(slot, dst), cid))
+        Request.Waitall(reqs)
+
+
+def _peer_slot(topo, my_rank: int, my_out_slot: int, dst: int) -> int:
+    """Which of the destination's in-neighbor slots names me for this
+    edge. Cart: my positive-direction send lands in the peer's negative
+    slot of the same dim (and vice versa). Graph/dist-graph: position of
+    my rank in the peer's in-neighbor list, disambiguated by edge
+    multiplicity order."""
+    from ompi_tpu.topo import CartTopo, in_out_neighbors
+
+    if isinstance(topo, CartTopo):
+        dim, parity = divmod(my_out_slot, 2)
+        return 2 * dim + (1 - parity)
+    peer_srcs, _ = in_out_neighbors(topo, dst)
+    # my k-th edge to this dst pairs with the k-th occurrence of me there
+    k = 0
+    _, my_dsts = in_out_neighbors(topo, my_rank)
+    for s in range(my_out_slot):
+        if my_dsts[s] == dst:
+            k += 1
+    seen = 0
+    for slot, s in enumerate(peer_srcs):
+        if s == my_rank:
+            if seen == k:
+                return slot
+            seen += 1
+    raise MPIError(ERR_ARG,
+                   f"asymmetric topology: rank {my_rank} not an "
+                   f"in-neighbor of {dst}")
+
+
+class NeighborCollComponent(Component):
+    NAME = "neighbor"
+    PRIORITY = 40
+
+    def query(self, comm=None, **ctx: Any) -> Optional[NeighborColl]:
+        from ompi_tpu.comm.communicator import ProcComm
+
+        if isinstance(comm, ProcComm) and comm.topo is not None:
+            return NeighborColl()
+        return None
+
+
+coll_framework.register(NeighborCollComponent())

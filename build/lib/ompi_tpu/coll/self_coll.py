@@ -1,0 +1,89 @@
+"""coll/self — collectives on single-member communicators.
+
+Reference: ompi/mca/coll/self. Every operation degenerates to a local copy
+(with the op applied to the single contribution); priority is high but the
+component only answers for size-1 communicators.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ompi_tpu.coll.base import CollModule, coll_framework
+from ompi_tpu.comm.communicator import parse_buffer
+from ompi_tpu.core.convertor import pack, unpack
+from ompi_tpu.mca.component import Component
+
+
+def _copy(sendbuf, recvbuf) -> None:
+    sobj, scount, sdt = parse_buffer(sendbuf)
+    robj, rcount, rdt = parse_buffer(recvbuf)
+    packed = pack(sobj, scount, sdt)
+    unpack(packed, robj, min(rcount, packed.nbytes // max(rdt.size, 1)), rdt)
+
+
+class SelfColl(CollModule):
+    def barrier(self, comm) -> None:
+        pass
+
+    def bcast(self, comm, buf, root) -> None:
+        pass
+
+    def reduce(self, comm, sendbuf, recvbuf, op, root) -> None:
+        if sendbuf is not None:
+            _copy(sendbuf, recvbuf)
+
+    def allreduce(self, comm, sendbuf, recvbuf, op) -> None:
+        if sendbuf is not None:
+            _copy(sendbuf, recvbuf)
+
+    def allgather(self, comm, sendbuf, recvbuf) -> None:
+        _copy(sendbuf, recvbuf)
+
+    def allgatherv(self, comm, sendbuf, recvbuf, counts, displs) -> None:
+        _copy(sendbuf, recvbuf)
+
+    def gather(self, comm, sendbuf, recvbuf, root) -> None:
+        _copy(sendbuf, recvbuf)
+
+    def gatherv(self, comm, sendbuf, recvbuf, counts, displs, root) -> None:
+        _copy(sendbuf, recvbuf)
+
+    def scatter(self, comm, sendbuf, recvbuf, root) -> None:
+        _copy(sendbuf, recvbuf)
+
+    def scatterv(self, comm, sendbuf, recvbuf, counts, displs, root) -> None:
+        _copy(sendbuf, recvbuf)
+
+    def alltoall(self, comm, sendbuf, recvbuf) -> None:
+        _copy(sendbuf, recvbuf)
+
+    def alltoallv(self, comm, sendbuf, recvbuf, sc, sd, rc, rd) -> None:
+        _copy(sendbuf, recvbuf)
+
+    def reduce_scatter(self, comm, sendbuf, recvbuf, recvcounts, op) -> None:
+        _copy(sendbuf, recvbuf)
+
+    def reduce_scatter_block(self, comm, sendbuf, recvbuf, op) -> None:
+        _copy(sendbuf, recvbuf)
+
+    def scan(self, comm, sendbuf, recvbuf, op) -> None:
+        _copy(sendbuf, recvbuf)
+
+    def exscan(self, comm, sendbuf, recvbuf, op) -> None:
+        pass  # undefined at rank 0 per MPI
+
+
+class SelfCollComponent(Component):
+    NAME = "self"
+    PRIORITY = 75  # reference coll/self default priority
+
+    def query(self, comm=None, **ctx):
+        from ompi_tpu.comm.communicator import ProcComm
+
+        if isinstance(comm, ProcComm) and comm.size == 1:
+            return SelfColl()
+        return None
+
+
+coll_framework.register(SelfCollComponent())

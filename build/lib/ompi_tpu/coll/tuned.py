@@ -1,0 +1,138 @@
+"""coll/tuned — decision layer choosing host algorithms by message size,
+communicator size, and op properties.
+
+Reference: ompi/mca/coll/tuned (6,890 LoC) — fixed heuristics per
+op/size/commsize (coll_tuned_decision_fixed.c:55 for allreduce) plus
+per-op forced-algorithm MCA vars. Same shape here: thresholds and forced
+choices are MCA vars; the algorithms live in coll/algorithms.py and run
+through the schedule engine. Slots not decided here fall through to
+coll/basic (priority ordering in the per-comm table does that).
+
+Decision rules (mirroring the reference's fixed rules, simplified):
+- allreduce: non-commutative -> linear reduce+bcast (basic); small
+  messages -> recursive doubling; large -> ring; very large -> segmented
+  ring (pipelined).
+- allgather: small -> bruck (latency-optimal); large -> ring (bw-optimal).
+- reduce: commutative -> binomial; else linear.
+- bcast: binomial (already the basic algorithm; kept for the forced var).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ompi_tpu.coll.base import CollModule, coll_framework
+from ompi_tpu.coll.basic import BasicColl, COLL_CID_BIT
+from ompi_tpu.coll import algorithms as alg
+from ompi_tpu.coll.sched import run_blocking
+from ompi_tpu.comm.communicator import parse_buffer
+from ompi_tpu.core import op as _op
+from ompi_tpu.mca.component import Component
+from ompi_tpu.mca.var import register_var, get_var
+
+register_var("coll_tuned", "allreduce_algorithm", "auto",
+             help="Forced allreduce algorithm: auto|linear|"
+                  "recursive_doubling|ring|ring_segmented", level=5,
+             enum_values=("auto", "linear", "recursive_doubling", "ring",
+                          "ring_segmented"))
+register_var("coll_tuned", "allgather_algorithm", "auto",
+             help="Forced allgather algorithm: auto|ring|bruck", level=5,
+             enum_values=("auto", "ring", "bruck"))
+register_var("coll_tuned", "allreduce_small_msg", 8192,
+             help="Bytes below which allreduce uses recursive doubling",
+             level=6)
+register_var("coll_tuned", "allreduce_segsize", 1 << 20,
+             help="Segment size for the pipelined segmented-ring allreduce",
+             level=6)
+register_var("coll_tuned", "allgather_small_msg", 65536,
+             help="Total bytes below which allgather uses bruck", level=6)
+
+TAG_TUNED = -30  # dedicated tag inside the collective CID plane
+
+
+def _run(comm, gen) -> None:
+    run_blocking(comm, gen, TAG_TUNED, comm.cid | COLL_CID_BIT)
+
+
+def _msg_bytes(buf) -> int:
+    obj, count, dt = parse_buffer(buf)
+    return count * dt.size
+
+
+class TunedColl(CollModule):
+    """Decision slots; inherits nothing — undecided ops fall through to the
+    lower-priority basic module via per-slot table selection."""
+
+    # ------------------------------------------------------------ allreduce
+    def allreduce(self, comm, sendbuf, recvbuf, op: _op.Op) -> None:
+        choice = get_var("coll_tuned", "allreduce_algorithm")
+        nbytes = _msg_bytes(recvbuf)
+        if choice == "auto":
+            if not op.commutative or comm.size == 1:
+                choice = "linear"
+            elif nbytes <= get_var("coll_tuned", "allreduce_small_msg"):
+                choice = "recursive_doubling"
+            elif nbytes <= 4 * get_var("coll_tuned", "allreduce_segsize"):
+                choice = "ring"
+            else:
+                choice = "ring_segmented"
+        if choice == "linear" or (comm.size == 1):
+            self._basic().allreduce(comm, sendbuf, recvbuf, op)
+        elif choice == "recursive_doubling":
+            _run(comm, alg.allreduce_recursive_doubling(
+                comm, sendbuf, recvbuf, op))
+        elif choice == "ring":
+            _run(comm, alg.allreduce_ring(comm, sendbuf, recvbuf, op))
+        else:
+            seg = max(1, get_var("coll_tuned", "allreduce_segsize"))
+            nseg = max(1, -(-nbytes // seg))
+            _run(comm, alg.allreduce_ring(comm, sendbuf, recvbuf, op,
+                                          nseg=nseg))
+
+    # ------------------------------------------------------------ allgather
+    def allgather(self, comm, sendbuf, recvbuf) -> None:
+        choice = get_var("coll_tuned", "allgather_algorithm")
+        if choice == "auto":
+            total = _msg_bytes(recvbuf)
+            choice = ("bruck"
+                      if total <= get_var("coll_tuned", "allgather_small_msg")
+                      else "ring")
+        if comm.size == 1 or choice == "ring":
+            _run(comm, alg.allgather_ring(comm, sendbuf, recvbuf))
+        else:
+            _run(comm, alg.allgather_bruck(comm, sendbuf, recvbuf))
+
+    # --------------------------------------------------------------- reduce
+    def reduce(self, comm, sendbuf, recvbuf, op: _op.Op, root: int) -> None:
+        if op.commutative and comm.size > 2:
+            _run(comm, alg.reduce_binomial(comm, sendbuf, recvbuf, op, root))
+        else:
+            _run(comm, alg.reduce_linear(comm, sendbuf, recvbuf, op, root))
+
+    # ------------------------------------------------------------- internals
+    _basic_mod: Optional[BasicColl] = None
+
+    @classmethod
+    def _basic(cls) -> BasicColl:
+        if cls._basic_mod is None:
+            cls._basic_mod = BasicColl()
+        return cls._basic_mod
+
+
+class TunedCollComponent(Component):
+    NAME = "tuned"
+    PRIORITY = 30  # above basic(10), below self(~) — reference: tuned=30
+
+    _module: Optional[TunedColl] = None
+
+    def query(self, comm=None, **ctx):
+        from ompi_tpu.comm.communicator import ProcComm
+
+        if isinstance(comm, ProcComm) and comm.size > 1:
+            if TunedCollComponent._module is None:
+                TunedCollComponent._module = TunedColl()
+            return TunedCollComponent._module
+        return None
+
+
+coll_framework.register(TunedCollComponent())

@@ -1,0 +1,635 @@
+"""coll/xla — MPI collectives lowered to XLA collective HLO over the ICI mesh.
+
+This is the flagship component (BASELINE.json north star): for mesh-mode
+communicators every collective is a traced/jitted ``shard_map`` program.
+No Python runs on the data path after trace time; compiles are cached per
+(verb, op, dtype, shape) in the communicator (the compile-cache discipline
+SURVEY.md §7 lists as hard part 6).
+
+Communicator→mesh projection (SURVEY.md §7 hard part 2):
+
+- **World comm** (every mesh position): collectives lower 1:1 to native XLA
+  HLO — ``psum``/``pmax``/``pmin`` (AllReduce), ``all_gather``,
+  ``psum_scatter`` (ReduceScatter), ``all_to_all`` — the compiler owns the
+  ICI schedule.
+- **Sub-communicators** (arbitrary partitions from Split/Create_group):
+  jax's shard_map does not support ``axis_index_groups``, so grouped
+  collectives lower to **ppermute schedules**: recursive doubling for
+  power-of-two groups, ring rotation otherwise — the reference's own
+  algorithm library (coll_base_allreduce.c:134 recursive doubling, :345
+  ring; bcast/scan trees in coll_base_bcast.c) re-expressed as ICI
+  collective-permute chains instead of PML round-trips, exactly the
+  SURVEY.md §5 mapping. All rounds trace into one XLA program, so XLA
+  fuses the elementwise combine into each permute step.
+
+Singleton groups (the padding for non-members of Create_group and
+MPI_UNDEFINED colors) are masked out of every schedule and keep their own
+data — which is also the correct MPI semantics for 1-member comms.
+
+MPI_Op → device computation: SUM/MAX/MIN lower natively; PROD,
+logical/bitwise and jax-traceable user fns use their elementwise combine
+inside the schedule (reference analog: op/avx SIMD kernels become VPU
+vector code emitted by XLA). MINLOC/MAXLOC reduce (value, index) PAIR
+arrays on device — trailing dim of 2, values in [..., 0], indices in
+[..., 1] — since XLA has no structured record dtype; the host path keeps
+the record-array layout (reference analog: op/avx's 2-wide pair kernels
+over MPI_FLOAT_INT and friends).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.coll.base import CollModule, coll_framework
+from ompi_tpu.core import op as _op
+from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_UNSUPPORTED_OPERATION
+from ompi_tpu.mca.component import Component
+
+
+from ompi_tpu.parallel.axes import shard_map_compat as _shard_map
+
+
+def _is_bool(dtype) -> bool:
+    return np.dtype(dtype) == np.bool_
+
+
+def _check_device_op(op: _op.Op, x=None) -> None:
+    """Validate the op's device lowering before trace time. MINLOC/MAXLOC
+    reduce (value, index) pairs: the host path carries them as structured
+    record arrays (no XLA dtype), so the device layout is a trailing dim
+    of 2 — ``x[..., 0]`` values, ``x[..., 1]`` indices (reference analog:
+    the 2-wide pair kernels of op/avx)."""
+    if op.name in _op.PAIR_OPS:
+        if x is None or x.ndim < 1 or x.shape[-1] != 2:
+            raise MPIError(
+                ERR_UNSUPPORTED_OPERATION,
+                f"device {op.name} reduces pair arrays: shape [..., 2] "
+                "with (value, index) in the last dim (structured record "
+                "dtypes have no XLA representation)")
+
+
+# --------------------------------------------------------------- schedules
+def _shift_perm(groups, d: int) -> Tuple[Tuple[int, int], ...]:
+    """Ring shift by +d within each (non-singleton) group."""
+    out = []
+    for g in groups:
+        n = len(g)
+        if n < 2:
+            continue
+        out.extend((g[i], g[(i + d) % n]) for i in range(n))
+    return tuple(out)
+
+
+def _xor_perm(groups, bit: int) -> Tuple[Tuple[int, int], ...]:
+    """Recursive-doubling partner exchange within each group."""
+    out = []
+    for g in groups:
+        if len(g) < 2:
+            continue
+        out.extend((g[i], g[i ^ bit]) for i in range(len(g)))
+    return tuple(out)
+
+
+def cache_key(verb: str, op: Optional[_op.Op] = None, extra: Tuple = ()):
+    """Public compile-cache key layout (shared with XlaComm's fast path —
+    the per-call dispatch must be one dict hit, reference analog: the
+    pre-resolved per-comm fn table pointers of comm->c_coll)."""
+    key = (verb,)
+    if op is not None:
+        key += (op.uid,)
+    return key + tuple(extra)
+
+
+class XlaColl(CollModule):
+    """Collectives for XlaComm; one compiled executable per
+    (verb, op, dtype, shape), cached on the communicator."""
+
+    # ------------------------------------------------------------ plumbing
+    def _cached(self, comm, key, builder):
+        fn = comm._jit_cache.get(key)
+        if fn is None:
+            fn = builder()
+            comm._jit_cache[key] = fn
+        return fn
+
+    def _wrap(self, comm, body, n_in: int = 1, rooted: bool = False):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        specs = tuple([P(comm.axis)] * n_in + ([P()] if rooted else []))
+        f = _shard_map(body, comm.mesh, specs, P(comm.axis))
+        return jax.jit(f)
+
+    @staticmethod
+    def _masks(comm):
+        """(pos_map, singleton_mask) as jnp constants for traced lookups."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(comm.pos_map), jnp.asarray(comm.singleton_mask)
+
+    @staticmethod
+    def _group_sizes(comm):
+        """Per-mesh-position group size as a jnp constant."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        gs = np.ones(comm.world_size, dtype=np.int32)
+        if comm.groups is not None:
+            for g in comm.groups:
+                for r in g:
+                    gs[r] = len(g)
+        else:
+            gs[:] = comm.world_size
+        return jnp.asarray(gs)
+
+    # ------------------------------------------- grouped allreduce schedule
+    def _grouped_allreduce_body(self, comm, op: _op.Op):
+        """Build body(block)->block implementing in-group allreduce via
+        ppermute rounds. Uniform power-of-two colors take recursive
+        doubling; everything else (including NON-UNIFORM color sizes —
+        the reference supports arbitrary Splits, comm.c) takes a masked
+        ring: rounds = max group size - 1, and each rank stops
+        accumulating after its own group's size-1 rounds while values
+        keep rotating harmlessly around the smaller rings."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        groups = comm.groups
+        axis = comm.axis
+        pos_map, single = self._masks(comm)
+        sizes = {len(g) for g in groups if len(g) > 1}
+        max_g = max(sizes) if sizes else 1
+        uniform = len(sizes) <= 1
+
+        pow2 = uniform and max_g >= 2 and (max_g & (max_g - 1)) == 0
+        if pow2:
+            perms = [_xor_perm(groups, 1 << k)
+                     for k in range(int(math.log2(max_g)))]
+        else:
+            perms = [_shift_perm(groups, 1)] * max(max_g - 1, 0)
+        gsize = self._group_sizes(comm)
+
+        def body(b_in):
+            idx = lax.axis_index(axis)
+            b = (b_in != 0).astype(jnp.int32) if op.logical else b_in
+            acc = b
+            if pow2:
+                # reference: coll_base_allreduce.c:134 recursive doubling
+                for perm in perms:
+                    other = lax.ppermute(acc, axis, perm)
+                    acc = op.jax_reduce(acc, other)
+            else:
+                # reference: coll_base_allreduce.c:345 ring, with a
+                # per-rank round mask for non-uniform group sizes
+                cur = b
+                for d, perm in enumerate(perms):
+                    cur = lax.ppermute(cur, axis, perm)
+                    nxt = op.jax_reduce(acc, cur)
+                    acc = jnp.where(d < gsize[idx] - 1, nxt, acc)
+            out = jnp.where(single[idx], b, acc.astype(b.dtype))
+            return out.astype(b_in.dtype)
+
+        return body
+
+    # ---------------------------------------------------------- collectives
+    def allreduce(self, comm, x, op: _op.Op = _op.SUM):
+        import jax.numpy as jnp
+        from jax import lax
+
+        _check_device_op(op, x)
+        key = cache_key("allreduce", op)
+
+        def build():
+            axis = comm.axis
+            if comm.groups is None:
+                kind = op.jax_kind
+
+                def body(b):
+                    # logical ops reduce truthiness, not values; bools ride
+                    # the int path because XLA AllReduce wants arithmetic
+                    if op.logical:
+                        v = (b != 0).astype(jnp.int32)
+                    elif _is_bool(b.dtype):
+                        v = b.astype(jnp.int32)
+                    else:
+                        v = b
+                    if kind == "psum":
+                        r = lax.psum(v, axis)
+                    elif kind == "pmax":
+                        r = lax.pmax(v, axis)
+                    elif kind == "pmin":
+                        r = lax.pmin(v, axis)
+                    else:
+                        g = lax.all_gather(v[0], axis)  # [W, ...]
+                        acc = g[0]
+                        for i in range(1, g.shape[0]):
+                            acc = op.jax_reduce(acc, g[i])
+                        return acc[None].astype(b.dtype)
+                    return r.astype(b.dtype)
+
+            else:
+                body = self._grouped_allreduce_body(comm, op)
+            return self._wrap(comm, body)
+
+        return self._cached(comm, key, build)(x)
+
+    def reduce(self, comm, x, op: _op.Op = _op.SUM, root: int = 0):
+        """MPI only defines the root row; we return the reduction on every
+        group row (a legal strengthening — free on a mesh, where Reduce and
+        Allreduce cost the same under XLA's schedules)."""
+        return self.allreduce(comm, x, op)
+
+    def bcast(self, comm, x, root: int = 0):
+        import jax.numpy as jnp
+        from jax import lax
+
+        key = cache_key("bcast")
+
+        def build():
+            axis = comm.axis
+            pos_map, single = self._masks(comm)
+
+            def body(b, r):
+                # mask non-root contributions, then sum — one AllReduce
+                # (or grouped schedule); works for every castable dtype.
+                idx = lax.axis_index(axis)
+                pos = pos_map[idx]
+                v = b.astype(jnp.int32) if _is_bool(b.dtype) else b
+                contrib = jnp.where(pos == r, v, jnp.zeros_like(v))
+                if comm.groups is None:
+                    out = lax.psum(contrib, axis)
+                else:
+                    out = self._grouped_allreduce_body(comm, _op.SUM)(contrib)
+                out = jnp.where(single[idx], v, out)
+                return out.astype(b.dtype)
+
+            return self._wrap(comm, body, rooted=True)
+
+        return self._cached(comm, key, build)(x, jnp.int32(root))
+
+    def allgather(self, comm, x):
+        """[W, ...] -> [W, G, ...]: each rank-row becomes its group's
+        stacked contributions (MPI_Allgather, stacked layout)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        key = cache_key("allgather")
+
+        def build():
+            axis = comm.axis
+            G = comm.size
+            pos_map, single = self._masks(comm)
+
+            if comm.groups is None:
+
+                def body(b):
+                    return lax.all_gather(b[0], axis)[None]
+
+            else:
+                perms = [_shift_perm(comm.groups, 1)] * max(G - 1, 0)
+
+                def body(b):
+                    # ring allgather (reference: coll_base_allgather.c ring)
+                    idx = lax.axis_index(axis)
+                    pos = pos_map[idx]
+                    out = jnp.zeros((1, G) + b.shape[1:], b.dtype)
+                    out = lax.dynamic_update_index_in_dim(
+                        out, b, pos, axis=1)
+                    cur = b
+                    for d, perm in enumerate(perms, start=1):
+                        cur = lax.ppermute(cur, axis, perm)
+                        out = lax.dynamic_update_index_in_dim(
+                            out, cur, (pos - d) % G, axis=1)
+                    return out
+
+            return self._wrap(comm, body)
+
+        return self._cached(comm, key, build)(x)
+
+    def alltoall(self, comm, x):
+        """[W, G, ...] -> [W, G, ...]: chunk j of group-rank i goes to
+        chunk i of group-rank j (MPI_Alltoall)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        G = comm.size
+        if x.ndim < 2 or x.shape[1] != G:
+            raise MPIError(
+                ERR_ARG,
+                f"alltoall expects [world, group_size={G}, ...], got "
+                f"{tuple(x.shape)}",
+            )
+        key = cache_key("alltoall")
+
+        def build():
+            axis = comm.axis
+            pos_map, single = self._masks(comm)
+
+            if comm.groups is None:
+
+                def body(b):
+                    r = lax.all_to_all(b[0], axis, split_axis=0,
+                                       concat_axis=0, tiled=False)
+                    return r[None]
+
+            else:
+
+                def body(b):
+                    # one ppermute per ring offset (reference:
+                    # coll_base_alltoall.c pairwise exchange)
+                    idx = lax.axis_index(axis)
+                    pos = pos_map[idx]
+                    chunks = b[0]  # [G, ...]
+                    out = jnp.zeros_like(chunks)
+                    out = lax.dynamic_update_index_in_dim(
+                        out, chunks[pos], pos, axis=0)
+                    for d in range(1, G):
+                        perm = _shift_perm(comm.groups, d)
+                        send = lax.dynamic_index_in_dim(
+                            chunks, (pos + d) % G, axis=0, keepdims=False)
+                        recv = lax.ppermute(send, axis, perm)
+                        out = lax.dynamic_update_index_in_dim(
+                            out, recv, (pos - d) % G, axis=0)
+                    return out[None]
+
+            return self._wrap(comm, body)
+
+        return self._cached(comm, key, build)(x)
+
+    def reduce_scatter_block(self, comm, x, op: _op.Op = _op.SUM):
+        """[W, G, ...] -> [W, ...]: reduce across the group elementwise,
+        rank p keeps chunk p (MPI_Reduce_scatter_block)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        G = comm.size
+        if x.ndim < 2 or x.shape[1] != G:
+            raise MPIError(
+                ERR_ARG,
+                f"reduce_scatter expects [world, group_size={G}, ...], got "
+                f"{tuple(x.shape)}",
+            )
+        _check_device_op(op, x)
+        key = cache_key("reduce_scatter_block", op)
+
+        def build():
+            axis = comm.axis
+            pos_map, single = self._masks(comm)
+
+            if comm.groups is None and op.jax_kind == "psum":
+
+                def body(b):
+                    r = lax.psum_scatter(b[0], axis, scatter_dimension=0,
+                                         tiled=False)
+                    return r[None]
+
+            elif comm.groups is None:
+
+                def body(b):
+                    g = lax.all_gather(b[0], axis)  # [W, G, ...]
+                    acc = g[0]
+                    for i in range(1, g.shape[0]):
+                        acc = op.jax_reduce(acc, g[i])
+                    idx = lax.axis_index(axis)
+                    return acc[pos_map[idx]][None]
+
+            else:
+                red_body = self._grouped_allreduce_body(comm, op)
+
+                def body(b):
+                    red = red_body(b)  # [1, G, ...] group-reduced
+                    idx = lax.axis_index(axis)
+                    return lax.dynamic_index_in_dim(
+                        red[0], pos_map[idx], axis=0, keepdims=False)[None]
+
+            return self._wrap(comm, body)
+
+        return self._cached(comm, key, build)(x)
+
+    def scan(self, comm, x, op: _op.Op = _op.SUM, exclusive: bool = False):
+        """Prefix reduction across group ranks via Hillis–Steele doubling
+        (log G masked ppermute rounds — reference analog: the linear
+        MPI_Scan over PML sends, coll_base_scan.c, upgraded to a parallel
+        scan schedule)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        _check_device_op(op, x)
+        key = cache_key("scan", op, (exclusive,))
+
+        def build():
+            axis = comm.axis
+            pos_map, single = self._masks(comm)
+            groups = comm.groups
+            if groups is None:
+                groups = (tuple(range(comm.world_size)),)
+            # rounds sized by the LARGEST group; the pos >= d mask is
+            # group-local, so non-uniform colors just idle early
+            max_g = max((len(g) for g in groups), default=1)
+            rounds = max(int(math.ceil(math.log2(max(max_g, 1)))), 0)
+
+            def body(b):
+                idx = lax.axis_index(axis)
+                pos = pos_map[idx]
+                acc = b
+                for k in range(rounds):
+                    d = 1 << k
+                    perm = _shift_perm(groups, d)
+                    sh = lax.ppermute(acc, axis, perm)
+                    # ring shift wraps; mask wrapped contributions
+                    acc = jnp.where(pos >= d, op.jax_reduce(sh, acc), acc)
+                if exclusive:
+                    perm1 = _shift_perm(groups, 1)
+                    sh = lax.ppermute(acc, axis, perm1)
+                    acc = jnp.where(pos == 0, jnp.zeros_like(b), sh)
+                return jnp.where(single[idx], b, acc).astype(b.dtype)
+
+            return self._wrap(comm, body)
+
+        return self._cached(comm, key, build)(x)
+
+    def exscan(self, comm, x, op: _op.Op = _op.SUM):
+        return self.scan(comm, x, op, exclusive=True)
+
+    def barrier(self, comm) -> None:
+        """Whole-mesh sync: tiny psum, block until ready."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        key = cache_key("barrier")
+
+        def build():
+            def body(b):
+                return lax.psum(b, comm.axis)
+
+            return self._wrap(comm, body)
+
+        x = comm.shard(jnp.ones((comm.world_size, 1), dtype=jnp.int32))
+        self._cached(comm, key, build)(x).block_until_ready()
+
+    # --------------------------------------------- layout ("root") movers
+    def gather(self, comm, x, root: int = 0):
+        """[W, ...] -> [W, G, ...]: the root's row holds its group's
+        stacked contributions. MPI defines only the root row; returning
+        the gather on every row is the same legal strengthening as
+        reduce->allreduce (free on a mesh under XLA's schedules)."""
+        return self.allgather(comm, x)
+
+    def scatter(self, comm, x, root: int = 0):
+        """[W, G, ...] -> [W, ...]: group rank p receives ROOT's chunk p
+        (real MPI_Scatter semantics — the r1 reshard stub ignored the
+        root's data)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        G = comm.size
+        if x.ndim < 2 or x.shape[1] != G:
+            raise MPIError(
+                ERR_ARG,
+                f"scatter expects [world, group_size={G}, ...], got "
+                f"{tuple(x.shape)}")
+        key = cache_key("scatter")
+
+        def build():
+            axis = comm.axis
+            pos_map, single = self._masks(comm)
+
+            def body(b, r):
+                idx = lax.axis_index(axis)
+                pos = pos_map[idx]
+                chunks = b[0]  # [G, ...]
+                v = chunks.astype(jnp.int32) if _is_bool(chunks.dtype) \
+                    else chunks
+                contrib = jnp.where(pos == r, v, jnp.zeros_like(v))
+                if comm.groups is None:
+                    full = lax.psum(contrib, axis)
+                else:
+                    full = self._grouped_allreduce_body(comm, _op.SUM)(
+                        contrib[None])[0]
+                out = lax.dynamic_index_in_dim(full, pos, axis=0,
+                                               keepdims=False)
+                own = lax.dynamic_index_in_dim(v, pos, axis=0,
+                                               keepdims=False)
+                return jnp.where(single[idx], own,
+                                 out).astype(chunks.dtype)[None]
+
+            return self._wrap(comm, body, rooted=True)
+
+        return self._cached(comm, key, build)(x, jnp.int32(root))
+
+    # ---------------------------------------------- neighborhood collectives
+    # Reference: the coll.h neighbor_* slots. On a mesh, a cart topology's
+    # neighbor exchange is exactly what the ICI torus is wired for: one
+    # collective-permute per direction, wraparound links for periodic dims,
+    # zero-fill standing in for MPI_PROC_NULL's undefined blocks.
+    def _cart_in_perms(self, comm):
+        """Per neighbor slot k: ppermute pairs (src -> me) for every rank
+        whose k-th in-neighbor exists."""
+        from ompi_tpu.topo import CartTopo
+
+        t = comm.topo
+        if not isinstance(t, CartTopo) or comm.groups is not None:
+            raise MPIError(
+                ERR_UNSUPPORTED_OPERATION,
+                "mesh neighbor collectives need a cartesian topology over "
+                "the whole mesh axis (graph topologies ride the host path)")
+        nbrs = [t.neighbors(me) for me in range(comm.world_size)]
+        perms = []
+        for k in range(2 * t.ndims):
+            pairs = [(nbrs[me][k], me) for me in range(comm.world_size)
+                     if nbrs[me][k] >= 0]
+            perms.append(tuple(pairs))
+        return perms
+
+    def neighbor_allgather(self, comm, x):
+        """[W, ...] -> [W, K, ...]: slot k carries the k-th neighbor's row
+        (cart order: per dim, negative then positive peer)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        perms = self._cart_in_perms(comm)
+        key = cache_key("neighbor_allgather")
+
+        def build():
+            axis = comm.axis
+
+            def body(b):
+                outs = [lax.ppermute(b[0], axis, p) for p in perms]
+                return jnp.stack(outs, axis=0)[None]
+
+            return self._wrap(comm, body)
+
+        return self._cached(comm, key, build)(x)
+
+    def neighbor_alltoall(self, comm, x):
+        """[W, K, ...] -> [W, K, ...]: block k goes to neighbor k; recv
+        block k arrives from neighbor k (who sent its opposite-direction
+        block along the same edge)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        perms = self._cart_in_perms(comm)
+        K = len(perms)
+        if x.ndim < 2 or x.shape[1] != K:
+            raise MPIError(
+                ERR_ARG,
+                f"neighbor_alltoall expects [world, {K}, ...], got "
+                f"{tuple(x.shape)}")
+        key = cache_key("neighbor_alltoall")
+
+        def build():
+            axis = comm.axis
+
+            def body(b):
+                blocks = b[0]  # [K, ...]
+                outs = []
+                for k in range(K):
+                    d, parity = divmod(k, 2)
+                    opp = 2 * d + (1 - parity)
+                    outs.append(lax.ppermute(blocks[opp], axis, perms[k]))
+                return jnp.stack(outs, axis=0)[None]
+
+            return self._wrap(comm, body)
+
+        return self._cached(comm, key, build)(x)
+
+    # ------------------------------------------------------------- pt2pt
+    def permute(self, comm, x, perm: Tuple[Tuple[int, int], ...]):
+        """Collective permute along GLOBAL mesh ranks — the mesh-native
+        tag-free pt2pt (SURVEY.md §5: ppermute chains replace PML
+        round-trips)."""
+        from jax import lax
+
+        key = cache_key("permute", extra=(tuple(perm),))
+
+        def build():
+            axis = comm.axis
+
+            def body(b):
+                return lax.ppermute(b, axis, perm)
+
+            return self._wrap(comm, body)
+
+        return self._cached(comm, key, build)(x)
+
+
+class XlaCollComponent(Component):
+    NAME = "xla"
+    PRIORITY = 100  # beats every host algorithm on mesh comms
+
+    _module: Optional[XlaColl] = None
+
+    def query(self, comm=None, **ctx):
+        from ompi_tpu.parallel.mesh import XlaComm
+
+        if isinstance(comm, XlaComm):
+            if XlaCollComponent._module is None:
+                XlaCollComponent._module = XlaColl()
+            return XlaCollComponent._module
+        return None
+
+
+coll_framework.register(XlaCollComponent())

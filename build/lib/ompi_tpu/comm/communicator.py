@@ -58,7 +58,6 @@ class _Keyval:
 
 _keyvals: Dict[int, _Keyval] = {}
 _next_keyval = [100]
-_ATTR_UNSET = object()  # distinguishes "not set" from a stored None
 
 
 def parse_buffer(buf) -> Tuple[Any, int, Datatype]:
@@ -143,21 +142,15 @@ class Communicator:
         self.errhandler = eh
 
     def Set_attr(self, keyval: int, value: Any) -> None:
-        # replacing a value fires the delete callback on the old one
-        # (MPI_Comm_set_attr contract — the callback releases resources)
-        if keyval in self.attributes:
-            self.Delete_attr(keyval)
         self.attributes[keyval] = value
 
     def Get_attr(self, keyval: int) -> Any:
         return self.attributes.get(keyval)
 
     def Delete_attr(self, keyval: int) -> None:
-        value = self.attributes.pop(keyval, _ATTR_UNSET)
-        if value is _ATTR_UNSET:
-            return
+        value = self.attributes.pop(keyval, None)
         kv = _keyvals.get(keyval)
-        if kv is not None and kv.delete_fn is not None:
+        if kv is not None and kv.delete_fn is not None and value is not None:
             kv.delete_fn(self, keyval, value)
 
     # MPI keyvals with copy/delete callbacks (reference: ompi/attribute,

@@ -255,7 +255,7 @@ class Intercomm(Communicator):
                         name=f"{self.name}-merged")
 
     def Free(self) -> None:
-        self._delete_all_attrs()
+        pass
 
 
 def intercomm_create(local_comm: ProcComm, local_leader: int,

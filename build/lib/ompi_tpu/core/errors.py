@@ -1,0 +1,127 @@
+"""MPI error codes, exception type, and error handlers.
+
+Reference: ompi/errhandler (3,163 LoC) + the MPI_ERR_* constants of
+ompi/include/mpi.h.in. Error *classes* are stable integers; the Python-native
+surface raises ``MPIError`` carrying the class, while the errhandler objects
+reproduce MPI_ERRORS_ARE_FATAL / MPI_ERRORS_RETURN semantics for code that
+wants C-style return handling.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+SUCCESS = 0
+ERR_BUFFER = 1
+ERR_COUNT = 2
+ERR_TYPE = 3
+ERR_TAG = 4
+ERR_COMM = 5
+ERR_RANK = 6
+ERR_REQUEST = 7
+ERR_ROOT = 8
+ERR_GROUP = 9
+ERR_OP = 10
+ERR_TOPOLOGY = 11
+ERR_DIMS = 12
+ERR_ARG = 13
+ERR_UNKNOWN = 14
+ERR_TRUNCATE = 15
+ERR_OTHER = 16
+ERR_INTERN = 17
+ERR_IN_STATUS = 18
+ERR_PENDING = 19
+ERR_ACCESS = 20
+ERR_AMODE = 21
+ERR_BAD_FILE = 23
+ERR_FILE = 27
+ERR_FILE_EXISTS = 25
+ERR_FILE_IN_USE = 26
+ERR_IO = 32
+ERR_NO_SPACE = 36
+ERR_NO_SUCH_FILE = 37
+ERR_READ_ONLY = 40
+ERR_WIN = 45
+ERR_KEYVAL = 48
+ERR_INFO = 50
+ERR_NO_MEM = 51
+ERR_BASE = 52
+ERR_PORT = 55
+ERR_SERVICE = 56
+ERR_NAME = 57
+ERR_SPAWN = 61
+ERR_UNSUPPORTED_DATAREP = 62
+ERR_UNSUPPORTED_OPERATION = 63
+ERR_SESSION = 72
+# ULFM fault-tolerance error classes (reference: ompi/mpiext/ftmpi — the
+# MPIX_ERR_* codes guarded by OPAL_ENABLE_FT_MPI)
+ERR_PROC_FAILED = 75
+ERR_PROC_FAILED_PENDING = 76
+ERR_REVOKED = 77
+
+_ERROR_STRINGS = {
+    SUCCESS: "MPI_SUCCESS: no error",
+    ERR_BUFFER: "MPI_ERR_BUFFER: invalid buffer pointer",
+    ERR_COUNT: "MPI_ERR_COUNT: invalid count argument",
+    ERR_TYPE: "MPI_ERR_TYPE: invalid datatype argument",
+    ERR_TAG: "MPI_ERR_TAG: invalid tag argument",
+    ERR_COMM: "MPI_ERR_COMM: invalid communicator",
+    ERR_RANK: "MPI_ERR_RANK: invalid rank",
+    ERR_REQUEST: "MPI_ERR_REQUEST: invalid request",
+    ERR_ROOT: "MPI_ERR_ROOT: invalid root",
+    ERR_GROUP: "MPI_ERR_GROUP: invalid group",
+    ERR_OP: "MPI_ERR_OP: invalid reduce operation",
+    ERR_TOPOLOGY: "MPI_ERR_TOPOLOGY: invalid communicator topology",
+    ERR_DIMS: "MPI_ERR_DIMS: invalid dimension argument",
+    ERR_ARG: "MPI_ERR_ARG: invalid argument",
+    ERR_UNKNOWN: "MPI_ERR_UNKNOWN: unknown error",
+    ERR_TRUNCATE: "MPI_ERR_TRUNCATE: message truncated",
+    ERR_OTHER: "MPI_ERR_OTHER: known error not in list",
+    ERR_INTERN: "MPI_ERR_INTERN: internal error",
+    ERR_IN_STATUS: "MPI_ERR_IN_STATUS: error code in status",
+    ERR_PENDING: "MPI_ERR_PENDING: pending request",
+    ERR_WIN: "MPI_ERR_WIN: invalid window",
+    ERR_SESSION: "MPI_ERR_SESSION: invalid session",
+    ERR_PROC_FAILED: "MPIX_ERR_PROC_FAILED: process failure",
+    ERR_REVOKED: "MPIX_ERR_REVOKED: communicator revoked",
+    ERR_UNSUPPORTED_OPERATION: "MPI_ERR_UNSUPPORTED_OPERATION",
+}
+
+
+def Error_string(code: int) -> str:
+    return _ERROR_STRINGS.get(code, f"MPI error class {code}")
+
+
+class MPIError(Exception):
+    def __init__(self, code: int, detail: str = ""):
+        self.code = code
+        msg = Error_string(code)
+        if detail:
+            msg = f"{msg} ({detail})"
+        super().__init__(msg)
+
+
+class Errhandler:
+    """MPI errhandler object (reference: ompi/errhandler/errhandler.h).
+
+    ``fn(comm_like, code, detail)`` decides how an error surfaces.
+    """
+
+    def __init__(self, fn: Callable, name: str = "user"):
+        self.fn = fn
+        self.name = name
+
+    def invoke(self, obj, code: int, detail: str = "") -> int:
+        return self.fn(obj, code, detail)
+
+
+def _fatal(obj, code: int, detail: str = "") -> int:
+    raise MPIError(code, detail)
+
+
+def _ret(obj, code: int, detail: str = "") -> int:
+    return code
+
+
+ERRORS_ARE_FATAL = Errhandler(_fatal, "MPI_ERRORS_ARE_FATAL")
+ERRORS_RETURN = Errhandler(_ret, "MPI_ERRORS_RETURN")

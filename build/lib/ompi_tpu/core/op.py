@@ -1,0 +1,155 @@
+"""MPI reduction operations.
+
+Reference: ompi/op (1,204 LoC dispatch) + the SIMD kernel components
+ompi/mca/op/{avx,aarch64,riscv64} (op_avx_functions.c:31-39). The TPU-native
+re-design: every op carries
+
+- ``np_reduce(a, b)``  — elementwise numpy kernel for the host/DCN path
+  (numpy ufuncs are the host-SIMD analog of op/avx), and
+- ``jax_kind``         — how coll/xla lowers it on device:
+  'psum' / 'pmax' / 'pmin' lower straight to XLA AllReduce computations;
+  'gather' ops (prod, logical/bitwise, loc-pairs, user fns) lower to
+  all_gather + an on-device tree reduction, which XLA fuses — still one
+  collective on the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIError, ERR_OP
+
+
+_op_counter = [0]
+
+
+class Op:
+    def __init__(
+        self,
+        name: str,
+        np_reduce: Callable,
+        jax_kind: str = "gather",
+        jax_reduce: Optional[Callable] = None,
+        commutative: bool = True,
+        logical: bool = False,
+    ):
+        self.name = name
+        self.np_reduce = np_reduce
+        self.jax_kind = jax_kind  # 'psum' | 'pmax' | 'pmin' | 'gather'
+        self._jax_reduce = jax_reduce
+        self.commutative = commutative
+        # logical ops normalize operands to {0,1} before lowering (MPI_LAND
+        # on ints is truthiness, not numeric min/max)
+        self.logical = logical
+        # unique id: compiled-executable caches key on this, so two distinct
+        # user ops never share an executable even with the same name
+        _op_counter[0] += 1
+        self.uid = _op_counter[0]
+
+    def jax_reduce(self, a, b):
+        """Elementwise combine traceable by XLA (used by the gather path and
+        by ring/segmented schedules)."""
+        if self._jax_reduce is not None:
+            return self._jax_reduce(a, b)
+        if not _JNP_EQUIV:  # late import: core must not require jax
+            _register_jnp_equivs()
+        fn = _JNP_EQUIV.get(self.name)
+        if fn is None:
+            raise MPIError(ERR_OP, f"op {self.name} has no device kernel")
+        return fn(a, b)
+
+    @staticmethod
+    def Create(fn: Callable, commute: bool = True, name: str = "user") -> "Op":
+        """User-defined op (MPI_Op_create). `fn(a, b)` must be elementwise;
+        if it is jax-traceable it also runs on device via the gather path."""
+        return Op(name, fn, jax_kind="gather", jax_reduce=fn,
+                  commutative=commute)
+
+    def __repr__(self) -> str:
+        return f"Op({self.name})"
+
+
+def _minloc(a, b):
+    """Elementwise on structured (value, index) pairs; ties take the lower
+    index, per MPI_MINLOC."""
+    take_b = (b["f0"] < a["f0"]) | ((b["f0"] == a["f0"]) & (b["f1"] < a["f1"]))
+    out = np.array(a, copy=True)
+    out[take_b] = b[take_b]
+    return out
+
+
+def _maxloc(a, b):
+    take_b = (b["f0"] > a["f0"]) | ((b["f0"] == a["f0"]) & (b["f1"] < a["f1"]))
+    out = np.array(a, copy=True)
+    out[take_b] = b[take_b]
+    return out
+
+
+def _minloc_jax(a, b):
+    """Device MINLOC: operands are pair arrays with a trailing dim of 2
+    holding (value, index) — the XLA-representable layout replacing the
+    host path's structured dtype (reference: the MPI pair types
+    ompi_datatype FLOAT_INT etc., reduced by op/avx's 2-wide kernels)."""
+    import jax.numpy as jnp
+
+    av, ai = a[..., 0], a[..., 1]
+    bv, bi = b[..., 0], b[..., 1]
+    take_a = (av < bv) | ((av == bv) & (ai <= bi))
+    return jnp.stack([jnp.where(take_a, av, bv),
+                      jnp.where(take_a, ai, bi)], axis=-1)
+
+
+def _maxloc_jax(a, b):
+    import jax.numpy as jnp
+
+    av, ai = a[..., 0], a[..., 1]
+    bv, bi = b[..., 0], b[..., 1]
+    take_a = (av > bv) | ((av == bv) & (ai <= bi))
+    return jnp.stack([jnp.where(take_a, av, bv),
+                      jnp.where(take_a, ai, bi)], axis=-1)
+
+
+_JNP_EQUIV = {}
+
+# ops whose device operands are (value, index) pair arrays ([..., 2])
+PAIR_OPS = ("MPI_MINLOC", "MPI_MAXLOC")
+
+
+def _register_jnp_equivs():
+    import jax.numpy as jnp
+
+    _JNP_EQUIV.update({
+        "MPI_MINLOC": _minloc_jax,
+        "MPI_MAXLOC": _maxloc_jax,
+        "MPI_SUM": jnp.add,
+        "MPI_PROD": jnp.multiply,
+        "MPI_MAX": jnp.maximum,
+        "MPI_MIN": jnp.minimum,
+        "MPI_LAND": jnp.logical_and,
+        "MPI_LOR": jnp.logical_or,
+        "MPI_LXOR": jnp.logical_xor,
+        "MPI_BAND": jnp.bitwise_and,
+        "MPI_BOR": jnp.bitwise_or,
+        "MPI_BXOR": jnp.bitwise_xor,
+        "MPI_REPLACE": lambda a, b: b,
+        "MPI_NO_OP": lambda a, b: a,
+    })
+
+
+SUM = Op("MPI_SUM", np.add, jax_kind="psum")
+PROD = Op("MPI_PROD", np.multiply, jax_kind="gather")
+MAX = Op("MPI_MAX", np.maximum, jax_kind="pmax")
+MIN = Op("MPI_MIN", np.minimum, jax_kind="pmin")
+LAND = Op("MPI_LAND", np.logical_and, jax_kind="pmin", logical=True)
+LOR = Op("MPI_LOR", np.logical_or, jax_kind="pmax", logical=True)
+LXOR = Op("MPI_LXOR", np.logical_xor, jax_kind="gather", logical=True)
+BAND = Op("MPI_BAND", np.bitwise_and, jax_kind="gather")
+BOR = Op("MPI_BOR", np.bitwise_or, jax_kind="gather")
+BXOR = Op("MPI_BXOR", np.bitwise_xor, jax_kind="gather")
+MINLOC = Op("MPI_MINLOC", _minloc, jax_kind="gather")
+MAXLOC = Op("MPI_MAXLOC", _maxloc, jax_kind="gather")
+REPLACE = Op("MPI_REPLACE", lambda a, b: b, jax_kind="gather",
+             commutative=False)
+NO_OP = Op("MPI_NO_OP", lambda a, b: a, jax_kind="gather", commutative=False)

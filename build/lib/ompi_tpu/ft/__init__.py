@@ -1,0 +1,4 @@
+"""ULFM-style fault tolerance (reference: ompi/communicator/ft + coll/ftagree
++ ompi/mpiext/ftmpi — MPIX_Comm_revoke/shrink/agree and the heartbeat
+failure detector). The detector lives in ompi_tpu.ft.detector; revoke/shrink
+in ompi_tpu.ft.revoke; agreement in ompi_tpu.ft.agreement."""

@@ -1,0 +1,30 @@
+"""Fault-tolerant agreement (MPIX_Comm_agree).
+
+Reference: ompi/mca/coll/ftagree (4,326 LoC, early-returning consensus /
+ERA). The MPI contract: every live process contributes a flag; the result
+is the bitwise AND across live contributions, and the call succeeds even in
+the presence of (already-detected) failures. Here: a BAND allreduce over
+the live members; failed members are excluded from the schedule.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def agree(comm, flag: int) -> int:
+    from ompi_tpu.core import op as _op
+    from ompi_tpu.ft.detector import known_failed
+
+    failed = known_failed()
+    if not failed or all(r not in failed for r in comm.group.ranks):
+        buf = np.array([flag], dtype=np.int64)
+        out = np.zeros(1, dtype=np.int64)
+        comm.Allreduce(buf, out, op=_op.BAND)
+        return int(out[0])
+    # with known failures: agree over the shrunken membership
+    live = comm.Shrink()
+    buf = np.array([flag], dtype=np.int64)
+    out = np.zeros(1, dtype=np.int64)
+    live.Allreduce(buf, out, op=_op.BAND)
+    return int(out[0])

@@ -1,0 +1,116 @@
+"""Failure detector.
+
+Reference: ompi/communicator/ft/comm_ft_detector.c (728 LoC) — a ring
+heartbeat: each process observes its ring predecessor; a missed-heartbeat
+timeout marks the peer failed and the propagator broadcasts the failure.
+Process mode runs the heartbeat over the btl (started by wireup when
+``ft_enable`` is set); mesh mode has a single controller, so failure
+handling reduces to XLA/PJRT error propagation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.utils.output import get_logger
+
+register_var("ft", "enable", False,
+             help="Enable the ULFM heartbeat failure detector", level=3)
+register_var("ft", "heartbeat_period", 0.2,
+             help="Seconds between heartbeats (reference: the detector's "
+                  "period MCA var)", level=6)
+register_var("ft", "heartbeat_timeout", 2.0,
+             help="Seconds without heartbeat before declaring failure",
+             level=6)
+
+HEARTBEAT_TAG = -4243
+
+_failed: Set[int] = set()
+_failed_lock = threading.Lock()
+_callbacks: List[Callable[[int], None]] = []
+_log = get_logger("ft.detector")
+
+
+def known_failed() -> Set[int]:
+    with _failed_lock:
+        return set(_failed)
+
+
+def mark_failed(rank: int) -> None:
+    with _failed_lock:
+        if rank in _failed:
+            return
+        _failed.add(rank)
+    _log.warning("rank %d declared FAILED", rank)
+    for cb in list(_callbacks):
+        cb(rank)
+
+
+def on_failure(cb: Callable[[int], None]) -> None:
+    """Register a failure observer (reference: the PMIx event handlers
+    registered at instance.c init)."""
+    _callbacks.append(cb)
+
+
+class HeartbeatDetector:
+    """Ring heartbeat: rank r observes (r-1) mod n and pings (r+1) mod n
+    (reference topology: comm_ft_detector.c ring observation)."""
+
+    def __init__(self, pml, my_rank: int, size: int):
+        self.pml = pml
+        self.rank = my_rank
+        self.size = size
+        self.observed = (my_rank - 1) % size
+        self.target = (my_rank + 1) % size
+        self.last_seen = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self.size < 2:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ompi-tpu-ft-detector")
+        self._thread.start()
+
+    def note_heartbeat(self, src: int) -> None:
+        if src == self.observed:
+            self.last_seen = time.monotonic()
+
+    def _run(self) -> None:
+        import numpy as np
+        from ompi_tpu.core.datatype import INT64
+
+        period = get_var("ft", "heartbeat_period")
+        timeout = get_var("ft", "heartbeat_timeout")
+        beat = np.array([self.rank], dtype=np.int64)
+        while not self._stop.is_set():
+            try:
+                self.pml.isend(beat, 1, INT64, self.target,
+                               HEARTBEAT_TAG, 0)
+            except Exception:
+                pass
+            if time.monotonic() - self.last_seen > timeout:
+                mark_failed(self.observed)
+                # re-route around the failure (ring heals: observe next
+                # living predecessor — reference: detector ring repair)
+                nxt = (self.observed - 1) % self.size
+                while nxt in known_failed() and nxt != self.rank:
+                    nxt = (nxt - 1) % self.size
+                self.observed = nxt
+                self.last_seen = time.monotonic()
+            self._stop.wait(period)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+
+def _reset_for_testing() -> None:
+    with _failed_lock:
+        _failed.clear()
+    _callbacks.clear()

@@ -1,0 +1,25 @@
+"""hook framework — generic init/finalize interposition.
+
+Reference: ompi/mca/hook (ompi_hook_base_mpi_init_top is the first call in
+ompi_mpi_init.c:350). Components register callables for the four phases;
+used by the SPC counter bring-up and available to users/tools.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable, Dict, List
+
+_hooks: Dict[str, List[Callable[[], None]]] = defaultdict(list)
+
+PHASES = ("init_top", "init_bottom", "finalize_top", "finalize_bottom")
+
+
+def register_hook(phase: str, fn: Callable[[], None]) -> None:
+    assert phase in PHASES, phase
+    _hooks[phase].append(fn)
+
+
+def run_hooks(phase: str) -> None:
+    for fn in list(_hooks[phase]):
+        fn()

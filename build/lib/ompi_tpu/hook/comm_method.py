@@ -1,0 +1,36 @@
+"""hook/comm_method — print the per-peer transport matrix at init.
+
+Reference: ompi/mca/hook/comm_method prints which BTL/PML connects each
+peer pair right after MPI_Init so users can verify sm vs tcp selection.
+Enable with ``--mca hook_comm_method 1``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ompi_tpu.hook import register_hook
+from ompi_tpu.mca.var import register_var, get_var
+
+register_var("hook", "comm_method", False,
+             help="Print the peer->transport matrix after init "
+                  "(reference: hook/comm_method)", level=3)
+
+
+def _print_matrix() -> None:
+    if not get_var("hook", "comm_method"):
+        return
+    from ompi_tpu.runtime.state import get_world
+
+    world = get_world()
+    pml = getattr(world, "pml", None)
+    if pml is None:
+        return
+    me = pml.my_rank
+    cells = []
+    for peer in sorted(pml.endpoints):
+        cells.append(f"{peer}:{pml.endpoints[peer].NAME}")
+    print(f"comm_method rank {me}: " + " ".join(cells), file=sys.stderr)
+
+
+register_hook("init_bottom", _print_matrix)

@@ -1,0 +1,10 @@
+from ompi_tpu.io.file import (
+    File,
+    MODE_RDONLY,
+    MODE_WRONLY,
+    MODE_RDWR,
+    MODE_CREATE,
+    MODE_APPEND,
+    MODE_EXCL,
+    MODE_DELETE_ON_CLOSE,
+)

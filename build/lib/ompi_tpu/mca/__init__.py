@@ -1,0 +1,30 @@
+"""MCA — Modular Component Architecture, TPU-native edition.
+
+The reference's single most load-bearing design (opal/mca/base, ~12k LoC C):
+every concern (transport, collectives, accelerator, ...) is a *framework* with
+pluggable *components* selected at runtime by registered priority, and every
+tunable is a typed *variable* sourced from defaults, param files, environment,
+and programmatic overrides (reference: mca_base_var.c:1524 register;
+mca_base_framework.c:161 open; mca_base_components_select.c selection).
+
+We keep the contract but drop the dlopen machinery in favor of Python entry
+points + import-time registration; third-party components register via
+``ompi_tpu.mca.register_component``.
+"""
+
+from ompi_tpu.mca.var import (
+    Var,
+    VarScope,
+    VarSource,
+    register_var,
+    get_var,
+    set_var,
+    all_vars,
+)
+from ompi_tpu.mca.component import (
+    Component,
+    Framework,
+    framework,
+    register_component,
+    all_frameworks,
+)

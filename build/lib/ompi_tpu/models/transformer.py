@@ -1,0 +1,256 @@
+"""Flagship demo model: a causal-LM transformer parallelized with the
+framework's collective vocabulary.
+
+This is the framework's end-to-end proof (the analog of the reference's
+examples/ + the OSU/Horovod ladder configs in BASELINE.md): a training step
+whose every communication — tensor-parallel activation reductions,
+sequence-parallel ring attention, data-parallel gradient allreduce — is an
+ompi_tpu collective (ompi_tpu.parallel.axes in-mesh verbs + ops.ring_attention),
+laid out Megatron-style over a (dp, sp, tp) mesh:
+
+- tp: QKV/W1 column-parallel, WO/W2 row-parallel with psum of partial
+  outputs (attention heads sharded over tp)
+- sp: sequence dim sharded; attention runs as ring attention (ppermute
+  K/V rotation with flash-style accumulation)
+- dp: batch sharded; gradients allreduced (the "Horovod-style 1GB gradient
+  allreduce" BASELINE config is exactly this traffic)
+
+All matmuls run in bfloat16 on the MXU with float32 accumulation/params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab: int = 512
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 512
+    seq_len: int = 128
+    lr: float = 1e-2
+    # rematerialize each block's activations in backward (jax.checkpoint):
+    # trades ~30% more FLOPs for O(layers) less HBM — the standard TPU
+    # memory/compute exchange, letting batch sizes that keep the MXU busy
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(key, cfg: Config) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    scale = lambda d: 1.0 / np.sqrt(d)
+    params: Dict[str, Any] = {
+        "embed": jax.random.normal(keys[0], (cfg.vocab, cfg.d_model),
+                                   jnp.float32) * scale(cfg.d_model),
+        "pos": jax.random.normal(keys[1], (cfg.seq_len, cfg.d_model),
+                                 jnp.float32) * scale(cfg.d_model),
+        "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
+        "blocks": [],
+    }
+    for i in range(cfg.n_layers):
+        k1, k2, k3, k4 = jax.random.split(keys[2 + i], 4)
+        params["blocks"].append({
+            "ln1": jnp.ones((cfg.d_model,), jnp.float32),
+            # [D, H, 3*hd]: sharding the heads dim over tp keeps each
+            # shard's q/k/v intact (a flat [D, 3D] column shard would mix
+            # q columns with k columns)
+            "qkv": jax.random.normal(
+                k1, (cfg.d_model, cfg.n_heads, 3 * cfg.head_dim),
+                jnp.float32
+            ) * scale(cfg.d_model),
+            "wo": jax.random.normal(
+                k2, (cfg.d_model, cfg.d_model), jnp.float32
+            ) * scale(cfg.d_model),
+            "ln2": jnp.ones((cfg.d_model,), jnp.float32),
+            "w1": jax.random.normal(
+                k3, (cfg.d_model, cfg.d_ff), jnp.float32
+            ) * scale(cfg.d_model),
+            "w2": jax.random.normal(
+                k4, (cfg.d_ff, cfg.d_model), jnp.float32
+            ) * scale(cfg.d_ff),
+        })
+    return params
+
+
+def param_specs(cfg: Config):
+    """Megatron sharding plan as PartitionSpecs (tp axis only; every param
+    is replicated over dp and sp)."""
+    from jax.sharding import PartitionSpec as P
+
+    block = {
+        "ln1": P(), "ln2": P(),
+        "qkv": P(None, "tp", None),  # heads sharded (column parallel)
+        "wo": P("tp", None),         # row parallel -> psum
+        "w1": P(None, "tp"),         # column parallel
+        "w2": P("tp", None),         # row parallel -> psum
+    }
+    return {
+        "embed": P(), "pos": P(), "ln_f": P(),
+        "blocks": [dict(block) for _ in range(cfg.n_layers)],
+    }
+
+
+def _ln(x, g):
+    import jax.numpy as jnp
+
+    x = x - jnp.mean(x, axis=-1, keepdims=True)
+    x = x / jnp.sqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
+    return x * g
+
+
+def _mm(a, w):
+    """bf16 MXU matmul with f32 accumulation."""
+    import jax.numpy as jnp
+
+    return jnp.einsum("...d,df->...f", a.astype(jnp.bfloat16),
+                      w.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+
+
+def forward_local(params, tokens, cfg: Config, tp: int = 1, sp: int = 1,
+                  in_mesh: bool = False, causal_ring: bool = True):
+    """Forward on local shards. Inside shard_map (``in_mesh=True``): tokens
+    [B/dp, S/sp]; tp-sharded weights arrive as local slices; activations
+    psum over 'tp' after every row-parallel matmul (emitted even when
+    tp == 1 — a size-1 psum is free and lets shard_map prove the loss is
+    tp-replicated); attention rotates K/V over 'sp'. With in_mesh=False
+    this is the plain single-device forward.
+    """
+    import jax.numpy as jnp
+
+    from ompi_tpu.ops.ring_attention import ring_attention
+    from ompi_tpu.parallel import axes
+
+    B, T = tokens.shape
+    h_local = cfg.n_heads // tp
+    hd = cfg.head_dim
+
+    if in_mesh:
+        seq_off = axes.rank("sp") * T
+        pos_idx = seq_off + jnp.arange(T)
+    else:
+        pos_idx = jnp.arange(T)
+    x = params["embed"][tokens] + params["pos"][pos_idx][None]
+
+    def block(x, blk):
+        h = _ln(x, blk["ln1"])
+        w_qkv = blk["qkv"]  # local [D, H/tp, 3*hd]
+        qkv = jnp.einsum("btd,dhf->bthf", h.astype(jnp.bfloat16),
+                         w_qkv.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        q, k, v = jnp.split(qkv, 3, axis=-1)  # each [B, T, H/tp, hd]
+        if in_mesh:
+            # full-tile chunk: the checkpointed flash body recomputes the
+            # scores in backward, so the dense tile is memory-safe and
+            # avoids scan overhead (measured best MFU on v5e); long-seq
+            # configs shrink the tile via the chunk arg
+            att = ring_attention(q, k, v, "sp", sp, causal=causal_ring,
+                                 mxu_dtype=jnp.bfloat16, chunk=T)
+        else:
+            from ompi_tpu.ops.ring_attention import reference_attention
+
+            att = reference_attention(q, k, v, causal=True)
+        att = att.reshape(B, T, h_local * hd)
+        out = _mm(att, blk["wo"])  # partial over tp (row parallel)
+        if in_mesh:
+            out = axes.allreduce(out, "tp")  # MPI_Allreduce on ICI
+        x = x + out
+
+        h2 = _ln(x, blk["ln2"])
+        ff = _mm(jnp.maximum(_mm(h2, blk["w1"]), 0.0), blk["w2"])
+        if in_mesh:
+            ff = axes.allreduce(ff, "tp")
+        return x + ff
+
+    if cfg.remat:
+        import jax
+
+        block = jax.checkpoint(block)
+    for blk in params["blocks"]:
+        x = block(x, blk)
+
+    x = _ln(x, params["ln_f"])
+    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.bfloat16),
+                        params["embed"].astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def forward(params, tokens, cfg: Config):
+    """Single-device forward (jittable as-is) — the graft entry fn."""
+    return forward_local(params, tokens, cfg, tp=1, sp=1, in_mesh=False)
+
+
+def _loss_local(params, tokens, targets, cfg: Config, tp: int, sp: int,
+                denom: float):
+    import jax.numpy as jnp
+
+    logits = forward_local(params, tokens, cfg, tp=tp, sp=sp, in_mesh=True)
+    logz = jnp.log(jnp.sum(jnp.exp(
+        logits - jnp.max(logits, -1, keepdims=True)), -1)) + \
+        jnp.max(logits, -1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.sum(logz - gold) / denom
+
+
+def make_train_step(mesh, cfg: Config):
+    """Build the jitted full training step over a (dp, sp, tp) mesh:
+    forward + backward + dp/sp gradient allreduce + SGD update."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dp = int(mesh.shape["dp"])
+    sp = int(mesh.shape["sp"])
+    tp = int(mesh.shape["tp"])
+    pspecs = param_specs(cfg)
+    tok_spec = P("dp", "sp")
+
+    def step_local(params, tokens, targets):
+        B, T = tokens.shape
+        denom = float(B * T * dp * sp)
+
+        def lossfn(p):
+            return _loss_local(p, tokens, targets, cfg, tp, sp, denom)
+
+        loss, grads = jax.value_and_grad(lossfn)(params)
+        # NOTE on the gradient allreduce (the Horovod-style traffic of
+        # BASELINE config #5): params are replicated over (dp, sp), so
+        # shard_map's replication-preserving AD *auto-inserts* the psum of
+        # their cotangents across dp/sp — the collective is in the compiled
+        # program without an explicit call here (an explicit psum would
+        # double-count; verified by loss-trajectory tests).
+        loss = lax.psum(loss, ("dp", "sp"))
+        new_params = jax.tree.map(
+            lambda p, g: (p - cfg.lr * g).astype(p.dtype), params, grads)
+        return loss, new_params
+
+    from ompi_tpu.parallel.axes import shard_map_compat
+
+    step = shard_map_compat(step_local, mesh,
+                            (pspecs, tok_spec, tok_spec),
+                            (P(), pspecs))
+    jitted = jax.jit(step)
+
+    def place(params, tokens, targets):
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, pspecs)
+        sh = NamedSharding(mesh, tok_spec)
+        return params, jax.device_put(tokens, sh), jax.device_put(targets, sh)
+
+    return jitted, place

@@ -1,0 +1,94 @@
+"""Native (C++) components and their ctypes bindings.
+
+The reference implements its transports, rings, and atomics in C
+(opal/class/opal_fifo.c, btl/sm); this package holds the TPU framework's
+C++ equivalents, compiled on demand with the system toolchain and loaded
+via ctypes (no pybind11 in the image). Every native component has a
+pure-Python fallback so the framework still runs where no compiler
+exists — the fallback implements the exact same memory layout, so a
+Python rank and a C++ rank can share one ring.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+from ompi_tpu.utils.output import get_logger
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "sm_ring.cpp")
+_SO = os.path.join(_HERE, "_ompi_tpu_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _build() -> bool:
+    """Compile the native library. Multiple ranks may race here: each
+    compiles to a private temp file, then atomically renames into place
+    (last writer wins; identical content makes the race harmless)."""
+    log = get_logger("native")
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+             "-o", tmp, _SRC],
+            check=True, capture_output=True, text=True, timeout=120,
+        )
+        os.rename(tmp, _SO)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        log.warning("native build failed (falling back to Python): %s",
+                    detail.strip()[:500])
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it if needed; None if unavailable."""
+    global _lib, _lib_tried
+    with _lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        src_mtime = os.path.getmtime(_SRC)
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            get_logger("native").warning("cannot load %s: %s", _SO, e)
+            return None
+        lib.smr_header_bytes.restype = ctypes.c_uint64
+        lib.smr_init.restype = ctypes.c_int
+        lib.smr_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.smr_capacity.restype = ctypes.c_uint64
+        lib.smr_capacity.argtypes = [ctypes.c_void_p]
+        lib.smr_push2.restype = ctypes.c_int
+        lib.smr_push2.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_uint64, ctypes.c_void_p,
+                                  ctypes.c_uint64]
+        lib.smr_pop.restype = ctypes.c_int64
+        lib.smr_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_uint64]
+        lib.smr_peek.restype = ctypes.c_int64
+        lib.smr_peek.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_uint64)]
+        lib.smr_advance.restype = None
+        lib.smr_advance.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.smr_used.restype = ctypes.c_uint64
+        lib.smr_used.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib

@@ -1,0 +1,1 @@
+from ompi_tpu.osc.window import Win, LOCK_EXCLUSIVE, LOCK_SHARED

@@ -1,0 +1,660 @@
+"""One-sided communication (RMA windows).
+
+Reference: ompi/mca/osc (25,779 LoC; fn-table contract osc.h:172-360 —
+put/get/accumulate/CAS/fetch-op + fence/PSCW/lock/flush). Per SURVEY.md §7
+the host path starts as osc/rdma-over-PML emulation: RMA verbs become
+active messages handled inside the target's progress engine (the progress
+thread gives true passive-target semantics — the target application never
+has to call MPI), applied to the window buffer under a per-window lock.
+
+Protocol (system-tag plane, OSC_TAG): payload = json-less packed header
+(win_id, verb, origin, disp, count, dtype_id, op_id, req_id) + data bytes.
+Every origin-side verb gets an ACK (with data for GET/FOP/CAS), so
+``Flush``/``Fence`` are exact: wait for all outstanding acks (reference
+analog: osc/rdma's outstanding-ops counters).
+
+Mesh mode: the single controller owns every rank's memory, so RMA is
+driver-level array update — see MeshWin below (XLA emits any transfers).
+"""
+
+from __future__ import annotations
+
+import itertools
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ompi_tpu.core import op as _op
+from ompi_tpu.core.datatype import Datatype, from_numpy_dtype
+from ompi_tpu.core.errors import MPIError, ERR_WIN, ERR_RANK, ERR_OP
+from ompi_tpu.runtime import spc
+from ompi_tpu.utils.output import get_logger
+
+OSC_TAG = -4300
+
+# verbs
+(_PUT, _GET, _ACC, _FOP, _CAS, _ACK, _LOCK, _UNLOCK, _LOCK_GRANT,
+ _POST, _COMPLETE) = range(11)
+
+LOCK_EXCLUSIVE = 1
+LOCK_SHARED = 2
+
+_HDR = struct.Struct("<iiiqqiii")
+# win_id, verb, origin, disp_bytes, count, dtype_code, op_code, req_id
+
+_OPS_BY_CODE = {}
+_CODE_BY_OP = {}
+for _i, _o in enumerate((_op.SUM, _op.PROD, _op.MAX, _op.MIN, _op.BAND,
+                         _op.BOR, _op.BXOR, _op.LAND, _op.LOR, _op.LXOR,
+                         _op.REPLACE, _op.NO_OP)):
+    _OPS_BY_CODE[_i] = _o
+    _CODE_BY_OP[_o.uid] = _i
+
+_DTYPES = {}
+
+
+def _dtype_code(dt: Datatype) -> int:
+    if dt.np_dtype is None:
+        raise MPIError(ERR_WIN, "RMA requires predefined datatypes (v1)")
+    code = np.dtype(dt.np_dtype).num
+    _DTYPES[code] = np.dtype(dt.np_dtype)
+    return code
+
+
+def _np_from_code(code: int) -> np.dtype:
+    dt = _DTYPES.get(code)
+    if dt is None:
+        from ompi_tpu.core.datatype import _BY_NP
+
+        for cand in _BY_NP:
+            if cand.num == code:
+                dt = cand
+                break
+        if dt is None:
+            raise MPIError(ERR_WIN, f"unknown RMA dtype code {code}")
+        _DTYPES[code] = dt
+    return dt
+
+
+_windows: Dict[int, "Win"] = {}
+_win_id_lock = threading.Lock()
+_next_win_id = [1]
+_req_ids = itertools.count(1)
+_handler_installed = False
+
+
+def _install_handler(pml) -> None:
+    global _handler_installed
+    if not _handler_installed:
+        pml.register_system_handler(OSC_TAG, _on_message)
+        _handler_installed = True
+
+
+class _Pending:
+    __slots__ = ("event", "data", "callback", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.data: Optional[bytes] = None
+        self.callback = None  # set before the op is sent (no ack race)
+        self.error = 0
+
+
+_pending: Dict[int, _Pending] = {}
+
+
+def _on_message(hdr, payload: bytes) -> None:
+    """Runs inside the progress engine on the *target* (or origin for
+    ACKs) — the reference's osc callbacks registered on the btl."""
+    # BTLs deliver bytes-like frames; the self BTL short-circuits the
+    # PML's zero-copy pack views (ndarrays) straight through. Normalize
+    # here so every downstream slice/truthiness sees plain bytes.
+    if not isinstance(payload, (bytes, bytearray)):
+        payload = bytes(payload)
+    win_id, verb, origin, disp, count, dcode, opcode, req_id = \
+        _HDR.unpack(payload[: _HDR.size])
+    body = payload[_HDR.size:]
+    if verb == _ACK:
+        p = _pending.pop(req_id, None)
+        if p is not None:
+            p.data = body
+            p.error = opcode  # target-side error rides the opcode field
+            p.event.set()
+            if p.callback is not None:
+                p.callback(p)
+        return
+    win = _windows.get(win_id)
+    if win is None:
+        return
+    win._handle(verb, origin, disp, count, dcode, opcode, req_id, body)
+
+
+from ompi_tpu.core.request import Request
+
+
+class OscRequest(Request):
+    """Request-based RMA completion (reference: the Rput/Rget request
+    variants of osc.h and osc/rdma's request objects). Completes when the
+    target's ack arrives; Rget-style ops unpack their reply into the
+    origin buffer first."""
+
+    def __init__(self, win: "Win", rid: int, on_data=None,
+                 fire_and_forget: bool = False):
+        super().__init__()
+        self._win = win
+        self._rid = rid
+        self._on_data = on_data
+        self._fire_and_forget = fire_and_forget
+
+    def _on_ack(self, p: _Pending) -> None:
+        if not p.error and self._on_data is not None:
+            self._on_data(b"" if p.data is None else p.data)
+        if p.error and self._fire_and_forget:
+            # fire-and-forget Put/Accumulate errors surface at the next
+            # synchronization (MPI: errors attach to the epoch); waited
+            # requests raise from their own Wait instead. Record BEFORE
+            # popping _outstanding: Flush polls that dict from another
+            # thread and must not observe drained-but-unpoisoned state.
+            self._win._epoch_error = p.error
+        self._win._outstanding.pop(self._rid, None)
+        self._set_complete(p.error)
+
+
+class Win:
+    """MPI_Win over a ProcComm (reference: ompi/win + osc/rdma).
+
+    Completion model (reference: osc/rdma outstanding-ops counters,
+    osc_rdma_comm.c:838): Put/Accumulate complete LOCALLY at return (the
+    payload is copied out), remotely at Flush/Fence/Unlock/Complete —
+    true one-sided overlap. Get/Fetch_and_op/Compare_and_swap block for
+    their reply; the R-variants (Rput/Rget/Raccumulate) return Requests.
+    """
+
+    def __init__(self, buffer: Optional[np.ndarray], comm, win_id=None):
+        self.comm = comm
+        self.buf = buffer if buffer is not None else np.zeros(0, np.uint8)
+        self._bytes = self.buf.reshape(-1).view(np.uint8) if self.buf.size \
+            else np.zeros(0, np.uint8)
+        self.lock = threading.RLock()
+        self._outstanding: Dict[int, tuple] = {}  # rid -> (pending, target)
+        self._lock_state = 0  # >0 shared count, -1 exclusive
+        self._lock_waiters = []
+        self._lock_cond = threading.Condition()
+        self.attributes: Dict[int, Any] = {}
+        # PSCW epoch state (reference: osc active target pscw)
+        self._pscw_cond = threading.Condition()
+        self._posts_received: set = set()
+        self._completes_received: set = set()
+        self._access_group = None
+        # dynamic-window regions: base -> flat uint8 view
+        self.dynamic = False
+        self._regions: Dict[int, np.ndarray] = {}
+        self._next_attach_base = 1 << 20
+        # agree on the window id collectively (like a CID)
+        if win_id is None:
+            with _win_id_lock:
+                proposal = np.array([_next_win_id[0]], np.int64)
+            agreed = np.zeros(1, np.int64)
+            with spc.suppressed():
+                comm.Allreduce(proposal, agreed, op=_op.MAX)
+            win_id = int(agreed[0])
+            with _win_id_lock:
+                _next_win_id[0] = win_id + 1
+        self.win_id = win_id
+        _windows[win_id] = self
+        _install_handler(comm.pml)
+        with spc.suppressed():
+            comm.Barrier()
+
+    # ------------------------------------------------------------- plumbing
+    @staticmethod
+    def Create(buffer, comm) -> "Win":
+        return Win(buffer, comm)
+
+    @staticmethod
+    def Allocate(nbytes: int, comm) -> "Win":
+        return Win(np.zeros(nbytes, np.uint8), comm)
+
+    @staticmethod
+    def Create_dynamic(comm) -> "Win":
+        """MPI_Win_create_dynamic: no initial memory; ranks Attach/Detach
+        regions later (reference: osc/rdma dynamic windows,
+        osc_rdma_dynamic.c)."""
+        win = Win(None, comm)
+        win.dynamic = True
+        return win
+
+    def Attach(self, arr: np.ndarray) -> int:
+        """Expose `arr` in this window; returns its base displacement —
+        the analog of the attached region's address, which peers use as
+        target_disp (real MPI apps exchange attached addresses the same
+        way)."""
+        if not self.dynamic:
+            raise MPIError(ERR_WIN, "Attach requires a dynamic window")
+        if not arr.flags.c_contiguous:
+            # reshape(-1) of a non-contiguous array COPIES: peers would
+            # RMA into a detached buffer while the caller's memory never
+            # changes
+            raise MPIError(ERR_WIN, "Attach requires a C-contiguous array")
+        with self.lock:
+            base = self._next_attach_base
+            view = arr.reshape(-1).view(np.uint8)
+            self._next_attach_base = base + ((view.nbytes + 4095) & ~4095) \
+                + 4096
+            self._regions[base] = view
+        return base
+
+    def Detach(self, base_or_arr) -> None:
+        with self.lock:
+            if isinstance(base_or_arr, (int, np.integer)):
+                self._regions.pop(int(base_or_arr), None)
+                return
+            tgt = base_or_arr.reshape(-1).view(np.uint8)
+            for b, v in list(self._regions.items()):
+                if v.base is tgt.base or v is tgt:
+                    del self._regions[b]
+                    return
+
+    def _resolve(self, disp: int, nbytes: int) -> tuple:
+        """(flat view, local offset) for a target displacement; bounds
+        violations raise so the origin gets an error ack instead of a
+        dropped frame (static windows included — numpy would otherwise
+        raise a bare ValueError on writes and silently CLAMP reads,
+        hanging the origin's unpack)."""
+        if not self.dynamic:
+            if disp < 0 or disp + nbytes > self._bytes.nbytes:
+                raise MPIError(
+                    ERR_WIN,
+                    f"displacement [{disp}, {disp + nbytes}) outside the "
+                    f"{self._bytes.nbytes}-byte window")
+            return self._bytes, disp
+        for base, view in self._regions.items():
+            if base <= disp and disp + nbytes <= base + view.nbytes:
+                return view, disp - base
+        raise MPIError(ERR_WIN,
+                       f"displacement {disp} not in any attached region")
+
+    def Free(self) -> None:
+        # flush before the barrier: Put is asynchronous now, and a frame
+        # still in flight when the target pops its window would vanish
+        self.Flush()
+        with spc.suppressed():
+            self.comm.Barrier()
+        _windows.pop(self.win_id, None)
+
+    def _send(self, target: int, verb: int, disp: int, count: int,
+              dcode: int, opcode: int, req_id: int, body: bytes) -> None:
+        payload = _HDR.pack(self.win_id, verb, self.comm.rank, disp, count,
+                            dcode, opcode, req_id) + body
+        arr = np.frombuffer(payload, dtype=np.uint8)
+        from ompi_tpu.core.datatype import BYTE
+
+        self.comm.pml.isend(arr, arr.nbytes, BYTE,
+                            self.comm._world_rank(target), OSC_TAG,
+                            self.comm.cid)
+
+    def _post_op(self, target: int, verb: int, disp: int, count: int,
+                 dcode: int, opcode: int, body: bytes, on_data=None,
+                 fire_and_forget: bool = False) -> OscRequest:
+        """Issue one RMA op; returns the request that completes on ack.
+        The pending callback is armed BEFORE the send so a synchronous
+        self-BTL ack can't race past registration."""
+        rid = next(_req_ids)
+        p = _Pending()
+        req = OscRequest(self, rid, on_data, fire_and_forget)
+        p.callback = req._on_ack
+        _pending[rid] = p
+        self._outstanding[rid] = (p, target)
+        self._send(target, verb, disp, count, dcode, opcode, rid, body)
+        return req
+
+    # --------------------------------------------------------------- verbs
+    # Put/Accumulate complete locally at return (payload copied); their
+    # R-variants expose the remote-completion request.
+    def Rput(self, origin_arr: np.ndarray, target: int,
+             target_disp: int = 0) -> OscRequest:
+        spc.record_bytes("rma_put", origin_arr.nbytes)
+        dt = from_numpy_dtype(origin_arr.dtype)
+        return self._post_op(target, _PUT, target_disp * dt.size,
+                             origin_arr.size, _dtype_code(dt), 0,
+                             origin_arr.tobytes())
+
+    def Put(self, origin_arr: np.ndarray, target: int,
+            target_disp: int = 0) -> None:
+        spc.record_bytes("rma_put", origin_arr.nbytes)
+        dt = from_numpy_dtype(origin_arr.dtype)
+        self._post_op(target, _PUT, target_disp * dt.size,
+                      origin_arr.size, _dtype_code(dt), 0,
+                      origin_arr.tobytes(), fire_and_forget=True)
+
+    def Rget(self, origin_arr: np.ndarray, target: int,
+             target_disp: int = 0) -> OscRequest:
+        spc.record_bytes("rma_get", origin_arr.nbytes)
+        dt = from_numpy_dtype(origin_arr.dtype)
+
+        def land(data: bytes) -> None:
+            origin_arr.reshape(-1)[:] = np.frombuffer(
+                data, dtype=origin_arr.dtype)
+
+        return self._post_op(target, _GET, target_disp * dt.size,
+                             origin_arr.size, _dtype_code(dt), 0, b"",
+                             on_data=land)
+
+    def Get(self, origin_arr: np.ndarray, target: int,
+            target_disp: int = 0) -> None:
+        self.Rget(origin_arr, target, target_disp).Wait()
+
+    def Raccumulate(self, origin_arr: np.ndarray, target: int,
+                    target_disp: int = 0,
+                    op: _op.Op = _op.SUM) -> OscRequest:
+        dt = from_numpy_dtype(origin_arr.dtype)
+        code = _CODE_BY_OP.get(op.uid)
+        if code is None:
+            raise MPIError(ERR_OP, f"{op.name} not supported for RMA")
+        spc.record_bytes("rma_accumulate", origin_arr.nbytes)
+        return self._post_op(target, _ACC, target_disp * dt.size,
+                             origin_arr.size, _dtype_code(dt), code,
+                             origin_arr.tobytes())
+
+    def Accumulate(self, origin_arr: np.ndarray, target: int,
+                   target_disp: int = 0, op: _op.Op = _op.SUM) -> None:
+        dt = from_numpy_dtype(origin_arr.dtype)
+        code = _CODE_BY_OP.get(op.uid)
+        if code is None:
+            raise MPIError(ERR_OP, f"{op.name} not supported for RMA")
+        spc.record_bytes("rma_accumulate", origin_arr.nbytes)
+        self._post_op(target, _ACC, target_disp * dt.size,
+                      origin_arr.size, _dtype_code(dt), code,
+                      origin_arr.tobytes(), fire_and_forget=True)
+
+    def Fetch_and_op(self, value: np.ndarray, result: np.ndarray,
+                     target: int, target_disp: int = 0,
+                     op: _op.Op = _op.SUM) -> None:
+        dt = from_numpy_dtype(value.dtype)
+        code = _CODE_BY_OP.get(op.uid)
+        if code is None:
+            raise MPIError(ERR_OP, f"{op.name} not supported for RMA")
+
+        def land(data: bytes) -> None:
+            result.reshape(-1)[:1] = np.frombuffer(
+                data, dtype=result.dtype)[:1]
+
+        self._post_op(target, _FOP, target_disp * dt.size, 1,
+                      _dtype_code(dt), code, value.tobytes(),
+                      on_data=land).Wait()
+
+    def Compare_and_swap(self, compare: np.ndarray, origin: np.ndarray,
+                         result: np.ndarray, target: int,
+                         target_disp: int = 0) -> None:
+        dt = from_numpy_dtype(origin.dtype)
+        body = compare.tobytes() + origin.tobytes()
+
+        def land(data: bytes) -> None:
+            result.reshape(-1)[:1] = np.frombuffer(
+                data, dtype=result.dtype)[:1]
+
+        self._post_op(target, _CAS, target_disp * dt.size, 1,
+                      _dtype_code(dt), 0, body, on_data=land).Wait()
+
+    # ------------------------------------------------------- target handler
+    def _handle(self, verb, origin, disp, count, dcode, opcode, req_id,
+                body: bytes) -> None:
+        if verb == _LOCK:
+            self._grant_or_queue(origin, opcode, req_id)
+            return
+        if verb == _UNLOCK:
+            self._do_unlock()
+            ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0, 0,
+                            req_id)
+            self._reply(origin, ack)
+            return
+        if verb == _POST:
+            with self._pscw_cond:
+                self._posts_received.add(origin)
+                self._pscw_cond.notify_all()
+            return
+        if verb == _COMPLETE:
+            with self._pscw_cond:
+                self._completes_received.add(origin)
+                self._pscw_cond.notify_all()
+            return
+        npdt = _np_from_code(dcode) if dcode else np.dtype(np.uint8)
+        try:
+            reply = self._apply(verb, disp, count, npdt, opcode, body)
+        except Exception as e:
+            # ANY target-side failure must fail the ORIGIN's request, not
+            # silently drop the frame and hang its Flush
+            code = e.code if isinstance(e, MPIError) else ERR_WIN
+            ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0,
+                            code, req_id)
+            self._reply(origin, ack)
+            return
+        ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0, 0,
+                        req_id) + reply
+        self._reply(origin, ack)
+
+    def _apply(self, verb, disp, count, npdt, opcode,
+               body: bytes) -> bytes:
+        reply = b""
+        with self.lock:
+            if verb == _PUT:
+                view, off = self._resolve(disp, len(body))
+                view[off: off + len(body)] = np.frombuffer(body, np.uint8)
+            elif verb == _GET:
+                nbytes = count * npdt.itemsize
+                view, off = self._resolve(disp, nbytes)
+                reply = view[off: off + nbytes].tobytes()
+            elif verb == _ACC:
+                op = _OPS_BY_CODE[opcode]
+                incoming = np.frombuffer(body, dtype=npdt)
+                nbytes = incoming.nbytes
+                view, off = self._resolve(disp, nbytes)
+                cur = view[off: off + nbytes].view(npdt)
+                cur[:] = op.np_reduce(cur, incoming).astype(npdt)
+            elif verb == _FOP:
+                op = _OPS_BY_CODE[opcode]
+                incoming = np.frombuffer(body, dtype=npdt)
+                view, off = self._resolve(disp, npdt.itemsize)
+                cur = view[off: off + npdt.itemsize].view(npdt)
+                reply = cur.tobytes()
+                cur[:] = op.np_reduce(cur, incoming).astype(npdt)
+            elif verb == _CAS:
+                half = len(body) // 2
+                compare = np.frombuffer(body[:half], dtype=npdt)
+                newval = np.frombuffer(body[half:], dtype=npdt)
+                view, off = self._resolve(disp, npdt.itemsize)
+                cur = view[off: off + npdt.itemsize].view(npdt)
+                reply = cur.tobytes()
+                if cur[0] == compare[0]:
+                    cur[:] = newval
+        return reply
+
+    def _reply(self, origin: int, payload: bytes) -> None:
+        from ompi_tpu.core.datatype import BYTE
+
+        arr = np.frombuffer(payload, dtype=np.uint8)
+        self.comm.pml.isend(arr, arr.nbytes, BYTE,
+                            self.comm._world_rank(origin), OSC_TAG,
+                            self.comm.cid)
+
+    # ------------------------------------------------------- sync: fence
+    def Flush(self, rank: Optional[int] = None) -> None:
+        """Wait for remote completion: all outstanding acks, or only
+        those targeting `rank` (reference: osc/rdma's per-peer
+        outstanding-ops counters, osc_rdma_comm.c:838)."""
+        from ompi_tpu.runtime.progress import progress
+
+        def pending() -> bool:
+            if rank is None:
+                return bool(self._outstanding)
+            return any(t == rank
+                       for _, t in list(self._outstanding.values()))
+
+        while pending():
+            progress()
+        err = getattr(self, "_epoch_error", 0)
+        if err:
+            self._epoch_error = 0
+            raise MPIError(err, "RMA operation failed at the target")
+
+    def Flush_all(self) -> None:
+        self.Flush()
+
+    def Flush_local(self, rank: Optional[int] = None) -> None:
+        # local completion is immediate in this model: payloads are
+        # copied at issue time (reference: the rdma pipeline's local
+        # completion callbacks fire at bounce-buffer copy)
+        pass
+
+    def Fence(self) -> None:
+        """Active-target epoch boundary: local flush + barrier (reference:
+        osc_rdma active_target fence)."""
+        self.Flush()
+        with spc.suppressed():
+            self.comm.Barrier()
+
+    # ----------------------------------------------- sync: passive target
+    def Lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
+        self._post_op(target, _LOCK, 0, 0, 0, lock_type, b"").Wait()
+
+    def Unlock(self, target: int) -> None:
+        self.Flush(target)
+        self._post_op(target, _UNLOCK, 0, 0, 0, 0, b"").Wait()
+
+    def Lock_all(self) -> None:
+        for r in range(self.comm.size):
+            self.Lock(r, LOCK_SHARED)
+
+    def Unlock_all(self) -> None:
+        for r in range(self.comm.size):
+            self.Unlock(r)
+
+    def _grant_or_queue(self, origin: int, lock_type: int,
+                        req_id: int) -> None:
+        with self._lock_cond:
+            can = (self._lock_state == 0 or
+                   (lock_type == LOCK_SHARED and self._lock_state > 0))
+            if can:
+                self._lock_state = (self._lock_state + 1
+                                    if lock_type == LOCK_SHARED else -1)
+                ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0,
+                                0, req_id)
+                self._reply(origin, ack)
+            else:
+                self._lock_waiters.append((origin, lock_type, req_id))
+
+    def _do_unlock(self) -> None:
+        with self._lock_cond:
+            if self._lock_state == -1:
+                self._lock_state = 0
+            elif self._lock_state > 0:
+                self._lock_state -= 1
+            while self._lock_waiters and self._lock_state >= 0:
+                origin, lt, rid = self._lock_waiters[0]
+                if lt == LOCK_EXCLUSIVE and self._lock_state != 0:
+                    break
+                self._lock_waiters.pop(0)
+                self._lock_state = (self._lock_state + 1
+                                    if lt == LOCK_SHARED else -1)
+                ack = _HDR.pack(self.win_id, _ACK, self.comm.rank, 0, 0, 0,
+                                0, rid)
+                self._reply(origin, ack)
+                if lt == LOCK_EXCLUSIVE:
+                    break
+
+    # PSCW (reference: osc active-target Start/Complete/Post/Wait —
+    # osc_rdma_active_target.c). Real epoch protocol: Post notifies each
+    # origin; Start blocks for the matching Posts; Complete flushes then
+    # notifies each target; Wait blocks for all Completes.
+    def _comm_ranks(self, group) -> list:
+        return [self.comm.group.rank_of(w) for w in group.ranks]
+
+    def Post(self, group) -> None:
+        """Open an exposure epoch to `group` (origins)."""
+        self._post_group = self._comm_ranks(group)
+        for r in self._post_group:
+            self._send(r, _POST, 0, 0, 0, 0, 0, b"")
+
+    def Start(self, group) -> None:
+        """Open an access epoch to `group` (targets); blocks until every
+        target's Post notice arrives (MPI allows Start to block)."""
+        from ompi_tpu.runtime.progress import progress
+
+        self._access_group = self._comm_ranks(group)
+        want = set(self._access_group)
+        while True:
+            with self._pscw_cond:
+                if want.issubset(self._posts_received):
+                    self._posts_received -= want
+                    return
+            progress()
+
+    def Complete(self) -> None:
+        """End the access epoch: remote-complete every op, then notify
+        the targets."""
+        if self._access_group is None:
+            raise MPIError(ERR_WIN, "Complete without Start")
+        self.Flush()
+        for r in self._access_group:
+            self._send(r, _COMPLETE, 0, 0, 0, 0, 0, b"")
+        self._access_group = None
+
+    def Wait(self) -> None:
+        """End the exposure epoch: block until every origin Completed."""
+        from ompi_tpu.runtime.progress import progress
+
+        want = set(getattr(self, "_post_group", []))
+        while True:
+            with self._pscw_cond:
+                if want.issubset(self._completes_received):
+                    self._completes_received -= want
+                    return
+            progress()
+
+    def Test(self) -> bool:
+        """Nonblocking Wait (MPI_Win_test)."""
+        from ompi_tpu.runtime.progress import progress
+
+        progress()
+        want = set(getattr(self, "_post_group", []))
+        with self._pscw_cond:
+            if want.issubset(self._completes_received):
+                self._completes_received -= want
+                return True
+        return False
+
+
+class MeshWin:
+    """Mesh-mode window: driver-level RMA on a [world, n] jax array.
+
+    The single controller owns all rank memory, so Put/Get/Accumulate are
+    array updates (XLA inserts any cross-device movement) — one-sided
+    semantics come for free, which is the TPU-native answer to SURVEY.md
+    §7's 'osc over ICI is research-y' (hard part list).
+    """
+
+    def __init__(self, comm, shape_per_rank, dtype=None):
+        import jax.numpy as jnp
+
+        self.comm = comm
+        dtype = dtype or jnp.float32
+        self.array = comm.shard(
+            jnp.zeros((comm.world_size,) + tuple(shape_per_rank), dtype))
+
+    def Put(self, data, target: int) -> None:
+        self.array = self.array.at[target].set(data)
+
+    def Get(self, target: int):
+        return self.array[target]
+
+    def Accumulate(self, data, target: int, op: _op.Op = _op.SUM) -> None:
+        if op is _op.SUM:
+            self.array = self.array.at[target].add(data)
+        else:
+            self.array = self.array.at[target].set(
+                op.jax_reduce(self.array[target], data))
+
+    def Fence(self) -> None:
+        self.comm.barrier()

@@ -1,0 +1,3 @@
+import ompi_tpu.coll.xla  # noqa: F401 — register the coll/xla component
+
+from ompi_tpu.parallel.mesh import XlaComm, mesh_world

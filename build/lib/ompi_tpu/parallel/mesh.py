@@ -383,7 +383,6 @@ class XlaComm(Intracomm):
                        name=f"{self.name}-sub")
 
     def Free(self) -> None:
-        self._delete_all_attrs()
         self._jit_cache.clear()
         self.coll = None
 

@@ -1,0 +1,136 @@
+"""PML base: wire headers, matching engine, send/recv requests.
+
+Reference contracts:
+- protocol set: ompi/mca/pml/ob1/pml_ob1_hdr.h:43-52 (MATCH, RNDV, RGET,
+  ACK, FRAG, FIN ...) — we keep EAGER(=MATCH), RNDV RTS/CTS/DATA.
+- matching: pml_ob1_recvfrag.c:938 `match_one` — posted-receive queue vs
+  unexpected-fragment queue, FIFO per source, wildcard source/tag.
+- fn-table contract: ompi/mca/pml/pml.h:536-572.
+
+The matching engine is shared by every BTL; one instance per process. A
+single engine lock suffices (transports deliver from a progress thread; the
+hot path is short and the GIL serializes Python anyway — the analog of the
+reference's opal_using_threads() coarse mode).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.core.convertor import Convertor, pack as cv_pack
+from ompi_tpu.core.datatype import Datatype
+from ompi_tpu.core.errors import MPIError, ERR_TRUNCATE
+from ompi_tpu.core.request import Request
+from ompi_tpu.core.status import Status
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+
+# Header kinds (reference: pml_ob1_hdr.h type enum)
+EAGER = 1
+RNDV_RTS = 2
+RNDV_CTS = 3
+RNDV_DATA = 4
+
+_HDR = struct.Struct("<BiiqQQQQ")  # kind, src, cid, tag, seq, nbytes, offset, msgid
+HDR_SIZE = _HDR.size
+
+
+def pack_header(kind: int, src: int, cid: int, tag: int, seq: int,
+                nbytes: int, offset: int, msgid: int) -> bytes:
+    return _HDR.pack(kind, src, cid, tag, seq, nbytes, offset, msgid)
+
+
+class Header:
+    __slots__ = ("kind", "src", "cid", "tag", "seq", "nbytes", "offset", "msgid")
+
+    def __init__(self, raw: bytes):
+        (self.kind, self.src, self.cid, self.tag, self.seq,
+         self.nbytes, self.offset, self.msgid) = _HDR.unpack(raw)
+
+
+class SendRequest(Request):
+    def __init__(self, dst: int, tag: int, cid: int, nbytes: int):
+        super().__init__()
+        self.dst = dst
+        self.tag = tag
+        self.cid = cid
+        self.nbytes = nbytes
+        self.convertor: Optional[Convertor] = None
+        self.msgid = 0
+
+
+class RecvRequest(Request):
+    def __init__(self, buf, count: int, datatype: Datatype,
+                 src: int, tag: int, cid: int):
+        super().__init__()
+        self.buf = buf
+        self.count = count
+        self.datatype = datatype
+        self.src = src  # may be ANY_SOURCE
+        self.tag = tag  # may be ANY_TAG
+        self.cid = cid
+        self.convertor: Optional[Convertor] = None
+        self.matched = False
+
+    def matches(self, hdr: Header) -> bool:
+        # ANY_TAG only matches user tags (>= 0): system-plane traffic
+        # (osc/ft notices) uses negative tags and must never satisfy a
+        # wildcard user receive. Collective and partitioned traffic is
+        # isolated by dedicated CID planes instead (COLL_CID_BIT in
+        # coll/basic.py, PART_CID_BIT in pml/partitioned.py) — both guards
+        # are load-bearing; don't collapse one into the other.
+        return (
+            hdr.cid == self.cid
+            and (self.src == ANY_SOURCE or self.src == hdr.src)
+            and (hdr.tag >= 0 if self.tag == ANY_TAG
+                 else self.tag == hdr.tag)
+        )
+
+
+class UnexpectedFrag:
+    """An eager message or RTS that arrived before its receive was posted
+    (reference: the unexpected queue of match_one)."""
+
+    __slots__ = ("hdr", "payload")
+
+    def __init__(self, hdr: Header, payload: Optional[bytes]):
+        self.hdr = hdr
+        self.payload = payload
+
+
+class MatchingEngine:
+    """Posted-recv and unexpected queues with MPI matching semantics."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.posted: List[RecvRequest] = []
+        self.unexpected: List[UnexpectedFrag] = []
+
+    # Called with lock held -----------------------------------------------
+    def match_posted(self, hdr: Header) -> Optional[RecvRequest]:
+        for i, req in enumerate(self.posted):
+            if not req.matched and req.matches(hdr):
+                req.matched = True
+                req.status.source = hdr.src
+                req.status.tag = hdr.tag
+                del self.posted[i]
+                return req
+        return None
+
+    def match_unexpected(self, req: RecvRequest,
+                         remove: bool = True) -> Optional[UnexpectedFrag]:
+        for i, frag in enumerate(self.unexpected):
+            if req.matches(frag.hdr):
+                if remove:
+                    del self.unexpected[i]
+                return frag
+        return None
+
+    def find_unexpected(self, src: int, tag: int, cid: int) -> Optional[UnexpectedFrag]:
+        probe = RecvRequest(None, 0, None, src, tag, cid)  # matcher only
+        return self.match_unexpected(probe, remove=False)

@@ -1,0 +1,303 @@
+"""The default matching/protocol PML.
+
+Reference: ompi/mca/pml/ob1 (13,748 LoC) — eager MATCH + rendezvous
+RTS/CTS/DATA, per-peer sequence numbers (pml_ob1_isend.c:288), scheduling
+over BTLs via the BML endpoint map (bml/r2). Re-design notes:
+
+- Eager messages <= the btl's eager limit ship header+payload in one frame
+  and complete the send immediately (buffered-send semantics, like the
+  reference's send_inline fast path pml_ob1_isend.c:297).
+- Larger messages run RTS/CTS then pipelined DATA fragments drained from a
+  convertor — the reference's RNDV/FRAG protocol (pml_ob1_sendreq.c:501-555)
+  minus RDMA (no RDMA on the host/DCN path; device bulk data rides the
+  coll/xla ICI path instead, which is the TPU-native answer to RGET).
+- The BML multiplexer collapses to a per-peer btl map: one best transport
+  per peer (self < shm < tcp by locality), chosen at add_procs time like
+  bml/r2 orders endpoints by priority.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Optional
+
+from ompi_tpu.core.convertor import Convertor
+from ompi_tpu.core.datatype import Datatype
+from ompi_tpu.core.errors import MPIError, ERR_TRUNCATE, ERR_RANK, ERR_INTERN
+from ompi_tpu.core.status import Status
+from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.pml.base import (
+    ANY_SOURCE,
+    ANY_TAG,
+    EAGER,
+    RNDV_CTS,
+    RNDV_DATA,
+    RNDV_RTS,
+    Header,
+    MatchingEngine,
+    RecvRequest,
+    SendRequest,
+    UnexpectedFrag,
+    pack_header,
+)
+from ompi_tpu.utils.output import get_logger
+
+register_var("pml", "eager_limit", 65536,
+             help="Max bytes sent eagerly without RTS/CTS handshake "
+                  "(reference: btl_eager_limit, btl.h:1179)", level=4)
+register_var("pml", "frag_size", 1 << 20,
+             help="Rendezvous DATA fragment size (reference: the RDMA "
+                  "pipeline frag knobs, btl.h:1183-1186)", level=5)
+
+
+class Ob1Pml:
+    def __init__(self, my_rank: int):
+        self.my_rank = my_rank
+        self.engine = MatchingEngine()
+        self.endpoints: Dict[int, "Btl"] = {}  # world rank -> btl module
+        self.log = get_logger("pml.ob1")
+        self._seq = itertools.count(1)
+        self._msgid = itertools.count(1)
+        self._pending_sends: Dict[int, SendRequest] = {}  # msgid -> req
+        self._active_recvs: Dict[int, RecvRequest] = {}  # msgid -> req
+        # system-message plane: tags <= SYSTEM_TAG_BASE bypass matching and
+        # dispatch to registered handlers (ULFM revoke notices, heartbeats —
+        # reference analog: the PMIx event plane + ob1's internal hdr types)
+        self.system_handlers: Dict[int, object] = {}
+        # live queue-depth pvars (reference: ob1's MPI_T pvars for the
+        # unexpected/posted match queues)
+        from ompi_tpu.mca.var import register_pvar
+
+        register_pvar("pml", "unexpected_queue_length",
+                      lambda: len(self.engine.unexpected),
+                      help="Unexpected-message queue depth")
+        register_pvar("pml", "posted_recv_queue_length",
+                      lambda: len(self.engine.posted),
+                      help="Posted-receive queue depth")
+
+    # ------------------------------------------------------------- wiring
+    def add_endpoint(self, rank: int, btl) -> None:
+        """BML add_procs analog: bind the best transport for a peer."""
+        self.endpoints[rank] = btl
+
+    # Lazy endpoint resolution for peers outside the initial add_procs
+    # set (spawned jobs, connect/accept) — set by wireup (reference:
+    # ob1's add_procs called again from dpm for dynamic processes).
+    endpoint_resolver = None
+
+    def _btl_for(self, rank: int):
+        btl = self.endpoints.get(rank)
+        if btl is None and self.endpoint_resolver is not None:
+            btl = self.endpoint_resolver(rank)
+            if btl is not None:
+                self.endpoints[rank] = btl
+        if btl is None:
+            raise MPIError(ERR_RANK, f"no endpoint for rank {rank}")
+        return btl
+
+    # -------------------------------------------------------------- verbs
+    def isend(self, buf, count: int, datatype: Datatype, dst: int,
+              tag: int, cid: int) -> SendRequest:
+        btl = self._btl_for(dst)
+        conv = Convertor(buf, count, datatype, for_send=True)
+        req = SendRequest(dst, tag, cid, conv.packed_size)
+        req.convertor = conv
+        eager_limit = btl.eager_limit
+        # system-plane messages (osc active messages, ft notices) bypass
+        # matching, so they can never run the RTS/CTS handshake — always
+        # ship them in one frame (transports queue arbitrary frame sizes)
+        if tag <= self.SYSTEM_TAG_BASE:
+            eager_limit = None
+        if eager_limit is None or conv.packed_size <= eager_limit:
+            hdr = pack_header(EAGER, self.my_rank, cid, tag, next(self._seq),
+                              conv.packed_size, 0, 0)
+            payload = conv.pack_frag(conv.packed_size)
+            btl.send(dst, hdr, payload)
+            req.status._nbytes = conv.packed_size
+            req._set_complete(0)
+        else:
+            req.msgid = next(self._msgid)
+            self._pending_sends[req.msgid] = req
+            hdr = pack_header(RNDV_RTS, self.my_rank, cid, tag,
+                              next(self._seq), conv.packed_size, 0, req.msgid)
+            btl.send(dst, hdr, b"")
+        return req
+
+    def irecv(self, buf, count: int, datatype: Datatype, src: int,
+              tag: int, cid: int) -> RecvRequest:
+        req = RecvRequest(buf, count, datatype, src, tag, cid)
+        with self.engine.lock:
+            frag = self.engine.match_unexpected(req)
+            if frag is None:
+                self.engine.posted.append(req)
+                return req
+        # matched an already-arrived message
+        self._deliver_matched(req, frag.hdr, frag.payload)
+        return req
+
+    def iprobe(self, src: int, tag: int, cid: int,
+               status: Optional[Status]) -> bool:
+        with self.engine.lock:
+            frag = self.engine.find_unexpected(src, tag, cid)
+        if frag is None:
+            return False
+        if status is not None:
+            status.source = frag.hdr.src
+            status.tag = frag.hdr.tag
+            status._nbytes = frag.hdr.nbytes
+        return True
+
+    def improbe(self, src: int, tag: int, cid: int,
+                status: Optional[Status]):
+        """Matched probe: atomically claim the message (reference:
+        ompi/message mprobe support). Returns an opaque message handle."""
+        probe = RecvRequest(None, 0, None, src, tag, cid)
+        with self.engine.lock:
+            frag = self.engine.match_unexpected(probe, remove=True)
+        if frag is None:
+            return None
+        if status is not None:
+            status.source = frag.hdr.src
+            status.tag = frag.hdr.tag
+            status._nbytes = frag.hdr.nbytes
+        return frag
+
+    def mrecv(self, buf, count: int, datatype: Datatype,
+              message: UnexpectedFrag) -> RecvRequest:
+        req = RecvRequest(buf, count, datatype, message.hdr.src,
+                          message.hdr.tag, message.hdr.cid)
+        req.status.source = message.hdr.src
+        req.status.tag = message.hdr.tag
+        self._deliver_matched(req, message.hdr, message.payload)
+        return req
+
+    def cancel_recv(self, req: RecvRequest) -> bool:
+        with self.engine.lock:
+            if req in self.engine.posted:
+                self.engine.posted.remove(req)
+                req.status.cancelled = True
+                req._set_complete(0)
+                return True
+        return False
+
+    # ------------------------------------------------- incoming dispatch
+    SYSTEM_TAG_BASE = -4000
+
+    def register_system_handler(self, tag: int, fn) -> None:
+        self.system_handlers[tag] = fn
+
+    def handle_incoming(self, raw_hdr: bytes, payload: bytes) -> None:
+        """Single entry point for every BTL's received frames (reference:
+        the btl recv callbacks registered per hdr type in ob1)."""
+        hdr = Header(raw_hdr)
+        if hdr.tag <= self.SYSTEM_TAG_BASE:
+            fn = self.system_handlers.get(hdr.tag)
+            if fn is not None:
+                fn(hdr, payload)
+            return
+        if hdr.kind == EAGER:
+            self._incoming_eager(hdr, payload)
+        elif hdr.kind == RNDV_RTS:
+            self._incoming_rts(hdr)
+        elif hdr.kind == RNDV_CTS:
+            self._incoming_cts(hdr)
+        elif hdr.kind == RNDV_DATA:
+            self._incoming_data(hdr, payload)
+        else:
+            raise MPIError(ERR_INTERN, f"bad header kind {hdr.kind}")
+
+    def _incoming_eager(self, hdr: Header, payload: bytes) -> None:
+        with self.engine.lock:
+            req = self.engine.match_posted(hdr)
+            if req is None:
+                self.engine.unexpected.append(
+                    UnexpectedFrag(hdr, bytes(payload)))
+                return
+        self._deliver_matched(req, hdr, payload)
+
+    def _deliver_matched(self, req: RecvRequest, hdr: Header,
+                         payload: Optional[bytes]) -> None:
+        req.status.source = hdr.src
+        req.status.tag = hdr.tag
+        if hdr.kind == EAGER:
+            conv = Convertor(req.buf, req.count, req.datatype, for_send=False)
+            if hdr.nbytes > conv.packed_size:
+                req.status._nbytes = 0
+                req._set_complete(ERR_TRUNCATE)
+                return
+            conv.unpack_frag(payload)
+            req.status._nbytes = hdr.nbytes
+            req._set_complete(0)
+        else:  # RNDV_RTS — matched now; run the CTS handshake
+            conv = Convertor(req.buf, req.count, req.datatype, for_send=False)
+            if hdr.nbytes > conv.packed_size:
+                req.status._nbytes = 0
+                req._set_complete(ERR_TRUNCATE)
+                return
+            req.convertor = conv
+            req.status._nbytes = hdr.nbytes
+            recv_id = next(self._msgid)
+            self._active_recvs[recv_id] = req
+            cts = pack_header(RNDV_CTS, self.my_rank, hdr.cid, hdr.tag, 0,
+                              hdr.nbytes, hdr.msgid, recv_id)
+            try:
+                self._btl_for(hdr.src).send(hdr.src, cts, b"")
+            except MPIError as e:
+                # dead transport: fail the receive instead of leaving it
+                # matched-but-incomplete (Wait would spin forever)
+                del self._active_recvs[recv_id]
+                req.status._nbytes = 0
+                req._set_complete(e.code)
+
+    def _incoming_rts(self, hdr: Header) -> None:
+        with self.engine.lock:
+            req = self.engine.match_posted(hdr)
+            if req is None:
+                self.engine.unexpected.append(UnexpectedFrag(hdr, None))
+                return
+        self._deliver_matched(req, hdr, None)
+
+    def _incoming_cts(self, hdr: Header) -> None:
+        # hdr.offset carries the sender msgid; hdr.msgid the receiver reqid.
+        sreq = self._pending_sends.pop(int(hdr.offset), None)
+        if sreq is None:
+            return
+        conv = sreq.convertor
+        frag_size = get_var("pml", "frag_size")
+        btl = self._btl_for(hdr.src)
+        offset = 0
+        try:
+            while conv.remaining > 0:
+                frag = conv.pack_frag(frag_size)
+                dhdr = pack_header(RNDV_DATA, self.my_rank, sreq.cid,
+                                   sreq.tag, 0, sreq.nbytes, offset,
+                                   hdr.msgid)
+                btl.send(hdr.src, dhdr, frag)
+                offset += frag.nbytes
+        except MPIError as e:
+            # transport died mid-rendezvous: fail the send request so the
+            # sender's Wait surfaces the loss instead of spinning
+            sreq.status._nbytes = offset
+            sreq._set_complete(e.code)
+            return
+        sreq.status._nbytes = sreq.nbytes
+        sreq._set_complete(0)
+
+    def _incoming_data(self, hdr: Header, payload: bytes) -> None:
+        req = self._active_recvs.get(hdr.msgid)
+        if req is None:
+            return
+        conv = req.convertor
+        conv.set_position(int(hdr.offset))
+        conv.unpack_frag(payload)
+        # Completion when every byte landed (frags may arrive in any order
+        # across transports; count via the convertor's high-water mark).
+        if conv.position >= hdr.nbytes and self._recv_done(req, hdr):
+            del self._active_recvs[hdr.msgid]
+            req._set_complete(0)
+
+    def _recv_done(self, req: RecvRequest, hdr: Header) -> bool:
+        # In-order transports (tcp per-connection, self, shm fifo) deliver
+        # sequentially, so position==nbytes ⇔ done.
+        return req.convertor.position >= hdr.nbytes

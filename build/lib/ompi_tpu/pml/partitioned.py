@@ -1,0 +1,148 @@
+"""MPI-4 partitioned point-to-point communication.
+
+Reference: ompi/mca/part/persist (2,262 LoC — Psend_init/Precv_init built
+on persistent pt2pt, part.h:163,227). A partitioned send exposes
+sub-message parallelism: the sender marks partitions ready (Pready) in any
+order, each flying as its own tagged transfer; the receiver completes when
+every partition has landed and can poll per-partition arrival (Parrived).
+
+This is the host-side analog of what the mesh path gets from segmented
+ppermute schedules (SURVEY.md §5 maps partitioned comm to exactly that).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from ompi_tpu.comm.communicator import PROC_NULL
+from ompi_tpu.core.datatype import Datatype
+from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_PENDING
+from ompi_tpu.core.request import Request
+
+# Partition traffic rides its own CID plane (like the collective plane's
+# COLL_CID_BIT in coll/basic.py) so it can use non-negative composite tags
+# that (a) never collide with user traffic on the base cid, (b) never cross
+# into the system-tag band (tags <= Ob1Pml.SYSTEM_TAG_BASE bypass matching
+# entirely — the round-1 deadlock), and (c) are invisible to ANY_TAG
+# wildcard receives by cid mismatch alone.
+PART_CID_BIT = 1 << 29
+_MAX_PARTITIONS = 1 << 20
+
+
+def _part_tag(user_tag: int, partition: int) -> int:
+    if user_tag < 0 or user_tag >= (1 << 20):
+        raise MPIError(ERR_ARG,
+                       f"partitioned tag {user_tag} outside [0, 2^20)")
+    tag = user_tag * _MAX_PARTITIONS + partition
+    assert tag >= 0, "partition tag escaped the non-negative plane"
+    return tag
+
+
+class PartitionedRequest(Request):
+    def __init__(self, comm, buf, partitions: int, count: int,
+                 datatype: Datatype, peer: int, tag: int, send: bool):
+        super().__init__()
+        if partitions <= 0:
+            raise MPIError(ERR_ARG, "partitions must be positive")
+        self.comm = comm
+        self.buf = np.asarray(buf).reshape(-1)
+        self.partitions = partitions
+        self.count = count  # elements per partition
+        self.datatype = datatype
+        self.peer = peer
+        self.tag = tag
+        if partitions > _MAX_PARTITIONS:
+            raise MPIError(ERR_ARG,
+                           f"partitions {partitions} > {_MAX_PARTITIONS}")
+        _part_tag(tag, partitions - 1)  # validate the band eagerly: a
+        # lazy raise inside Start() would leave an activated request
+        # permanently incomplete (Wait would hang)
+        self.is_send = send
+        self.persistent = True
+        self._complete.set()  # inactive
+        self._inner: List[Optional[Request]] = [None] * partitions
+        self._lock = threading.Lock()
+
+    def _partition_view(self, i: int) -> np.ndarray:
+        start = i * self.count
+        return self.buf[start: start + self.count]
+
+    # ----------------------------------------------------------- lifecycle
+    def Start(self) -> "PartitionedRequest":
+        self.comm._check_usable()  # raw-pml path below skips the Comm
+        # wrapper's revoked-comm guard; enforce it here
+        if self.peer == PROC_NULL:
+            self._set_complete(0)
+            return self
+        self._complete.clear()
+        with self._lock:
+            self._inner = [None] * self.partitions
+        if not self.is_send:
+            # post all partition receives up front (reference: persist
+            # posts the persistent recv at Start)
+            for i in range(self.partitions):
+                req = self.comm.pml.irecv(
+                    self._partition_view(i), self.count, self.datatype,
+                    self.comm._world_rank(self.peer),
+                    _part_tag(self.tag, i),
+                    self.comm.cid | PART_CID_BIT)
+                with self._lock:
+                    self._inner[i] = req
+                req.add_completion_callback(lambda r: self._maybe_done())
+        return self
+
+    def Pready(self, partition: int) -> None:
+        """Sender marks a partition ready; it ships immediately."""
+        if not self.is_send:
+            raise MPIError(ERR_ARG, "Pready on a receive request")
+        if not 0 <= partition < self.partitions:
+            raise MPIError(ERR_ARG, f"partition {partition}")
+        self.comm._check_usable()
+        if self.peer == PROC_NULL:
+            return
+        req = self.comm.pml.isend(
+            self._partition_view(partition), self.count, self.datatype,
+            self.comm._world_rank(self.peer),
+            _part_tag(self.tag, partition),
+            self.comm.cid | PART_CID_BIT)
+        with self._lock:
+            self._inner[partition] = req
+        req.add_completion_callback(lambda r: self._maybe_done())
+
+    def Pready_range(self, lo: int, hi: int) -> None:
+        for i in range(lo, hi + 1):
+            self.Pready(i)
+
+    def Parrived(self, partition: int) -> bool:
+        """Receiver polls one partition (reference: part.h Parrived)."""
+        if self.peer == PROC_NULL:
+            return self.is_complete
+        from ompi_tpu.runtime.progress import progress
+
+        progress()
+        with self._lock:
+            req = self._inner[partition]
+        return req is not None and req.is_complete
+
+    def _maybe_done(self) -> None:
+        with self._lock:
+            done = all(r is not None and r.is_complete for r in self._inner)
+        if done:
+            self.status._nbytes = (self.partitions * self.count *
+                                   self.datatype.size)
+            self._set_complete(0)
+
+
+def Psend_init(comm, buf, partitions: int, count: int, datatype: Datatype,
+               dest: int, tag: int = 0) -> PartitionedRequest:
+    return PartitionedRequest(comm, buf, partitions, count, datatype,
+                              dest, tag, send=True)
+
+
+def Precv_init(comm, buf, partitions: int, count: int, datatype: Datatype,
+               source: int, tag: int = 0) -> PartitionedRequest:
+    return PartitionedRequest(comm, buf, partitions, count, datatype,
+                              source, tag, send=False)

@@ -1,0 +1,118 @@
+"""Dynamic process management: MPI_Comm_spawn + MPI_Comm_get_parent.
+
+Reference: ompi/dpm/dpm.c (2,313 LoC) — spawn asks the runtime (PMIx) to
+launch a new job, then bridges parent and child worlds with an
+intercomm. Redesign: the launcher-hosted modex server allocates a new
+job (universe-rank block + its own fence domain); the spawn root execs
+the children itself with the job's coordinates in the environment;
+endpoints across jobs wire lazily from modex cards (tcp). The
+parent-child intercomm handshake runs leader-to-leader over the DPM
+plane exactly like Intercomm_create.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_SPAWN
+
+_parent_intercomm = None
+
+
+def Comm_get_parent():
+    """The intercomm to the spawning job, or None (MPI_COMM_NULL) if this
+    process was not spawned (reference: dpm.c ompi_dpm_dyn_init)."""
+    return _parent_intercomm
+
+
+def connect_parent_if_spawned(world) -> None:
+    """Called at the end of process-mode init: if this job was spawned,
+    run the child side of the parent-child intercomm handshake (the
+    reference does this inside MPI_Init via ompi_dpm_dyn_init)."""
+    global _parent_intercomm
+    parent_root = os.environ.get("OMPI_TPU_PARENT")
+    if parent_root is None:
+        return
+    from ompi_tpu.comm.intercomm import intercomm_create
+
+    tag = int(os.environ.get("OMPI_TPU_SPAWN_TAG", "0"))
+    _parent_intercomm = intercomm_create(
+        world, 0, int(parent_root), tag=tag)
+    _parent_intercomm.name = "parent-intercomm"
+
+
+def spawn(comm, command: str, args: Sequence[str] = (), maxprocs: int = 1,
+          root: int = 0, info: Optional[dict] = None):
+    """MPI_Comm_spawn: collective over `comm`; returns the intercomm to
+    the child job. ``command`` may be a python script (launched with the
+    current interpreter) or any executable."""
+    from ompi_tpu.comm.intercomm import intercomm_create
+    from ompi_tpu.runtime import wireup
+
+    ctx = wireup._ctx
+    if ctx is None:
+        raise MPIError(ERR_SPAWN, "spawn requires process mode (mpirun)")
+    modex = ctx["modex"]
+
+    # The root launches; every rank learns the outcome from the Bcast —
+    # a launch failure must reach ALL ranks or the others deadlock in
+    # the intercomm handshake (reference: dpm.c propagates the PMIx
+    # spawn status collectively).
+    job = base = -1
+    err = ""
+    if comm.rank == root:
+        try:
+            job, base = modex.spawn(maxprocs)
+            _launch_children(command, list(args), maxprocs, job, base,
+                             parent_root=comm.pml.my_rank,
+                             spawn_tag=job, info=info or {}, ctx=ctx)
+        except Exception as e:
+            job, base = -1, -1
+            err = str(e)
+    meta = np.array([job, base], np.int64)
+    comm.Bcast(meta, root=root)
+    job, base = int(meta[0]), int(meta[1])
+    if job < 0:
+        raise MPIError(ERR_SPAWN,
+                       f"spawn failed at root: {err or 'see root rank'}")
+
+    # parent side of the handshake: leader = the spawn root; child side
+    # runs in connect_parent_if_spawned with the same tag (= job id)
+    inter = intercomm_create(comm, root, base, tag=job)
+    inter.name = f"spawn-intercomm-{job}"
+    return inter
+
+
+def _launch_children(command: str, args: List[str], n: int, job: int,
+                     base: int, parent_root: int, spawn_tag: int,
+                     info: dict, ctx) -> None:
+    argv_base: List[str]
+    if command.endswith(".py"):
+        argv_base = [sys.executable, command]
+    else:
+        argv_base = [command]
+    for i in range(n):
+        env = dict(os.environ)
+        env.update({
+            "OMPI_TPU_RANK": str(i),
+            "OMPI_TPU_SIZE": str(n),
+            "OMPI_TPU_MODEX": os.environ["OMPI_TPU_MODEX"],
+            "OMPI_TPU_JOB": str(job),
+            "OMPI_TPU_BASE": str(base),
+            "OMPI_TPU_PARENT": str(parent_root),
+            "OMPI_TPU_SPAWN_TAG": str(spawn_tag),
+        })
+        # info {'env_FOO': 'bar'} sets FOO=bar in the child environment
+        # (reference: the MPI_Info "env" key of MPI_Comm_spawn)
+        env.update({str(k)[4:]: str(v) for k, v in info.items()
+                    if str(k).startswith("env_")})
+        try:
+            p = subprocess.Popen(argv_base + args, env=env)
+        except OSError as e:
+            raise MPIError(ERR_SPAWN, f"cannot exec {command}: {e}")
+        ctx["spawned"].append(p)

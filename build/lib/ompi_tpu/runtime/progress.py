@@ -1,0 +1,109 @@
+"""Central progress engine.
+
+Reference: opal/runtime/opal_progress.c:216-230 — a registered-callback
+array polled in a loop; low-priority callbacks (libevent) only every 8th
+call. Same contract: transports register a ``fn() -> int`` (number of events
+they handled); ``progress()`` polls them all. Blocking request waits drive
+this loop (ompi_tpu.core.request binds to it at import).
+
+Process mode can additionally run a dedicated progress *thread* (MCA var
+``runtime_progress_thread``) so blocked Python code still progresses — the
+analog of the reference's async-progress option, and the right default here
+because transports are socket-based (the GIL is released in select()).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List
+
+from ompi_tpu.core import request as _request
+from ompi_tpu.mca.var import register_var, get_var
+
+_callbacks: List[Callable[[], int]] = []
+_low_priority: List[Callable[[], int]] = []
+_lock = threading.Lock()
+_call_count = 0
+
+register_var(
+    "runtime", "progress_thread", True,
+    help="Run a dedicated progress thread in process mode", level=4,
+)
+
+
+def register_progress(fn: Callable[[], int], low_priority: bool = False) -> None:
+    """Reference: opal_progress_register (opal_progress.c:416)."""
+    with _lock:
+        (_low_priority if low_priority else _callbacks).append(fn)
+
+
+def unregister_progress(fn: Callable[[], int]) -> None:
+    with _lock:
+        for lst in (_callbacks, _low_priority):
+            if fn in lst:
+                lst.remove(fn)
+
+
+def progress() -> int:
+    """Poll all registered callbacks once; low-priority every 8th call
+    (the reference's event-library yield cadence)."""
+    global _call_count
+    _call_count += 1
+    n = 0
+    for fn in list(_callbacks):
+        n += fn()
+    if _call_count % 8 == 0:
+        for fn in list(_low_priority):
+            n += fn()
+    return n
+
+
+_request._bind_progress(progress)
+
+
+class ProgressThread:
+    """Optional dedicated progress thread."""
+
+    def __init__(self, interval: float = 0.0002):
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="ompi-tpu-progress", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        import time
+
+        idle = 0
+        while not self._stop.is_set():
+            try:
+                made = progress()
+            except Exception:
+                # a transport bug must not silently kill async progress
+                from ompi_tpu.utils.output import get_logger
+
+                get_logger("runtime.progress").exception(
+                    "progress callback raised")
+                made = 0
+            if made > 0:
+                idle = 0
+            elif idle < 1000:
+                # stay hot but yield the GIL between polls, so incoming
+                # traffic sees microsecond wake latency while app threads
+                # still run (reference: async progress threads busy-poll)
+                idle += 1
+                time.sleep(0)
+            else:
+                self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None

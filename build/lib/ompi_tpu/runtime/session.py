@@ -1,0 +1,78 @@
+"""MPI-4 Sessions.
+
+Reference: ompi/instance (1,671 LoC — ompi_mpi_instance_init owns the real
+bring-up; MPI_Session_init is a thin veneer). Sessions expose named process
+sets ("mpi://WORLD", "mpi://SELF") from which groups and communicators are
+built without MPI_Init's global state.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_SESSION
+from ompi_tpu.core.group import Group
+from ompi_tpu.core.info import Info
+
+
+class Session:
+    def __init__(self, info: Optional[Info] = None):
+        # sessions share the instance the same way the reference's
+        # instances refcount one ompi_mpi_instance (instance.c)
+        from ompi_tpu.runtime import state
+
+        state.Init()
+        self.info = info or Info()
+        self._world = state.get_world()
+        self._finalized = False
+
+    @staticmethod
+    def Init(info: Optional[Info] = None) -> "Session":
+        return Session(info)
+
+    def Finalize(self) -> None:
+        self._finalized = True
+
+    def _check(self) -> None:
+        if self._finalized:
+            raise MPIError(ERR_SESSION, "session finalized")
+
+    # ------------------------------------------------------- process sets
+    def Get_num_psets(self) -> int:
+        self._check()
+        return 2
+
+    def Get_nth_pset(self, n: int) -> str:
+        self._check()
+        psets = ["mpi://WORLD", "mpi://SELF"]
+        if not 0 <= n < len(psets):
+            raise MPIError(ERR_ARG, f"pset index {n}")
+        return psets[n]
+
+    def Get_pset_info(self, name: str) -> Info:
+        self._check()
+        g = self.Group_from_pset(name)
+        return Info({"size": str(g.size), "mpi_size": str(g.size)})
+
+    def Group_from_pset(self, name: str) -> Group:
+        self._check()
+        if name == "mpi://WORLD":
+            return self._world.Get_group()
+        if name == "mpi://SELF":
+            return Group([self._world.pml.my_rank])
+        raise MPIError(ERR_ARG, f"unknown pset {name!r}")
+
+    def Comm_create_from_group(self, group: Group, tag: str = "",
+                               info: Optional[Info] = None):
+        self._check()
+        from ompi_tpu.comm.communicator import ProcComm
+
+        # derive a deterministic CID from the stringtag so disjoint groups
+        # creating comms concurrently don't collide (reference:
+        # comm_create_from_group's stringtag-based agreement); crc32 is
+        # stable across processes (hash() is salted per interpreter)
+        import zlib
+
+        base = zlib.crc32(tag.encode()) % 100000 + 50000
+        return ProcComm(group, base, self._world.pml,
+                        name=f"session-comm-{tag or base}")

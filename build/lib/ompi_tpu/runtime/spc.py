@@ -1,0 +1,121 @@
+"""SPC — software performance counters.
+
+Reference: ompi/runtime/ompi_spc.c — a ~120-entry counter enum recorded
+inline in every binding (SPC_RECORD in allreduce.c.in:44, init at
+ompi_spc.c:275) and exported as MPI_T pvars (ompi_spc.c:318).
+
+Redesign: counters are named dynamically (no fixed enum — Python dict
+increments cost what an enum-indexed array would here), recorded at the
+communicator verb layer and the pml/osc byte paths, and exported as
+pvars through the MCA var system (mca/var.py register_pvar). The
+``spc_enable`` MCA var gates recording; attach/detach granularity
+(the reference's mpi_spc_attach list) collapses to on/off since
+per-counter gating saves nothing in Python.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict
+
+import contextlib
+
+from ompi_tpu.mca.var import register_var
+
+# Reading the Var handle's .value each record keeps set_var('spc',
+# 'enable', ...) live at runtime (a cached bool went stale — r2 review)
+# at the cost of one attribute load.
+_enable_var = register_var("spc", "enable", True,
+                           help="Record software performance counters "
+                                "(reference: mpi_spc_attach)", level=4)
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = defaultdict(int)
+_suppress = threading.local()
+
+
+@contextlib.contextmanager
+def suppressed():
+    """Suppress recording for library-internal traffic (CID agreement,
+    window setup barriers …) so counters report USER activity only —
+    the reference gets this for free by recording in the MPI bindings
+    rather than the internal entry points."""
+    depth = getattr(_suppress, "depth", 0)
+    _suppress.depth = depth + 1
+    try:
+        yield
+    finally:
+        _suppress.depth = depth
+
+
+def _enabled() -> bool:
+    return _enable_var.value and not getattr(_suppress, "depth", 0)
+
+
+def record(name: str, value: int = 1) -> None:
+    """SPC_RECORD analog (reference: the inline macro in every binding)."""
+    if not _enabled():
+        return
+    with _lock:
+        _counters[name] += value
+
+
+def record_bytes(name: str, nbytes: int) -> None:
+    if not _enabled():
+        return
+    with _lock:
+        _counters[name + "_count"] += 1
+        _counters[name + "_bytes"] += int(nbytes)
+
+
+class timer:
+    """Context manager accumulating wall time in microseconds
+    (reference: the SPC_TIMER watermark counters)."""
+
+    __slots__ = ("name", "_t0")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns() if _enabled() else 0
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0:
+            us = (time.perf_counter_ns() - self._t0) // 1000
+            with _lock:
+                _counters[self.name + "_time_us"] += us
+        return False
+
+
+def snapshot() -> Dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def get(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()
+
+
+def dump(file=None) -> None:
+    """Human-readable counter dump (reference: the SPC finalize report
+    under mpi_spc_dump_enabled)."""
+    import sys
+
+    out = file or sys.stderr
+    snap = snapshot()
+    if not snap:
+        print("spc: no counters recorded", file=out)
+        return
+    width = max(len(k) for k in snap)
+    for k in sorted(snap):
+        print(f"spc: {k:<{width}} {snap[k]}", file=out)

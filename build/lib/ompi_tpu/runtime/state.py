@@ -1,0 +1,172 @@
+"""Init / finalize state machine and the world communicators.
+
+Reference: ompi/runtime/ompi_mpi_init.c:340 — an atomic state machine
+(NOT_INITIALIZED → INIT_STARTED → INIT_COMPLETED → FINALIZE...) around the
+instance bring-up (ompi/instance/instance.c:362 init_common: RTE init,
+framework opens, PML select, modex fence, add_procs).
+
+Two launch shapes:
+- **process mode**: ``ompi_tpu.tools.mpirun`` sets OMPI_TPU_RANK/SIZE and
+  the modex address; init connects to the modex (PMIx_Init analog,
+  ompi_rte.c:581), selects transports, exchanges business cards, wires
+  endpoints (add_procs, instance.c:730).
+- **singleton**: no launcher env — a 1-rank world over btl/self
+  (reference: the is_singleton path, ompi_mpi_init.c:451).
+
+``COMM_WORLD`` / ``COMM_SELF`` are lazy proxies that auto-initialize on
+first use (the convenience the reference gets from mpi4py-style bindings).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Optional
+
+from ompi_tpu.comm.communicator import ProcComm
+from ompi_tpu.core.errors import MPIError, ERR_OTHER
+from ompi_tpu.core.group import Group
+from ompi_tpu.utils.output import get_logger
+from ompi_tpu.utils.show_help import show_help
+
+# Thread support levels (reference: mpi.h.in)
+THREAD_SINGLE = 0
+THREAD_FUNNELED = 1
+THREAD_SERIALIZED = 2
+THREAD_MULTIPLE = 3
+
+_NOT_INITIALIZED = 0
+_INITIALIZED = 1
+_FINALIZED = 2
+
+_lock = threading.Lock()
+_state = _NOT_INITIALIZED
+_world: Optional[ProcComm] = None
+_self_comm: Optional[ProcComm] = None
+_thread_level = THREAD_MULTIPLE
+_log = get_logger("runtime")
+
+# import side effect: register built-in components
+import ompi_tpu.btl.self_btl  # noqa: F401,E402
+import ompi_tpu.btl.sm  # noqa: F401,E402
+import ompi_tpu.btl.tcp  # noqa: F401,E402
+import ompi_tpu.coll.self_coll  # noqa: F401,E402
+import ompi_tpu.coll.basic  # noqa: F401,E402
+import ompi_tpu.coll.tuned  # noqa: F401,E402
+import ompi_tpu.coll.nbc  # noqa: F401,E402
+import ompi_tpu.coll.neighbor  # noqa: F401,E402
+import ompi_tpu.coll.han  # noqa: F401,E402
+import ompi_tpu.hook.comm_method  # noqa: F401,E402
+
+
+def Init(required: int = THREAD_MULTIPLE) -> int:
+    """MPI_Init / MPI_Init_thread. Returns the provided thread level."""
+    global _state, _world, _self_comm, _thread_level
+    with _lock:
+        if _state == _FINALIZED:
+            show_help("runtime", "already-finalized")
+            raise MPIError(ERR_OTHER, "init after finalize")
+        if _state == _INITIALIZED:
+            return _thread_level
+        # hook interposition point (reference: ompi_hook_base_mpi_init_top,
+        # ompi_mpi_init.c:354)
+        from ompi_tpu.hook import run_hooks
+
+        run_hooks("init_top")
+        if os.environ.get("OMPI_TPU_RANK") is not None:
+            from ompi_tpu.runtime.wireup import init_process_mode
+
+            _world = init_process_mode()
+        else:
+            _world = _init_singleton()
+        me = _world.pml.my_rank
+        _self_comm = ProcComm(Group([me]), cid=1, pml=_world.pml,
+                              name="MPI_COMM_SELF")
+        _thread_level = THREAD_MULTIPLE if required is None else required
+        _state = _INITIALIZED
+        run_hooks("init_bottom")
+        return _thread_level
+
+
+def _init_singleton() -> ProcComm:
+    from ompi_tpu.btl.base import btl_framework
+    from ompi_tpu.pml.ob1 import Ob1Pml
+
+    pml = Ob1Pml(my_rank=0)
+    _, self_btl = btl_framework.select_one(deliver=pml.handle_incoming)
+    pml.add_endpoint(0, self_btl)
+    return ProcComm(Group([0]), cid=0, pml=pml, name="MPI_COMM_WORLD")
+
+
+def Finalize() -> None:
+    global _state, _world, _self_comm
+    with _lock:
+        if _state != _INITIALIZED:
+            return
+        from ompi_tpu.hook import run_hooks
+
+        run_hooks("finalize_top")
+        if _world is not None:
+            try:
+                from ompi_tpu.runtime import spc
+
+                with spc.suppressed():
+                    _world.Barrier()
+            except Exception:
+                pass
+            from ompi_tpu.runtime import wireup
+
+            wireup.shutdown()
+        _world = None
+        _self_comm = None
+        _state = _FINALIZED
+        run_hooks("finalize_bottom")
+
+
+def Is_initialized() -> bool:
+    return _state == _INITIALIZED
+
+
+def Is_finalized() -> bool:
+    return _state == _FINALIZED
+
+
+def get_world() -> ProcComm:
+    if _state != _INITIALIZED:
+        Init()
+    assert _world is not None
+    return _world
+
+
+def get_self_comm() -> ProcComm:
+    if _state != _INITIALIZED:
+        Init()
+    assert _self_comm is not None
+    return _self_comm
+
+
+# lowercase aliases
+init = Init
+finalize = Finalize
+
+
+class _CommProxy:
+    """Lazy forwarding proxy so ``ompi_tpu.COMM_WORLD`` exists at import
+    time but only initializes the runtime on first use."""
+
+    def __init__(self, getter, label: str):
+        object.__setattr__(self, "_getter", getter)
+        object.__setattr__(self, "_label", label)
+
+    def __getattr__(self, item):
+        return getattr(self._getter(), item)
+
+    def __repr__(self):
+        return f"<proxy {self._label}>"
+
+
+COMM_WORLD = _CommProxy(get_world, "MPI_COMM_WORLD")
+COMM_SELF = _CommProxy(get_self_comm, "MPI_COMM_SELF")
+
+atexit.register(Finalize)

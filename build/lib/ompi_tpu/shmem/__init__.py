@@ -1,0 +1,256 @@
+"""OpenSHMEM-style PGAS layer.
+
+Reference: oshmem/ (52,531 LoC) — a PGAS API initialized ON TOP of MPI
+(oshmem_shmem_init.c:141 calls ompi_mpi_init), with frameworks: spml
+(one-sided put/get engine), memheap (symmetric heap allocator), scoll
+(collectives delegating to MPI coll — scoll/mpi), atomic.
+
+Redesign: the symmetric heap is one RMA window over COMM_WORLD
+(spml == the osc active-message engine); symmetry holds by construction
+— every PE performs the same allocation sequence, so offsets agree
+(the reference's memheap contract). Collectives delegate to the MPI
+layer exactly like scoll/mpi. The TPU note: PGAS on the mesh path is
+the MeshWin driver-array model; this module is the host/process-mode
+surface.
+
+Usage::
+
+    from ompi_tpu import shmem
+    shmem.init()
+    a = shmem.zeros(8, np.float64)        # symmetric across PEs
+    shmem.barrier_all()
+    shmem.put(a, np.arange(8.), pe=1)     # write into PE 1's copy
+    shmem.quiet()
+    v = shmem.atomic_fetch_add(a, 5.0, pe=0)
+    shmem.finalize()
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ompi_tpu.core import op as _op
+from ompi_tpu.core.errors import MPIError, ERR_OTHER
+from ompi_tpu.mca.var import register_var, get_var
+
+register_var("shmem", "heap_bytes", 1 << 24,
+             help="Symmetric heap size per PE (reference: memheap's "
+                  "SHMEM_SYMMETRIC_HEAP_SIZE)", level=3)
+
+_lock = threading.Lock()
+_ctx: Optional[dict] = None
+
+_ALIGN = 16
+
+
+class SymArray:
+    """A symmetric allocation: same offset in every PE's heap
+    (reference: memheap block). ``local`` is THIS PE's data."""
+
+    __slots__ = ("off", "count", "dtype", "local")
+
+    def __init__(self, off: int, count: int, dtype, local: np.ndarray):
+        self.off = off
+        self.count = count
+        self.dtype = np.dtype(dtype)
+        self.local = local
+
+    def _disp(self, index: int = 0) -> int:
+        # element-unit displacement for Win verbs
+        byte = self.off + index * self.dtype.itemsize
+        assert byte % self.dtype.itemsize == 0
+        return byte // self.dtype.itemsize
+
+
+def init() -> None:
+    """shmem_init (reference: oshmem_shmem_init -> ompi_mpi_init)."""
+    global _ctx
+    with _lock:
+        if _ctx is not None:
+            return
+        import ompi_tpu
+        from ompi_tpu.osc.window import Win
+
+        ompi_tpu.Init()
+        comm = ompi_tpu.runtime.state.get_world()
+        heap = np.zeros(int(get_var("shmem", "heap_bytes")), np.uint8)
+        _ctx = {
+            "comm": comm,
+            "heap": heap,
+            "win": Win.Create(heap, comm),
+            "brk": 0,
+        }
+
+
+def finalize() -> None:
+    global _ctx
+    with _lock:
+        if _ctx is None:
+            return
+        _ctx["win"].Free()
+        _ctx = None
+
+
+def _need() -> dict:
+    if _ctx is None:
+        init()
+    return _ctx
+
+
+def my_pe() -> int:
+    return _need()["comm"].Get_rank()
+
+
+def n_pes() -> int:
+    return _need()["comm"].Get_size()
+
+
+# ----------------------------------------------------------- memheap
+def zeros(count: int, dtype=np.float64) -> SymArray:
+    """Symmetric allocation (shmem_malloc + zero). SYMMETRY CONTRACT:
+    every PE must perform the same allocation sequence (the reference's
+    memheap makes the same assumption — remote addresses are computed,
+    not exchanged)."""
+    ctx = _need()
+    dt = np.dtype(dtype)
+    nbytes = count * dt.itemsize
+    off = (ctx["brk"] + _ALIGN - 1) & ~(_ALIGN - 1)
+    if off + nbytes > ctx["heap"].nbytes:
+        raise MPIError(ERR_OTHER,
+                       f"symmetric heap exhausted ({ctx['heap'].nbytes}B; "
+                       "raise shmem_heap_bytes)")
+    ctx["brk"] = off + nbytes
+    local = ctx["heap"][off : off + nbytes].view(dt)
+    local[:] = 0
+    return SymArray(off, count, dt, local)
+
+
+def free(arr: SymArray) -> None:
+    """shmem_free — the bump allocator only reclaims a trailing block
+    (the reference's memheap buddy/ptmalloc do better; symmetric frees
+    are rare in practice)."""
+    ctx = _need()
+    if arr.off + arr.count * arr.dtype.itemsize == ctx["brk"]:
+        ctx["brk"] = arr.off
+
+
+# ------------------------------------------------------------- put/get
+def put(arr: SymArray, src, pe: int, offset: int = 0) -> None:
+    """shmem_put: write ``src`` into PE ``pe``'s copy of ``arr``
+    (nonblocking-ish: local completion immediate, remote at quiet())."""
+    ctx = _need()
+    src = np.ascontiguousarray(np.asarray(src, dtype=arr.dtype))
+    ctx["win"].Put(src, pe, target_disp=arr._disp(offset))
+
+
+def get(arr: SymArray, count: int, pe: int, offset: int = 0) -> np.ndarray:
+    """shmem_get: fetch ``count`` elements of PE ``pe``'s copy."""
+    ctx = _need()
+    out = np.zeros(count, arr.dtype)
+    ctx["win"].Get(out, pe, target_disp=arr._disp(offset))
+    return out
+
+
+def p(arr: SymArray, value, pe: int, offset: int = 0) -> None:
+    """shmem_p (single element)."""
+    put(arr, np.asarray([value], arr.dtype), pe, offset)
+
+
+def g(arr: SymArray, pe: int, offset: int = 0):
+    """shmem_g (single element)."""
+    return get(arr, 1, pe, offset)[0]
+
+
+# ------------------------------------------------------------- atomics
+def atomic_add(arr: SymArray, value, pe: int, offset: int = 0) -> None:
+    ctx = _need()
+    ctx["win"].Accumulate(np.asarray([value], arr.dtype), pe,
+                          target_disp=arr._disp(offset), op=_op.SUM)
+
+
+def atomic_fetch_add(arr: SymArray, value, pe: int, offset: int = 0):
+    ctx = _need()
+    out = np.zeros(1, arr.dtype)
+    ctx["win"].Fetch_and_op(np.asarray([value], arr.dtype), out, pe,
+                            target_disp=arr._disp(offset), op=_op.SUM)
+    return out[0]
+
+
+def atomic_compare_swap(arr: SymArray, cond, value, pe: int,
+                        offset: int = 0):
+    ctx = _need()
+    out = np.zeros(1, arr.dtype)
+    ctx["win"].Compare_and_swap(np.asarray([cond], arr.dtype),
+                                np.asarray([value], arr.dtype), out, pe,
+                                target_disp=arr._disp(offset))
+    return out[0]
+
+
+def atomic_fetch(arr: SymArray, pe: int, offset: int = 0):
+    return g(arr, pe, offset)
+
+
+# ------------------------------------------------------ ordering/sync
+def fence() -> None:
+    """shmem_fence: order puts per-PE — our transports deliver per-peer
+    in order, and quiet() is stronger; provided for API parity."""
+    quiet()
+
+
+def quiet() -> None:
+    """shmem_quiet: remote completion of all outstanding puts/atomics."""
+    _need()["win"].Flush()
+
+
+def barrier_all() -> None:
+    """shmem_barrier_all: quiet + barrier (reference: shmem_barrier_all
+    implies completion of all remote writes)."""
+    ctx = _need()
+    ctx["win"].Flush()
+    from ompi_tpu.runtime import spc
+
+    with spc.suppressed():
+        ctx["comm"].Barrier()
+
+
+# --------------------------------------------------- collectives (scoll)
+def broadcast(arr: SymArray, root: int = 0) -> None:
+    """shmem_broadcast over the symmetric block (scoll/mpi pattern:
+    delegate to the MPI collective)."""
+    ctx = _need()
+    ctx["comm"].Bcast([arr.local, arr.count,
+                       _dt_of(arr.dtype)], root=root)
+
+
+def sum_to_all(target: SymArray, source: SymArray) -> None:
+    ctx = _need()
+    ctx["comm"].Allreduce(
+        [source.local, source.count, _dt_of(source.dtype)],
+        [target.local, target.count, _dt_of(target.dtype)], op=_op.SUM)
+
+
+def max_to_all(target: SymArray, source: SymArray) -> None:
+    ctx = _need()
+    ctx["comm"].Allreduce(
+        [source.local, source.count, _dt_of(source.dtype)],
+        [target.local, target.count, _dt_of(target.dtype)], op=_op.MAX)
+
+
+def collect(arr: SymArray) -> np.ndarray:
+    """shmem_collect (fixed size): every PE's block, concatenated."""
+    ctx = _need()
+    n = ctx["comm"].Get_size()
+    out = np.zeros(arr.count * n, arr.dtype)
+    ctx["comm"].Allgather(
+        [arr.local, arr.count, _dt_of(arr.dtype)],
+        [out, arr.count * n, _dt_of(arr.dtype)])
+    return out
+
+
+def _dt_of(np_dtype):
+    from ompi_tpu.core.datatype import from_numpy_dtype
+
+    return from_numpy_dtype(np_dtype)

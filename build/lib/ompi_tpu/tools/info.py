@@ -1,0 +1,116 @@
+"""ompi_tpu_info — introspection CLI.
+
+Reference: ompi/tools/ompi_info — dumps every framework, component, and
+MCA parameter so users can see exactly what the library will select and
+which knobs exist. Usage:
+
+    python -m ompi_tpu.tools.info                 # everything, level <= 6
+    python -m ompi_tpu.tools.info --level 9       # developer params too
+    python -m ompi_tpu.tools.info --param btl     # one framework's vars
+    python -m ompi_tpu.tools.info --pvars         # performance variables
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _load_everything() -> None:
+    """Import every component module so registries are populated (the
+    CLI analog of the reference's component-repository scan —
+    mca_base_component_repository.c:365)."""
+    import ompi_tpu.runtime.state  # btl/coll component side effects
+    import ompi_tpu.accelerator  # accelerator framework
+    import ompi_tpu.coll.xla  # mesh collectives
+    import ompi_tpu.coll.neighbor  # topology collectives
+    import ompi_tpu.runtime.spc  # spc vars
+    import ompi_tpu.pml.ob1  # pml vars
+
+
+def print_header(out) -> None:
+    from ompi_tpu.version import __version__
+
+    print(f"ompi_tpu: {__version__}", file=out)
+    print(f"python:   {sys.version.split()[0]}", file=out)
+    try:
+        import jax
+
+        print(f"jax:      {jax.__version__}", file=out)
+    except Exception:
+        print("jax:      unavailable", file=out)
+
+
+def print_components(out) -> None:
+    from ompi_tpu.mca.component import all_frameworks
+
+    print("\nframeworks / components "
+          "(reference: ompi_info component list):", file=out)
+    for fname, fw in sorted(all_frameworks().items()):
+        comps = sorted(fw.components.values(),
+                       key=lambda c: -c.PRIORITY)
+        names = ", ".join(f"{c.NAME} (priority {c.PRIORITY})"
+                          for c in comps) or "-"
+        print(f"  {fname:<14} {fw.description}", file=out)
+        print(f"  {'':<14} components: {names}", file=out)
+
+
+def print_vars(out, level: int, framework: str = "") -> None:
+    from ompi_tpu.mca.var import all_vars
+
+    print(f"\nmca parameters (level <= {level}"
+          + (f", framework '{framework}'" if framework else "") + "):",
+          file=out)
+    for key, var in sorted(all_vars().items()):
+        if var.level > level:
+            continue
+        if framework and var.framework != framework:
+            continue
+        src = var.source.name.lower()
+        print(f"  {var.full_name:<36} = {var.value!r:<14} "
+              f"[{var.typ.__name__}, level {var.level}, source {src}]",
+              file=out)
+        if var.help:
+            print(f"  {'':<36}   {var.help}", file=out)
+
+
+def print_pvars(out) -> None:
+    from ompi_tpu.mca.var import all_pvars
+
+    print("\nperformance variables (reference: MPI_T pvars / "
+          "mca_base_pvar.c):", file=out)
+    pvars = all_pvars()
+    if not pvars:
+        print("  (none recorded yet)", file=out)
+    for key, pv in sorted(pvars.items()):
+        print(f"  {pv.full_name:<36} = {pv.value!r}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ompi_tpu_info",
+        description="Dump frameworks, components, and MCA parameters")
+    ap.add_argument("--level", type=int, default=6,
+                    help="max parameter level to show (1-9, default 6)")
+    ap.add_argument("--param", default="",
+                    help="restrict parameters to one framework")
+    ap.add_argument("--pvars", action="store_true",
+                    help="show performance variables")
+    ap.add_argument("--all", action="store_true",
+                    help="everything incl. level-9 params and pvars")
+    opts = ap.parse_args(argv)
+    if opts.all:
+        opts.level, opts.pvars = 9, True
+
+    _load_everything()
+    out = sys.stdout
+    print_header(out)
+    print_components(out)
+    print_vars(out, opts.level, opts.param)
+    if opts.pvars:
+        print_pvars(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,94 @@
+"""mpirun — process-mode launcher.
+
+Reference: ompi/tools/mpirun/main.c (a thin wrapper handing off to PRRTE's
+prterun) + the prted PMIx server it relies on. Here the launcher hosts the
+modex server itself (no external runtime dependency) and spawns one Python
+process per rank with the launch-contract env:
+
+    OMPI_TPU_RANK, OMPI_TPU_SIZE, OMPI_TPU_MODEX
+
+Usage:
+    python -m ompi_tpu.tools.mpirun -np 4 [--mca k v]... script.py [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+from typing import List
+
+from ompi_tpu.runtime.modex import ModexServer
+
+
+def main(argv: List[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="mpirun (ompi_tpu)")
+    parser.add_argument("-np", "-n", type=int, required=True, dest="np",
+                        help="number of ranks")
+    parser.add_argument("--mca", nargs=2, action="append", default=[],
+                        metavar=("VAR", "VALUE"),
+                        help="set an MCA variable (framework_name value)")
+    parser.add_argument("--timeout", type=float, default=600.0,
+                        help="job wall-clock limit in seconds")
+    parser.add_argument("--with-tpu", action="store_true",
+                        help="let ranks claim TPU devices (default: ranks "
+                             "are host-only; the device path belongs to "
+                             "mesh mode / the single controller)")
+    parser.add_argument("program", help="python script to run")
+    parser.add_argument("args", nargs=argparse.REMAINDER)
+    opts = parser.parse_args(argv)
+
+    server = ModexServer(opts.np)
+    env_base = dict(os.environ)
+    env_base["OMPI_TPU_SIZE"] = str(opts.np)
+    env_base["OMPI_TPU_MODEX"] = server.address
+    # ranks run `python script.py`, which puts the script's dir (not our
+    # cwd) on sys.path — propagate the launcher's import environment so
+    # `import ompi_tpu` resolves the same way it did for the launcher
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    extra = [os.getcwd(), pkg_root]
+    prior = env_base.get("PYTHONPATH")
+    if prior:
+        extra.append(prior)
+    env_base["PYTHONPATH"] = os.pathsep.join(extra)
+    if not opts.with_tpu:
+        # A TPU chip is an exclusive grant; N rank interpreters racing to
+        # claim it deadlock at startup. Process-mode ranks are host-only
+        # unless explicitly opted in (the device path is mesh mode's).
+        env_base.pop("PALLAS_AXON_POOL_IPS", None)
+        env_base["JAX_PLATFORMS"] = "cpu"
+    for var, value in opts.mca:
+        env_base[f"OMPI_TPU_MCA_{var}"] = value
+
+    procs: List[subprocess.Popen] = []
+    try:
+        for rank in range(opts.np):
+            env = dict(env_base)
+            env["OMPI_TPU_RANK"] = str(rank)
+            procs.append(subprocess.Popen(
+                [sys.executable, opts.program, *opts.args], env=env))
+        rc = 0
+        for p in procs:
+            try:
+                code = p.wait(timeout=opts.timeout)
+            except subprocess.TimeoutExpired:
+                code = 124
+            if code != 0 and rc == 0:
+                rc = code
+        if rc != 0:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

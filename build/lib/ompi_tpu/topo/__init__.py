@@ -1,0 +1,280 @@
+"""Process topologies: Cartesian, graph, and distributed graph.
+
+Reference: ompi/mca/topo (4,651 LoC — topo.h module contract, base
+cart/graph math in base/topo_base_cart_*.c) plus the neighborhood
+collective slots those topologies feed (coll.h:545-620).
+
+TPU-native notes: a Cartesian topology on a mesh-mode communicator is the
+natural projection onto the ICI torus — cart coordinates are a row-major
+reshape of the mesh axis, and Cart shifts become collective-permute rings
+(the very traffic pattern ICI is wired for). Periodic dims map onto the
+torus wraparound links. Host-mode comms get the same coordinate math with
+pt2pt shifts (PROC_NULL at non-periodic edges).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_TOPOLOGY
+from ompi_tpu.comm.communicator import PROC_NULL, UNDEFINED
+
+# MPI topology type constants (reference: mpi.h MPI_CART/MPI_GRAPH/...)
+CART = 1
+GRAPH = 2
+DIST_GRAPH = 3
+
+
+def Dims_create(nnodes: int, ndims: int,
+                dims: Optional[Sequence[int]] = None) -> List[int]:
+    """MPI_Dims_create: balanced factorization of nnodes over ndims,
+    honoring pre-set (nonzero) entries, result non-increasing
+    (reference: ompi/mpi/c/dims_create.c.in's assignnodes/factor)."""
+    out = list(dims) if dims is not None else [0] * ndims
+    if len(out) != ndims:
+        raise MPIError(ERR_ARG, "dims length != ndims")
+    fixed = 1
+    free_idx = [i for i, d in enumerate(out) if d == 0]
+    for d in out:
+        if d < 0:
+            raise MPIError(ERR_ARG, f"negative dim {d}")
+        fixed *= d or 1
+    if not free_idx:
+        if fixed != nnodes:
+            raise MPIError(ERR_ARG, f"dims product {fixed} != {nnodes}")
+        return out
+    rem, r = divmod(nnodes, fixed)
+    if r:
+        raise MPIError(ERR_ARG,
+                       f"{nnodes} not divisible by fixed dims {fixed}")
+    # prime-factorize rem, then greedily multiply onto the smallest bucket
+    factors = []
+    n, p = rem, 2
+    while p * p <= n:
+        while n % p == 0:
+            factors.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        factors.append(n)
+    buckets = [1] * len(free_idx)
+    for f in sorted(factors, reverse=True):
+        buckets[buckets.index(min(buckets))] *= f
+    buckets.sort(reverse=True)
+    for i, b in zip(free_idx, buckets):
+        out[i] = b
+    return out
+
+
+class CartTopo:
+    """Cartesian topology descriptor attached to a communicator
+    (reference: mca_topo_base_comm_cart_2_2_0_t)."""
+
+    kind = CART
+
+    def __init__(self, dims: Sequence[int], periods: Sequence[bool]):
+        self.dims = [int(d) for d in dims]
+        self.periods = [bool(p) for p in periods]
+        if len(self.dims) != len(self.periods):
+            raise MPIError(ERR_ARG, "dims/periods length mismatch")
+        if any(d <= 0 for d in self.dims):
+            raise MPIError(ERR_ARG, f"bad dims {self.dims}")
+        self.ndims = len(self.dims)
+        self.size = int(np.prod(self.dims)) if self.dims else 1
+
+    # ------------------------------------------------------ coordinate math
+    def rank(self, coords: Sequence[int]) -> int:
+        """Row-major coords -> rank, wrapping periodic dims (reference:
+        topo_base_cart_rank.c)."""
+        r = 0
+        for d, (c, n, per) in enumerate(zip(coords, self.dims,
+                                            self.periods)):
+            c = int(c)
+            if per:
+                c %= n
+            elif not 0 <= c < n:
+                raise MPIError(ERR_ARG,
+                               f"coord {c} out of range for dim {d}")
+            r = r * n + c
+        return r
+
+    def coords(self, rank: int) -> List[int]:
+        """rank -> row-major coords (reference: topo_base_cart_coords.c)."""
+        if not 0 <= rank < self.size:
+            raise MPIError(ERR_ARG, f"rank {rank} out of cart range")
+        out = []
+        for n in reversed(self.dims):
+            out.append(rank % n)
+            rank //= n
+        return out[::-1]
+
+    def shift(self, rank: int, direction: int, disp: int) -> Tuple[int, int]:
+        """(source, dest) for a shift along `direction` by `disp`
+        (reference: topo_base_cart_shift.c); PROC_NULL off non-periodic
+        edges."""
+        c = self.coords(rank)
+
+        def move(sign: int) -> int:
+            cc = list(c)
+            cc[direction] += sign * disp
+            n = self.dims[direction]
+            if self.periods[direction]:
+                cc[direction] %= n
+            elif not 0 <= cc[direction] < n:
+                return PROC_NULL
+            return self.rank(cc)
+
+        return move(-1), move(+1)
+
+    def neighbors(self, rank: int) -> List[int]:
+        """Neighbor order for cart neighborhood collectives: for each
+        dimension, (negative-displacement peer, positive peer) —
+        reference: the ordering mandated by MPI-3 §7.6 and implemented in
+        mca_topo_base_neighbor_count."""
+        out = []
+        for d in range(self.ndims):
+            src, dst = self.shift(rank, d, 1)
+            out.extend((src, dst))
+        return out
+
+    def sub_colors(self, remain: Sequence[bool]) -> Tuple[List[int], List[int]]:
+        """(colors, keys) for Cart_sub: color = coords over dropped dims,
+        key = linear rank over kept dims (reference: topo_base_cart_sub.c)."""
+        if len(remain) != self.ndims:
+            raise MPIError(ERR_ARG,
+                           f"remain_dims has {len(remain)} entries for a "
+                           f"{self.ndims}-dim cart")
+        colors, keys = [], []
+        for r in range(self.size):
+            c = self.coords(r)
+            color = key = 0
+            for d in range(self.ndims):
+                if remain[d]:
+                    key = key * self.dims[d] + c[d]
+                else:
+                    color = color * self.dims[d] + c[d]
+            colors.append(color)
+            keys.append(key)
+        return colors, keys
+
+
+class GraphTopo:
+    """MPI_Graph_create topology: CSR (index, edges) over all ranks."""
+
+    kind = GRAPH
+
+    def __init__(self, index: Sequence[int], edges: Sequence[int]):
+        self.index = [int(i) for i in index]
+        self.edges = [int(e) for e in edges]
+        self.size = len(self.index)
+        if self.index and self.index[-1] != len(self.edges):
+            raise MPIError(ERR_ARG, "index[-1] must equal len(edges)")
+
+    def neighbors(self, rank: int) -> List[int]:
+        lo = self.index[rank - 1] if rank > 0 else 0
+        return self.edges[lo : self.index[rank]]
+
+
+class DistGraphTopo:
+    """MPI_Dist_graph_create_adjacent topology: explicit in/out neighbor
+    lists per rank (held whole on each rank — the driver-visible form)."""
+
+    kind = DIST_GRAPH
+
+    def __init__(self, sources: Sequence[int], destinations: Sequence[int]):
+        self.sources = [int(s) for s in sources]
+        self.destinations = [int(d) for d in destinations]
+
+    def in_neighbors(self, rank: int) -> List[int]:
+        return list(self.sources)
+
+    def out_neighbors(self, rank: int) -> List[int]:
+        return list(self.destinations)
+
+
+def in_out_neighbors(topo, rank: int) -> Tuple[List[int], List[int]]:
+    """Uniform neighbor view for the neighborhood collectives: cart and
+    graph are symmetric; dist-graph is explicit."""
+    if topo is None:
+        raise MPIError(ERR_TOPOLOGY, "communicator has no topology")
+    if isinstance(topo, DistGraphTopo):
+        return topo.in_neighbors(rank), topo.out_neighbors(rank)
+    nbrs = topo.neighbors(rank)
+    return list(nbrs), list(nbrs)
+
+
+def attach_sub_cart(sub, topo: CartTopo, remain) -> None:
+    """Attach the kept-dims cart to a Cart_sub result (shared by the
+    host and mesh Sub implementations)."""
+    remain = [bool(r) for r in remain]
+    if len(remain) != topo.ndims:
+        raise MPIError(ERR_ARG,
+                       f"remain_dims has {len(remain)} entries for a "
+                       f"{topo.ndims}-dim cart")
+    kept = [d for d, keep in zip(topo.dims, remain) if keep]
+    kept_p = [p for p, keep in zip(topo.periods, remain) if keep]
+    sub.topo = CartTopo(kept or [1], kept_p or [False])
+    _reselect_coll(sub)
+
+
+# ----------------------------------------------------------- constructors
+def cart_create_proc(comm, dims: Sequence[int],
+                     periods: Optional[Sequence[bool]] = None,
+                     reorder: bool = False):
+    """MPI_Cart_create for process-mode comms: members beyond the cart
+    size get None (MPI_COMM_NULL). reorder is accepted and ignored — rank
+    order is already arbitrary on the host path (the reference's
+    topo/basic does the same; treematch is the only reorderer)."""
+    from ompi_tpu.core.group import Group
+
+    topo = CartTopo(dims, periods if periods is not None
+                    else [False] * len(dims))
+    if topo.size > comm.size:
+        raise MPIError(ERR_TOPOLOGY,
+                       f"cart needs {topo.size} ranks, comm has {comm.size}")
+    members = [comm._world_rank(r) for r in range(topo.size)]
+    sub = comm.Create_group(Group(members))
+    if sub is None:
+        return None
+    sub.topo = topo
+    _reselect_coll(sub)
+    sub.name = f"{comm.name}-cart"
+    return sub
+
+
+def graph_create_proc(comm, index, edges, reorder: bool = False):
+    from ompi_tpu.core.group import Group
+
+    topo = GraphTopo(index, edges)
+    if topo.size > comm.size:
+        raise MPIError(ERR_TOPOLOGY,
+                       f"graph needs {topo.size} ranks")
+    members = [comm._world_rank(r) for r in range(topo.size)]
+    sub = comm.Create_group(Group(members))
+    if sub is None:
+        return None
+    sub.topo = topo
+    _reselect_coll(sub)
+    sub.name = f"{comm.name}-graph"
+    return sub
+
+
+def dist_graph_adjacent_proc(comm, sources, destinations,
+                             reorder: bool = False):
+    sub = comm.Dup()
+    sub.topo = DistGraphTopo(sources, destinations)
+    _reselect_coll(sub)
+    sub.name = f"{comm.name}-distgraph"
+    return sub
+
+
+def _reselect_coll(comm) -> None:
+    """Topology attach happens after construction; re-run the per-comm
+    selection so topo-aware components can claim their slots (the
+    reference selects at comm creation *with* the topo already set —
+    comm_cart is built before coll selection in ompi_comm_enable)."""
+    from ompi_tpu.coll.base import select_coll
+
+    comm.coll = select_coll(comm)

@@ -1,0 +1,58 @@
+"""User-facing tagged error messages with cross-rank de-duplication.
+
+Reference: opal/util/show_help.c (renders help-*.txt topic files and
+de-duplicates identical messages arriving from many ranks). We keep the
+contract — topic+key rendering with dedup — with messages registered inline
+rather than parsed from .txt files.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, Tuple
+
+_messages: Dict[Tuple[str, str], str] = {}
+_shown: set = set()
+_lock = threading.Lock()
+
+
+def register_topic(topic: str, key: str, text: str) -> None:
+    _messages[(topic, key)] = text
+
+
+def show_help(topic: str, key: str, once: bool = True, **fmt) -> str:
+    """Render and print a help message; returns the rendered text.
+
+    With once=True (default) repeated (topic, key) pairs are suppressed —
+    the reference's aggregation behavior for identical messages from N ranks.
+    """
+    text = _messages.get((topic, key), f"[no help for {topic}:{key}]")
+    try:
+        rendered = text.format(**fmt)
+    except (KeyError, IndexError):
+        rendered = text
+    with _lock:
+        if once and (topic, key) in _shown:
+            return rendered
+        _shown.add((topic, key))
+    banner = "-" * 62
+    print(f"{banner}\n{rendered}\n{banner}", file=sys.stderr)
+    return rendered
+
+
+register_topic(
+    "runtime", "not-initialized",
+    "ompi_tpu has not been initialized. Call ompi_tpu.Init() (or use\n"
+    "ompi_tpu.tools.mpirun to launch) before invoking MPI operations.",
+)
+register_topic(
+    "runtime", "already-finalized",
+    "ompi_tpu has already been finalized; MPI operations are no longer\n"
+    "available in this process.",
+)
+register_topic(
+    "comm", "revoked",
+    "Communicator {name} has been revoked (ULFM). Collective and\n"
+    "point-to-point operations on it will fail with ERR_REVOKED.",
+)

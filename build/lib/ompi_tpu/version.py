@@ -1,0 +1,10 @@
+"""Version of the ompi_tpu framework (reference: VERSION:18-24 — the reference
+tracks an MPI standard compliance level alongside its own version; we do the
+same)."""
+
+__version__ = "0.1.0"
+
+# MPI standard level this framework targets (reference: VERSION:23-24 declares
+# MPI 3.1 + selected MPI-4 features: Sessions, partitioned communication).
+MPI_VERSION = 3
+MPI_SUBVERSION = 1

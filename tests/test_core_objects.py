@@ -139,3 +139,56 @@ def test_info():
     d = i.Dup()
     i.Delete("a")
     assert d.Get("a") == "1" and i.Get("a") is None
+
+
+# ------------------- r2: attribute keyvals + FT hardening ---------------
+def test_keyval_copy_delete_callbacks():
+    """MPI_Comm_create_keyval semantics (reference: ompi/attribute —
+    copy at Dup, delete at Delete_attr/Free)."""
+    import ompi_tpu
+    from ompi_tpu import COMM_WORLD
+
+    events = []
+    kv_copy = ompi_tpu.Communicator.Create_keyval(
+        copy_fn=lambda c, k, v: (True, v + 1),
+        delete_fn=lambda c, k, v: events.append(("del", v)))
+    kv_nocopy = ompi_tpu.Communicator.Create_keyval()
+
+    COMM_WORLD.Set_attr(kv_copy, 10)
+    COMM_WORLD.Set_attr(kv_nocopy, 77)
+    dup = COMM_WORLD.Dup()
+    assert dup.Get_attr(kv_copy) == 11        # copied through the callback
+    assert dup.Get_attr(kv_nocopy) is None    # NULL_COPY_FN
+    dup.Delete_attr(kv_copy)
+    assert events == [("del", 11)]
+    # replacing a value fires delete on the old one (r2 review)
+    dup.Set_attr(kv_copy, 1)
+    dup.Set_attr(kv_copy, 2)
+    assert events[-1] == ("del", 1)
+    # a stored None still gets its delete callback
+    dup.Set_attr(kv_copy, None)
+    assert events[-1] == ("del", 2)
+    dup.Delete_attr(kv_copy)
+    assert events[-1] == ("del", None)
+    dup.Free()
+    COMM_WORLD.Delete_attr(kv_copy)
+    COMM_WORLD.Delete_attr(kv_nocopy)
+    assert events[-1] == ("del", 10)
+    ompi_tpu.Communicator.Free_keyval(kv_copy)
+    ompi_tpu.Communicator.Free_keyval(kv_nocopy)
+
+
+def test_shrink_cid_agreement_singleton():
+    """Shrink allocates its CID through the live-member agreement (r1
+    left this as 'future work')."""
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu import COMM_WORLD
+
+    dup = COMM_WORLD.Dup()
+    dup.Revoke()
+    shrunk = dup.Shrink()
+    assert shrunk.Get_size() == 1
+    out = np.zeros(1, np.float64)
+    shrunk.Allreduce(np.ones(1), out)
+    assert out[0] == 1.0
