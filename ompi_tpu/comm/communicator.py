@@ -40,7 +40,7 @@ from ompi_tpu.core.errors import (
 from ompi_tpu.core.group import Group
 from ompi_tpu.core.request import Request
 from ompi_tpu.core.status import Status
-from ompi_tpu.runtime import spc
+from ompi_tpu.runtime import peruse, spc
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -253,7 +253,14 @@ class ProcComm(Intracomm):
         obj, count, dt = parse_buffer(buf)
         wdest = self._world_rank(dest)
         spc.record_bytes("send", count * dt.size)
-        return self.pml.isend(obj, count, dt, wdest, tag, self.cid)
+        if peruse.enabled:
+            peruse.fire("send_posted", comm=self, dest=dest, tag=tag,
+                        nbytes=count * dt.size)
+        req = self.pml.isend(obj, count, dt, wdest, tag, self.cid)
+        if peruse.enabled:
+            req.add_completion_callback(
+                lambda r: peruse.fire("request_complete", request=r))
+        return req
 
     def Irecv(self, buf, source: int = ANY_SOURCE,
               tag: int = ANY_TAG) -> Request:
@@ -267,9 +274,14 @@ class ProcComm(Intracomm):
             return r
         obj, count, dt = parse_buffer(buf)
         wsrc = source if source == ANY_SOURCE else self._world_rank(source)
+        if peruse.enabled:
+            peruse.fire("recv_posted", comm=self, source=source, tag=tag)
         req = self.pml.irecv(obj, count, dt, wsrc, tag, self.cid)
         # report comm-rank, not world-rank, in the status
         req.add_completion_callback(self._fix_status_source)
+        if peruse.enabled:
+            req.add_completion_callback(
+                lambda r: peruse.fire("request_complete", request=r))
         return req
 
     def _fix_status_source(self, req) -> None:
@@ -324,8 +336,14 @@ class ProcComm(Intracomm):
 
     def Mrecv(self, buf, message, status: Optional[Status] = None) -> None:
         obj, count, dt = parse_buffer(buf)
+        if peruse.enabled:
+            peruse.fire("recv_posted", comm=self, source=ANY_SOURCE,
+                        tag=ANY_TAG)
         req = self.pml.mrecv(obj, count, dt, message)
         req.add_completion_callback(self._fix_status_source)
+        if peruse.enabled:
+            req.add_completion_callback(
+                lambda r: peruse.fire("request_complete", request=r))
         req.Wait(status)
 
     def Send_init(self, buf, dest: int, tag: int = 0):

@@ -105,8 +105,17 @@ class Intercomm(Communicator):
 
             return CompletedRequest()
         obj, count, dt = parse_buffer(buf)
-        return self.pml.isend(obj, count, dt, self._remote_urank(dest),
-                              tag, self.cid)
+        from ompi_tpu.runtime import peruse
+
+        if peruse.enabled:
+            peruse.fire("send_posted", comm=self, dest=dest, tag=tag,
+                        nbytes=count * dt.size)
+        req = self.pml.isend(obj, count, dt, self._remote_urank(dest),
+                             tag, self.cid)
+        if peruse.enabled:
+            req.add_completion_callback(
+                lambda r: peruse.fire("request_complete", request=r))
+        return req
 
     def Irecv(self, buf, source: int = ANY_SOURCE,
               tag: int = ANY_TAG) -> Request:
@@ -116,10 +125,17 @@ class Intercomm(Communicator):
 
             return CompletedRequest()
         obj, count, dt = parse_buffer(buf)
+        from ompi_tpu.runtime import peruse
+
+        if peruse.enabled:
+            peruse.fire("recv_posted", comm=self, source=source, tag=tag)
         wsrc = (ANY_SOURCE if source == ANY_SOURCE
                 else self._remote_urank(source))
         req = self.pml.irecv(obj, count, dt, wsrc, tag, self.cid)
         req.add_completion_callback(self._fix_status_source)
+        if peruse.enabled:
+            req.add_completion_callback(
+                lambda r: peruse.fire("request_complete", request=r))
         return req
 
     def _fix_status_source(self, req) -> None:

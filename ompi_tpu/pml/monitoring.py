@@ -1,0 +1,122 @@
+"""pml/monitoring — interposition layer counting point-to-point traffic.
+
+Reference: ompi/mca/pml/monitoring + ompi/mca/common/monitoring (the
+interposition PML that counts messages/bytes per peer then forwards to
+the real PML; matrix output via profile2mat.pl). Redesign: a delegating
+wrapper around the selected PML, enabled with
+``--mca pml_monitoring_enable 1``; per-peer counters surface as pvars
+and the finalize hook prints the communication matrix (one row per
+rank: ``peer:msgs/bytes``), the profile2mat analog.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, Tuple
+
+from ompi_tpu.mca.var import register_var, get_var, register_pvar
+
+register_var("pml_monitoring", "enable", False,
+             help="Interpose the pml and count per-peer messages/bytes "
+                  "(reference: pml/monitoring)", level=4)
+
+
+class MonitoringPml:
+    """Forwarding wrapper (reference: every pml/monitoring verb bumps
+    counters then calls the underlying module)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._lock = threading.Lock()
+        # (peer, direction) -> [messages, bytes]
+        self.counts: Dict[Tuple[int, str], list] = defaultdict(
+            lambda: [0, 0])
+        register_pvar("pml_monitoring", "total_sent_bytes",
+                      lambda: sum(v[1] for (p, d), v in self.counts.items()
+                                  if d == "tx"),
+                      help="Bytes sent through the monitored pml")
+        register_pvar("pml_monitoring", "total_recv_bytes",
+                      lambda: sum(v[1] for (p, d), v in self.counts.items()
+                                  if d == "rx"),
+                      help="Bytes received through the monitored pml")
+
+    # Count USER pt2pt only: plane-bit cids (collective schedules, nbc,
+    # partitioned, dpm, ft) and system tags (heartbeats, osc active
+    # messages, revoke floods) are library-internal — the repo's
+    # internal-traffic-suppression convention (cf. spc.suppressed();
+    # the reference monitoring component likewise separates user pt2pt
+    # from collective/internal classes).
+    _PLANE_MASK = ~((1 << 25) - 1)  # any cid bit >= 2^25 marks a plane
+
+    def _user_traffic(self, tag: int, cid: int) -> bool:
+        from ompi_tpu.pml.ob1 import Ob1Pml
+
+        return ((cid & self._PLANE_MASK) == 0
+                and tag > Ob1Pml.SYSTEM_TAG_BASE)
+
+    def _bump(self, peer: int, direction: str, nbytes: int) -> None:
+        with self._lock:
+            c = self.counts[(peer, direction)]
+            c[0] += 1
+            c[1] += nbytes
+
+    # ------------------------------------------------- monitored verbs
+    def isend(self, buf, count, datatype, dst, tag, cid):
+        if self._user_traffic(tag, cid):
+            self._bump(dst, "tx", count * datatype.size)
+        return self._inner.isend(buf, count, datatype, dst, tag, cid)
+
+    def irecv(self, buf, count, datatype, src, tag, cid):
+        req = self._inner.irecv(buf, count, datatype, src, tag, cid)
+        if self._user_traffic(tag, cid):
+            def done(r):
+                if r.status.source >= 0:
+                    self._bump(r.status.source, "rx", r.status._nbytes)
+
+            req.add_completion_callback(done)
+        return req
+
+    # ------------------------------------------------- plain delegation
+    _OWN = ("_inner", "_lock", "counts")
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __setattr__(self, name, value):
+        # writes fall through to the real pml (wireup assigns
+        # endpoint_resolver post-construction; landing it on the wrapper
+        # would silently break cross-job endpoint resolution)
+        if name in self._OWN:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._inner, name, value)
+
+    # ------------------------------------------------------ matrix dump
+    def dump_matrix(self, file=None) -> None:
+        """The comm-matrix report (reference: common/monitoring's
+        output consumed by profile2mat.pl)."""
+        import sys
+
+        out = file or sys.stderr
+        tx = {p: v for (p, d), v in sorted(self.counts.items())
+              if d == "tx"}
+        rx = {p: v for (p, d), v in sorted(self.counts.items())
+              if d == "rx"}
+        me = self._inner.my_rank
+        cells = " ".join(f"{p}:{v[0]}/{v[1]}B" for p, v in tx.items())
+        print(f"pml_monitoring rank {me} sent: {cells or '-'}", file=out)
+        cells = " ".join(f"{p}:{v[0]}/{v[1]}B" for p, v in rx.items())
+        print(f"pml_monitoring rank {me} recv: {cells or '-'}", file=out)
+
+
+def maybe_wrap(pml):
+    """Interpose if enabled (called by wireup at PML selection — the
+    reference's monitoring component wins selection then forwards)."""
+    if not get_var("pml_monitoring", "enable"):
+        return pml
+    wrapped = MonitoringPml(pml)
+    from ompi_tpu.hook import register_hook
+
+    register_hook("finalize_top", wrapped.dump_matrix)
+    return wrapped

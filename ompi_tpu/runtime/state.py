@@ -94,6 +94,9 @@ def _init_singleton() -> ProcComm:
     from ompi_tpu.pml.ob1 import Ob1Pml
 
     pml = Ob1Pml(my_rank=0)
+    from ompi_tpu.pml.monitoring import maybe_wrap
+
+    pml = maybe_wrap(pml)  # interposition applies in EVERY init mode
     _, self_btl = btl_framework.select_one(deliver=pml.handle_incoming)
     pml.add_endpoint(0, self_btl)
     return ProcComm(Group([0]), cid=0, pml=pml, name="MPI_COMM_WORLD")

@@ -39,6 +39,11 @@ def init_process_mode():
     urank = base + rank
 
     pml = Ob1Pml(my_rank=urank)
+    # optional traffic-counting interposition (reference: pml/monitoring
+    # wins selection then forwards to the real pml)
+    from ompi_tpu.pml.monitoring import maybe_wrap
+
+    pml = maybe_wrap(pml)
     modex = ModexClient(modex_addr, urank, size, job=job)
 
     # btl selection (reference: mca_pml_base_select opening BTLs via bml/r2)

@@ -132,3 +132,26 @@ def test_registered_pvars():
     pv = all_pvars()
     assert "pml_unexpected_queue_length" in pv
     assert pv["pml_unexpected_queue_length"].value >= 0
+
+
+def test_peruse_events():
+    """PERUSE-style request-lifecycle events (reference: ompi/peruse,
+    hooks at pml_ob1_isend.c:321)."""
+    from ompi_tpu.runtime import peruse
+
+    seen = []
+    fn = lambda ev, info: seen.append(ev)
+    peruse.subscribe("send_posted", fn)
+    peruse.subscribe("recv_posted", fn)
+    peruse.subscribe("request_complete", fn)
+    try:
+        buf = np.zeros(2, np.float64)
+        COMM_WORLD.Send(np.ones(2), dest=0, tag=77)
+        COMM_WORLD.Recv(buf, source=0, tag=77)
+        assert "send_posted" in seen
+        assert "recv_posted" in seen
+        assert seen.count("request_complete") >= 2
+    finally:
+        for ev in ("send_posted", "recv_posted", "request_complete"):
+            peruse.unsubscribe(ev, fn)
+    assert not peruse.enabled
