@@ -45,6 +45,28 @@ DPM_CID_BIT = 1 << 27
 _TAG_XCHG = 0  # handshake messages ride (DPM_CID_BIT | tag) with seq'd tags
 
 
+def _leader_recv_then_send(pml, tag: int, payload: bytes):
+    """Passive half of a leader handshake (MPI_Comm_accept side): learn
+    the peer from the first frame's source, read its blob, reply with
+    ours. Returns (their blob, peer universe rank)."""
+    cid = DPM_CID_BIT
+    rlen = np.zeros(8, np.uint8)
+    st = Status()
+    pml.irecv(rlen, 8, BYTE, ANY_SOURCE, tag, cid).Wait(st)
+    peer = st.source
+    # reply with OUR length immediately: the active side waits for it
+    # before sending its body (phase-matched with _leader_exchange —
+    # replying only after the body would deadlock the pair)
+    hdr = struct.pack("<Q", len(payload))
+    pml.isend(np.frombuffer(hdr, np.uint8), 8, BYTE, peer, tag, cid).Wait()
+    n = struct.unpack("<Q", rlen.tobytes())[0]
+    body = np.zeros(max(n, 1), np.uint8)
+    pml.irecv(body, n, BYTE, peer, tag, cid).Wait()
+    pml.isend(np.frombuffer(payload, np.uint8), len(payload), BYTE,
+              peer, tag, cid).Wait()
+    return body[:n].tobytes(), peer
+
+
 def _leader_exchange(pml, peer: int, tag: int, payload: bytes,
                      cid: int = DPM_CID_BIT) -> bytes:
     """Symmetric sendrecv of a variable-size blob with a cross-world
@@ -275,7 +297,8 @@ class Intercomm(Communicator):
 
 
 def intercomm_create(local_comm: ProcComm, local_leader: int,
-                     remote_leader_urank: int, tag: int = 0) -> Intercomm:
+                     remote_leader_urank: int, tag: int = 0,
+                     passive: bool = False) -> Intercomm:
     """Build an intercomm from a local intracomm and the UNIVERSE rank of
     the remote side's leader (the dpm/spawn entry point; the MPI-surface
     Intercomm_create with a peer_comm resolves remote_leader through it
@@ -293,8 +316,14 @@ def intercomm_create(local_comm: ProcComm, local_leader: int,
                         for i in range(local_comm.size)]
             blob = json.dumps({"ranks": my_ranks,
                                "cid": int(lmax[0])}).encode()
-            theirs = json.loads(_leader_exchange(
-                pml, remote_leader_urank, 1000 + tag, blob))
+            if passive:
+                # Comm_accept side: the peer identifies itself
+                raw, remote_leader_urank = _leader_recv_then_send(
+                    pml, 1000 + tag, blob)
+                theirs = json.loads(raw)
+            else:
+                theirs = json.loads(_leader_exchange(
+                    pml, remote_leader_urank, 1000 + tag, blob))
             cid = max(int(lmax[0]), int(theirs["cid"]))
             payload = json.dumps(
                 {"remote": theirs["ranks"], "cid": cid}).encode()

@@ -88,6 +88,97 @@ def spawn(comm, command: str, args: Sequence[str] = (), maxprocs: int = 1,
     return inter
 
 
+# ---------------------------------------------------- connect / accept
+# Reference: dpm.c ompi_dpm_connect_accept — MPI_Open_port publishes a
+# rendezvous token; Comm_accept/Comm_connect on two independent comms
+# bridge them into an intercomm. The token carries the acceptor root's
+# universe rank + a tag; the modex KV is the name service
+# (MPI_Publish_name analog).
+_port_seq = [0]
+
+
+def Open_port(comm=None) -> str:
+    """Returns a port name another job can Comm_connect to."""
+    from ompi_tpu.runtime import wireup
+
+    ctx = wireup._ctx
+    if ctx is None:
+        raise MPIError(ERR_SPAWN, "ports require process mode")
+    _port_seq[0] += 1
+    # tag space above the spawn handshake band
+    return f"{ctx['world'].pml.my_rank}:{500000 + _port_seq[0]}"
+
+
+def _modex():
+    from ompi_tpu.runtime import wireup
+
+    if wireup._ctx is None:
+        raise MPIError(ERR_SPAWN, "the name service requires process mode")
+    return wireup._ctx["modex"]
+
+
+# Name-service entries live on a reserved modex rank (-1) so lookups
+# need not know the publisher (the reference's global name server).
+_NS_RANK = -1
+
+
+def Publish_name(service: str, port: str) -> None:
+    """MPI_Publish_name over the modex KV."""
+    _modex().put(f"dpm.port.{service}", port, rank=_NS_RANK)
+
+
+def Unpublish_name(service: str) -> None:
+    """MPI_Unpublish_name: retract the entry (stale ports hand
+    connectors a tag nobody will ever accept)."""
+    _modex().put(f"dpm.port.{service}", None, rank=_NS_RANK)
+
+
+def Lookup_name(service: str, timeout: float = 30.0) -> str:
+    port = _modex().get(_NS_RANK, f"dpm.port.{service}", timeout=timeout)
+    if port is None:
+        raise MPIError(ERR_SPAWN, f"service {service!r} was unpublished")
+    return port
+
+
+def Comm_accept(port: str, comm, root: int = 0):
+    """Collective over `comm`; bridges to the connector (reference:
+    ompi_dpm_connect_accept, acceptor side). The port is significant
+    only at the root (MPI-3 §10.4) — and the root must be the process
+    that opened it, since the connector addresses the port's embedded
+    universe rank."""
+    from ompi_tpu.comm.intercomm import intercomm_create
+
+    tag = 0
+    if comm.rank == root:
+        opener, tag = (int(x) for x in port.split(":"))
+        if opener != comm.pml.my_rank:
+            raise MPIError(
+                ERR_ARG,
+                f"port {port!r} was opened by universe rank {opener}; "
+                f"Comm_accept's root must be that process (the "
+                "connector addresses it directly)")
+    # non-roots get the tag from the root via the handshake bcast inside
+    # intercomm_create; the tag arg only matters at the leader
+    tag_arr = np.array([tag], np.int64)
+    comm.Bcast(tag_arr, root=root)
+    return intercomm_create(comm, root, -1, tag=int(tag_arr[0]),
+                            passive=True)
+
+
+def Comm_connect(port: str, comm, root: int = 0):
+    """Collective over `comm`; bridges to the acceptor. Port significant
+    only at the root."""
+    from ompi_tpu.comm.intercomm import intercomm_create
+
+    acceptor_rank = -1
+    tag = 0
+    if comm.rank == root:
+        acceptor_rank, tag = (int(x) for x in port.split(":"))
+    tag_arr = np.array([tag], np.int64)
+    comm.Bcast(tag_arr, root=root)
+    return intercomm_create(comm, root, acceptor_rank, tag=int(tag_arr[0]))
+
+
 def _launch_children(command: str, args: List[str], n: int, job: int,
                      base: int, parent_root: int, spawn_tag: int,
                      info: dict, ctx) -> None:

@@ -190,8 +190,12 @@ class ModexClient:
             raise RuntimeError(resp["error"])
         return resp
 
-    def put(self, key: str, val: Any) -> None:
-        self._rpc({"op": "put", "rank": self.rank, "key": key, "val": val})
+    def put(self, key: str, val: Any, rank: Optional[int] = None) -> None:
+        """Publish under this rank, or an explicit one (the reserved
+        name-service channel uses rank -1)."""
+        self._rpc({"op": "put",
+                   "rank": self.rank if rank is None else rank,
+                   "key": key, "val": val})
 
     def get(self, rank: int, key: str, timeout: float = 30.0) -> Any:
         deadline = time.monotonic() + timeout

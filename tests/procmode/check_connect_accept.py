@@ -1,0 +1,61 @@
+"""MPI_Open_port / Publish_name / Comm_accept / Comm_connect
+(reference: dpm.c ompi_dpm_connect_accept + the name service)."""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.runtime.dpm import (
+    Comm_accept,
+    Comm_connect,
+    Lookup_name,
+    Open_port,
+    Publish_name,
+)
+
+
+def main() -> int:
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+    assert n == 4, "run with -np 4"
+
+    side = r // 2  # two independent 2-rank groups
+    local = COMM_WORLD.Split(side, r)
+
+    if side == 0:
+        if local.Get_rank() == 0:
+            port = Open_port()
+            Publish_name("svc", port)
+        # every member passes the same port string (the name service
+        # makes it visible to non-roots too)
+        port = Lookup_name("svc")
+        inter = Comm_accept(port, local, root=0)
+    else:
+        port = Lookup_name("svc")
+        inter = Comm_connect(port, local, root=0)
+
+    assert inter.Get_remote_size() == 2
+    lr = local.Get_rank()
+    out = np.zeros(1, np.int64)
+    inter.Send(np.array([side * 100 + lr], np.int64), dest=lr, tag=2)
+    inter.Recv(out, source=lr, tag=2)
+    assert out[0] == (1 - side) * 100 + lr, out
+
+    red = np.zeros(1, np.float64)
+    inter.Allreduce(np.full(1, float(r + 1)), red)
+    want = {0: (3 + 4), 1: (1 + 2)}[side]
+    assert red[0] == want, (red, want)
+
+    merged = inter.Merge(high=(side == 1))
+    tot = np.zeros(1, np.int64)
+    merged.Allreduce(np.array([1], np.int64), tot)
+    assert tot[0] == 4
+
+    print(f"CONNECT-OK rank {r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

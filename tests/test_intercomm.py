@@ -20,3 +20,11 @@ def test_spawn_merge_allreduce():
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("SPAWN-PARENT-OK") == 2
     assert r.stdout.count("SPAWN-CHILD-OK") == 2
+
+
+def test_connect_accept_via_name_service():
+    """Open_port/Publish_name/Comm_accept/Comm_connect bridging two
+    independent groups (reference: dpm.c connect_accept)."""
+    r = run_mpi(4, "tests/procmode/check_connect_accept.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("CONNECT-OK") == 4
