@@ -25,7 +25,11 @@ import numpy as np
 from ompi_tpu.coll.base import CollModule, coll_framework
 from ompi_tpu.comm.communicator import parse_buffer
 from ompi_tpu.core import op as _op
-from ompi_tpu.core.convertor import pack as cv_pack, unpack as cv_unpack
+from ompi_tpu.core.convertor import (
+    _as_byte_view as _as_bytes,
+    pack as cv_pack,
+    unpack as cv_unpack,
+)
 from ompi_tpu.core.datatype import BYTE, Datatype
 from ompi_tpu.core.errors import MPIError, ERR_UNSUPPORTED_OPERATION
 from ompi_tpu.mca.component import Component
@@ -339,6 +343,45 @@ class BasicColl(CollModule):
                             TAG_ALLTOALL)
             out[rdispls[src] * re_ : rdispls[src] * re_ + got.nbytes] = got
         cv_unpack(out, robj, rcount, rdt)
+
+    def alltoallw(self, comm, sendbuf, recvbuf, sendcounts, sdispls,
+                  sendtypes, recvcounts, rdispls, recvtypes) -> None:
+        """MPI_Alltoallw: per-peer counts, BYTE displacements, and
+        datatypes (the fully general exchange — reference:
+        coll_basic_alltoallw.c; displacements are in bytes per the MPI
+        spec, unlike alltoallv's element units)."""
+        n, r = comm.size, comm.rank
+        sobj, _, _ = parse_buffer(sendbuf)
+        robj, _, _ = parse_buffer(recvbuf)
+        sview = _as_bytes(sobj)
+        rview = _as_bytes(robj)
+
+        def _seg_len(dt, cnt: int) -> int:
+            # full footprint incl. a leading true_lb gap (the convertor
+            # gathers up to true_lb + true_extent - 1 on element 0)
+            return max((cnt - 1) * dt.extent + dt.true_lb
+                       + dt.true_extent, 0)
+
+        def pack_block(dst: int) -> np.ndarray:
+            dt = sendtypes[dst]
+            cnt = sendcounts[dst]
+            seg = sview[sdispls[dst] : sdispls[dst] + _seg_len(dt, cnt)]
+            return np.ascontiguousarray(cv_pack(seg, cnt, dt))
+
+        def unpack_block(src: int, data: np.ndarray) -> None:
+            dt = recvtypes[src]
+            cnt = recvcounts[src]
+            seg = rview[rdispls[src] : rdispls[src] + _seg_len(dt, cnt)]
+            cv_unpack(data, seg, cnt, dt)
+
+        unpack_block(r, pack_block(r))
+        for d in range(1, n):
+            dst = (r + d) % n
+            src = (r - d) % n
+            got = _sendrecv(comm, pack_block(dst), dst,
+                            recvcounts[src] * recvtypes[src].size, src,
+                            TAG_ALLTOALL)
+            unpack_block(src, got)
 
     # -------------------------------------------------------- reduce_scatter
     def reduce_scatter_block(self, comm, sendbuf, recvbuf,

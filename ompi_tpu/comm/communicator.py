@@ -431,6 +431,14 @@ class ProcComm(Intracomm):
         self._coll("alltoallv")(self, sendbuf, recvbuf, sendcounts, sdispls,
                                 recvcounts, rdispls)
 
+    def Alltoallw(self, sendbuf, recvbuf, sendcounts, sdispls, sendtypes,
+                  recvcounts, rdispls, recvtypes) -> None:
+        """Fully-general exchange: per-peer counts, BYTE displacements,
+        and datatypes (MPI_Alltoallw)."""
+        self._coll("alltoallw")(self, sendbuf, recvbuf, sendcounts,
+                                sdispls, sendtypes, recvcounts, rdispls,
+                                recvtypes)
+
     def Reduce_scatter(self, sendbuf, recvbuf, recvcounts,
                        op: _op.Op = _op.SUM) -> None:
         self._coll("reduce_scatter")(self, sendbuf, recvbuf, recvcounts, op)

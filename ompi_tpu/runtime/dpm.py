@@ -134,7 +134,13 @@ def Unpublish_name(service: str) -> None:
 
 
 def Lookup_name(service: str, timeout: float = 30.0) -> str:
-    port = _modex().get(_NS_RANK, f"dpm.port.{service}", timeout=timeout)
+    try:
+        port = _modex().get(_NS_RANK, f"dpm.port.{service}",
+                            timeout=timeout)
+    except TimeoutError:
+        raise MPIError(ERR_SPAWN,
+                       f"service {service!r} is not published "
+                       "(MPI_ERR_NAME)")
     if port is None:
         raise MPIError(ERR_SPAWN, f"service {service!r} was unpublished")
     return port
@@ -148,19 +154,28 @@ def Comm_accept(port: str, comm, root: int = 0):
     universe rank."""
     from ompi_tpu.comm.intercomm import intercomm_create
 
-    tag = 0
+    # root-side failures must reach every rank BEFORE they block in the
+    # Bcast (same invariant spawn() documents): a bad port propagates as
+    # tag -1 and all ranks raise together
+    tag = -1
+    err = ""
     if comm.rank == root:
-        opener, tag = (int(x) for x in port.split(":"))
-        if opener != comm.pml.my_rank:
-            raise MPIError(
-                ERR_ARG,
-                f"port {port!r} was opened by universe rank {opener}; "
-                f"Comm_accept's root must be that process (the "
-                "connector addresses it directly)")
-    # non-roots get the tag from the root via the handshake bcast inside
-    # intercomm_create; the tag arg only matters at the leader
+        try:
+            opener, tag = (int(x) for x in port.split(":"))
+            if opener != comm.pml.my_rank:
+                raise MPIError(
+                    ERR_ARG,
+                    f"port {port!r} was opened by universe rank "
+                    f"{opener}; Comm_accept's root must be that process "
+                    "(the connector addresses it directly)")
+        except MPIError as e:
+            tag, err = -1, str(e)
+        except Exception as e:
+            tag, err = -1, f"bad port {port!r}: {e}"
     tag_arr = np.array([tag], np.int64)
     comm.Bcast(tag_arr, root=root)
+    if int(tag_arr[0]) < 0:
+        raise MPIError(ERR_ARG, err or "Comm_accept failed at the root")
     return intercomm_create(comm, root, -1, tag=int(tag_arr[0]),
                             passive=True)
 
@@ -171,11 +186,17 @@ def Comm_connect(port: str, comm, root: int = 0):
     from ompi_tpu.comm.intercomm import intercomm_create
 
     acceptor_rank = -1
-    tag = 0
+    tag = -1
+    err = ""
     if comm.rank == root:
-        acceptor_rank, tag = (int(x) for x in port.split(":"))
+        try:
+            acceptor_rank, tag = (int(x) for x in port.split(":"))
+        except Exception as e:
+            tag, err = -1, f"bad port {port!r}: {e}"
     tag_arr = np.array([tag], np.int64)
     comm.Bcast(tag_arr, root=root)
+    if int(tag_arr[0]) < 0:
+        raise MPIError(ERR_ARG, err or "Comm_connect failed at the root")
     return intercomm_create(comm, root, acceptor_rank, tag=int(tag_arr[0]))
 
 

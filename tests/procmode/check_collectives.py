@@ -108,6 +108,25 @@ def main() -> int:
         assert st.Get_count(ompi_tpu.FLOAT64) == big.size
         np.testing.assert_array_equal(got, big)
 
+    # alltoallw: one int32 per peer at 4-byte displacements (the fully
+    # general exchange — per-peer datatypes + byte displs)
+    from ompi_tpu.core.datatype import INT32 as _I32
+
+    wsend = np.zeros(4 * n, np.uint8)
+    for dst in range(n):
+        wsend[4 * dst : 4 * dst + 4] = np.frombuffer(
+            np.array([r * 10 + dst], np.int32).tobytes(), np.uint8)
+    wrecv = np.zeros(4 * n, np.uint8)
+    COMM_WORLD.Alltoallw(
+        wsend, wrecv,
+        sendcounts=[1] * n, sdispls=[4 * i for i in range(n)],
+        sendtypes=[_I32] * n,
+        recvcounts=[1] * n, rdispls=[4 * i for i in range(n)],
+        recvtypes=[_I32] * n)
+    got = np.frombuffer(wrecv.tobytes(), np.int32)
+    for src in range(n):
+        assert got[src] == src * 10 + r, (got, src)
+
     COMM_WORLD.Barrier()
     ompi_tpu.Finalize()
     print(f"rank {r}: COLLECTIVES-OK", flush=True)

@@ -192,3 +192,21 @@ def test_shrink_cid_agreement_singleton():
     out = np.zeros(1, np.float64)
     shrunk.Allreduce(np.ones(1), out)
     assert out[0] == 1.0
+
+
+def test_alltoallw_singleton_mixed_types():
+    """MPI_Alltoallw: per-peer datatypes + byte displacements (the last
+    unprovided slot of the declared 17-op surface)."""
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu import COMM_WORLD
+    from ompi_tpu.core.datatype import FLOAT64, INT32
+
+    send = np.zeros(16, np.uint8)
+    send[:8] = np.frombuffer(np.array([2.5], np.float64).tobytes(),
+                             np.uint8)
+    recv = np.zeros(16, np.uint8)
+    COMM_WORLD.Alltoallw(send, recv,
+                         sendcounts=[1], sdispls=[0], sendtypes=[FLOAT64],
+                         recvcounts=[1], rdispls=[8], recvtypes=[FLOAT64])
+    assert np.frombuffer(recv[8:16].tobytes(), np.float64)[0] == 2.5
