@@ -149,13 +149,22 @@ class SmBtl(Btl):
 
     def send(self, peer: int, header: bytes, payload) -> None:
         ring = self._out_ring(peer)
+        plen = (payload.nbytes if hasattr(payload, "nbytes")
+                else len(payload) if isinstance(payload, (bytes, bytearray))
+                else memoryview(payload).nbytes)
         with self._out_lock:
             pend = self._pending.setdefault(peer, deque())
+            # A frame that can NEVER fit inline must spill regardless of
+            # queue state: queued inline it would make _flush() spin on
+            # push()==-1 forever and wedge this peer's channel.
+            if not ring.can_fit(8 + len(header) + plen):
+                self._send_overflow(ring, pend, peer, header, payload)
+                return
             if not pend:
                 rc = ring.push(self._INLINE + header, payload)
                 if rc == 1:
                     return
-                if rc < 0:
+                if rc < 0:  # unreachable after the pre-screen; keep safe
                     self._send_overflow(ring, pend, peer, header, payload)
                     return
             # ring full: queue, preserve per-peer order (tcp wbuf pattern)
@@ -164,17 +173,21 @@ class SmBtl(Btl):
                     if not hasattr(payload, "tobytes") else payload.tobytes()
             pend.append((self._INLINE + header, payload))
 
-    def _send_overflow(self, ring, pend, peer: int, header: bytes,
-                       payload) -> None:
-        """Caller holds _out_lock. Spill an over-ring-size payload to a
-        side file; the tiny marker frame keeps per-peer ordering."""
+    def _spill(self, payload) -> bytes:
+        """Write payload to a side file; return the path (marker body)."""
         fd, path = tempfile.mkstemp(
             prefix=f"ompi_tpu_ovf_r{self.my_rank}_",
             dir=os.path.dirname(self.seg_path) or None)
         with os.fdopen(fd, "wb") as f:
             f.write(payload if isinstance(payload, (bytes, bytearray))
                     else memoryview(payload).cast("B"))
-        marker = path.encode()
+        return path.encode()
+
+    def _send_overflow(self, ring, pend, peer: int, header: bytes,
+                       payload) -> None:
+        """Caller holds _out_lock. Spill an over-ring-size payload to a
+        side file; the tiny marker frame keeps per-peer ordering."""
+        marker = self._spill(payload)
         if pend or ring.push(self._OVERFLOW + header, marker) != 1:
             pend.append((self._OVERFLOW + header, marker))
 
@@ -188,10 +201,21 @@ class SmBtl(Btl):
                 ring = ring[1]
                 while pend:
                     hdr, payload = pend[0]
-                    if ring.push(hdr, payload) != 1:
-                        break
-                    pend.popleft()
-                    n += 1
+                    rc = ring.push(hdr, payload)
+                    if rc == 1:
+                        pend.popleft()
+                        n += 1
+                        continue
+                    if rc < 0 and hdr[:8] == self._INLINE:
+                        # belt-and-braces: convert in place so the channel
+                        # stays live instead of wedging (ordering kept).
+                        # Only INLINE frames convert, and only once — a
+                        # still-failing push (e.g. corrupt ring magic)
+                        # must stall here, not spin spawning spill files.
+                        pend[0] = (self._OVERFLOW + hdr[8:],
+                                   self._spill(payload))
+                        continue
+                    break  # rc == 0 (full) or unconvertible: retry later
         return n
 
     # ----------------------------------------------------------- progress

@@ -306,10 +306,9 @@ class ProcComm(Intracomm):
 
     def Probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
               status: Optional[Status] = None) -> None:
-        while not self.Iprobe(source, tag, status):
-            from ompi_tpu.runtime.progress import progress
+        from ompi_tpu.runtime.progress import progress_until
 
-            progress()
+        progress_until(lambda: self.Iprobe(source, tag, status))
 
     def Iprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
                status: Optional[Status] = None) -> bool:
@@ -323,16 +322,19 @@ class ProcComm(Intracomm):
 
     def Mprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
                status: Optional[Status] = None):
-        from ompi_tpu.runtime.progress import progress
+        from ompi_tpu.runtime.progress import progress_until
 
         wsrc = source if source == ANY_SOURCE else self._world_rank(source)
-        while True:
-            msg = self.pml.improbe(wsrc, tag, self.cid, status)
-            if msg is not None:
-                if status is not None and status.source >= 0:
-                    status.source = self.group.rank_of(status.source)
-                return msg
-            progress()
+        holder = [None]
+
+        def claimed() -> bool:
+            holder[0] = self.pml.improbe(wsrc, tag, self.cid, status)
+            return holder[0] is not None
+
+        progress_until(claimed)
+        if status is not None and status.source >= 0:
+            status.source = self.group.rank_of(status.source)
+        return holder[0]
 
     def Mrecv(self, buf, message, status: Optional[Status] = None) -> None:
         obj, count, dt = parse_buffer(buf)

@@ -20,8 +20,45 @@ from ompi_tpu.core.status import Status
 
 # Wait-loop policy: on a multicore host blocking waits spin hot (the
 # reference busy-polls in ompi_request_wait_completion); on a single core
-# spinning just burns the peer's timeslice, so yield immediately.
-_MULTICORE = (os.cpu_count() or 1) > 1
+# spinning just burns the peer's timeslice, so yield immediately. Use the
+# AFFINITY mask, not cpu_count: a rank pinned to one core of a big host
+# is effectively single-core.
+try:
+    _MULTICORE = len(os.sched_getaffinity(0)) > 1
+except AttributeError:  # non-Linux
+    _MULTICORE = (os.cpu_count() or 1) > 1
+
+
+class IdleBackoff:
+    """The ONE wait-loop yield discipline every blocking wait shares
+    (Request.Wait, Waitany, progress_until): busy-poll while events flow,
+    yield the GIL once briefly idle, back off to millisecond waits under
+    sustained idleness. A pure spin starves the peer rank on one-core
+    hosts (reference: ompi_request_wait_completion's busy-poll, tempered
+    by opal's yield_when_idle)."""
+
+    __slots__ = ("_idle_since",)
+
+    def __init__(self):
+        self._idle_since = None
+
+    def step(self, made_progress: bool, idle_wait=None) -> None:
+        """Call once per loop iteration after no-completion was observed;
+        ``idle_wait`` (seconds -> None) replaces the deep-idle sleep with
+        a condition-variable wait where one is available."""
+        if made_progress:
+            self._idle_since = None
+            return
+        now = time.monotonic()
+        if self._idle_since is None:
+            self._idle_since = now
+        idle = now - self._idle_since
+        if idle >= 0.002:
+            (idle_wait or time.sleep)(0.001)
+        elif _MULTICORE and idle < 0.0003:
+            pass  # pure spin: yields cost ~100us under load
+        else:
+            time.sleep(0)  # single core: hand the CPU to the peer
 
 
 class Request:
@@ -75,30 +112,14 @@ class Request:
         """Block until complete, driving progress (reference: request.h:451
         hot loop over opal_progress)."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        idle_since = None
+        backoff = IdleBackoff()
         while not self._complete.is_set():
             made_progress = _progress_once()
             if self._complete.is_set():
                 break
             if deadline is not None and time.monotonic() > deadline:
                 raise MPIError(ERR_PENDING, "Wait timed out")
-            if made_progress:
-                idle_since = None
-                continue
-            # Busy-poll while recently active (blocking MPI waits spin —
-            # the reference never sleeps in ompi_request_wait_completion);
-            # only after ~2ms of continuous idleness back off to the
-            # condition variable so oversubscribed ranks don't thrash.
-            now = time.monotonic()
-            if idle_since is None:
-                idle_since = now
-            idle = now - idle_since
-            if idle >= 0.002:
-                _completion_cond_wait(0.001)
-            elif _MULTICORE and idle < 0.0003:
-                pass  # pure spin: yields cost ~100us under load
-            else:
-                time.sleep(0)  # single core: hand the CPU to the peer
+            backoff.step(made_progress, _completion_cond_wait)
         self._finish(status)
 
     def _finish(self, status: Optional[Status]) -> None:
@@ -131,22 +152,13 @@ class Request:
                 status: Optional[Status] = None) -> int:
         if not requests:
             return -1
-        idle_since = None
+        backoff = IdleBackoff()
         while True:
             for i, r in enumerate(requests):
                 if r.is_complete:
                     r._finish(status)
                     return i
-            if _progress_once():
-                idle_since = None
-                continue
-            now = time.monotonic()
-            if idle_since is None:
-                idle_since = now
-            if now - idle_since < 0.002:
-                time.sleep(0)
-            else:
-                _completion_cond_wait(0.001)
+            backoff.step(_progress_once(), _completion_cond_wait)
 
     @staticmethod
     def Waitsome(requests: Sequence["Request"]) -> List[int]:

@@ -51,43 +51,97 @@ FT_CID_BIT = 1 << 25
 _TAG_SHRINK = 90
 
 
-def _agree_max_alive(pml, alive, cid: int, value: int) -> int:
+def _agree_max_alive(pml, alive, cid: int, value: int,
+                     timeout: float = 30.0) -> int:
     """MAX-agreement among the live members over direct pml exchange —
     the revoked comm's collectives are unusable, which is exactly why
     ftagree exists (reference: coll/ftagree ERA; this is the
     coordinator-based simplification over an already-shrunk live set).
-    A coordinator failure mid-agreement falls back to the local value
-    after a timeout rather than hanging."""
+
+    Failure handling (r2 advice: never silently return the local value —
+    diverging members would adopt different CIDs and hang):
+    - a contributor that dies mid-round is excluded once the detector
+      confirms it;
+    - a coordinator that dies mid-round triggers a retry with the next
+      live coordinator on fresh tags;
+    - an *undetected* stall raises MPIError after the timeout, with every
+      outstanding irecv cancelled, instead of diverging.
+
+    Known limit vs real ERA: a coordinator that dies after a PARTIAL
+    result broadcast leaves the recipients returned while the rest retry
+    a round the recipients no longer serve — those ranks raise after the
+    timeout (fail-fast, not divergence). Full mid-call consensus is
+    ft/era.py's job; this coordinator round remains only as the transport
+    for already-shrunk live sets."""
+    import time
+
     import numpy as np
 
     from ompi_tpu.core.datatype import INT64
+    from ompi_tpu.core.errors import MPIError, ERR_PENDING
+    from ompi_tpu.ft.detector import known_failed
 
-    coord = min(alive)
     plane = cid | FT_CID_BIT
-    try:
+    coords = sorted(alive)
+    for rnd, coord in enumerate(coords):
+        if coord in known_failed():
+            continue
+        tag_in = _TAG_SHRINK + 2 * rnd
+        tag_out = tag_in + 1
+        deadline = time.monotonic() + timeout
+
+        def recv_from(peer: int, tag: int, who: str):
+            """(value, None) on success, (None, 'dead') when the peer died
+            (detector-confirmed); raises on an undetected stall. A reply
+            racing the peer's detected death still counts: cancel_recv
+            returns False when the request already completed, in which
+            case the buffer holds the value."""
+            buf = np.zeros(1, np.int64)
+            req = pml.irecv(buf, 1, INT64, peer, tag, plane)
+            while True:
+                try:
+                    req.Wait(timeout=0.25)
+                    return int(buf[0]), None
+                except MPIError:
+                    if peer in known_failed():
+                        if not pml.cancel_recv(req) and not req._error:
+                            return int(buf[0]), None  # reply won the race
+                        return None, "dead"
+                    if time.monotonic() > deadline:
+                        pml.cancel_recv(req)
+                        raise MPIError(
+                            ERR_PENDING,
+                            f"shrink agreement stalled on {who} {peer}")
+
         if pml.my_rank == coord:
             vals = [value]
             for r in alive:
-                if r == coord:
+                if r == coord or r in known_failed():
                     continue
-                buf = np.zeros(1, np.int64)
-                pml.irecv(buf, 1, INT64, r, _TAG_SHRINK, plane).Wait(
-                    timeout=30.0)
-                vals.append(int(buf[0]))
+                v, dead = recv_from(r, tag_in, "rank")
+                if dead is None:
+                    vals.append(v)  # dead contributors are excluded
             agreed = max(vals)
             out = np.array([agreed], np.int64)
             for r in alive:
-                if r != coord:
-                    pml.isend(out, 1, INT64, r, _TAG_SHRINK + 1, plane)
+                if r != coord and r not in known_failed():
+                    try:
+                        pml.isend(out, 1, INT64, r, tag_out, plane)
+                    except MPIError:
+                        pass  # recipient's transport died: detector's job
             return agreed
-        pml.isend(np.array([value], np.int64), 1, INT64, coord,
-                  _TAG_SHRINK, plane)
-        buf = np.zeros(1, np.int64)
-        pml.irecv(buf, 1, INT64, coord, _TAG_SHRINK + 1, plane).Wait(
-            timeout=30.0)
-        return int(buf[0])
-    except Exception:
-        return value  # degraded: detector will catch diverging members
+        try:
+            pml.isend(np.array([value], np.int64), 1, INT64, coord,
+                      tag_in, plane)
+        except MPIError:
+            # coordinator's transport already dead (tcp marks connections
+            # dead before the detector confirms): roll to the next round
+            continue
+        v, dead = recv_from(coord, tag_out, "coordinator")
+        if dead is None:
+            return v
+        # coordinator died: next round, next coordinator
+    raise MPIError(ERR_PENDING, "shrink agreement: no live coordinator")
 
 
 def shrink_comm(comm):

@@ -48,6 +48,15 @@ class SmRing:
                 ctypes.c_char.from_buffer(mm, offset))
         # scratch buffer for native pops (one per ring, reused)
         self._scratch = np.empty(nbytes, dtype=np.uint8)
+        # capacity is a pure function of nbytes (both init paths compute
+        # (nbytes - HDR_BYTES) & ~7) — cache it so senders can pre-screen
+        # can-never-fit frames without a per-send ctypes call
+        self._cap = (nbytes - HDR_BYTES) & ~7
+
+    def can_fit(self, length: int) -> bool:
+        """Whether a frame with len(hdr)+len(payload) == ``length`` can
+        EVER fit (the exact complement of push()'s -1 condition)."""
+        return _align8(8 + length) + 8 <= self._cap
 
     # ------------------------------------------------------------ lifecycle
     def init(self) -> None:
@@ -167,7 +176,9 @@ class SmRing:
                 return None
             length = _U64.unpack_from(v, HDR_BYTES)[0]
         if length > cap:
-            raise RuntimeError("sm ring corrupt")
+            raise RuntimeError(
+                f"sm ring corrupt: len={length:#x} pos={pos} head={head} "
+                f"tail={tail} cap={cap}")
         self._peeked = length
         self._peek_tail = tail
         return v[HDR_BYTES + pos + 8 : HDR_BYTES + pos + 8 + length]
@@ -197,7 +208,9 @@ class SmRing:
                 return None
             length = _U64.unpack_from(v, HDR_BYTES)[0]
         if length > cap:
-            raise RuntimeError("sm ring corrupt")
+            raise RuntimeError(
+                f"sm ring corrupt: len={length:#x} pos={pos} head={head} "
+                f"tail={tail} cap={cap}")
         out = bytes(v[HDR_BYTES + pos + 8 : HDR_BYTES + pos + 8 + length])
         _U64.pack_into(v, 64, tail + _align8(8 + length))
         return out

@@ -183,10 +183,14 @@ class Win:
         self._lock_waiters = []
         self._lock_cond = threading.Condition()
         self.attributes: Dict[int, Any] = {}
-        # PSCW epoch state (reference: osc active target pscw)
+        # PSCW epoch state (reference: osc active target pscw). COUNTERS,
+        # not sets: back-to-back epochs can land two POST/COMPLETE notices
+        # from the same origin before the first Start/Wait consumes one —
+        # a set collapses them and the second epoch hangs (the r2
+        # test_rma_procmode liveness flake).
         self._pscw_cond = threading.Condition()
-        self._posts_received: set = set()
-        self._completes_received: set = set()
+        self._posts_received: Dict[int, int] = {}
+        self._completes_received: Dict[int, int] = {}
         self._access_group = None
         # dynamic-window regions: base -> flat uint8 view
         self.dynamic = False
@@ -412,12 +416,14 @@ class Win:
             return
         if verb == _POST:
             with self._pscw_cond:
-                self._posts_received.add(origin)
+                self._posts_received[origin] = \
+                    self._posts_received.get(origin, 0) + 1
                 self._pscw_cond.notify_all()
             return
         if verb == _COMPLETE:
             with self._pscw_cond:
-                self._completes_received.add(origin)
+                self._completes_received[origin] = \
+                    self._completes_received.get(origin, 0) + 1
                 self._pscw_cond.notify_all()
             return
         npdt = _np_from_code(dcode) if dcode else np.dtype(np.uint8)
@@ -484,16 +490,15 @@ class Win:
         """Wait for remote completion: all outstanding acks, or only
         those targeting `rank` (reference: osc/rdma's per-peer
         outstanding-ops counters, osc_rdma_comm.c:838)."""
-        from ompi_tpu.runtime.progress import progress
+        from ompi_tpu.runtime.progress import progress_until
 
-        def pending() -> bool:
+        def drained() -> bool:
             if rank is None:
-                return bool(self._outstanding)
-            return any(t == rank
-                       for _, t in list(self._outstanding.values()))
+                return not self._outstanding
+            return not any(t == rank
+                           for _, t in list(self._outstanding.values()))
 
-        while pending():
-            progress()
+        progress_until(drained)
         err = getattr(self, "_epoch_error", 0)
         if err:
             self._epoch_error = 0
@@ -580,16 +585,15 @@ class Win:
     def Start(self, group) -> None:
         """Open an access epoch to `group` (targets); blocks until every
         target's Post notice arrives (MPI allows Start to block)."""
-        from ompi_tpu.runtime.progress import progress
+        from ompi_tpu.runtime.progress import progress_until
 
         self._access_group = self._comm_ranks(group)
-        want = set(self._access_group)
-        while True:
-            with self._pscw_cond:
-                if want.issubset(self._posts_received):
-                    self._posts_received -= want
-                    return
-            progress()
+        want = list(self._access_group)
+        progress_until(lambda: all(
+            self._posts_received.get(r, 0) > 0 for r in want))
+        with self._pscw_cond:
+            for r in want:
+                self._posts_received[r] -= 1
 
     def Complete(self) -> None:
         """End the access epoch: remote-complete every op, then notify
@@ -603,25 +607,25 @@ class Win:
 
     def Wait(self) -> None:
         """End the exposure epoch: block until every origin Completed."""
-        from ompi_tpu.runtime.progress import progress
+        from ompi_tpu.runtime.progress import progress_until
 
-        want = set(getattr(self, "_post_group", []))
-        while True:
-            with self._pscw_cond:
-                if want.issubset(self._completes_received):
-                    self._completes_received -= want
-                    return
-            progress()
+        want = list(getattr(self, "_post_group", []))
+        progress_until(lambda: all(
+            self._completes_received.get(r, 0) > 0 for r in want))
+        with self._pscw_cond:
+            for r in want:
+                self._completes_received[r] -= 1
 
     def Test(self) -> bool:
         """Nonblocking Wait (MPI_Win_test)."""
         from ompi_tpu.runtime.progress import progress
 
         progress()
-        want = set(getattr(self, "_post_group", []))
+        want = list(getattr(self, "_post_group", []))
         with self._pscw_cond:
-            if want.issubset(self._completes_received):
-                self._completes_received -= want
+            if all(self._completes_received.get(r, 0) > 0 for r in want):
+                for r in want:
+                    self._completes_received[r] -= 1
                 return True
         return False
 

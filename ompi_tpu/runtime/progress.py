@@ -58,6 +58,29 @@ def progress() -> int:
     return n
 
 
+import time as _time
+
+
+def progress_until(pred: Callable[[], bool],
+                   timeout: float | None = None) -> bool:
+    """Drive progress() until ``pred()`` holds, yielding per the shared
+    IdleBackoff discipline. Every blocking wait outside Request.Wait must
+    funnel through here — a pure ``while: progress()`` spin starves the
+    peer rank on one-core hosts (r2 lesson; reference: the single
+    opal_progress() loop all waits share, opal_progress.c:216)."""
+    if pred():
+        return True
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    backoff = _request.IdleBackoff()
+    while True:
+        made = progress()
+        if pred():
+            return True
+        if deadline is not None and _time.monotonic() > deadline:
+            return False
+        backoff.step(made)
+
+
 _request._bind_progress(progress)
 
 

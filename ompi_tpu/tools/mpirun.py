@@ -70,18 +70,37 @@ def main(argv: List[str] | None = None) -> int:
             env["OMPI_TPU_RANK"] = str(rank)
             procs.append(subprocess.Popen(
                 [sys.executable, opts.program, *opts.args], env=env))
+        # Poll ALL children: the first abnormal exit tears down the whole
+        # job immediately (reference: prterun kills the job on abnormal
+        # termination) — waiting rank-by-rank would let a peer blocked on
+        # the dead rank hang until the full job timeout.
+        import time
+
         rc = 0
-        for p in procs:
-            try:
-                code = p.wait(timeout=opts.timeout)
-            except subprocess.TimeoutExpired:
-                code = 124
-            if code != 0 and rc == 0:
-                rc = code
+        deadline = time.monotonic() + opts.timeout
+        remaining = set(range(opts.np))
+        while remaining:
+            for i in list(remaining):
+                code = procs[i].poll()
+                if code is not None:
+                    remaining.discard(i)
+                    if code != 0 and rc == 0:
+                        rc = code
+            if rc != 0:
+                break
+            if time.monotonic() > deadline:
+                rc = 124
+                break
+            if remaining:
+                time.sleep(0.05)
         if rc != 0:
             for p in procs:
                 if p.poll() is None:
                     p.send_signal(signal.SIGTERM)
+            grace = time.monotonic() + 2.0
+            while (any(p.poll() is None for p in procs)
+                   and time.monotonic() < grace):
+                time.sleep(0.05)
         return rc
     finally:
         for p in procs:

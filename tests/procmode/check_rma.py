@@ -30,6 +30,8 @@ def main() -> int:
     if r == 1:
         for i in range(16):
             assert base[4 * i] == float(i + 1), (i, base[4 * i])
+    # close the read epoch before the next block RMAs the same bytes
+    win.Fence()
 
     # ---- Rput/Rget requests
     if r == 0:
@@ -41,19 +43,23 @@ def main() -> int:
         np.testing.assert_array_equal(got, [99.0, 99.0])
     win.Fence()
 
-    # ---- PSCW: rank 0 origin, rank 1 target
+    # ---- PSCW: rank 0 origin, rank 1 target — BACK-TO-BACK epochs with
+    # no intervening barrier: a second POST/COMPLETE notice may arrive
+    # before the first Start/Wait consumes one, which must not be lost
+    # (regression: the r2 set-collapse liveness flake)
     g_other = Group([COMM_WORLD._world_rank(other)])
     if r == 1:
         base[:] = 0
     win.Fence()
-    if r == 0:
-        win.Start(g_other)
-        win.Put(np.full(3, 7.5), target=1, target_disp=8)
-        win.Complete()
-    else:
-        win.Post(g_other)
-        win.Wait()
-        np.testing.assert_array_equal(base[8:11], [7.5] * 3)
+    for epoch in range(8):
+        if r == 0:
+            win.Start(g_other)
+            win.Put(np.full(3, 7.5 + epoch), target=1, target_disp=8)
+            win.Complete()
+        else:
+            win.Post(g_other)
+            win.Wait()
+            np.testing.assert_array_equal(base[8:11], [7.5 + epoch] * 3)
 
     # ---- passive target: lock_all + accumulate from both sides
     win.Fence()

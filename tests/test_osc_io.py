@@ -278,3 +278,29 @@ def test_rma_procmode():
     r = run_mpi(2, "tests/procmode/check_rma.py")
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("RMA-OK") == 2
+
+
+def test_pscw_notices_are_counted_not_collapsed():
+    """Two epochs' POST/COMPLETE notices from the same origin arriving
+    before any Start/Wait consumes one must both survive (r2 flake: set
+    semantics collapsed them and the second epoch hung)."""
+    from ompi_tpu.core.group import Group
+    from ompi_tpu.osc.window import Win
+    from ompi_tpu.runtime.progress import progress_until
+
+    base = np.zeros(4, np.float64)
+    win = Win.Create(base, COMM_WORLD)
+    g = Group([COMM_WORLD._world_rank(0)])
+    win.Post(g)
+    win.Post(g)
+    assert progress_until(
+        lambda: win._posts_received.get(0, 0) >= 2, timeout=10)
+    win.Start(g)
+    win.Complete()
+    win.Start(g)  # consumes the second notice; with sets this hung
+    win.Complete()
+    assert progress_until(
+        lambda: win._completes_received.get(0, 0) >= 2, timeout=10)
+    win.Wait()
+    win.Wait()
+    win.Free()

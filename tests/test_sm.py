@@ -12,6 +12,7 @@ import pytest
 
 from ompi_tpu.native import get_lib
 from ompi_tpu.native.ring import HDR_BYTES, SmRing
+from ompi_tpu.pml.base import HDR_SIZE as HDR_BYTES_PML
 from tests.test_process_mode import run_mpi
 
 NATIVE = get_lib() is not None
@@ -105,6 +106,51 @@ def test_ring_numpy_payload(ring):
     assert ring.push(b"NP", arr) == 1
     f = ring.pop()
     np.testing.assert_array_equal(np.frombuffer(f[2:], np.float64), arr)
+
+
+def test_sm_oversized_frame_with_backlog():
+    """An over-ring-size frame sent while the pending queue is non-empty
+    must spill to the overflow path, not queue inline — an inline frame
+    that can never fit would wedge _flush() and the peer's channel
+    forever (r2 advisor finding)."""
+    from ompi_tpu.btl.sm import SmBtl
+    from ompi_tpu.mca.var import get_var, set_var
+
+    saved = get_var("btl_sm", "ring_bytes")
+    set_var("btl_sm", "ring_bytes", 4096)
+    got = []
+    try:
+        a = SmBtl(lambda h, p: None, my_rank=0, n_ranks=2)
+        b = SmBtl(lambda h, p: got.append((bytes(h), bytes(p))),
+                  my_rank=1, n_ranks=2)
+        try:
+            a.set_peers({1: b.seg_path})
+            b.set_peers({0: a.seg_path})
+            small = b"s" * 512
+            hdr = b"H" * HDR_BYTES_PML
+            # fill the tiny ring until sends start queueing
+            for i in range(16):
+                a.send(1, hdr, small)
+            assert a._pending[1], "expected a backlog for this test"
+            big = b"B" * 16384  # can never fit a 4KB ring
+            a.send(1, hdr, big)
+            tail = b"t" * 100
+            a.send(1, hdr, tail)
+            for _ in range(200):
+                a.progress()
+                b.progress()
+                if len(got) == 18:
+                    break
+            payloads = [p for _, p in got]
+            assert len(got) == 18, f"only {len(got)} frames delivered"
+            assert payloads[:16] == [small] * 16
+            assert payloads[16] == big  # ordered, via overflow spill
+            assert payloads[17] == tail
+        finally:
+            a.finalize()
+            b.finalize()
+    finally:
+        set_var("btl_sm", "ring_bytes", saved)
 
 
 # ---------------------------------------------------------- multi-rank

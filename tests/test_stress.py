@@ -84,3 +84,35 @@ def test_pml_monitoring_matrix():
     assert "pml_monitoring rank 1 recv:" in r.stderr
     # the ring sends at least one message each way
     assert "/8B" in r.stderr or "B" in r.stderr
+
+
+def test_rma_procmode_under_load():
+    """r2 flake repro harness: the 2-rank RMA check must finish even when
+    CPU burners oversubscribe ONE core — every blocking wait has to
+    yield, never pure-spin (reference: the shared opal_progress loop,
+    opal_progress.c:216). Everything is pinned to a single CPU so the
+    oversubscription is real on multi-core hosts too."""
+    import os
+    import subprocess
+    import sys
+
+    cpu = min(os.sched_getaffinity(0))
+    pin = ["taskset", "-c", str(cpu)]
+    burners = [subprocess.Popen(pin + [sys.executable, "-c",
+                                       "while True:\n    pass"])
+               for _ in range(2)]
+    try:
+        # run_mpi goes through the launcher, so pin this test's own
+        # affinity and let the children inherit it
+        saved = os.sched_getaffinity(0)
+        os.sched_setaffinity(0, {cpu})
+        try:
+            r = run_mpi(2, "tests/procmode/check_rma.py", timeout=110)
+        finally:
+            os.sched_setaffinity(0, saved)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count("RMA-OK") == 2
+    finally:
+        for b in burners:
+            b.kill()
+            b.wait()
