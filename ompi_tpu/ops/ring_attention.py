@@ -93,30 +93,90 @@ def _block_attend(q, k, v, keep_full, keep_tri, sm_scale, mxu_dtype,
     return acc, m, den
 
 
+NEG_BIG = -1e30
+
+
+def _lax_block(q, k, v, keep_full, keep_tri, sm_scale, mxu_dtype,
+               chunk: int):
+    """(out, lse) via the chunked lax path — the portable fallback behind
+    the Pallas kernel (ops/flash_attention.py), sharing its contract:
+    normalized out [B,Tq,H,D] f32 + lse [B,H,Tq] f32 with -1e30 empty
+    sentinel."""
+    import jax.numpy as jnp
+
+    acc, m, den = _block_attend(q, k, v, keep_full, keep_tri, sm_scale,
+                                mxu_dtype, chunk)
+    # epsilon must survive SQUARING in f32 (the division VJP computes
+    # -g*acc/den^2; (1e-30)^2 underflows to 0 and births NaNs on
+    # fully-masked rows). Any attended row has den >= 1, so 1e-9 is free.
+    out = acc / jnp.maximum(_bhq_to_bqh1(den), 1e-9)
+    lse = jnp.where(den > 0.0,
+                    jnp.where(jnp.isneginf(m), NEG_BIG, m) + jnp.log(
+                        jnp.maximum(den, 1e-9)),
+                    NEG_BIG)
+    return out, lse
+
+
+def use_flash_default(q_shape, k_shape, layout: str = "bthd") -> bool:
+    """Pick the Pallas kernel when running on a real TPU and the shapes
+    tile cleanly; the lax path covers everything else (CPU meshes, odd
+    shapes)."""
+    import jax
+
+    from ompi_tpu.ops.flash_attention import flash_supported
+
+    try:
+        kind = getattr(jax.devices()[0], "device_kind", "")
+    except Exception:
+        return False
+    return "TPU" in str(kind).upper() and flash_supported(q_shape, k_shape,
+                                                          layout)
+
+
 def ring_attention(q, k, v, axis_name: str, sp_size: int,
                    sm_scale: Optional[float] = None, causal: bool = True,
-                   mxu_dtype=None, chunk: int = 512):
+                   mxu_dtype=None, chunk: int = 512,
+                   use_flash: Optional[bool] = None,
+                   layout: str = "bthd"):
     """Sequence-parallel attention inside shard_map.
 
-    q, k, v: local shards [B, S/sp, H, D] on each device of the ``axis_name``
-    ring (sp_size devices). Returns the local output shard [B, S/sp, H, D].
-    ``mxu_dtype=jnp.bfloat16`` runs both attention matmuls at MXU rate
-    with f32 accumulation (None = exact f32 math); ``chunk`` bounds the
-    KV tile each flash step scores against.
+    q, k, v: local shards on each device of the ``axis_name`` ring
+    (sp_size devices) — [B, S/sp, H, D] with layout 'bthd' (default) or
+    [B, H, S/sp, D] with layout 'bhtd' (the kernel-native fast path: no
+    transposes are emitted). Returns the local output shard in the input
+    layout. Each ring step computes one Q-shard x KV-shard block pair —
+    through the Pallas flash kernel on TPU (ops/flash_attention.py) or
+    the chunked lax path elsewhere — and merges the partials in
+    (out, lse) space, the flash-style log-sum-exp combine.
+    ``mxu_dtype=jnp.bfloat16`` runs the lax path's matmuls at MXU rate
+    (the kernel is always bf16-MXU with f32 accumulation); ``chunk``
+    bounds the lax path's KV tile.
     """
     import jax.numpy as jnp
     from jax import lax
 
-    B, T, H, D = q.shape
+    if layout == "bhtd":
+        B, H, T, D = q.shape
+    else:
+        B, T, H, D = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(D))
+    if use_flash is None:
+        use_flash = use_flash_default(q.shape, k.shape, layout)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
 
-    # running flash accumulators
-    acc = jnp.zeros_like(q, dtype=jnp.float32)          # numerator
-    m = jnp.full((B, H, T), -jnp.inf, dtype=jnp.float32)  # running max
-    den = jnp.zeros((B, H, T), dtype=jnp.float32)        # running denom
+    def lift(lse_bht):
+        """[B,H,T] row stats broadcast against the output layout."""
+        if layout == "bhtd":
+            return lse_bht[..., None]
+        return _bhq_to_bqh1(lse_bht)
+
+    # running (out, lse) accumulators — vzero makes the carry vary over
+    # the ring axis for shard_map's replication checker
+    vzero = q.reshape(-1)[0].astype(jnp.float32) * 0.0
+    out = jnp.zeros(q.shape, jnp.float32) + vzero
+    lse = jnp.full((B, H, T), NEG_BIG, jnp.float32) + vzero
 
     kv = (k, v)
 
@@ -130,23 +190,30 @@ def ring_attention(q, k, v, axis_name: str, sp_size: int,
         else:
             keep_full = jnp.bool_(True)
             keep_tri = jnp.bool_(False)
-        num_p, m_p, den_p = _block_attend(
-            q, k_blk, v_blk, keep_full, keep_tri, sm_scale, mxu_dtype,
-            chunk)
-        # merge partial into running accumulators (log-sum-exp rescaling)
-        m_new = jnp.maximum(m, m_p)
-        safe = lambda x: jnp.where(jnp.isneginf(x), 0.0, x)
-        alpha = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf,
-                                  m - safe(m_new)))
-        beta = jnp.exp(jnp.where(jnp.isneginf(m_p), -jnp.inf,
-                                 m_p - safe(m_new)))
-        acc = acc * _bhq_to_bqh1(alpha) + num_p * _bhq_to_bqh1(beta)
-        den = den * alpha + den_p * beta
-        m = m_new
+        if use_flash:
+            from ompi_tpu.ops.flash_attention import flash_block
+
+            o_p, lse_p = flash_block(q, k_blk, v_blk, keep_full, keep_tri,
+                                     sm_scale, layout=layout)
+        elif layout == "bhtd":
+            # lax fallback is bthd-native; transpose at the boundary
+            tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+            o_p, lse_p = _lax_block(tr(q), tr(k_blk), tr(v_blk),
+                                    keep_full, keep_tri, sm_scale,
+                                    mxu_dtype, chunk)
+            o_p = tr(o_p)
+        else:
+            o_p, lse_p = _lax_block(q, k_blk, v_blk, keep_full, keep_tri,
+                                    sm_scale, mxu_dtype, chunk)
+        # log-sum-exp merge of normalized partials (all finite: -1e30
+        # sentinel keeps the exps and their gradients NaN-free)
+        lse_new = jnp.logaddexp(lse, lse_p)
+        out = (out * lift(jnp.exp(lse - lse_new)) +
+               o_p * lift(jnp.exp(lse_p - lse_new)))
+        lse = lse_new
         if step != sp_size - 1:
             kv = lax.ppermute(kv, axis_name, perm)
 
-    out = acc / jnp.maximum(_bhq_to_bqh1(den), 1e-30)
     return out.astype(q.dtype)
 
 

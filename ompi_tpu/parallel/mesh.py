@@ -78,6 +78,7 @@ class XlaComm(Intracomm):
         super().__init__(Group(range(self.world_size)), cid,
                          name or f"mesh-comm-{cid}")
         self._jit_cache = {}
+        self._fast_allreduce = {}  # op.uid -> compiled fn (hot path)
         from ompi_tpu.coll.base import select_coll
 
         self.coll = select_coll(self)
@@ -142,22 +143,35 @@ class XlaComm(Intracomm):
         return self.coll.get(name)
 
     def allreduce(self, x, op: _op.Op = _op.SUM):
-        # hot path: one dict hit to the compiled executable (the per-comm
-        # fn-table pointer chase of the reference, minus everything else)
+        # hot path: ONE plain-int dict hit to the compiled executable (the
+        # per-comm fn-table pointer chase of the reference, minus
+        # everything else) — the r2 bench showed the 32KB point paying
+        # ~9us of Python prologue per call, so everything else (usability
+        # check, tuple key build, module imports) lives on the miss path
+        fn = self._fast_allreduce.get(op.uid)
+        if fn is not None and not self.revoked:
+            spc.record("allreduce")
+            if op.name in _op.PAIR_OPS:
+                from ompi_tpu.coll.xla import _check_device_op
+
+                _check_device_op(op, x)
+            return fn(x)
+        return self._allreduce_slow(x, op)
+
+    def _allreduce_slow(self, x, op: _op.Op):
         self._check_usable()
-        from ompi_tpu.coll.xla import cache_key
+        from ompi_tpu.coll.xla import cache_key, _check_device_op
 
         spc.record("allreduce")
         if op.name in _op.PAIR_OPS:
             # the cached executable retraces per shape, so the pair-layout
             # contract must hold on every call, not just the first
-            from ompi_tpu.coll.xla import _check_device_op
-
             _check_device_op(op, x)
+        out = self.coll.get("allreduce")(self, x, op)
         fn = self._jit_cache.get(cache_key("allreduce", op))
         if fn is not None:
-            return fn(x)
-        return self.coll.get("allreduce")(self, x, op)
+            self._fast_allreduce[op.uid] = fn
+        return out
 
     def reduce(self, x, op: _op.Op = _op.SUM, root: int = 0):
         self._check_root(root)
@@ -385,6 +399,7 @@ class XlaComm(Intracomm):
     def Free(self) -> None:
         self._delete_all_attrs()
         self._jit_cache.clear()
+        self._fast_allreduce.clear()
         self.coll = None
 
 

@@ -93,9 +93,12 @@ def test_rma_procmode_under_load():
     opal_progress.c:216). Everything is pinned to a single CPU so the
     oversubscription is real on multi-core hosts too."""
     import os
+    import shutil
     import subprocess
     import sys
 
+    if not hasattr(os, "sched_getaffinity") or not shutil.which("taskset"):
+        pytest.skip("needs Linux CPU affinity + taskset")
     cpu = min(os.sched_getaffinity(0))
     pin = ["taskset", "-c", str(cpu)]
     burners = [subprocess.Popen(pin + [sys.executable, "-c",
