@@ -31,20 +31,6 @@ import sys
 import time
 
 
-def _timed(fn, args, warmup=3, iters=15):
-    import jax
-
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    ts = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        ts.append(time.perf_counter() - t0)
-    ts.sort()
-    return ts[len(ts) // 2]
-
-
 def _paired_times(fn_a, fn_b, args, warmup: int = 5, iters: int = 30):
     """Interleave timings of two implementations so clock/tunnel drift
     cancels; returns (median_a, median_b) over per-round samples."""
@@ -185,7 +171,7 @@ def bench_verbs(world, n):
         world.axis))
     t_ours = _chained_time(world, lambda a: world.bcast(a, 0), x, 10, rtt)
     t_raw = _chained_time(world, raw_bc, x, 10, rtt)
-    res["bcast_16MB"] = {"ours_s": round(t_ours, 5),
+    res["bcast_16MB_total"] = {"ours_s": round(t_ours, 5),
                          "raw_s": round(t_raw, 5),
                          "fraction": round(t_raw / t_ours, 4)}
 

@@ -24,12 +24,12 @@ per-comm tag counter, nbc_internal.h SCHED tag logic).
 from __future__ import annotations
 
 import threading
-from typing import Generator, List, Optional, Sequence, Tuple
+from typing import Callable, Generator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ompi_tpu.core.datatype import BYTE
-from ompi_tpu.core.errors import MPIError
+from ompi_tpu.core.errors import MPIError, ERR_REQUEST
 from ompi_tpu.core.request import Request
 
 # Distinct CID plane per traffic class: COLL_CID_BIT = 1<<30 (coll/basic),
@@ -158,6 +158,56 @@ class NbcRequest(Request):
                 return  # the last callback will re-enter _advance
 
 
+class PersistentCollRequest(Request):
+    """Persistent collective (MPI_Allreduce_init & co, MPI-4).
+
+    Reference: ompi/mca/coll/coll.h:545-620 declares the *_init third of the
+    triple surface; libnbc builds the schedule at init and replays it per
+    Start. Here ``issue`` is a thunk capturing the buffers/op/root that
+    builds and launches a fresh NbcRequest per Start — the generator *is*
+    the schedule, so replay == regenerate. Tag consistency across ranks
+    holds because MPI requires persistent starts (like every collective) to
+    be identically ordered on all members, so the per-comm NBC sequence
+    counter stays aligned."""
+
+    def __init__(self, issue: Callable[[], Request]):
+        super().__init__()
+        self.persistent = True
+        self._issue = issue
+        # Active state is distinct from completion: the request stays
+        # *active* from Start until Wait/Test collects it, even though the
+        # inner schedule may have completed microseconds after Start (MPI
+        # 3.0 §3.9: a started persistent request must be completed by a
+        # completion call before it can be restarted).
+        self._active = False
+        self._complete.set()  # inactive == complete (MPI semantics)
+
+    def Start(self) -> "PersistentCollRequest":
+        if self._active:
+            raise MPIError(ERR_REQUEST,
+                           "persistent collective already active")
+        self._active = True
+        self._complete.clear()
+        self._error = 0
+        inner = self._issue()
+
+        def done(r):
+            self.status = r.status
+            self._set_complete(r._error)
+
+        inner.add_completion_callback(done)
+        return self
+
+    def _finish(self, status) -> None:
+        self._active = False
+        super()._finish(status)
+
+    @staticmethod
+    def Startall(requests) -> None:
+        for r in requests:
+            r.Start()
+
+
 class JaxRequest(Request):
     """Mesh-path nonblocking collective: the jitted executable has been
     dispatched (jax dispatch is asynchronous); the request completes when
@@ -167,6 +217,9 @@ class JaxRequest(Request):
         super().__init__()
         self.result = result
         self._set_dispatch_complete()
+
+    def Start(self):
+        raise MPIError(ERR_REQUEST, "not a persistent request")
 
     def _set_dispatch_complete(self):
         # Completion flag tracks device readiness lazily: Test polls
@@ -211,3 +264,47 @@ class JaxRequest(Request):
         if not self._complete.is_set():
             self._set_complete(0)
         self._finish(status)
+
+
+class MeshPersistentRequest(JaxRequest):
+    """Persistent mesh collective (Allreduce_init & co on XlaComm).
+
+    The TPU-native reading of MPI-4 persistence: the setup that init
+    amortizes is trace+compile — XlaComm's init methods run one warm-up
+    dispatch so every Start is a cached-executable dispatch only. jax
+    operands are immutable, so "re-reads the buffer at Start" becomes an
+    optional fresh operand argument (same shape/dtype/sharding triggers no
+    retrace); omitted, the init-time operand is re-run. ``result`` holds
+    the latest Start's output once Wait/Test observes completion."""
+
+    def __init__(self, comm, dispatch, x):
+        Request.__init__(self)
+        self.persistent = True
+        self._comm = comm
+        self._dispatch = dispatch
+        self._x = x
+        self._active = False
+        self.result = None
+        self._complete.set()  # inactive == complete
+
+    def Start(self, x=None):
+        if self._active:
+            raise MPIError(ERR_REQUEST,
+                           "persistent collective already active")
+        self._comm._check_usable()  # revoked comms must not dispatch
+        self._active = True
+        if x is not None:
+            self._x = x
+        self._complete.clear()
+        self._error = 0
+        self.result = self._dispatch(self._x)
+        return self
+
+    def _finish(self, status) -> None:
+        self._active = False
+        super()._finish(status)
+
+    @staticmethod
+    def Startall(requests) -> None:
+        for r in requests:
+            r.Start()

@@ -501,6 +501,69 @@ class ProcComm(Intracomm):
     def Iexscan(self, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> Request:
         return self._coll("iexscan")(self, sendbuf, recvbuf, op)
 
+    # ------------------------------------------- persistent collectives
+    # MPI-4's third of the coll triple surface (reference:
+    # ompi/mca/coll/coll.h:545-620 *_init slots). Each init fixes the
+    # buffers/op/root and returns an inactive persistent request; every
+    # Start replays the schedule against the *current* buffer contents
+    # (the thunk rebuilds the round generator — see
+    # coll/sched.PersistentCollRequest).
+    def _pcoll(self, slot: str, *args) -> Request:
+        from ompi_tpu.coll.sched import PersistentCollRequest
+
+        self._check_usable()
+        issue = self.coll.get(slot)
+
+        def start_issue():
+            self._check_usable()  # a revoked comm must fail at Start too
+            return issue(self, *args)
+
+        return PersistentCollRequest(start_issue)
+
+    def Barrier_init(self) -> Request:
+        return self._pcoll("ibarrier")
+
+    def Bcast_init(self, buf, root: int = 0) -> Request:
+        self._check_root(root)
+        return self._pcoll("ibcast", buf, root)
+
+    def Reduce_init(self, sendbuf, recvbuf, op: _op.Op = _op.SUM,
+                    root: int = 0) -> Request:
+        self._check_root(root)
+        return self._pcoll("ireduce", sendbuf, recvbuf, op, root)
+
+    def Allreduce_init(self, sendbuf, recvbuf,
+                       op: _op.Op = _op.SUM) -> Request:
+        return self._pcoll("iallreduce", sendbuf, recvbuf, op)
+
+    def Allgather_init(self, sendbuf, recvbuf) -> Request:
+        return self._pcoll("iallgather", sendbuf, recvbuf)
+
+    def Allgatherv_init(self, sendbuf, recvbuf, counts,
+                        displs=None) -> Request:
+        return self._pcoll("iallgatherv", sendbuf, recvbuf, counts, displs)
+
+    def Alltoall_init(self, sendbuf, recvbuf) -> Request:
+        return self._pcoll("ialltoall", sendbuf, recvbuf)
+
+    def Gather_init(self, sendbuf, recvbuf, root: int = 0) -> Request:
+        self._check_root(root)
+        return self._pcoll("igather", sendbuf, recvbuf, root)
+
+    def Scatter_init(self, sendbuf, recvbuf, root: int = 0) -> Request:
+        self._check_root(root)
+        return self._pcoll("iscatter", sendbuf, recvbuf, root)
+
+    def Reduce_scatter_block_init(self, sendbuf, recvbuf,
+                                  op: _op.Op = _op.SUM) -> Request:
+        return self._pcoll("ireduce_scatter_block", sendbuf, recvbuf, op)
+
+    def Scan_init(self, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> Request:
+        return self._pcoll("iscan", sendbuf, recvbuf, op)
+
+    def Exscan_init(self, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> Request:
+        return self._pcoll("iexscan", sendbuf, recvbuf, op)
+
     # ------------------------------------------------------ comm management
     def _alloc_cid(self) -> int:
         """Agree on a fresh CID: MAX-allreduce of the local next-free id

@@ -66,7 +66,7 @@ def _sds(shape, dtype, vma):
 
 
 def _pick_blocks(tq: int, tk: int) -> Tuple[int, int]:
-    """Largest power-of-two tiles <= (256, 512) that divide the shards
+    """Largest power-of-two tiles <= (512, 1024) that divide the shards
     (MXU-friendly: multiples of 128 when the sequence allows)."""
     bq = 512
     while bq > 1 and tq % bq:

@@ -252,6 +252,50 @@ class XlaComm(Intracomm):
         self.barrier()
         return CompletedRequest()
 
+    # ------------------------------------ persistent collectives (X_init)
+    # MPI-4's third of the triple surface, TPU-native: the setup that
+    # persistence amortizes is trace+compile. init runs one warm-up
+    # dispatch (populating the per-comm jit cache), so every Start is a
+    # cached-executable dispatch; Wait blocks on device readiness.
+    # Reference: ompi/mca/coll/coll.h:545-620 *_init slots.
+    def _pcoll_init(self, verb: str, x, *args):
+        from ompi_tpu.coll.sched import MeshPersistentRequest
+
+        fn = getattr(self, verb)
+        fn(x, *args)  # warm-up: trace+compile now, dispatch-only later
+        return MeshPersistentRequest(self, lambda op_x: fn(op_x, *args), x)
+
+    def allreduce_init(self, x, op: _op.Op = _op.SUM):
+        return self._pcoll_init("allreduce", x, op)
+
+    def bcast_init(self, x, root: int = 0):
+        return self._pcoll_init("bcast", x, root)
+
+    def reduce_init(self, x, op: _op.Op = _op.SUM, root: int = 0):
+        return self._pcoll_init("reduce", x, op, root)
+
+    def allgather_init(self, x):
+        return self._pcoll_init("allgather", x)
+
+    def alltoall_init(self, x):
+        return self._pcoll_init("alltoall", x)
+
+    def reduce_scatter_init(self, x, op: _op.Op = _op.SUM):
+        return self._pcoll_init("reduce_scatter", x, op)
+
+    def scan_init(self, x, op: _op.Op = _op.SUM):
+        return self._pcoll_init("scan", x, op)
+
+    def exscan_init(self, x, op: _op.Op = _op.SUM):
+        return self._pcoll_init("exscan", x, op)
+
+    Allreduce_init = allreduce_init
+    Bcast_init = bcast_init
+    Reduce_init = reduce_init
+    Allgather_init = allgather_init
+    Alltoall_init = alltoall_init
+    Reduce_scatter_init = reduce_scatter_init
+
     # ------------------------------------------------------------- pt2pt
     def permute(self, x, perm: Sequence[Tuple[int, int]]):
         """Tag-free pt2pt: move rank-rows along (src, dst) pairs in comm

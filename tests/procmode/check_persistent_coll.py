@@ -1,0 +1,99 @@
+"""Multi-rank persistent-collective correctness under mpirun.
+
+Reference: the MPI-4 *_init surface (ompi/mca/coll/coll.h:545-620) —
+init once, Start/Wait repeatedly; each Start re-reads the buffers."""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.core.errors import MPIError
+from ompi_tpu.coll.sched import PersistentCollRequest
+
+
+def main() -> int:
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+
+    # Allreduce_init: three Starts, mutating the send buffer between them
+    send = np.zeros(4, np.float64)
+    recv = np.zeros(4, np.float64)
+    areq = COMM_WORLD.Allreduce_init(send, recv)
+    assert areq.is_complete  # inactive == complete
+    for k in range(1, 4):
+        send[:] = float(r + k)
+        areq.Start()
+        areq.Wait()
+        expect = sum(i + k for i in range(n))
+        assert recv[0] == expect and recv[-1] == expect, (k, recv)
+
+    # double-Start without Wait must raise
+    areq.Start()
+    try:
+        areq.Start()
+        raise AssertionError("double Start did not raise")
+    except MPIError:
+        pass
+    areq.Wait()
+
+    # Bcast_init from nonzero root, restarted with fresh root data
+    buf = np.zeros(3, np.int64)
+    breq = COMM_WORLD.Bcast_init(buf, root=n - 1)
+    for k in (5, 9):
+        if r == n - 1:
+            buf[:] = k
+        else:
+            buf[:] = -1
+        breq.Start()
+        breq.Wait()
+        assert buf[0] == k and buf[-1] == k, (k, buf)
+
+    # Barrier_init + Startall semantics across two persistent requests
+    barr = COMM_WORLD.Barrier_init()
+    g = np.zeros(n, np.int32)
+    greq = COMM_WORLD.Allgather_init(np.array([r], np.int32), g)
+    PersistentCollRequest.Startall([barr, greq])
+    barr.Wait()
+    greq.Wait()
+    assert list(g) == list(range(n)), g
+
+    # Reduce_init at root 0 with MAX, twice
+    ro = np.zeros(1, np.int64)
+    rreq = COMM_WORLD.Reduce_init(np.array([r], np.int64), ro,
+                                  op=mpi_op.MAX, root=0)
+    for _ in range(2):
+        rreq.Start()
+        rreq.Wait()
+        if r == 0:
+            assert ro[0] == n - 1, ro
+
+    # Scan_init replay
+    sc = np.zeros(1, np.int64)
+    sreq = COMM_WORLD.Scan_init(np.array([r + 1], np.int64), sc)
+    sreq.Start()
+    sreq.Wait()
+    assert sc[0] == (r + 1) * (r + 2) // 2, sc
+
+    # interleave a persistent start with a plain nonblocking collective:
+    # both ride the NBC plane; identical call order on all ranks keeps
+    # the per-comm sequence tags aligned
+    a1 = np.zeros(1, np.float32)
+    areq2 = COMM_WORLD.Allreduce_init(np.full(1, float(r), np.float32), a1)
+    a2 = np.zeros(n, np.int32)
+    areq2.Start()
+    ireq = COMM_WORLD.Iallgather(np.array([r * 2], np.int32), a2)
+    areq2.Wait()
+    ireq.Wait()
+    assert a1[0] == n * (n - 1) / 2 and list(a2) == [2 * i for i in range(n)]
+
+    COMM_WORLD.Barrier()
+    ompi_tpu.Finalize()
+    print(f"rank {r}: PCOLL-OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

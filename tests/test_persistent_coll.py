@@ -1,0 +1,93 @@
+"""Persistent collectives (MPI-4 *_init): process mode + mesh mode.
+
+Reference: ompi/mca/coll/coll.h:545-620 — the third of the triple
+surface. Host comms replay libnbc-style round schedules per Start
+(coll/sched.PersistentCollRequest); mesh comms amortize trace+compile at
+init and dispatch the cached executable per Start
+(coll/sched.MeshPersistentRequest)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.core.errors import MPIError
+from ompi_tpu.parallel import mesh_world
+from tests.test_process_mode import run_mpi
+
+W = 8
+
+
+@pytest.fixture(scope="module")
+def world():
+    assert jax.device_count() >= W
+    return mesh_world(jax.devices()[:W])
+
+
+# ------------------------------------------------------------ process mode
+@pytest.mark.parametrize("np_", [2, 3])
+def test_persistent_procmode(np_):
+    r = run_mpi(np_, "tests/procmode/check_persistent_coll.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("PCOLL-OK") == np_
+
+
+# ---------------------------------------------------------------- mesh mode
+def _ranked(k=0):
+    base = np.arange(4, dtype=np.float32) + k
+    return np.stack([base + r for r in range(W)])
+
+
+def test_mesh_allreduce_init_restart(world):
+    req = world.allreduce_init(world.shard(_ranked()))
+    assert req.persistent and req.is_complete  # inactive
+    for k in (0, 3, 7):
+        req.Start(world.shard(_ranked(k)))
+        req.Wait()
+        np.testing.assert_allclose(np.asarray(req.result),
+                                   np.stack([_ranked(k).sum(0)] * W))
+
+
+def test_mesh_init_reuses_init_operand(world):
+    x = world.shard(_ranked(2))
+    req = world.allgather_init(x)
+    req.Start()  # no operand: re-run on the init-time one
+    req.Wait()
+    out = np.asarray(req.result)
+    np.testing.assert_allclose(out[0], _ranked(2))
+
+
+def test_mesh_double_start_raises(world):
+    req = world.bcast_init(world.shard(_ranked()), root=1)
+    req.Start()
+    with pytest.raises(MPIError):
+        req.Start()
+    req.Wait()
+    np.testing.assert_allclose(np.asarray(req.result),
+                               np.stack([_ranked()[1]] * W))
+
+
+def test_mesh_reduce_scatter_init(world):
+    xr = world.shard(np.stack([np.arange(W, dtype=np.float32) + r
+                               for r in range(W)]))
+    req = world.reduce_scatter_init(xr)
+    req.Start()
+    req.Wait()
+    out = np.asarray(req.result)
+    expect = np.asarray([sum(i + r for r in range(W)) for i in range(W)],
+                        np.float32)
+    np.testing.assert_allclose(out.reshape(-1), expect)
+
+
+def test_mesh_startall(world):
+    from ompi_tpu.coll.sched import MeshPersistentRequest
+
+    a = world.allreduce_init(world.shard(_ranked()))
+    b = world.alltoall_init(world.shard(
+        np.arange(W * W, dtype=np.float32).reshape(W, W)))
+    MeshPersistentRequest.Startall([a, b])
+    a.Wait()
+    b.Wait()
+    np.testing.assert_allclose(np.asarray(a.result),
+                               np.stack([_ranked().sum(0)] * W))
