@@ -189,7 +189,15 @@ class PersistentCollRequest(Request):
         self._active = True
         self._complete.clear()
         self._error = 0
-        inner = self._issue()
+        try:
+            inner = self._issue()
+        except BaseException:
+            # a failed issue (revoked comm, bad schedule) must not wedge
+            # the request: roll back to inactive so the error is
+            # retryable and Wait doesn't spin forever
+            self._active = False
+            self._complete.set()
+            raise
 
         def done(r):
             self.status = r.status
@@ -201,11 +209,6 @@ class PersistentCollRequest(Request):
     def _finish(self, status) -> None:
         self._active = False
         super()._finish(status)
-
-    @staticmethod
-    def Startall(requests) -> None:
-        for r in requests:
-            r.Start()
 
 
 class JaxRequest(Request):
@@ -292,19 +295,19 @@ class MeshPersistentRequest(JaxRequest):
             raise MPIError(ERR_REQUEST,
                            "persistent collective already active")
         self._comm._check_usable()  # revoked comms must not dispatch
-        self._active = True
+        # dispatch before committing any state: a failed dispatch (bad
+        # shape/sharding) must leave the request inactive with the
+        # previous operand and result intact, not report stale data as
+        # this Start's success
+        result = self._dispatch(self._x if x is None else x)
         if x is not None:
             self._x = x
+        self._active = True
         self._complete.clear()
         self._error = 0
-        self.result = self._dispatch(self._x)
+        self.result = result
         return self
 
     def _finish(self, status) -> None:
         self._active = False
         super()._finish(status)
-
-    @staticmethod
-    def Startall(requests) -> None:
-        for r in requests:
-            r.Start()

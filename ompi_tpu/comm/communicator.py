@@ -516,6 +516,7 @@ class ProcComm(Intracomm):
 
         def start_issue():
             self._check_usable()  # a revoked comm must fail at Start too
+            spc.record(slot)      # each Start is one collective invocation
             return issue(self, *args)
 
         return PersistentCollRequest(start_issue)

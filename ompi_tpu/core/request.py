@@ -171,6 +171,13 @@ class Request:
         return done
 
     @staticmethod
+    def Startall(requests: Sequence["Request"]) -> None:
+        """Start every persistent request (MPI_Startall); lives on the
+        base class so mixed persistent-request kinds share one entry."""
+        for r in requests:
+            r.Start()
+
+    @staticmethod
     def Testall(requests: Sequence["Request"]) -> bool:
         _progress_once()
         return all(r.is_complete for r in requests)
@@ -237,11 +244,6 @@ class Prequest(Request):
         self.status = Status()
         self._start_fn(self)
         return self
-
-    @staticmethod
-    def Startall(requests: Sequence["Prequest"]) -> None:
-        for r in requests:
-            r.Start()
 
 
 # ---------------------------------------------------------------- progress

@@ -2,10 +2,14 @@
 
 Reference: ompi/mca/coll/ftagree (4,326 LoC, early-returning consensus /
 ERA). The MPI contract: every live process contributes a flag; the result
-is the bitwise AND across live contributions, and the call succeeds even in
-the presence of (already-detected) failures. Here: a BAND allreduce over
-the live members; failed members are excluded from the schedule.
-"""
+is the bitwise AND across live contributions, uniform on all survivors,
+and the call succeeds even when members fail *during* it.
+
+Process mode delegates to ft/era.py — the early-returning engine that
+survives mid-call coordinator death (no Shrink, no leaked comms: the
+agreement runs directly over the live membership on the system plane).
+Mesh mode is a single controller, so agreement degenerates to a BAND
+allreduce (there is no independent failure to survive)."""
 
 from __future__ import annotations
 
@@ -13,18 +17,15 @@ import numpy as np
 
 
 def agree(comm, flag: int) -> int:
-    from ompi_tpu.core import op as _op
-    from ompi_tpu.ft.detector import known_failed
+    pml = getattr(comm, "pml", None)
+    if pml is None:
+        # mesh mode: one controller holds every rank — plain BAND
+        from ompi_tpu.core import op as _op
 
-    failed = known_failed()
-    if not failed or all(r not in failed for r in comm.group.ranks):
         buf = np.array([flag], dtype=np.int64)
         out = np.zeros(1, dtype=np.int64)
         comm.Allreduce(buf, out, op=_op.BAND)
         return int(out[0])
-    # with known failures: agree over the shrunken membership
-    live = comm.Shrink()
-    buf = np.array([flag], dtype=np.int64)
-    out = np.zeros(1, dtype=np.int64)
-    live.Allreduce(buf, out, op=_op.BAND)
-    return int(out[0])
+    from ompi_tpu.ft.era import engine_for
+
+    return engine_for(pml).agree(comm, flag)

@@ -27,10 +27,12 @@ register_var("ft", "heartbeat_timeout", 2.0,
              level=6)
 
 HEARTBEAT_TAG = -4243
+FAILURE_PROP_TAG = -4245
 
 _failed: Set[int] = set()
 _failed_lock = threading.Lock()
 _callbacks: List[Callable[[int], None]] = []
+_propagator: Optional[Callable[[int], None]] = None
 _log = get_logger("ft.detector")
 
 
@@ -39,12 +41,28 @@ def known_failed() -> Set[int]:
         return set(_failed)
 
 
+def set_propagator(fn: Callable[[int], None]) -> None:
+    """Install the failure-notice flood (reference: the reliable
+    broadcast of comm_ft_propagator.c). Detection is local — the ring
+    observer and a tcp EOF each see a death from one vantage point; the
+    flood re-forwards every *newly learned* failure to all peers, so any
+    connected component of live ranks converges (dedup = the _failed
+    set)."""
+    global _propagator
+    _propagator = fn
+
+
 def mark_failed(rank: int) -> None:
     with _failed_lock:
         if rank in _failed:
             return
         _failed.add(rank)
     _log.warning("rank %d declared FAILED", rank)
+    if _propagator is not None:
+        try:
+            _propagator(rank)
+        except Exception:
+            _log.warning("failure propagation failed", exc_info=True)
     for cb in list(_callbacks):
         cb(rank)
 
@@ -88,12 +106,21 @@ class HeartbeatDetector:
         timeout = get_var("ft", "heartbeat_timeout")
         beat = np.array([self.rank], dtype=np.int64)
         while not self._stop.is_set():
+            # heal the TARGET side too: when my successor dies, the next
+            # living successor must start receiving my heartbeats, or it
+            # will falsely declare ME dead once it heals its observer
+            # edge toward me (reference: the detector rebuilds both ring
+            # edges, comm_ft_detector.c)
+            failed = known_failed()
+            while self.target in failed and self.target != self.rank:
+                self.target = (self.target + 1) % self.size
             try:
                 self.pml.isend(beat, 1, INT64, self.target,
                                HEARTBEAT_TAG, 0)
             except Exception:
                 pass
-            if time.monotonic() - self.last_seen > timeout:
+            if (self.observed != self.rank
+                    and time.monotonic() - self.last_seen > timeout):
                 mark_failed(self.observed)
                 # re-route around the failure (ring heals: observe next
                 # living predecessor — reference: detector ring repair)

@@ -1,0 +1,299 @@
+"""Early-returning fault-tolerant agreement (ERA).
+
+Reference: ompi/mca/coll/ftagree/coll_ftagree_earlyreturning.c (4,326 LoC)
+— uniform consensus on a bitwise-AND flag that completes correctly even
+when members die *during* the call. Redesign around this package's
+system-message plane instead of the reference's tree topology:
+
+- Every member entering ``agree`` records per-(cid, seq) state and pushes
+  its contribution to every lower-ranked live member — any of which may
+  become coordinator, so a later coordinator already holds the flags of
+  every entered member (the reference rebalances its tree on failure;
+  with the driver-scale rank counts here, eager replication to potential
+  coordinators is simpler and needs no repair protocol).
+- The lowest live rank coordinates: it collects a contribution-or-death
+  for every member, then runs a *query phase* — every live member answers
+  whether it already holds a decision for this sequence. Any surviving
+  decision is adopted; only when no one holds one does the coordinator
+  compute AND over the collected flags. This is the early-returning
+  property: a member that returned early still serves its decision from
+  the background handler (states are kept for ERA_GC_KEEP sequences), so
+  a coordinator death after a partial broadcast can never split the
+  survivors.
+- Stale-decision fencing: answering a coordinator's query with "none"
+  commits the member to ignore decisions from any lower-ranked (dead)
+  coordinator still in flight (``min_decider``), closing the race where
+  an old DECIDE crosses a new coordinator's fresh computation.
+
+Failure model: fail-stop with the ft detector as the (assumed accurate)
+failure oracle — the same assumption the reference's detector-driven
+protocols make (comm_ft_detector.c). An undetected stall fails fast with
+ERR_PENDING after ``era_timeout`` rather than hanging or diverging.
+
+Message format: int64[4] = [kind, cid, seq, value] on the dedicated
+system tag ERA_TAG (negative tags are framework-internal and bypass comm
+usability — agreement must work on revoked comms; that is its job).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.mca.var import register_var, get_var
+
+ERA_TAG = -4244  # system plane (REVOKE=-4242, HEARTBEAT=-4243)
+
+K_CONTRIB = 1   # value = member's flag
+K_QUERY = 2     # value unused; answer with HAVE or NONE (coordinator only)
+K_HAVE = 3      # value = cached decision
+K_NONE = 4      # no decision cached (and src will not accept stale ones)
+K_DECIDE = 5    # value = decision
+K_PULL = 6      # member asking a (possibly returned) peer for a cached
+                # decision; answered with DECIDE iff one exists — no fence
+
+ERA_GC_KEEP = 16  # sequences of per-comm agreement state kept for serving
+
+register_var("ft", "era_timeout", 60.0,
+             help="Seconds before an undetected agreement stall fails "
+                  "fast with ERR_PENDING", level=6)
+register_var("ft", "era_inject", "",
+             help="Fault injection for the agreement test harness: "
+                  "'partial_decide' makes a coordinator die after "
+                  "broadcasting its decision to only one member "
+                  "(reference analog: the ftagree fault-injection hooks "
+                  "in its mpiext test suite)", level=9)
+
+
+class _AgreeState:
+    __slots__ = ("flag", "contribs", "decision", "qans", "min_decider",
+                 "lock")
+
+    def __init__(self):
+        self.flag: Optional[int] = None          # my contribution
+        self.contribs: Dict[int, int] = {}       # world rank -> flag
+        self.decision: Optional[int] = None
+        self.qans: Dict[int, Tuple[bool, int]] = {}  # rank -> (have, val)
+        self.min_decider = -1
+        self.lock = threading.Lock()
+
+
+class EraEngine:
+    """Per-pml agreement engine: background message service + the
+    blocking ``agree`` driver. One instance per process (all comms share
+    it; states are keyed by (cid, seq))."""
+
+    def __init__(self, pml):
+        self.pml = pml
+        self._states: Dict[Tuple[int, int], _AgreeState] = {}
+        self._seqs: Dict[int, int] = {}  # cid -> next sequence
+        self._lock = threading.Lock()
+        pml.register_system_handler(ERA_TAG, self._on_message)
+
+    # ------------------------------------------------------------ plumbing
+    def _state(self, cid: int, seq: int) -> _AgreeState:
+        with self._lock:
+            st = self._states.get((cid, seq))
+            if st is None:
+                st = self._states[(cid, seq)] = _AgreeState()
+            return st
+
+    def _gc(self, cid: int, seq: int) -> None:
+        with self._lock:
+            drop = [k for k in self._states
+                    if k[0] == cid and k[1] < seq - ERA_GC_KEEP]
+            for k in drop:
+                del self._states[k]
+
+    def _send(self, dst: int, kind: int, cid: int, seq: int,
+              value: int) -> None:
+        from ompi_tpu.core.datatype import INT64
+
+        msg = np.array([kind, cid, seq, value], dtype=np.int64)
+        try:
+            self.pml.isend(msg, 4, INT64, dst, ERA_TAG, 0)
+        except Exception:
+            pass  # dst dead or dying: the detector is the oracle
+
+    # --------------------------------------------------- background service
+    def _on_message(self, hdr, payload: bytes) -> None:
+        kind, cid, seq, value = (int(v) for v in
+                                 np.frombuffer(payload, dtype=np.int64)[:4])
+        src = hdr.src
+        st = self._state(cid, seq)
+        if kind == K_CONTRIB:
+            with st.lock:
+                st.contribs[src] = value
+        elif kind == K_QUERY:
+            with st.lock:
+                if st.decision is not None:
+                    ans, val = K_HAVE, st.decision
+                else:
+                    # fence: once we tell src "none", a stale DECIDE from
+                    # any lower-ranked (dead) coordinator must be ignored
+                    st.min_decider = max(st.min_decider, src)
+                    ans, val = K_NONE, 0
+            self._send(src, ans, cid, seq, val)
+        elif kind == K_HAVE:
+            with st.lock:
+                st.qans[src] = (True, value)
+                if st.decision is None:
+                    st.decision = value
+        elif kind == K_NONE:
+            with st.lock:
+                st.qans[src] = (False, 0)
+        elif kind == K_DECIDE:
+            with st.lock:
+                if st.decision is None and src >= st.min_decider:
+                    st.decision = value
+        elif kind == K_PULL:
+            with st.lock:
+                dec = st.decision
+            if dec is not None:
+                self._send(src, K_DECIDE, cid, seq, dec)
+
+    # ----------------------------------------------------------- the driver
+    def agree(self, comm, flag: int) -> int:
+        from ompi_tpu.core.errors import MPIError, ERR_PENDING
+        from ompi_tpu.ft.detector import known_failed
+        from ompi_tpu.runtime.progress import progress_until
+        import time
+
+        cid = comm.cid
+        with self._lock:
+            seq = self._seqs.get(cid, 0)
+            self._seqs[cid] = seq + 1
+        self._gc(cid, seq)
+        st = self._state(cid, seq)
+        me = self.pml.my_rank
+        members = sorted(comm.group.ranks)
+        flag = int(flag)
+        with st.lock:
+            st.flag = flag
+            st.contribs[me] = flag
+        # eager replication: every potential coordinator gets my flag now
+        for m in members:
+            if m < me and m not in known_failed():
+                self._send(m, K_CONTRIB, cid, seq, flag)
+
+        deadline = time.monotonic() + get_var("ft", "era_timeout")
+        recovering = False  # a coordinator died during this call
+        while True:
+            live = [m for m in members if m not in known_failed()]
+            if not live:
+                raise MPIError(ERR_PENDING, "agreement: no live members")
+            coord = live[0]
+            if coord == me:
+                return self._coordinate(comm, st, cid, seq, members,
+                                        deadline)
+            # member: wait for a decision or the coordinator's death.
+            # In recovery the new coordinator may have ALREADY returned
+            # (it got the dead coordinator's decision) and will never
+            # broadcast — pull its cached decision periodically; it
+            # serves pulls from the background handler after returning
+            # (the early-returning property).
+            if recovering:
+                self._send(coord, K_PULL, cid, seq, 0)
+            slice_s = 0.25 if recovering else None
+            left = max(0.0, deadline - time.monotonic())
+            done = progress_until(
+                lambda: st.decision is not None
+                or coord in known_failed(),
+                timeout=left if slice_s is None else min(slice_s, left))
+            if st.decision is not None:
+                return st.decision
+            if time.monotonic() >= deadline:
+                raise MPIError(ERR_PENDING,
+                               f"agreement stalled on coordinator {coord}")
+            if done and coord in known_failed():
+                recovering = True
+            # the loop recomputes the coordinator; my entry-time CONTRIB
+            # already reached every lower rank, and ranks above me pull
+            # state through the query phase — nothing to resend.
+
+    def _coordinate(self, comm, st: _AgreeState, cid: int, seq: int,
+                    members, deadline) -> int:
+        from ompi_tpu.core.errors import MPIError, ERR_PENDING
+        from ompi_tpu.ft.detector import known_failed
+        from ompi_tpu.runtime.progress import progress_until
+        import time
+
+        me = self.pml.my_rank
+
+        def remaining() -> float:
+            return max(0.0, deadline - time.monotonic())
+
+        # phase 1: a contribution-or-death for every member
+        def contribs_complete() -> bool:
+            failed = known_failed()
+            return all(m in st.contribs or m in failed for m in members)
+
+        if not progress_until(contribs_complete, timeout=remaining()):
+            missing = [m for m in members if m not in st.contribs
+                       and m not in known_failed()]
+            raise MPIError(ERR_PENDING,
+                           f"agreement: no contribution from {missing}")
+
+        # phase 2: query every live member for a surviving decision (the
+        # early-returning recovery path). min_decider fences out any
+        # DECIDE still in flight from a dead predecessor coordinator.
+        with st.lock:
+            st.min_decider = max(st.min_decider, me)
+            st.qans.clear()
+            prior = st.decision
+        queried = [m for m in members
+                   if m != me and m not in known_failed()]
+        if prior is None:
+            for m in queried:
+                self._send(m, K_QUERY, cid, seq, 0)
+
+            def queries_complete() -> bool:
+                failed = known_failed()
+                return all(m in st.qans or m in failed for m in queried)
+
+            if not progress_until(queries_complete, timeout=remaining()):
+                missing = [m for m in queried if m not in st.qans
+                           and m not in known_failed()]
+                raise MPIError(ERR_PENDING,
+                               f"agreement: no query answer from {missing}")
+
+        # decide: adopt any surviving decision, else AND over every
+        # collected contribution (contributions from members that died
+        # after contributing are included — uniformity is guaranteed
+        # because either this broadcast reaches the survivors or the next
+        # coordinator recovers this very decision through its query phase)
+        with st.lock:
+            if st.decision is None:
+                d = st.flag
+                for v in st.contribs.values():
+                    d &= v
+                st.decision = d
+            decision = st.decision
+        recipients = [m for m in members
+                      if m != me and m not in known_failed()]
+        if get_var("ft", "era_inject") == "partial_decide" and recipients:
+            # die after the decision escapes to exactly one member: the
+            # survivors must converge through that member's early-return
+            # service (the scenario ERA exists for)
+            import os
+
+            self._send(recipients[0], K_DECIDE, cid, seq, decision)
+            progress_until(lambda: False, timeout=0.5)  # drain the send
+            os._exit(0)
+        for m in recipients:
+            self._send(m, K_DECIDE, cid, seq, decision)
+        return decision
+
+
+_engines: Dict[int, EraEngine] = {}
+_engines_lock = threading.Lock()
+
+
+def engine_for(pml) -> EraEngine:
+    with _engines_lock:
+        eng = _engines.get(id(pml))
+        if eng is None:
+            eng = _engines[id(pml)] = EraEngine(pml)
+        return eng

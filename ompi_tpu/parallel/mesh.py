@@ -295,6 +295,9 @@ class XlaComm(Intracomm):
     Allgather_init = allgather_init
     Alltoall_init = alltoall_init
     Reduce_scatter_init = reduce_scatter_init
+    Reduce_scatter_block_init = reduce_scatter_init  # ProcComm's spelling
+    Scan_init = scan_init
+    Exscan_init = exscan_init
 
     # ------------------------------------------------------------- pt2pt
     def permute(self, x, perm: Sequence[Tuple[int, int]]):

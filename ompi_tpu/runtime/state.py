@@ -113,9 +113,21 @@ def Finalize() -> None:
         if _world is not None:
             try:
                 from ompi_tpu.runtime import spc
+                from ompi_tpu.ft.detector import known_failed
+                from ompi_tpu.runtime.progress import progress_until
 
-                with spc.suppressed():
-                    _world.Barrier()
+                # the exit fence cannot be met once a member died (a
+                # ULFM program shrinks/revokes before Finalize; atexit
+                # runs this on every clean exit, including FT-test
+                # survivors) — run it nonblocking and abandon it the
+                # moment a world member is declared failed, including a
+                # death first detected mid-wait
+                members = set(_world.group.ranks)
+                if _world.size > 1 and not (known_failed() & members):
+                    with spc.suppressed():
+                        req = _world.Ibarrier()
+                    progress_until(lambda: req.is_complete
+                                   or bool(known_failed() & members))
             except Exception:
                 pass
             from ompi_tpu.runtime import wireup

@@ -140,6 +140,42 @@ def init_process_mode():
 
     pml.register_system_handler(REVOKE_TAG, _on_revoke)
 
+    # failure-notice flood (reference: comm_ft_propagator.c): a locally
+    # detected death (ring heartbeat or tcp EOF) is re-forwarded to every
+    # peer; mark_failed's dedup terminates the flood
+    def _on_failure_prop(hdr, payload):
+        import numpy as _np
+
+        ft_detector.mark_failed(int(_np.frombuffer(payload,
+                                                   dtype=_np.int64)[0]))
+
+    def _propagate_failure(dead: int):
+        import numpy as _np
+
+        from ompi_tpu.core.datatype import INT64
+
+        notice = _np.array([dead], dtype=_np.int64)
+        for peer in job_peers:
+            if peer in (urank, dead) or \
+                    peer in ft_detector.known_failed():
+                continue
+            try:
+                pml.isend(notice, 1, INT64, peer,
+                          ft_detector.FAILURE_PROP_TAG, 0)
+            except Exception:
+                pass
+
+    pml.register_system_handler(ft_detector.FAILURE_PROP_TAG,
+                                _on_failure_prop)
+    ft_detector.set_propagator(_propagate_failure)
+
+    # agreement engine registers its system handler NOW: a peer entering
+    # MPIX_Comm_agree before this rank does must not have its
+    # contribution dropped by the no-handler path
+    from ompi_tpu.ft.era import engine_for
+
+    engine_for(pml)
+
     hb = None
     if get_var("ft", "enable") and job == 0:
         # the heartbeat ring runs over job-0 world ranks; spawned jobs
@@ -185,7 +221,10 @@ def shutdown() -> None:
         except Exception:
             p.kill()
     try:
-        _ctx["modex"].fence()
+        # the exit fence waits for every job rank — unreachable once a
+        # member died (FT survivors would hang here at atexit forever)
+        if not ft_detector.known_failed():
+            _ctx["modex"].fence()
     except Exception:
         pass
     if _ctx.get("detector") is not None:

@@ -1,0 +1,95 @@
+"""ERA agreement under real mid-call failures (3 ranks).
+
+Scenarios (selected by argv[1]):
+  member_dies  — a non-coordinator rank dies without contributing; the
+                 survivors' Agree must return AND over live flags.
+  coord_dies   — the coordinator (rank 0) dies before contributing; the
+                 next live rank coordinates.
+  partial      — fault injection: the coordinator decides, broadcasts to
+                 exactly ONE member, and dies. The other survivor must
+                 recover that decision through the early-return query
+                 service (reference: coll_ftagree_earlyreturning.c).
+
+Reference: ompi/mca/coll/ftagree + comm_ft_detector.c ring heartbeat."""
+
+import faulthandler
+import os
+import signal as _signal
+import sys
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+
+
+def main() -> int:
+    faulthandler.register(_signal.SIGUSR1)  # hang diagnosis: kill -USR1
+    mode = sys.argv[1]
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+    assert n == 3, "choreography assumes 3 ranks"
+
+    flags = {0: 0b1111, 1: 0b1101, 2: 0b0111}
+
+    if mode == "member_dies":
+        # rank 1 dies "during" the call: survivors are already inside
+        # Agree when the heartbeat declares it dead
+        if r == 1:
+            time.sleep(0.3)
+            os._exit(0)
+        got = COMM_WORLD.Agree(flags[r])
+        expect = flags[0] & flags[2]
+    elif mode == "coord_dies":
+        # rank 0 (the initial coordinator) dies; rank 1 takes over
+        if r == 0:
+            time.sleep(0.3)
+            os._exit(0)
+        got = COMM_WORLD.Agree(flags[r])
+        expect = flags[1] & flags[2]
+    elif mode == "partial":
+        # one warm-up agreement with everyone alive, then the injected
+        # partial-broadcast death of the coordinator
+        warm = COMM_WORLD.Agree(0b1)
+        assert warm == 0b1, warm
+        if r == 0:
+            from ompi_tpu.mca.var import set_var
+
+            set_var("ft", "era_inject", "partial_decide")
+        got = COMM_WORLD.Agree(flags[r])  # rank 0 never returns from this
+        expect = flags[0] & flags[1] & flags[2]
+        # cross-check over pt2pt: the early-returning recipient (rank 1)
+        # must stay alive serving decision pulls until the other survivor
+        # recovers — a real ULFM application keeps running after Agree;
+        # exiting the job is indistinguishable from failing. The
+        # handshake also asserts survivor consistency directly.
+        peer_val = np.zeros(1, np.int64)
+        if r == 1:
+            COMM_WORLD.Send(np.array([got], np.int64), dest=2)
+            COMM_WORLD.Recv(peer_val, source=2)
+        else:
+            COMM_WORLD.Recv(peer_val, source=1)
+            COMM_WORLD.Send(np.array([got], np.int64), dest=1)
+        assert int(peer_val[0]) == got, (r, int(peer_val[0]), got)
+    elif mode == "clean":
+        # no failures: everyone agrees on the 3-way AND, twice (sequence
+        # counters stay aligned across calls)
+        for _ in range(2):
+            got = COMM_WORLD.Agree(flags[r])
+        expect = flags[0] & flags[1] & flags[2]
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+
+    assert got == expect, (mode, r, bin(got), bin(expect))
+    # one atomic write: with unbuffered stdio, print()'s separate "\n"
+    # write interleaves across ranks sharing the launcher's fd
+    sys.stdout.write(f"rank {r}: AGREE-OK {got}\n")
+    sys.stdout.flush()
+    # no Finalize: its world barrier would wait on the dead rank (ULFM
+    # programs shrink or revoke first; here the job simply ends)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""ERA agreement: correctness under mid-call failures.
+
+Reference: ompi/mca/coll/ftagree/coll_ftagree_earlyreturning.c — the
+fault-tolerant consensus MPIX_Comm_agree requires. Each scenario kills a
+real rank mid-agreement under mpirun and asserts the survivors return
+the same (correct) flag."""
+
+from tests.test_process_mode import run_mpi
+
+# generous heartbeat margins: the suite oversubscribes one core, and a
+# starved heartbeat thread must not read as a death (the protocol — like
+# the reference's — assumes the detector does not false-positive)
+FT = (("ft_enable", "1"),
+      ("ft_heartbeat_period", "0.25"),
+      ("ft_heartbeat_timeout", "4.0"),
+      ("ft_era_timeout", "60"))
+
+
+def _agree_values(stdout):
+    import re
+
+    return [int(v) for v in re.findall(r"AGREE-OK (\d+)", stdout)]
+
+
+def test_agree_member_dies_midcall():
+    r = run_mpi(3, "tests/procmode/check_ft_agree.py", "member_dies",
+                timeout=120, mca=FT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    vals = _agree_values(r.stdout)
+    assert len(vals) == 2 and len(set(vals)) == 1, r.stdout
+
+
+def test_agree_coordinator_dies_midcall():
+    r = run_mpi(3, "tests/procmode/check_ft_agree.py", "coord_dies",
+                timeout=120, mca=FT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    vals = _agree_values(r.stdout)
+    assert len(vals) == 2 and len(set(vals)) == 1, r.stdout
+
+
+def test_agree_partial_broadcast_recovery():
+    """The ERA case: coordinator dies after its decision reached exactly
+    one member; the other survivor recovers it through the early-return
+    pull service. Decision must include the dead coordinator's flag."""
+    r = run_mpi(3, "tests/procmode/check_ft_agree.py", "partial",
+                timeout=120, mca=FT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    vals = _agree_values(r.stdout)
+    assert len(vals) == 2 and len(set(vals)) == 1, r.stdout
+    assert vals[0] == (0b1111 & 0b1101 & 0b0111), r.stdout
+
+
+def test_agree_no_failures_fast_path():
+    r = run_mpi(3, "tests/procmode/check_ft_agree.py", "clean",
+                timeout=120, mca=FT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    vals = _agree_values(r.stdout)
+    assert len(vals) == 3 and len(set(vals)) == 1, r.stdout
