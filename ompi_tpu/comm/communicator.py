@@ -222,7 +222,13 @@ class Communicator:
 
 
 class Intracomm(Communicator):
-    pass
+    def Agree(self, flag: int) -> int:
+        """MPIX_Comm_agree — lives on the base so both comm kinds serve
+        it: ProcComm runs the ERA engine, mesh comms (no pml) reduce to
+        a BAND allreduce under the single controller."""
+        from ompi_tpu.ft.agreement import agree
+
+        return agree(self, flag)
 
 
 class ProcComm(Intracomm):
@@ -696,11 +702,6 @@ class ProcComm(Intracomm):
         from ompi_tpu.ft.revoke import shrink_comm
 
         return shrink_comm(self)
-
-    def Agree(self, flag: int) -> int:
-        from ompi_tpu.ft.agreement import agree
-
-        return agree(self, flag)
 
 
 # Live communicator registry: cid -> comm, used by the ULFM revoke handler

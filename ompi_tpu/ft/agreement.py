@@ -19,13 +19,14 @@ import numpy as np
 def agree(comm, flag: int) -> int:
     pml = getattr(comm, "pml", None)
     if pml is None:
-        # mesh mode: one controller holds every rank — plain BAND
+        # mesh mode: one controller holds every rank; the agreement is a
+        # BAND allreduce over the rank dim (mesh collectives are
+        # functional: [W, ...] in, [W, ...] out)
         from ompi_tpu.core import op as _op
 
-        buf = np.array([flag], dtype=np.int64)
-        out = np.zeros(1, dtype=np.int64)
-        comm.Allreduce(buf, out, op=_op.BAND)
-        return int(out[0])
+        x = comm.shard(np.full((comm.world_size, 1), flag, np.int32))
+        out = comm.allreduce(x, _op.BAND)
+        return int(np.asarray(out)[0, 0])
     from ompi_tpu.ft.era import engine_for
 
     return engine_for(pml).agree(comm, flag)

@@ -30,6 +30,18 @@ from ompi_tpu.core.status import Status
 ANY_SOURCE = -1
 ANY_TAG = -1
 
+# User-traffic classification shared by every interposition PML
+# (pml/monitoring, pml/v): plane-bit cids (collective schedules, nbc,
+# partitioned, dpm, ft — any cid bit >= 2^25) and system tags
+# (heartbeats, osc active messages, revoke floods, tag <= -4000) are
+# library-internal, not application pt2pt.
+_PLANE_MASK = ~((1 << 25) - 1)
+SYSTEM_TAG_BASE = -4000
+
+
+def user_traffic(tag: int, cid: int) -> bool:
+    return (cid & _PLANE_MASK) == 0 and tag > SYSTEM_TAG_BASE
+
 # Header kinds (reference: pml_ob1_hdr.h type enum)
 EAGER = 1
 RNDV_RTS = 2

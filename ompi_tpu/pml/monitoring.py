@@ -41,19 +41,14 @@ class MonitoringPml:
                                   if d == "rx"),
                       help="Bytes received through the monitored pml")
 
-    # Count USER pt2pt only: plane-bit cids (collective schedules, nbc,
-    # partitioned, dpm, ft) and system tags (heartbeats, osc active
-    # messages, revoke floods) are library-internal — the repo's
-    # internal-traffic-suppression convention (cf. spc.suppressed();
-    # the reference monitoring component likewise separates user pt2pt
-    # from collective/internal classes).
-    _PLANE_MASK = ~((1 << 25) - 1)  # any cid bit >= 2^25 marks a plane
+    # Count USER pt2pt only (cf. spc.suppressed(); the reference
+    # monitoring component likewise separates user pt2pt from
+    # collective/internal classes) — classification shared with pml/v.
+    @staticmethod
+    def _user_traffic(tag: int, cid: int) -> bool:
+        from ompi_tpu.pml.base import user_traffic
 
-    def _user_traffic(self, tag: int, cid: int) -> bool:
-        from ompi_tpu.pml.ob1 import Ob1Pml
-
-        return ((cid & self._PLANE_MASK) == 0
-                and tag > Ob1Pml.SYSTEM_TAG_BASE)
+        return user_traffic(tag, cid)
 
     def _bump(self, peer: int, direction: str, nbytes: int) -> None:
         with self._lock:

@@ -182,7 +182,7 @@ class Ob1Pml:
         return False
 
     # ------------------------------------------------- incoming dispatch
-    SYSTEM_TAG_BASE = -4000
+    from ompi_tpu.pml.base import SYSTEM_TAG_BASE  # single source of truth
 
     def register_system_handler(self, tag: int, fn) -> None:
         self.system_handlers[tag] = fn

@@ -1,0 +1,302 @@
+"""pml/v — pessimist message logging for rollback recovery.
+
+Reference: ompi/mca/vprotocol/pessimist (vprotocol_pessimist.h: sender-
+based payload repository + event log + replay mode, ~3k LoC). The
+pessimist discipline: every nondeterministic event (which source a
+receive matched, in what order) is forced to stable storage BEFORE the
+message is delivered to the application, and every sent payload is kept
+by the sender — so a crashed process can be restarted alone and re-driven
+through the exact same receive sequence from its peers' payload logs.
+
+Redesign as an interposition PML (the pml/monitoring.py pattern):
+
+- live mode: ``isend`` appends (dst, tag, cid, payload) to this rank's
+  sender-based log; ``irecv`` completion appends (src, tag, cid, nbytes)
+  to the event log, flushed per record (the pessimist property).
+- replay mode (``--mca pml_v_replay 1`` after a restart): receives are
+  served from the peers' sender logs in the order dictated by this
+  rank's own event log — per-source FIFO cursors resolve the payload,
+  the event log resolves the cross-source interleaving (the only true
+  nondeterminism; pt2pt is FIFO per (src, cid) pair). Sends are
+  suppressed (their receivers already delivered them) and VERIFIED
+  byte-identical against the sender log — a divergence means the
+  application itself is nondeterministic and replay cannot be sound.
+
+Logs live under ``pml_v_logdir`` as ``sender_<rank>.log`` /
+``events_<rank>.log`` — the stable-storage assumption of pessimist
+logging (the reference mmaps its repository to disk the same way).
+Record framing: 4 little-endian int64 header words + raw payload.
+Probe results are not event-logged (reference covers them; documented
+gap), and replay ends when the event log is exhausted — further receives
+raise rather than silently going live without their peers.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.mca.var import register_var, get_var, register_pvar
+
+register_var("pml_v", "enable", False,
+             help="Interpose the pml with pessimist message logging "
+                  "(reference: ompi/mca/vprotocol/pessimist)", level=4)
+register_var("pml_v", "logdir", "pml_v_logs",
+             help="Stable-storage directory for sender-based payload "
+                  "and event logs", level=6)
+register_var("pml_v", "replay", False,
+             help="Restart mode: serve receives from the logged event "
+                  "sequence and suppress+verify resends", level=6)
+register_var("pml_v", "replay_rank", -1,
+             help="Original rank identity of a standalone restart (the "
+                  "restarted process runs without the launcher; its "
+                  "world is rebuilt from the logged metadata)", level=6)
+
+_HDR = struct.Struct("<qqqq")  # four int64 words
+
+
+def _append(f, a: int, b: int, c: int, d: int, payload: bytes = b"") -> None:
+    f.write(_HDR.pack(a, b, c, d))
+    if payload:
+        f.write(payload)
+    f.flush()
+    os.fsync(f.fileno())  # pessimist: stable BEFORE delivery/completion
+
+
+def _read_records(path: str, with_payload: bool):
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                break  # torn tail record from a crash: drop it
+            a, b, c, d = _HDR.unpack(hdr)
+            payload = b""
+            if with_payload:
+                payload = f.read(d)
+                if len(payload) < d:
+                    break
+            out.append((a, b, c, d, payload))
+    return out
+
+
+class VprotocolPml:
+    """Pessimist-logging interposition wrapper around the selected pml."""
+
+    _OWN = ("_inner", "_lock", "_sb", "_ev", "_replay", "_events",
+            "_ev_pos", "_peer_logs", "_send_log", "_send_pos",
+            "logged_send_bytes", "logged_events")
+
+    def __init__(self, inner, logdir: str, replay: bool):
+        self._inner = inner
+        self._lock = threading.Lock()
+        self._replay = replay
+        self.logged_send_bytes = 0
+        self.logged_events = 0
+        os.makedirs(logdir, exist_ok=True)
+        me = inner.my_rank
+        if replay:
+            self._sb = self._ev = None
+            # my event log dictates the receive sequence; peers' sender
+            # logs hold the payloads, filtered to records addressed to me
+            self._events = _read_records(
+                os.path.join(logdir, f"events_{me}.log"), False)
+            self._ev_pos = 0
+            self._peer_logs: Dict[int, list] = {}
+            for fn in os.listdir(logdir):
+                if fn.startswith("sender_") and fn.endswith(".log"):
+                    src = int(fn[len("sender_"):-len(".log")])
+                    if src == me:
+                        continue
+                    recs = _read_records(os.path.join(logdir, fn), True)
+                    self._peer_logs[src] = [
+                        r for r in recs if r[0] == me]
+            # my own sender log verifies resends byte-for-byte
+            self._send_log = _read_records(
+                os.path.join(logdir, f"sender_{me}.log"), True)
+            self._send_pos = 0
+        else:
+            self._sb = open(os.path.join(logdir, f"sender_{me}.log"),
+                            "ab")
+            self._ev = open(os.path.join(logdir, f"events_{me}.log"),
+                            "ab")
+        register_pvar("pml_v", "logged_send_bytes",
+                      lambda: self.logged_send_bytes,
+                      help="Payload bytes in the sender-based log")
+        register_pvar("pml_v", "logged_events",
+                      lambda: self.logged_events,
+                      help="Receive events forced to the event log")
+
+    # Only user pt2pt is logged/replayed: library-internal traffic
+    # (plane-bit cids, system tags) regenerates naturally on replay —
+    # classification shared with pml/monitoring (pml/base.user_traffic).
+    @staticmethod
+    def _user_traffic(tag: int, cid: int) -> bool:
+        from ompi_tpu.pml.base import user_traffic
+
+        return user_traffic(tag, cid)
+
+    @staticmethod
+    def _payload_of(buf, count, datatype) -> bytes:
+        from ompi_tpu.core.convertor import pack
+
+        return pack(buf, count, datatype).tobytes()
+
+    # ------------------------------------------------------------- verbs
+    def isend(self, buf, count, datatype, dst, tag, cid):
+        if not self._user_traffic(tag, cid):
+            return self._inner.isend(buf, count, datatype, dst, tag, cid)
+        data = self._payload_of(buf, count, datatype)
+        if self._replay:
+            return self._replay_send(data, dst, tag, cid)
+        with self._lock:
+            # the append and the send stay under ONE lock: replay
+            # resolves payloads by per-source FIFO over this log, so log
+            # order must equal wire order even with concurrent senders
+            _append(self._sb, dst, tag, cid, len(data), data)
+            self.logged_send_bytes += len(data)
+            return self._inner.isend(buf, count, datatype, dst, tag, cid)
+
+    def irecv(self, buf, count, datatype, src, tag, cid):
+        if not self._user_traffic(tag, cid):
+            return self._inner.irecv(buf, count, datatype, src, tag, cid)
+        if self._replay:
+            return self._replay_recv(buf, count, datatype, src, tag, cid)
+        req = self._inner.irecv(buf, count, datatype, src, tag, cid)
+
+        def done(r):
+            if r.status.cancelled or r.status.source < 0:
+                return
+            with self._lock:
+                _append(self._ev, r.status.source, r.status.tag, cid,
+                        r.status._nbytes)
+                self.logged_events += 1
+
+        req.add_completion_callback(done)
+        return req
+
+    # ------------------------------------------------------ replay engine
+    def _replay_send(self, data: bytes, dst, tag, cid):
+        from ompi_tpu.core.errors import MPIError, ERR_INTERN
+        from ompi_tpu.core.request import CompletedRequest
+
+        with self._lock:
+            if self._send_pos >= len(self._send_log):
+                raise MPIError(
+                    ERR_INTERN,
+                    "pml_v replay: send past the end of the sender log "
+                    "(restart reached the crash point; reconnect to the "
+                    "live job to continue)")
+            ldst, ltag, lcid, _, lpayload = self._send_log[self._send_pos]
+            self._send_pos += 1
+        if (ldst, ltag, lcid) != (dst, tag, cid) or lpayload != data:
+            raise MPIError(
+                ERR_INTERN,
+                f"pml_v replay diverged: send #{self._send_pos - 1} to "
+                f"{dst} tag {tag} does not match the log — the "
+                "application is nondeterministic beyond its receives")
+        return CompletedRequest(nbytes=len(data))
+
+    def _replay_recv(self, buf, count, datatype, src, tag, cid):
+        from ompi_tpu.core.convertor import unpack
+        from ompi_tpu.core.errors import MPIError, ERR_INTERN
+        from ompi_tpu.core.request import CompletedRequest
+
+        with self._lock:
+            if self._ev_pos >= len(self._events):
+                raise MPIError(
+                    ERR_INTERN,
+                    "pml_v replay: receive past the end of the event log "
+                    "(restart reached the crash point)")
+            esrc, etag, ecid, enbytes, _ = self._events[self._ev_pos]
+            self._ev_pos += 1
+        from ompi_tpu.pml.base import ANY_SOURCE as _ANY, ANY_TAG as _ANYT
+
+        if src not in (_ANY, esrc):
+            raise MPIError(
+                ERR_INTERN,
+                f"pml_v replay diverged: receive posted for source {src} "
+                f"but the event log matched {esrc}")
+        if tag not in (_ANYT, etag):
+            raise MPIError(
+                ERR_INTERN,
+                f"pml_v replay diverged: receive posted with tag {tag} "
+                f"but the event log matched {etag}")
+        with self._lock:
+            # the event log resolves the nondeterminism (which source);
+            # per-source FIFO order resolves the payload — take the first
+            # unconsumed record matching (tag, cid), skipping records a
+            # differently-tagged receive will consume later
+            recs = self._peer_logs.get(esrc, [])
+            cur = 0
+            while cur < len(recs) and not (
+                    recs[cur][1] == etag and recs[cur][2] == ecid):
+                cur += 1
+            if cur >= len(recs):
+                raise MPIError(
+                    ERR_INTERN,
+                    f"pml_v replay: no payload in rank {esrc}'s sender "
+                    f"log for event (tag {etag}, cid {ecid})")
+            payload = recs.pop(cur)[4]
+        unpack(np.frombuffer(payload, dtype=np.uint8), buf, count,
+               datatype)
+        req = CompletedRequest(nbytes=enbytes, source=esrc, tag=etag)
+        return req
+
+    # -------------------------------------------------- plain delegation
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __setattr__(self, name, value):
+        if name in self._OWN:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._inner, name, value)
+
+    def note_world(self, size: int, base: int = 0) -> None:
+        """Record the job geometry (live mode) so a standalone restart
+        can rebuild its world view; spawned jobs have universe ranks
+        base..base+size-1. Reference analog: the nspace info a restarted
+        process re-reads from the event logger."""
+        if self._replay:
+            return
+        logdir = get_var("pml_v", "logdir")
+        with open(os.path.join(logdir,
+                               f"meta_{self._inner.my_rank}.log"),
+                  "w") as f:
+            f.write(f"{size} {base}")
+
+    @staticmethod
+    def logged_world(logdir: str, rank: int) -> Tuple[int, int]:
+        """(size, base) of the crashed rank's job."""
+        with open(os.path.join(logdir, f"meta_{rank}.log")) as f:
+            parts = f.read().split()
+        return int(parts[0]), int(parts[1]) if len(parts) > 1 else 0
+
+    def close_logs(self) -> None:
+        for f in (self._sb, self._ev):
+            if f is not None:
+                try:
+                    f.close()
+                except Exception:
+                    pass
+
+
+def maybe_wrap(pml):
+    """Interpose if enabled (called at PML selection alongside
+    pml/monitoring; v wraps closest to the wire so monitoring counts
+    replayed traffic too)."""
+    if not get_var("pml_v", "enable"):
+        return pml
+    wrapped = VprotocolPml(pml, get_var("pml_v", "logdir"),
+                           bool(get_var("pml_v", "replay")))
+    from ompi_tpu.hook import register_hook
+
+    register_hook("finalize_bottom", wrapped.close_logs)
+    return wrapped

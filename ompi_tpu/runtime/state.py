@@ -93,12 +93,33 @@ def _init_singleton() -> ProcComm:
     from ompi_tpu.btl.base import btl_framework
     from ompi_tpu.pml.ob1 import Ob1Pml
 
-    pml = Ob1Pml(my_rank=0)
-    from ompi_tpu.pml.monitoring import maybe_wrap
+    from ompi_tpu.mca.var import get_var
+    import ompi_tpu.pml.vprotocol  # noqa: F401  (registers pml_v vars)
 
-    pml = maybe_wrap(pml)  # interposition applies in EVERY init mode
+    # pml/v standalone restart: the replayed process runs WITHOUT the
+    # launcher but must see its original world geometry — rebuild the
+    # world view from the logged metadata (receives come from the logs,
+    # sends are suppressed, so no real endpoints are needed; collectives
+    # are outside the replay contract)
+    replay_rank = -1
+    if get_var("pml_v", "enable") and get_var("pml_v", "replay"):
+        replay_rank = int(get_var("pml_v", "replay_rank"))
+
+    pml = Ob1Pml(my_rank=max(0, replay_rank))
+    from ompi_tpu.pml.monitoring import maybe_wrap
+    from ompi_tpu.pml.vprotocol import maybe_wrap as maybe_wrap_v
+
+    # interpositions apply in EVERY init mode (v closest to the wire)
+    pml = maybe_wrap(maybe_wrap_v(pml))
     _, self_btl = btl_framework.select_one(deliver=pml.handle_incoming)
-    pml.add_endpoint(0, self_btl)
+    pml.add_endpoint(pml.my_rank, self_btl)
+    if replay_rank >= 0:
+        from ompi_tpu.pml.vprotocol import VprotocolPml
+
+        size, base = VprotocolPml.logged_world(
+            get_var("pml_v", "logdir"), replay_rank)
+        return ProcComm(Group(range(base, base + size)), cid=0, pml=pml,
+                        name="MPI_COMM_WORLD")
     return ProcComm(Group([0]), cid=0, pml=pml, name="MPI_COMM_WORLD")
 
 

@@ -39,11 +39,13 @@ def init_process_mode():
     urank = base + rank
 
     pml = Ob1Pml(my_rank=urank)
-    # optional traffic-counting interposition (reference: pml/monitoring
-    # wins selection then forwards to the real pml)
+    # optional interpositions (reference: pml/monitoring and pml/v win
+    # selection then forward to the real pml); v wraps closest to the
+    # wire so monitoring counts replayed traffic too
     from ompi_tpu.pml.monitoring import maybe_wrap
+    from ompi_tpu.pml.vprotocol import maybe_wrap as maybe_wrap_v
 
-    pml = maybe_wrap(pml)
+    pml = maybe_wrap(maybe_wrap_v(pml))
     modex = ModexClient(modex_addr, urank, size, job=job)
 
     # btl selection (reference: mca_pml_base_select opening BTLs via bml/r2)
@@ -189,6 +191,8 @@ def init_process_mode():
 
     world = ProcComm(Group(job_peers), cid=0, pml=pml,
                      name="MPI_COMM_WORLD")
+    if hasattr(pml, "note_world"):  # pml/v live mode: record geometry
+        pml.note_world(size, base)
     _ctx = {
         "modex": modex,
         "btls": [mod for _, _, mod in modules],

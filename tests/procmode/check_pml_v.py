@@ -1,0 +1,73 @@
+"""pml/v pessimist logging: crash a rank, restart it standalone, replay
+its receive sequence to the crash point and verify identical state.
+
+Live (mpirun -np 3, pml_v enabled): ranks 0 and 1 each stream tagged
+messages to rank 2, which folds them into an ORDER-SENSITIVE checksum
+(ANY_SOURCE interleaving is the nondeterminism the event log pins
+down), acks every second message to rank 0, checkpoints its state after
+4 receives, and crashes without consuming the rest.
+
+Replay (standalone, pml_v replay mode as rank 2): the same code path
+re-executes; receives come from the peers' sender-based logs in event-
+log order, the acks are suppressed and verified byte-identical, and the
+recomputed checksum must equal the checkpoint — deterministic replay to
+consistency (reference: vprotocol_pessimist replay mode).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+from ompi_tpu import COMM_WORLD
+import ompi_tpu.pml.vprotocol  # noqa: F401  (registers the pml_v vars)
+from ompi_tpu.mca.var import get_var
+
+
+def main() -> int:
+    logdir = get_var("pml_v", "logdir")
+    replay = bool(get_var("pml_v", "replay"))
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+    assert n == 3, n
+    ckpt = os.path.join(logdir, "rank2_checkpoint.txt")
+
+    if r in (0, 1) and not replay:
+        for i in range(3):
+            msg = np.array([r * 1000 + i * 7, i], np.int64)
+            COMM_WORLD.Send(msg, dest=2, tag=7)
+        if r == 0:  # two acks arrive before the crash point
+            ack = np.zeros(1, np.int64)
+            for _ in range(2):
+                COMM_WORLD.Recv(ack, source=2, tag=9)
+        sys.stdout.write(f"rank {r}: V-SENDER-OK\n")
+        sys.stdout.flush()
+        return 0
+
+    # rank 2's logic — identical source in live and replay runs (the
+    # point of deterministic replay)
+    h = 0
+    buf = np.zeros(2, np.int64)
+    for i in range(6):
+        COMM_WORLD.Recv(buf, tag=7)  # ANY_SOURCE: the nondeterminism
+        h = (h * 31 + int(buf[0]) + 3 * int(buf[1])) & 0xFFFFFFFF
+        if i % 2 == 1:
+            COMM_WORLD.Send(np.array([h], np.int64), dest=0, tag=9)
+        if i == 3:
+            if not replay:
+                with open(ckpt, "w") as f:
+                    f.write(str(h))
+                sys.stdout.write("rank 2: V-CRASHING\n")
+                sys.stdout.flush()
+                os._exit(0)  # crash before consuming the last messages
+            with open(ckpt) as f:
+                want = int(f.read().strip())
+            assert h == want, (h, want)
+            sys.stdout.write(f"rank 2: V-REPLAY-OK {h}\n")
+            sys.stdout.flush()
+            return 0
+    raise AssertionError("unreachable")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

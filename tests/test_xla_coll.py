@@ -313,3 +313,9 @@ def test_gather_root_rows(world):
     x = world.shard(np.arange(8, dtype=np.float32)[:, None])
     out = np.asarray(world.gather(x, root=0))
     np.testing.assert_array_equal(out[0, :, 0], np.arange(8))
+
+
+def test_mesh_agree_band(world):
+    """MPIX_Comm_agree on a mesh comm: BAND under the single controller
+    (the pml-less branch of ft/agreement.agree)."""
+    assert world.Agree(0b1011) == 0b1011
