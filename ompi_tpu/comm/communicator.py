@@ -118,6 +118,10 @@ class Communicator:
         self.revoked = False  # ULFM (reference: communicator.h:360-363)
         self.coll = None  # CollTable, set by subclasses after selection
         self.topo = None  # topology module (cart/graph), set by topo layer
+        from ompi_tpu.mpit import emit  # MPI_T event (mpit.py)
+
+        emit("comm", "created", name=self.name, cid=cid,
+             size=group.size)
 
     # ------------------------------------------------------------- queries
     @property

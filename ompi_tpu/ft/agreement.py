@@ -24,6 +24,12 @@ def agree(comm, flag: int) -> int:
         # functional: [W, ...] in, [W, ...] out)
         from ompi_tpu.core import op as _op
 
+        flag = int(flag)
+        if not -2**31 <= flag < 2**31:
+            # jax demotes int64 to int32 without jax_enable_x64, which
+            # would silently wrap wide bitmasks; every mesh position
+            # contributes the same driver-held value, so AND == flag
+            return flag
         x = comm.shard(np.full((comm.world_size, 1), flag, np.int32))
         out = comm.allreduce(x, _op.BAND)
         return int(np.asarray(out)[0, 0])

@@ -58,6 +58,9 @@ def mark_failed(rank: int) -> None:
             return
         _failed.add(rank)
     _log.warning("rank %d declared FAILED", rank)
+    from ompi_tpu.mpit import emit  # MPI_T event (mpit.py)
+
+    emit("ft", "proc_failed", rank=rank)
     if _propagator is not None:
         try:
             _propagator(rank)

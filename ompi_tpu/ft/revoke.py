@@ -26,6 +26,9 @@ def revoke_comm(comm) -> None:
         return
     comm.revoked = True
     show_help("comm", "revoked", name=comm.name)
+    from ompi_tpu.mpit import emit  # MPI_T event (mpit.py)
+
+    emit("comm", "revoked", name=comm.name, cid=comm.cid)
     pml = getattr(comm, "pml", None)
     if pml is None:
         return  # mesh-mode comms revoke locally (single controller)

@@ -108,6 +108,11 @@ class Framework:
             if module is not None:
                 out.append((comp.PRIORITY, comp.NAME, module))
         out.sort(key=lambda t: (-t[0], t[1]))
+        if out:
+            from ompi_tpu.mpit import emit  # MPI_T event (mpit.py)
+
+            emit("mca", "component_selected", framework=self.name,
+                 component=out[0][1], priority=out[0][0])
         return out
 
     def select_one(self, **ctx: Any) -> Tuple[str, Any]:

@@ -16,6 +16,7 @@ from collections import defaultdict
 from typing import Dict, Tuple
 
 from ompi_tpu.mca.var import register_var, get_var, register_pvar
+from ompi_tpu.pml.base import user_traffic
 
 register_var("pml_monitoring", "enable", False,
              help="Interpose the pml and count per-peer messages/bytes "
@@ -41,15 +42,6 @@ class MonitoringPml:
                                   if d == "rx"),
                       help="Bytes received through the monitored pml")
 
-    # Count USER pt2pt only (cf. spc.suppressed(); the reference
-    # monitoring component likewise separates user pt2pt from
-    # collective/internal classes) — classification shared with pml/v.
-    @staticmethod
-    def _user_traffic(tag: int, cid: int) -> bool:
-        from ompi_tpu.pml.base import user_traffic
-
-        return user_traffic(tag, cid)
-
     def _bump(self, peer: int, direction: str, nbytes: int) -> None:
         with self._lock:
             c = self.counts[(peer, direction)]
@@ -58,13 +50,13 @@ class MonitoringPml:
 
     # ------------------------------------------------- monitored verbs
     def isend(self, buf, count, datatype, dst, tag, cid):
-        if self._user_traffic(tag, cid):
+        if user_traffic(tag, cid):
             self._bump(dst, "tx", count * datatype.size)
         return self._inner.isend(buf, count, datatype, dst, tag, cid)
 
     def irecv(self, buf, count, datatype, src, tag, cid):
         req = self._inner.irecv(buf, count, datatype, src, tag, cid)
-        if self._user_traffic(tag, cid):
+        if user_traffic(tag, cid):
             def done(r):
                 if r.status.source >= 0:
                     self._bump(r.status.source, "rx", r.status._nbytes)

@@ -95,7 +95,10 @@ class VprotocolPml:
 
     def __init__(self, inner, logdir: str, replay: bool):
         self._inner = inner
-        self._lock = threading.Lock()
+        # RLock: a self-send completes synchronously through SelfBtl,
+        # firing the receive's event-log callback on THIS thread while
+        # isend still holds the lock for its append+send critical section
+        self._lock = threading.RLock()
         self._replay = replay
         self.logged_send_bytes = 0
         self.logged_events = 0
@@ -133,38 +136,34 @@ class VprotocolPml:
                       lambda: self.logged_events,
                       help="Receive events forced to the event log")
 
+    # ------------------------------------------------------------- verbs
     # Only user pt2pt is logged/replayed: library-internal traffic
     # (plane-bit cids, system tags) regenerates naturally on replay —
     # classification shared with pml/monitoring (pml/base.user_traffic).
-    @staticmethod
-    def _user_traffic(tag: int, cid: int) -> bool:
+    def isend(self, buf, count, datatype, dst, tag, cid):
+        from ompi_tpu.core.convertor import pack
         from ompi_tpu.pml.base import user_traffic
 
-        return user_traffic(tag, cid)
-
-    @staticmethod
-    def _payload_of(buf, count, datatype) -> bytes:
-        from ompi_tpu.core.convertor import pack
-
-        return pack(buf, count, datatype).tobytes()
-
-    # ------------------------------------------------------------- verbs
-    def isend(self, buf, count, datatype, dst, tag, cid):
-        if not self._user_traffic(tag, cid):
+        if not user_traffic(tag, cid):
             return self._inner.isend(buf, count, datatype, dst, tag, cid)
-        data = self._payload_of(buf, count, datatype)
+        # one extra pack vs the inner pml's own convertor — accepted cost
+        # of the payload log; the memoryview write avoids a bytes copy
+        packed = pack(buf, count, datatype)
         if self._replay:
-            return self._replay_send(data, dst, tag, cid)
+            return self._replay_send(packed.tobytes(), dst, tag, cid)
         with self._lock:
             # the append and the send stay under ONE lock: replay
             # resolves payloads by per-source FIFO over this log, so log
             # order must equal wire order even with concurrent senders
-            _append(self._sb, dst, tag, cid, len(data), data)
-            self.logged_send_bytes += len(data)
+            _append(self._sb, dst, tag, cid, packed.nbytes,
+                    memoryview(packed))
+            self.logged_send_bytes += packed.nbytes
             return self._inner.isend(buf, count, datatype, dst, tag, cid)
 
     def irecv(self, buf, count, datatype, src, tag, cid):
-        if not self._user_traffic(tag, cid):
+        from ompi_tpu.pml.base import user_traffic
+
+        if not user_traffic(tag, cid):
             return self._inner.irecv(buf, count, datatype, src, tag, cid)
         if self._replay:
             return self._replay_recv(buf, count, datatype, src, tag, cid)
@@ -208,6 +207,11 @@ class VprotocolPml:
         from ompi_tpu.core.errors import MPIError, ERR_INTERN
         from ompi_tpu.core.request import CompletedRequest
 
+        from ompi_tpu.pml.base import ANY_SOURCE as _ANY, ANY_TAG as _ANYT
+
+        # ONE critical section: event pop + payload resolution must be
+        # atomic or concurrent replayed receives pair events with the
+        # wrong sender-log records
         with self._lock:
             if self._ev_pos >= len(self._events):
                 raise MPIError(
@@ -215,20 +219,17 @@ class VprotocolPml:
                     "pml_v replay: receive past the end of the event log "
                     "(restart reached the crash point)")
             esrc, etag, ecid, enbytes, _ = self._events[self._ev_pos]
+            if src not in (_ANY, esrc):
+                raise MPIError(
+                    ERR_INTERN,
+                    f"pml_v replay diverged: receive posted for source "
+                    f"{src} but the event log matched {esrc}")
+            if tag not in (_ANYT, etag):
+                raise MPIError(
+                    ERR_INTERN,
+                    f"pml_v replay diverged: receive posted with tag "
+                    f"{tag} but the event log matched {etag}")
             self._ev_pos += 1
-        from ompi_tpu.pml.base import ANY_SOURCE as _ANY, ANY_TAG as _ANYT
-
-        if src not in (_ANY, esrc):
-            raise MPIError(
-                ERR_INTERN,
-                f"pml_v replay diverged: receive posted for source {src} "
-                f"but the event log matched {esrc}")
-        if tag not in (_ANYT, etag):
-            raise MPIError(
-                ERR_INTERN,
-                f"pml_v replay diverged: receive posted with tag {tag} "
-                f"but the event log matched {etag}")
-        with self._lock:
             # the event log resolves the nondeterminism (which source);
             # per-source FIFO order resolves the payload — take the first
             # unconsumed record matching (tag, cid), skipping records a

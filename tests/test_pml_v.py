@@ -71,3 +71,31 @@ def test_pml_v_replay_detects_divergence(tmp_path):
                         timeout=120, env=_replay_env(logdir))
     assert r2.returncode != 0
     assert "diverged" in (r2.stdout + r2.stderr), r2.stdout + r2.stderr
+
+
+def test_pml_v_self_send_no_deadlock(tmp_path):
+    """A self-send completes synchronously through SelfBtl, firing the
+    event-log callback on the sending thread while isend holds the log
+    lock — must not deadlock (regression: the lock is reentrant)."""
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({
+        "OMPI_TPU_MCA_pml_v_enable": "1",
+        "OMPI_TPU_MCA_pml_v_logdir": str(tmp_path / "vlogs"),
+    })
+    prog = (
+        "import numpy as np\n"
+        "from ompi_tpu import COMM_WORLD\n"
+        "buf = np.zeros(3, np.int64)\n"
+        "req = COMM_WORLD.Irecv(buf, source=0, tag=5)\n"
+        "COMM_WORLD.Send(np.arange(3, dtype=np.int64), dest=0, tag=5)\n"
+        "req.Wait()\n"
+        "assert list(buf) == [0, 1, 2], buf\n"
+        "print('SELF-OK')\n"
+    )
+    r = subprocess.run([sys.executable, "-c", prog], cwd=REPO,
+                       capture_output=True, text=True, timeout=60,
+                       env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SELF-OK" in r.stdout
