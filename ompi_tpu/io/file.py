@@ -22,6 +22,7 @@ Redesign notes:
 from __future__ import annotations
 
 import os
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -30,6 +31,33 @@ from ompi_tpu.comm.communicator import parse_buffer
 from ompi_tpu.core import op as _op
 from ompi_tpu.core.datatype import BYTE, Datatype
 from ompi_tpu.core.errors import MPIError, ERR_AMODE, ERR_FILE, ERR_IO
+from ompi_tpu.core.request import Request
+from ompi_tpu.mca.var import register_var, get_var
+
+register_var("io", "num_aggregators", 2,
+             help="Aggregator count for two-phase collective IO "
+                  "(reference: fcoll/vulcan's aggregator selection)",
+             level=4)
+register_var("io", "stripe_size", 1 << 20,
+             help="File-cycle stripe: stripe s belongs to aggregator "
+                  "(s %% num_aggregators) — the vulcan round-robin cycle "
+                  "assignment", level=6)
+
+# Independent nonblocking IO rides a small worker pool (the fbtl/posix
+# aio analog: the request completes asynchronously and Wait's condition
+# variable wakes through the normal completion path).
+_io_pool = ThreadPoolExecutor(max_workers=2,
+                              thread_name_prefix="ompi-tpu-io")
+
+
+def _suppressed_spc():
+    from ompi_tpu.runtime import spc
+
+    return spc.suppressed()
+
+# CID plane for collective-IO exchange traffic (COLL=1<<30, PART=1<<29,
+# NBC=1<<28, DPM=1<<27, FT=1<<25 — IO takes 1<<26)
+IO_CID_BIT = 1 << 26
 
 MODE_RDONLY = 2
 MODE_RDWR = 8
@@ -79,6 +107,28 @@ class File:
         self.filetype: Datatype = BYTE
         self.offset = 0  # individual file pointer, in etypes
         self._shared_win = None
+        # private comm for collective-IO traffic (reference: ompio dups
+        # the communicator at file open, ompio_file_open.c) — collective
+        # phases never cross-match user traffic, and nonblocking
+        # collective IO can progress from a worker thread
+        from ompi_tpu.runtime import spc
+
+        with spc.suppressed():
+            self._io_comm = comm.Dup() if comm.size > 1 else comm
+        if self._io_comm is not comm:
+            # move the io comm onto its own CID plane (IO_CID_BIT): the
+            # two-phase exchange is library-internal traffic —
+            # pml/monitoring must not count it as application pt2pt and
+            # pml/v must not payload-log it (it regenerates on replay)
+            from ompi_tpu.comm.communicator import _live_comms
+
+            _live_comms.pop(self._io_comm.cid, None)
+            self._io_comm.cid |= IO_CID_BIT
+            _live_comms[self._io_comm.cid] = self._io_comm
+        # collective ops per file run on ONE serial worker: MPI requires
+        # collective calls in order per comm, so i*_all must not reorder
+        self._coll_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ompi-tpu-io-coll")
 
     @staticmethod
     def Open(comm, filename: str, amode: int = MODE_RDWR | MODE_CREATE
@@ -86,8 +136,11 @@ class File:
         return File(comm, filename, amode)
 
     def Close(self) -> None:
+        self._coll_pool.shutdown(wait=True)  # drain i*_all in flight
         self.comm.Barrier()
         os.close(self.fd)
+        if self._io_comm is not self.comm:
+            self._io_comm.Free()
         if self.amode & MODE_DELETE_ON_CLOSE and self.comm.rank == 0:
             try:
                 os.unlink(self.filename)
@@ -186,51 +239,157 @@ class File:
         os.fsync(self.fd)
 
     # ----------------------------------------------------- collective IO
+    # Two-phase with MULTIPLE aggregators (reference:
+    # fcoll/vulcan/fcoll_vulcan_file_write_all.c — aggregators own file
+    # cycles round-robin; every rank exchanges its stripe-split segments
+    # with the owning aggregator, which issues large coalesced IO).
+    def _aggregators(self) -> List[int]:
+        n = self._io_comm.size
+        a = max(1, min(int(get_var("io", "num_aggregators")), n))
+        # spread aggregators across the rank space (vulcan picks evenly
+        # spaced ranks for locality across nodes)
+        return [(i * n) // a for i in range(a)]
+
+    def _split_by_stripe(self, segs, naggs: int):
+        """Split (file_off, bytes) segments at stripe boundaries and
+        bucket them by owning aggregator index."""
+        stripe = max(1, int(get_var("io", "stripe_size")))
+        buckets: List[list] = [[] for _ in range(naggs)]
+        for foff, data in segs:
+            pos = 0
+            while pos < len(data):
+                s = (foff + pos) // stripe
+                end_of_stripe = (s + 1) * stripe - foff
+                piece = data[pos: min(len(data), end_of_stripe)]
+                buckets[int(s) % naggs].append((foff + pos, piece))
+                pos += len(piece)
+        return buckets
+
+    _TAG_WSEG = 11   # rank -> aggregator: pickled write segments
+    _TAG_RREQ = 12   # rank -> aggregator: pickled read runs
+    _TAG_RDAT = 13   # aggregator -> rank: concatenated read bytes
+
     def Write_at_all(self, offset: int, buf) -> int:
-        """Two-phase collective write, rank-0 aggregation (reference:
-        fcoll two-phase — gather segments, coalesce, one large write)."""
         obj, count, dt = parse_buffer(buf)
         from ompi_tpu.core.convertor import pack
 
         data = pack(obj, count, dt).tobytes()
         runs = self._file_runs(offset, len(data))
         segs = [(foff, data[soff: soff + ln]) for foff, soff, ln in runs]
-        return self._aggregate_write(segs)
+        return self._two_phase_write(segs)
 
-    def _aggregate_write(self, segs) -> int:
+    def _two_phase_write(self, segs) -> int:
         import pickle
 
-        blob = pickle.dumps(segs)
-        n = self.comm.size
-        if n == 1:
-            written = sum(os.pwrite(self.fd, d, o) for o, d in segs)
-            return written
-        sizes = np.zeros(n, np.int64)
-        self.comm.Allgather(np.array([len(blob)], np.int64), sizes)
-        recv_total = int(sizes.sum())
-        recvbuf = np.zeros(recv_total, np.uint8) if self.comm.rank == 0 \
-            else np.zeros(0, np.uint8)
-        self.comm.Gatherv(np.frombuffer(blob, np.uint8),
-                          [recvbuf, recv_total, BYTE],
-                          counts=sizes.tolist(), root=0)
+        comm = self._io_comm
         written = sum(len(d) for _, d in segs)
-        if self.comm.rank == 0:
-            off = 0
-            allsegs = []
-            for i in range(n):
-                allsegs.extend(pickle.loads(
-                    recvbuf[off: off + int(sizes[i])].tobytes()))
-                off += int(sizes[i])
-            allsegs.sort(key=lambda s: s[0])
-            for foff, d in allsegs:
-                os.pwrite(self.fd, d, foff)
-        self.comm.Barrier()
+        if comm.size == 1:
+            for o, d in segs:
+                os.pwrite(self.fd, d, o)
+            return written
+        aggs = self._aggregators()
+        buckets = self._split_by_stripe(segs, len(aggs))
+        reqs = []
+        for k, agg in enumerate(aggs):
+            blob = np.frombuffer(pickle.dumps(buckets[k]), np.uint8)
+            reqs.append(comm.Isend(blob, dest=agg, tag=self._TAG_WSEG))
+        if comm.rank in aggs:
+            mine: List[Tuple[int, bytes]] = []
+            for r in range(comm.size):
+                from ompi_tpu.core.status import Status
+
+                st = Status()
+                comm.Probe(source=r, tag=self._TAG_WSEG, status=st)
+                raw = np.zeros(st.Get_count(BYTE), np.uint8)
+                comm.Recv(raw, source=r, tag=self._TAG_WSEG)
+                mine.extend(pickle.loads(raw.tobytes()))
+            mine.sort(key=lambda s: s[0])
+            # coalesce adjacent pieces into large writes (phase 2)
+            i = 0
+            while i < len(mine):
+                foff, d = mine[i]
+                parts = [d]
+                end = foff + len(d)
+                j = i + 1
+                while j < len(mine) and mine[j][0] == end:
+                    parts.append(mine[j][1])
+                    end += len(mine[j][1])
+                    j += 1
+                os.pwrite(self.fd, b"".join(parts), foff)
+                i = j
+        Request.Waitall(reqs)
+        with _suppressed_spc():
+            comm.Barrier()
         return written
 
     def Read_at_all(self, offset: int, buf) -> int:
-        n = self.Read_at(offset, buf)
-        self.comm.Barrier()
-        return n
+        """Two-phase collective read: aggregators pread their stripes
+        and serve each rank's runs back (vulcan's read_all mirror)."""
+        obj, count, dt = parse_buffer(buf)
+        from ompi_tpu.core.convertor import unpack
+
+        nbytes = count * dt.size
+        runs = self._file_runs(offset, nbytes)
+        comm = self._io_comm
+        if comm.size == 1:
+            n = self.Read_at(offset, buf)
+            return n
+        import pickle
+
+        aggs = self._aggregators()
+        # bucket my runs (keeping local placement) by owning aggregator
+        stripe_runs = self._split_by_stripe(
+            [(foff, bytes(ln)) for foff, _, ln in runs], len(aggs))
+        # _split_by_stripe carried placeholder bytes; rebuild as
+        # (file_off, length) requests per aggregator
+        want = [[(foff, len(d)) for foff, d in b] for b in stripe_runs]
+        reqs = []
+        for k, agg in enumerate(aggs):
+            blob = np.frombuffer(pickle.dumps(want[k]), np.uint8)
+            reqs.append(comm.Isend(blob, dest=agg, tag=self._TAG_RREQ))
+        serve = []
+        if comm.rank in aggs:
+            for r in range(comm.size):
+                from ompi_tpu.core.status import Status
+
+                st = Status()
+                comm.Probe(source=r, tag=self._TAG_RREQ, status=st)
+                raw = np.zeros(st.Get_count(BYTE), np.uint8)
+                comm.Recv(raw, source=r, tag=self._TAG_RREQ)
+                asked = pickle.loads(raw.tobytes())
+                # per-run ACTUAL payloads: a pread at/past EOF is short,
+                # and the requester must know each run's real length or
+                # every later slice misaligns and zeros count as read
+                pieces = [os.pread(self.fd, ln, foff)
+                          for foff, ln in asked]
+                reply = np.frombuffer(pickle.dumps(pieces), np.uint8)
+                serve.append(comm.Isend(reply, dest=r,
+                                        tag=self._TAG_RDAT))
+        # collect my data from each aggregator, in my request order
+        chunks = bytearray(nbytes)
+        got_total = 0
+        for k, agg in enumerate(aggs):
+            from ompi_tpu.core.status import Status
+
+            st = Status()
+            comm.Probe(source=agg, tag=self._TAG_RDAT, status=st)
+            raw = np.zeros(st.Get_count(BYTE), np.uint8)
+            comm.Recv(raw, source=agg, tag=self._TAG_RDAT)
+            pieces = pickle.loads(raw.tobytes())
+            for (foff, _ln), piece in zip(want[k], pieces):
+                # map the stripe piece back into the local stream: find
+                # the containing original run
+                for rfoff, rsoff, rln in runs:
+                    if rfoff <= foff < rfoff + rln:
+                        dst = rsoff + (foff - rfoff)
+                        chunks[dst: dst + len(piece)] = piece
+                        got_total += len(piece)
+                        break
+        Request.Waitall(reqs + serve)
+        unpack(np.frombuffer(bytes(chunks), np.uint8), obj, count, dt)
+        with _suppressed_spc():
+            comm.Barrier()
+        return got_total
 
     def Write_all(self, buf) -> int:
         obj, count, dt = parse_buffer(buf)
@@ -243,6 +402,54 @@ class File:
         n = self.Read_at_all(self.offset, buf)
         self.offset += (count * dt.size) // max(self.etype.size, 1)
         return n
+
+    # ---------------------------------------------------- nonblocking IO
+    # Reference: common_ompio_file_iwrite{,_at,_all} (common_ompio.h:262
+    # -267) over the fbtl aio machinery. The request completes from a
+    # worker; independent ops share a small pool, collective ops run on
+    # the file's single serial worker (collective order per comm must be
+    # preserved) against the private io comm.
+    def _submit(self, pool, fn) -> Request:
+        req = Request()
+
+        def run():
+            try:
+                n = fn()
+                req.status._nbytes = int(n)
+                req._set_complete(0)
+            except MPIError as e:
+                req._set_complete(e.code)
+            except Exception:
+                req._set_complete(ERR_IO)
+
+        pool.submit(run)
+        return req
+
+    def Iwrite_at(self, offset: int, buf) -> Request:
+        return self._submit(_io_pool, lambda: self.Write_at(offset, buf))
+
+    def Iread_at(self, offset: int, buf) -> Request:
+        return self._submit(_io_pool, lambda: self.Read_at(offset, buf))
+
+    def Iwrite(self, buf) -> Request:
+        obj, count, dt = parse_buffer(buf)
+        off = self.offset
+        self.offset += (count * dt.size) // max(self.etype.size, 1)
+        return self._submit(_io_pool, lambda: self.Write_at(off, buf))
+
+    def Iread(self, buf) -> Request:
+        obj, count, dt = parse_buffer(buf)
+        off = self.offset
+        self.offset += (count * dt.size) // max(self.etype.size, 1)
+        return self._submit(_io_pool, lambda: self.Read_at(off, buf))
+
+    def Iwrite_at_all(self, offset: int, buf) -> Request:
+        return self._submit(self._coll_pool,
+                            lambda: self.Write_at_all(offset, buf))
+
+    def Iread_at_all(self, offset: int, buf) -> Request:
+        return self._submit(self._coll_pool,
+                            lambda: self.Read_at_all(offset, buf))
 
     # ------------------------------------------------- shared file pointer
     def _shared(self):

@@ -160,18 +160,23 @@ def pvar_get_index(name: str) -> int:
 
 class PvarSession:
     """pvar_session_create.c — handles are scoped to a session so
-    concurrent tools keep independent baselines/start state."""
+    concurrent tools keep independent baselines/start state; freeing
+    the session invalidates its handles (pvar_session_free semantics)."""
 
     def __init__(self):
         _check_init()
         self._handles: List[PvarHandle] = []
+        self._freed = False
 
     def handle_alloc(self, index: int) -> "PvarHandle":
+        if self._freed:
+            raise MPIError(ERR_ARG, "pvar session already freed")
         h = PvarHandle(self, index)
         self._handles.append(h)
         return h
 
     def free(self) -> None:
+        self._freed = True
         self._handles.clear()
 
 
@@ -184,15 +189,20 @@ class PvarHandle:
         ps = _pvar_list()
         if not 0 <= index < len(ps):
             raise MPIError(ERR_ARG, f"pvar index {index} out of range")
+        self._session = session
         self._pvar = ps[index]
         self._baseline: Any = 0
         self._started = True
         self._frozen: Any = None
 
     def _raw(self) -> Any:
+        if self._session._freed:
+            raise MPIError(ERR_ARG, "pvar handle's session was freed")
         return self._pvar.value
 
     def read(self) -> Any:
+        if self._session._freed:
+            raise MPIError(ERR_ARG, "pvar handle's session was freed")
         val = self._frozen if not self._started else self._raw()
         if isinstance(val, (int, float)) and isinstance(
                 self._baseline, (int, float)):
@@ -259,16 +269,23 @@ def category_get_index(name: str) -> int:
     return cats.index(name)
 
 
+def _category_name(index: int) -> str:
+    cats = _categories()
+    if not 0 <= index < len(cats):
+        raise MPIError(ERR_ARG, f"category index {index} out of range")
+    return cats[index]
+
+
 def category_get_cvars(index: int) -> List[int]:
     """Indices of the category's cvars (category_get_cvars.c)."""
     _check_init()
-    name = _categories()[index]
+    name = _category_name(index)
     return [i for i, v in enumerate(_cvar_list()) if v.framework == name]
 
 
 def category_get_pvars(index: int) -> List[int]:
     _check_init()
-    name = _categories()[index]
+    name = _category_name(index)
     return [i for i, p in enumerate(_pvar_list()) if p.framework == name]
 
 
