@@ -81,7 +81,11 @@ def init() -> None:
             "comm": comm,
             "heap": heap,
             "win": Win.Create(heap, comm),
-            "brk": 0,
+            # first-fit free list of (offset, size) spans — the memheap
+            # allocator analog (reference: oshmem/mca/memheap ptmalloc/
+            # buddy); symmetric because every PE runs the same sequence
+            "free": [(0, heap.nbytes)],
+            "nbi": [],  # outstanding nonblocking put/get requests
         }
 
 
@@ -111,30 +115,56 @@ def n_pes() -> int:
 # ----------------------------------------------------------- memheap
 def zeros(count: int, dtype=np.float64) -> SymArray:
     """Symmetric allocation (shmem_malloc + zero). SYMMETRY CONTRACT:
-    every PE must perform the same allocation sequence (the reference's
-    memheap makes the same assumption — remote addresses are computed,
-    not exchanged)."""
+    every PE must perform the same allocation/free sequence (the
+    reference's memheap makes the same assumption — remote addresses
+    are computed, not exchanged). First-fit over the free list with
+    alignment padding kept reusable."""
     ctx = _need()
     dt = np.dtype(dtype)
+    if count == 0:  # empty symmetric array: nothing to carve or address
+        return SymArray(0, 0, dt, np.zeros(0, dt))
     nbytes = count * dt.itemsize
-    off = (ctx["brk"] + _ALIGN - 1) & ~(_ALIGN - 1)
-    if off + nbytes > ctx["heap"].nbytes:
-        raise MPIError(ERR_OTHER,
-                       f"symmetric heap exhausted ({ctx['heap'].nbytes}B; "
-                       "raise shmem_heap_bytes)")
-    ctx["brk"] = off + nbytes
-    local = ctx["heap"][off : off + nbytes].view(dt)
-    local[:] = 0
-    return SymArray(off, count, dt, local)
+    for i, (foff, fsize) in enumerate(ctx["free"]):
+        off = (foff + _ALIGN - 1) & ~(_ALIGN - 1)
+        pad = off - foff
+        if pad + nbytes > fsize:
+            continue
+        # carve: [foff, off) stays free (alignment pad), the tail after
+        # the block stays free
+        repl = []
+        if pad:
+            repl.append((foff, pad))
+        tail = fsize - pad - nbytes
+        if tail:
+            repl.append((off + nbytes, tail))
+        ctx["free"][i: i + 1] = repl
+        local = ctx["heap"][off: off + nbytes].view(dt)
+        local[:] = 0
+        return SymArray(off, count, dt, local)
+    raise MPIError(ERR_OTHER,
+                   f"symmetric heap exhausted ({ctx['heap'].nbytes}B; "
+                   "raise shmem_heap_bytes)")
 
 
 def free(arr: SymArray) -> None:
-    """shmem_free — the bump allocator only reclaims a trailing block
-    (the reference's memheap buddy/ptmalloc do better; symmetric frees
-    are rare in practice)."""
+    """shmem_free: return the block to the free list, coalescing with
+    adjacent spans (reference: memheap's real allocator — long-running
+    PGAS programs must be able to reclaim)."""
     ctx = _need()
-    if arr.off + arr.count * arr.dtype.itemsize == ctx["brk"]:
-        ctx["brk"] = arr.off
+    nbytes = arr.count * arr.dtype.itemsize
+    if nbytes == 0:
+        return
+    spans = ctx["free"]
+    spans.append((arr.off, nbytes))
+    spans.sort()
+    merged = [spans[0]]
+    for off, size in spans[1:]:
+        loff, lsize = merged[-1]
+        if loff + lsize == off:
+            merged[-1] = (loff, lsize + size)
+        else:
+            merged.append((off, size))
+    ctx["free"] = merged
 
 
 # ------------------------------------------------------------- put/get
@@ -162,6 +192,130 @@ def p(arr: SymArray, value, pe: int, offset: int = 0) -> None:
 def g(arr: SymArray, pe: int, offset: int = 0):
     """shmem_g (single element)."""
     return get(arr, 1, pe, offset)[0]
+
+
+# ------------------------------------------------- nonblocking put/get
+def put_nbi(arr: SymArray, src, pe: int, offset: int = 0) -> None:
+    """shmem_put_nbi: neither local nor remote completion at return —
+    both at quiet() (reference: oshmem/shmem/c/shmem_put_nb.c; the src
+    buffer must stay unmodified until quiet)."""
+    ctx = _need()
+    src = np.ascontiguousarray(np.asarray(src, dtype=arr.dtype))
+    ctx["nbi"].append(ctx["win"].Rput(src, pe,
+                                      target_disp=arr._disp(offset)))
+
+
+def get_nbi(arr: SymArray, out: np.ndarray, pe: int,
+            offset: int = 0) -> None:
+    """shmem_get_nbi: ``out`` is valid only after quiet()."""
+    ctx = _need()
+    assert out.dtype == arr.dtype
+    ctx["nbi"].append(ctx["win"].Rget(out, pe,
+                                      target_disp=arr._disp(offset)))
+
+
+# -------------------------------------------------------- strided iput
+def iput(arr: SymArray, src, tst: int, sst: int, nelems: int,
+         pe: int, offset: int = 0) -> None:
+    """shmem_iput: element k of the strided source (stride sst) lands at
+    target index offset + k*tst (reference: oshmem/shmem/c/shmem_iput.c
+    — the spml likewise decomposes to element transfers)."""
+    ctx = _need()
+    src = np.asarray(src, dtype=arr.dtype)
+    for k in range(nelems):
+        ctx["win"].Put(np.ascontiguousarray(src[k * sst: k * sst + 1]),
+                       pe, target_disp=arr._disp(offset + k * tst))
+
+
+def iget(arr: SymArray, tst: int, sst: int, nelems: int, pe: int,
+         offset: int = 0) -> np.ndarray:
+    """shmem_iget: gather target indices offset + k*sst into a local
+    strided array of stride tst (returned dense of size nelems*tst)."""
+    ctx = _need()
+    out = np.zeros(max(1, 1 + (nelems - 1) * tst), arr.dtype)
+    reqs = []
+    for k in range(nelems):
+        reqs.append(ctx["win"].Rget(out[k * tst: k * tst + 1], pe,
+                                    target_disp=arr._disp(offset + k * sst)))
+    for r in reqs:
+        r.Wait()
+    return out
+
+
+# ------------------------------------------------------ wait_until/test
+CMP_EQ, CMP_NE, CMP_GT, CMP_GE, CMP_LT, CMP_LE = range(6)
+
+_CMPS = {
+    CMP_EQ: lambda a, b: a == b,
+    CMP_NE: lambda a, b: a != b,
+    CMP_GT: lambda a, b: a > b,
+    CMP_GE: lambda a, b: a >= b,
+    CMP_LT: lambda a, b: a < b,
+    CMP_LE: lambda a, b: a <= b,
+}
+
+
+def test(arr: SymArray, cmp: int, value, index: int = 0) -> bool:
+    """shmem_test: one progress-driving poll of the LOCAL location."""
+    from ompi_tpu.runtime.progress import progress
+
+    _need()
+    progress()
+    return bool(_CMPS[cmp](arr.local[index], value))
+
+
+def wait_until(arr: SymArray, cmp: int, value, index: int = 0,
+               timeout: Optional[float] = None) -> None:
+    """shmem_wait_until: block (driving progress) until a remote put or
+    atomic makes the local location satisfy the comparison (reference:
+    oshmem/shmem/c/shmem_wait.c over the spml's memory-update hooks —
+    here the osc active-message engine applies updates from progress)."""
+    from ompi_tpu.runtime.progress import progress_until
+
+    _need()
+    if not progress_until(
+            lambda: bool(_CMPS[cmp](arr.local[index], value)),
+            timeout=timeout):
+        raise MPIError(ERR_OTHER, "shmem_wait_until timed out")
+
+
+# -------------------------------------------------------- distributed lock
+def set_lock(lock: SymArray) -> None:
+    """shmem_set_lock: acquire via CAS(0 -> my_pe+1) on the lock's home
+    PE, spinning through the progress engine (reference:
+    oshmem/shmem/c/shmem_lock.c — theirs is an MCS queue over the
+    symmetric variable; a CAS spin with backoff serves the same mutual-
+    exclusion contract at driver scale)."""
+    from ompi_tpu.core.request import IdleBackoff
+
+    me = my_pe() + 1
+    backoff = IdleBackoff()
+    while True:
+        old = atomic_compare_swap(lock, 0, me, pe=_lock_home(lock))
+        if old == 0:
+            return
+        backoff.step(False)
+
+
+def test_lock(lock: SymArray) -> bool:
+    """shmem_test_lock: one acquisition attempt; True = got it."""
+    me = my_pe() + 1
+    return atomic_compare_swap(lock, 0, me, pe=_lock_home(lock)) == 0
+
+
+def clear_lock(lock: SymArray) -> None:
+    """shmem_clear_lock: release (must hold it)."""
+    me = my_pe() + 1
+    old = atomic_compare_swap(lock, me, 0, pe=_lock_home(lock))
+    if old != me:
+        raise MPIError(ERR_OTHER,
+                       f"clear_lock by non-holder (lock held by {old})")
+
+
+def _lock_home(lock: SymArray) -> int:
+    # deterministic home PE spread by heap offset (same value on every
+    # PE — the symmetry contract)
+    return (lock.off // _ALIGN) % n_pes()
 
 
 # ------------------------------------------------------------- atomics
@@ -201,8 +355,19 @@ def fence() -> None:
 
 
 def quiet() -> None:
-    """shmem_quiet: remote completion of all outstanding puts/atomics."""
-    _need()["win"].Flush()
+    """shmem_quiet: remote completion of all outstanding puts/atomics,
+    including the _nbi ones (their requests complete here)."""
+    ctx = _need()
+    reqs, ctx["nbi"] = ctx["nbi"], []
+    err = None
+    for r in reqs:
+        try:
+            r.Wait()
+        except MPIError as e:
+            err = err or e  # keep draining: no request may be dropped
+    if err is not None:
+        raise err
+    ctx["win"].Flush()
 
 
 def barrier_all() -> None:

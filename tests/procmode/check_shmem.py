@@ -66,6 +66,75 @@ def main() -> int:
     np.testing.assert_array_equal(
         coll, np.repeat(np.arange(1, n + 1, dtype=np.float64), 3))
 
+    # ---- nonblocking put/get: completion at quiet(), not at call
+    nb = shmem.zeros(4, np.float64)
+    shmem.barrier_all()
+    shmem.put_nbi(nb, np.full(4, 7.0 + me), pe=nxt)
+    shmem.quiet()
+    shmem.barrier_all()
+    assert nb.local[0] == 7.0 + prv, nb.local
+    out = np.zeros(4, np.float64)
+    shmem.get_nbi(nb, out, pe=nxt)
+    shmem.quiet()
+    assert out[0] == 7.0 + me, out
+
+    # ---- strided iput/iget (reference: shmem_iput/iget)
+    st = shmem.zeros(12, np.int64)
+    shmem.barrier_all()
+    # every 3rd target slot gets my consecutive values
+    shmem.iput(st, np.arange(4, dtype=np.int64) + 100 * me,
+               tst=3, sst=1, nelems=4, pe=nxt)
+    shmem.quiet()
+    shmem.barrier_all()
+    np.testing.assert_array_equal(st.local[::3],
+                                  np.arange(4) + 100 * prv)
+    gathered = shmem.iget(st, tst=1, sst=3, nelems=4, pe=nxt)
+    np.testing.assert_array_equal(gathered, np.arange(4) + 100 * me)
+
+    # ---- wait_until: neighbor flags me after a delay
+    flag = shmem.zeros(1, np.int64)
+    shmem.barrier_all()
+    shmem.p(flag, me + 1, pe=nxt)
+    shmem.quiet()
+    shmem.wait_until(flag, shmem.CMP_EQ, prv + 1, timeout=30.0)
+    assert not shmem.test(flag, shmem.CMP_EQ, -1)
+
+    # ---- distributed lock guarding a read-modify-write
+    lock = shmem.zeros(1, np.int64)
+    total = shmem.zeros(1, np.int64)
+    shmem.barrier_all()
+    for _ in range(3):
+        shmem.set_lock(lock)
+        v = shmem.g(total, pe=0)
+        shmem.p(total, v + 1, pe=0)
+        shmem.quiet()
+        shmem.clear_lock(lock)
+    shmem.barrier_all()
+    if me == 0:
+        assert total.local[0] == 3 * n, total.local
+
+    # test_lock semantics: PE 0 holds -> others must fail to acquire
+    shmem.barrier_all()
+    if me == 0:
+        assert shmem.test_lock(lock), "uncontended test_lock failed"
+    shmem.barrier_all()
+    if me != 0:
+        assert not shmem.test_lock(lock), "acquired a held lock"
+    shmem.barrier_all()
+    if me == 0:
+        shmem.clear_lock(lock)
+    shmem.barrier_all()
+
+    # ---- allocator: free + coalesce + reuse (symmetric sequence)
+    big1 = shmem.zeros(1000, np.float64)
+    big2 = shmem.zeros(1000, np.float64)
+    off1 = big1.off
+    shmem.free(big1)
+    shmem.free(big2)
+    big3 = shmem.zeros(1900, np.float64)  # fits only if spans coalesced
+    assert big3.off == off1, (big3.off, off1)
+    shmem.free(big3)
+
     shmem.finalize()
     print(f"SHMEM-OK pe {me}")
     return 0
