@@ -207,9 +207,17 @@ def put_nbi(arr: SymArray, src, pe: int, offset: int = 0) -> None:
 
 def get_nbi(arr: SymArray, out: np.ndarray, pe: int,
             offset: int = 0) -> None:
-    """shmem_get_nbi: ``out`` is valid only after quiet()."""
+    """shmem_get_nbi: ``out`` is valid only after quiet(). ``out`` must
+    be a contiguous array of the symmetric dtype — the landing callback
+    writes through a flat view, which would silently fill a temporary
+    for a strided destination."""
     ctx = _need()
-    assert out.dtype == arr.dtype
+    if out.dtype != arr.dtype:
+        raise MPIError(ERR_OTHER,
+                       f"get_nbi dtype mismatch: {out.dtype} vs "
+                       f"{arr.dtype}")
+    if not out.flags["C_CONTIGUOUS"]:
+        raise MPIError(ERR_OTHER, "get_nbi needs a contiguous out array")
     ctx["nbi"].append(ctx["win"].Rget(out, pe,
                                       target_disp=arr._disp(offset)))
 
@@ -230,9 +238,12 @@ def iput(arr: SymArray, src, tst: int, sst: int, nelems: int,
 def iget(arr: SymArray, tst: int, sst: int, nelems: int, pe: int,
          offset: int = 0) -> np.ndarray:
     """shmem_iget: gather target indices offset + k*sst into a local
-    strided array of stride tst (returned dense of size nelems*tst)."""
+    strided array of stride tst (returned dense, spanning
+    (nelems-1)*tst + 1 elements; empty for nelems == 0)."""
     ctx = _need()
-    out = np.zeros(max(1, 1 + (nelems - 1) * tst), arr.dtype)
+    if nelems == 0:
+        return np.zeros(0, arr.dtype)
+    out = np.zeros(1 + (nelems - 1) * tst, arr.dtype)
     reqs = []
     for k in range(nelems):
         reqs.append(ctx["win"].Rget(out[k * tst: k * tst + 1], pe,
@@ -372,9 +383,9 @@ def quiet() -> None:
 
 def barrier_all() -> None:
     """shmem_barrier_all: quiet + barrier (reference: shmem_barrier_all
-    implies completion of all remote writes)."""
+    implies completion of all remote writes, including _nbi ones)."""
     ctx = _need()
-    ctx["win"].Flush()
+    quiet()
     from ompi_tpu.runtime import spc
 
     with spc.suppressed():

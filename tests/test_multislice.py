@@ -1,0 +1,18 @@
+"""Multi-slice (ICI x DCN) two-level mesh collectives.
+
+Reference: ompi/mca/coll/han applied to mesh mode — slice-local XLA
+collective + leader exchange over the host btl + slice placement."""
+
+from tests.test_process_mode import run_mpi
+
+
+def test_two_slices_of_four_devices():
+    r = run_mpi(2, "tests/procmode/check_multislice.py", timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("MS-OK") == 2
+
+
+def test_four_slices_of_four_devices():
+    r = run_mpi(4, "tests/procmode/check_multislice.py", timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("MS-OK") == 4
