@@ -45,6 +45,26 @@ DPM_CID_BIT = 1 << 27
 _TAG_XCHG = 0  # handshake messages ride (DPM_CID_BIT | tag) with seq'd tags
 
 
+def _send_frame(pml, payload: bytes, dst: int, tag: int,
+                cid: int = DPM_CID_BIT) -> None:
+    """One-way length-prefixed blob (the single wire framing every
+    leader/collective exchange in this module speaks)."""
+    hdr = struct.pack("<Q", len(payload))
+    pml.isend(np.frombuffer(hdr, np.uint8), 8, BYTE, dst, tag, cid).Wait()
+    pml.isend(np.frombuffer(payload, np.uint8), len(payload), BYTE,
+              dst, tag, cid).Wait()
+
+
+def _recv_frame(pml, src: int, tag: int,
+                cid: int = DPM_CID_BIT) -> bytes:
+    rlen = np.zeros(8, np.uint8)
+    pml.irecv(rlen, 8, BYTE, src, tag, cid).Wait()
+    n = struct.unpack("<Q", rlen.tobytes())[0]
+    body = np.zeros(max(n, 1), np.uint8)
+    pml.irecv(body, n, BYTE, src, tag, cid).Wait()
+    return body[:n].tobytes()
+
+
 def _leader_recv_then_send(pml, tag: int, payload: bytes):
     """Passive half of a leader handshake (MPI_Comm_accept side): learn
     the peer from the first frame's source, read its blob, reply with
@@ -258,6 +278,227 @@ class Intercomm(Communicator):
             rv[:] = out
         self.local_comm.Bcast(recvbuf, root=0)
 
+    # ------------------------------ rooted inter collectives (full table)
+    # Reference: mca/coll/inter covers the whole rooted surface with the
+    # same ROOT/PROC_NULL/remote-rank argument convention as Bcast.
+    def Reduce(self, sendbuf, recvbuf, op: _op.Op = _op.SUM,
+               root=None) -> None:
+        """Data flows from the non-root (source) group: its members'
+        contributions are reduced and land at the root-group rank that
+        passed ROOT; source members pass the root's REMOTE rank, root-
+        group non-roots pass PROC_NULL."""
+        _check_inter_root(root)
+        if root == PROC_NULL:
+            return
+        if root == ROOT:
+            obj, count, dt = parse_buffer(recvbuf)
+            view = np.asarray(obj).reshape(-1).view(np.uint8)
+            self.pml.irecv(view, view.nbytes, BYTE,
+                           self._remote_leader(), self._TAG_COLL + 2,
+                           self._coll_cid()).Wait()
+            return
+        # source group: local reduce to leader, leader sends to the root
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        local_red = np.zeros_like(np.asarray(sobj))
+        self.local_comm.Reduce(sendbuf, local_red, op=op, root=0)
+        if self._is_leader():
+            self.pml.isend(local_red.reshape(-1).view(np.uint8),
+                           local_red.nbytes, BYTE,
+                           self._remote_urank(root), self._TAG_COLL + 2,
+                           self._coll_cid()).Wait()
+
+    def Gather(self, sendbuf, recvbuf, root=None) -> None:
+        """The source group's contributions, concatenated in remote rank
+        order, land at the ROOT."""
+        self.Gatherv(sendbuf, recvbuf, counts=None, root=root)
+
+    def Gatherv(self, sendbuf, recvbuf, counts=None, displs=None,
+                root=None) -> None:
+        _check_inter_root(root)
+        if root == PROC_NULL:
+            return
+        if root == ROOT:
+            obj, count, dt = parse_buffer(recvbuf)
+            rv = np.asarray(obj).reshape(-1)
+            n = len(self.remote_ranks)
+            if counts is None:
+                counts = [rv.size // n] * n
+            if displs is None:
+                displs = np.concatenate(
+                    ([0], np.cumsum(counts)[:-1])).tolist()
+            raw = self._recv_blob(self._remote_leader(),
+                                  self._TAG_COLL + 3)
+            flat = np.frombuffer(raw, dtype=rv.dtype)
+            if flat.size != sum(counts):
+                raise MPIError(
+                    ERR_ARG,
+                    f"Gatherv counts sum {sum(counts)} != remote total "
+                    f"{flat.size}")
+            pos = 0
+            for i in range(n):
+                rv[displs[i]: displs[i] + counts[i]] = \
+                    flat[pos: pos + counts[i]]
+                pos += counts[i]
+            return
+        # source side: local gatherv to leader, leader ships the blob
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        flat = np.asarray(sobj).reshape(-1)
+        sizes = np.zeros(self.local_comm.size, np.int64)
+        self.local_comm.Allgather(np.array([flat.size], np.int64), sizes)
+        total = int(sizes.sum())
+        gathered = np.zeros(total if self._is_leader() else 0, flat.dtype)
+        self.local_comm.Gatherv(
+            flat, [gathered, total, _dt_np(flat.dtype)],
+            counts=sizes.tolist(), root=0)
+        if self._is_leader():
+            self._send_blob(gathered.view(np.uint8).tobytes(),
+                            self._remote_urank(root), self._TAG_COLL + 3)
+
+    def Scatter(self, sendbuf, recvbuf, root=None) -> None:
+        self.Scatterv(sendbuf, recvbuf, counts=None, root=root)
+
+    def Scatterv(self, sendbuf, recvbuf, counts=None, displs=None,
+                 root=None) -> None:
+        """The ROOT's blocks scatter over the REMOTE group."""
+        _check_inter_root(root)
+        if root == PROC_NULL:
+            return
+        if root == ROOT:
+            obj, count, dt = parse_buffer(sendbuf)
+            sv = np.asarray(obj).reshape(-1)
+            n = len(self.remote_ranks)
+            if counts is None:
+                counts = [sv.size // n] * n
+            if displs is None:
+                displs = np.concatenate(
+                    ([0], np.cumsum(counts)[:-1])).tolist()
+            ordered = np.concatenate(
+                [sv[displs[i]: displs[i] + counts[i]] for i in range(n)]
+            ) if n else sv[:0]
+            header = json.dumps([int(c) for c in counts]).encode()
+            self._send_blob(header + b"\0" + ordered.tobytes(),
+                            self._remote_leader(), self._TAG_COLL + 4)
+            return
+        # receiving side: leader gets blob + per-rank counts, scatters
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        rv = np.asarray(robj).reshape(-1)
+        if self._is_leader():
+            raw = self._recv_blob(self._remote_urank(root),
+                                  self._TAG_COLL + 4)
+            hdr, body = raw.split(b"\0", 1)
+            counts = json.loads(hdr.decode())
+            flat = np.frombuffer(body, dtype=rv.dtype)
+            self.local_comm.Scatterv(
+                [flat, flat.size, _dt_np(rv.dtype)], rv,
+                counts=counts, root=0)
+        else:
+            self.local_comm.Scatterv(
+                [np.zeros(0, rv.dtype), 0, _dt_np(rv.dtype)], rv,
+                counts=None, root=0)
+
+    # ------------------------------------- pairwise inter collectives
+    def Alltoall(self, sendbuf, recvbuf) -> None:
+        """Block j of sendbuf goes to remote rank j; recv block j holds
+        remote rank j's block for me (direct pairwise exchange — the
+        coll/inter linear pattern)."""
+        n = len(self.remote_ranks)
+        ssize = np.asarray(parse_buffer(sendbuf)[0]).size
+        rsize = np.asarray(parse_buffer(recvbuf)[0]).size
+        if ssize % n or rsize % n:
+            raise MPIError(ERR_ARG,
+                           f"Alltoall buffers ({ssize}/{rsize} elems) "
+                           f"must divide the remote size {n}")
+        self.Alltoallv(sendbuf, recvbuf,
+                       [ssize // n] * n,
+                       [j * (ssize // n) for j in range(n)],
+                       [rsize // n] * n,
+                       [j * (rsize // n) for j in range(n)])
+
+    def Alltoallv(self, sendbuf, recvbuf, sendcounts, sdispls,
+                  recvcounts, rdispls) -> None:
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        sv = np.asarray(sobj).reshape(-1)
+        rv = np.asarray(robj).reshape(-1)
+        n = len(self.remote_ranks)
+        cid = self._coll_cid()
+        tag = self._TAG_COLL + 5
+        reqs = []
+        for j in range(n):
+            blk = np.ascontiguousarray(
+                sv[sdispls[j]: sdispls[j] + sendcounts[j]])
+            reqs.append(self.pml.isend(
+                blk.view(np.uint8), blk.nbytes, BYTE,
+                self._remote_urank(j), tag, cid))
+        landings = []
+        for j in range(n):
+            nb = int(recvcounts[j]) * rv.dtype.itemsize
+            buf = np.zeros(nb, np.uint8)
+            landings.append((j, buf))
+            reqs.append(self.pml.irecv(buf, nb, BYTE,
+                                       self._remote_urank(j), tag, cid))
+        Request.Waitall(reqs)
+        for j, buf in landings:
+            rv[rdispls[j]: rdispls[j] + recvcounts[j]] = \
+                buf.view(rv.dtype)
+
+    def Alltoallw(self, sendbuf, recvbuf, sendcounts, sdispls, sendtypes,
+                  recvcounts, rdispls, recvtypes) -> None:
+        """Fully-general pairwise exchange: per-peer counts, BYTE
+        displacements, and datatypes."""
+        from ompi_tpu.core.convertor import pack, unpack
+
+        sobj, _, _ = parse_buffer(sendbuf)
+        robj, _, _ = parse_buffer(recvbuf)
+        sraw = np.asarray(sobj).reshape(-1).view(np.uint8)
+        rraw = np.asarray(robj).reshape(-1).view(np.uint8)
+        n = len(self.remote_ranks)
+        cid = self._coll_cid()
+        tag = self._TAG_COLL + 6
+        reqs = []
+        for j in range(n):
+            seg = pack(sraw[sdispls[j]:], sendcounts[j], sendtypes[j])
+            reqs.append(self.pml.isend(seg, seg.nbytes, BYTE,
+                                       self._remote_urank(j), tag, cid))
+        landings = []
+        for j in range(n):
+            nb = int(recvcounts[j]) * recvtypes[j].size
+            buf = np.zeros(nb, np.uint8)
+            landings.append((j, buf))
+            reqs.append(self.pml.irecv(buf, nb, BYTE,
+                                       self._remote_urank(j), tag, cid))
+        Request.Waitall(reqs)
+        for j, buf in landings:
+            unpack(buf, rraw[rdispls[j]:], recvcounts[j], recvtypes[j])
+
+    def Reduce_scatter_block(self, sendbuf, recvbuf,
+                             op: _op.Op = _op.SUM) -> None:
+        """The REMOTE group's contributions (each a vector of
+        n_local * blk) are reduced and block i lands at local rank i
+        (MPI-3 §5.10 inter semantics), symmetrically both ways."""
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        local_red = np.zeros_like(np.asarray(sobj))
+        self.local_comm.Reduce(sendbuf, local_red, op=op, root=0)
+        blk = np.asarray(robj).reshape(-1)
+        if self._is_leader():
+            theirs = _leader_exchange(
+                self.pml, self._remote_leader(), self._TAG_COLL + 7,
+                local_red.reshape(-1).view(np.uint8).tobytes(),
+                cid=self._coll_cid())
+            flat = np.frombuffer(theirs, dtype=blk.dtype)
+        else:
+            flat = np.zeros(0, blk.dtype)
+        self.local_comm.Scatter(
+            [flat, flat.size, _dt_np(blk.dtype)], blk, root=0)
+
+    # ----------------------------------------------------- blob helpers
+    def _send_blob(self, payload: bytes, dst: int, tag: int) -> None:
+        _send_frame(self.pml, payload, dst, tag, self._coll_cid())
+
+    def _recv_blob(self, src: int, tag: int) -> bytes:
+        return _recv_frame(self.pml, src, tag, self._coll_cid())
+
     # ------------------------------------------------------------- merge
     def Merge(self, high: bool = False) -> ProcComm:
         """MPI_Intercomm_merge: one intracomm over both groups; the
@@ -294,6 +535,24 @@ class Intercomm(Communicator):
 
     def Free(self) -> None:
         self._delete_all_attrs()
+
+
+def _check_inter_root(root) -> None:
+    """Inter rooted ops have NO default root: every rank must pass
+    ROOT, PROC_NULL, or the root's remote rank (MPI-3 §5; a forgotten
+    root would otherwise route a root-group rank into the source branch
+    and strand the remote side)."""
+    if root is None or (root not in (ROOT, PROC_NULL)
+                        and not isinstance(root, int)):
+        raise MPIError(ERR_ARG,
+                       "inter collective needs root=ROOT, PROC_NULL, "
+                       "or a remote-group rank")
+
+
+def _dt_np(np_dtype):
+    from ompi_tpu.core.datatype import from_numpy_dtype
+
+    return from_numpy_dtype(np_dtype)
 
 
 def intercomm_create(local_comm: ProcComm, local_leader: int,

@@ -61,6 +61,101 @@ def main() -> int:
     want = [20, 30] if side == 0 else [0, 10]
     np.testing.assert_array_equal(ag, want)
 
+    # ---- full rooted table (reference: mca/coll/inter) ----
+    # Reduce: world rank 2 (remote rank 0 of side 1) is the root; side 0
+    # is the source group
+    rr = np.zeros(2, np.float64)
+    if side == 0:
+        inter.Reduce(np.full(2, float(lr + 1)), rr, op=mpi_op.SUM,
+                     root=0)
+    else:
+        inter.Reduce(None, rr, op=mpi_op.SUM,
+                     root=ROOT if lr == 0 else PROC_NULL)
+        if lr == 0:
+            assert rr[0] == 1 + 2, rr  # sum over source group (side 0)
+
+    # Gather at world rank 0 (side 0, remote-rank 0 for side 1)
+    gb = np.zeros(4, np.int64)
+    if side == 0:
+        inter.Gather(None, gb, root=ROOT if lr == 0 else PROC_NULL)
+        if lr == 0:
+            np.testing.assert_array_equal(gb, [200, 201, 210, 211])
+    else:
+        inter.Gather(np.array([200 + 10 * lr, 201 + 10 * lr], np.int64),
+                     None, root=0)
+
+    # Scatter from world rank 2: its 4 elements scatter over side 0
+    sb = np.zeros(2, np.int64)
+    if side == 0:
+        inter.Scatter(None, sb, root=0)
+        np.testing.assert_array_equal(sb, [300 + 2 * lr, 301 + 2 * lr])
+    else:
+        src = np.arange(300, 304, dtype=np.int64)
+        inter.Scatter(src, None, root=ROOT if lr == 0 else PROC_NULL)
+
+    # Gatherv with uneven counts at world rank 0
+    counts = [1, 3]
+    gvb = np.zeros(4, np.int64)
+    if side == 0:
+        inter.Gatherv(None, gvb, counts=counts,
+                      root=ROOT if lr == 0 else PROC_NULL)
+        if lr == 0:
+            np.testing.assert_array_equal(gvb, [7, 8, 9, 10])
+    else:
+        mine_v = (np.array([7], np.int64) if lr == 0
+                  else np.array([8, 9, 10], np.int64))
+        inter.Gatherv(mine_v, None, root=0)
+
+    # Scatterv uneven from world rank 2
+    svb = np.zeros(3 if lr == 1 else 1, np.int64)
+    if side == 0:
+        inter.Scatterv(None, svb, root=0)
+        want_v = [40] if lr == 0 else [41, 42, 43]
+        np.testing.assert_array_equal(svb, want_v)
+    else:
+        inter.Scatterv(np.arange(40, 44, dtype=np.int64), None,
+                       counts=[1, 3],
+                       root=ROOT if lr == 0 else PROC_NULL)
+
+    # Alltoall: block j -> remote rank j
+    a2a_out = np.zeros(2, np.int64)
+    inter.Alltoall(np.array([1000 * r, 1000 * r + 1], np.int64), a2a_out)
+    # my block from remote rank j is their element at index lr
+    rbase = [2, 3] if side == 0 else [0, 1]  # remote world ranks
+    want_a = [1000 * rbase[0] + lr, 1000 * rbase[1] + lr]
+    np.testing.assert_array_equal(a2a_out, want_a)
+
+    # Alltoallv: uneven pairwise exchange — I send lr+1 elems to remote
+    # rank 0 and 1 elem to remote rank 1
+    scounts = [lr + 1, 1]
+    sdis = [0, lr + 1]
+    sv = np.arange(sum(scounts), dtype=np.int64) + 10 * r
+    # remote rank j sends me (their lr == j) -> j+1 elems if I'm their
+    # rank-0 target... each remote rank j sends counts [j+1, 1]; I
+    # receive from j: (j+1) if lr==0 else 1
+    rcounts = [j + 1 if lr == 0 else 1 for j in range(2)]
+    rdis = [0, rcounts[0]]
+    rv = np.zeros(sum(rcounts), np.int64)
+    inter.Alltoallv(sv, rv, scounts, sdis, rcounts, rdis)
+    for j in range(2):
+        src_w = rbase[j]
+        if lr == 0:
+            want_blk = np.arange(j + 1, dtype=np.int64) + 10 * src_w
+        else:
+            want_blk = np.array([10 * src_w + (j + 1)], np.int64)
+        np.testing.assert_array_equal(
+            rv[rdis[j]: rdis[j] + rcounts[j]], want_blk)
+
+    # Reduce_scatter_block: remote group's vectors reduced, block lr
+    # lands here
+    rsb_in = np.arange(4, dtype=np.float64) + r  # n_remote*blk = 2*2
+    rsb_out = np.zeros(2, np.float64)
+    inter.Reduce_scatter_block(rsb_in, rsb_out)
+    rem = rbase
+    want_r = sum(np.arange(4, dtype=np.float64) + w for w in rem)
+    np.testing.assert_array_equal(rsb_out,
+                                  want_r[2 * lr: 2 * lr + 2])
+
     # merge: low side (side 0 passes high=False) ranks first
     merged = inter.Merge(high=(side == 1))
     assert merged.Get_size() == 4
