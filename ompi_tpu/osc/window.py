@@ -22,7 +22,7 @@ from __future__ import annotations
 import itertools
 import struct
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -40,6 +40,13 @@ OSC_TAG = -4300
 
 LOCK_EXCLUSIVE = 1
 LOCK_SHARED = 2
+
+# MPI_Win_fence assertions (mpi.h values)
+MODE_NOCHECK = 1024
+MODE_NOSTORE = 2048
+MODE_NOPUT = 4096
+MODE_NOPRECEDE = 8192
+MODE_NOSUCCEED = 16384
 
 _HDR = struct.Struct("<iiiqqiii")
 # win_id, verb, origin, disp_bytes, count, dtype_code, op_code, req_id
@@ -635,8 +642,23 @@ class MeshWin:
 
     The single controller owns all rank memory, so Put/Get/Accumulate are
     array updates (XLA inserts any cross-device movement) — one-sided
-    semantics come for free, which is the TPU-native answer to SURVEY.md
-    §7's 'osc over ICI is research-y' (hard part list).
+    DATA semantics come for free. What does NOT come for free is the
+    EPOCH discipline, which this class enforces with the same state
+    machine as the host-mode ``Win`` (reference: the access/exposure
+    epoch rules of osc_rdma_active_target.c / passive_target.c):
+
+    - every RMA verb requires an epoch covering the target (fence,
+      Start-group membership, or a held lock) — misuse raises ERR_WIN.
+      This is STRICTER than the host-mode Win (which, like most MPI
+      implementations, does not police access epochs at runtime): a
+      program correct here is epoch-correct on any conforming MPI;
+    - R-variants return requests completing on device readiness (the
+      dispatch IS the transfer; Wait = block_until_ready);
+    - Flush/Flush_local both mean device completion under one
+      controller — the distinction collapses by design, kept for parity;
+    - locks track shared/exclusive state per target (single controller
+      => no contention, but double-exclusive and unlock-without-lock
+      are real program bugs and are caught).
     """
 
     def __init__(self, comm, shape_per_rank, dtype=None):
@@ -646,19 +668,185 @@ class MeshWin:
         dtype = dtype or jnp.float32
         self.array = comm.shard(
             jnp.zeros((comm.world_size,) + tuple(shape_per_rank), dtype))
+        self._fence_open = False
+        self._access_group: Optional[List[int]] = None
+        self._exposure_group: Optional[List[int]] = None
+        self._locks: Dict[int, int] = {}  # target -> 0 shared / 1 excl
+        self._lock_all = False
 
+    # ------------------------------------------------------ epoch guard
+    def _check_target(self, target: int) -> None:
+        if not 0 <= target < self.comm.world_size:
+            # jax silently drops out-of-bounds scatters and clamps
+            # gathers — an unchecked bad rank would corrupt quietly
+            raise MPIError(ERR_RANK, f"target {target} out of range")
+
+    def _check_epoch(self, target: int) -> None:
+        self._check_target(target)
+        if self._fence_open or self._lock_all:
+            return
+        if self._access_group is not None and target in self._access_group:
+            return
+        if target in self._locks:
+            return
+        raise MPIError(ERR_WIN,
+                       f"RMA to {target} outside any epoch (need Fence, "
+                       "Start including it, or Lock on it)")
+
+    # ------------------------------------------------------- RMA verbs
     def Put(self, data, target: int) -> None:
+        self._check_epoch(target)
         self.array = self.array.at[target].set(data)
 
     def Get(self, target: int):
+        self._check_epoch(target)
         return self.array[target]
 
     def Accumulate(self, data, target: int, op: _op.Op = _op.SUM) -> None:
+        self._check_epoch(target)
         if op is _op.SUM:
             self.array = self.array.at[target].add(data)
         else:
             self.array = self.array.at[target].set(
                 op.jax_reduce(self.array[target], data))
 
-    def Fence(self) -> None:
+    def Rput(self, data, target: int):
+        from ompi_tpu.coll.sched import JaxRequest
+
+        self.Put(data, target)
+        return JaxRequest(self.array)
+
+    def Rget(self, target: int):
+        """Request whose ``result`` is the fetched row."""
+        from ompi_tpu.coll.sched import JaxRequest
+
+        return JaxRequest(self.Get(target))
+
+    def Raccumulate(self, data, target: int, op: _op.Op = _op.SUM):
+        from ompi_tpu.coll.sched import JaxRequest
+
+        self.Accumulate(data, target, op)
+        return JaxRequest(self.array)
+
+    def Fetch_and_op(self, value, target: int, index: int = 0,
+                     op: _op.Op = _op.SUM):
+        """Atomic under the single controller: returns the old element."""
+        self._check_epoch(target)
+        old = self.array[target, index]
+        if op is _op.SUM:
+            self.array = self.array.at[target, index].add(value)
+        else:
+            self.array = self.array.at[target, index].set(
+                op.jax_reduce(self.array[target, index], value))
+        return old
+
+    def Compare_and_swap(self, compare, value, target: int,
+                         index: int = 0):
+        import jax.numpy as jnp
+
+        self._check_epoch(target)
+        old = self.array[target, index]
+        self.array = self.array.at[target, index].set(
+            jnp.where(old == compare, value, old))
+        return old
+
+    # --------------------------------------------------- fence epochs
+    def Fence(self, assertion: int = 0) -> None:
+        """End the previous fence epoch and start the next (MPI
+        semantics: successive fences delimit epochs, so RMA is legal
+        between ANY two fences); completes every outstanding device op
+        and synchronizes the mesh. Pass MODE_NOSUCCEED on the closing
+        fence to end the final epoch."""
+        import jax
+
+        jax.block_until_ready(self.array)
         self.comm.barrier()
+        self._fence_open = not (assertion & MODE_NOSUCCEED)
+
+    # ----------------------------------------------------- PSCW epochs
+    def Start(self, targets) -> None:
+        if self._access_group is not None:
+            raise MPIError(ERR_WIN, "Start inside an access epoch")
+        self._access_group = [int(t) for t in targets]
+
+    def Complete(self) -> None:
+        import jax
+
+        if self._access_group is None:
+            raise MPIError(ERR_WIN, "Complete without Start")
+        jax.block_until_ready(self.array)
+        self._access_group = None
+
+    def Post(self, origins) -> None:
+        if self._exposure_group is not None:
+            raise MPIError(ERR_WIN, "Post inside an exposure epoch")
+        self._exposure_group = [int(o) for o in origins]
+
+    def Wait(self) -> None:
+        import jax
+
+        if self._exposure_group is None:
+            raise MPIError(ERR_WIN, "Wait without Post")
+        # single controller: origins' Completes have already executed in
+        # program order; device readiness is the only real wait
+        jax.block_until_ready(self.array)
+        self._exposure_group = None
+
+    def Test(self) -> bool:
+        from ompi_tpu.coll.sched import JaxRequest
+
+        if self._exposure_group is None:
+            raise MPIError(ERR_WIN, "Test without Post")
+        ready = JaxRequest(self.array).is_complete
+        if ready:
+            self._exposure_group = None
+        return ready
+
+    # -------------------------------------------------- passive target
+    def Lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
+        self._check_target(target)
+        if self._lock_all:
+            raise MPIError(ERR_WIN,
+                           "Lock while Lock_all holds (MPI-4 §12.5.3)")
+        if target in self._locks:
+            raise MPIError(ERR_WIN, f"already holding lock on {target}")
+        self._locks[target] = lock_type
+
+    def Unlock(self, target: int) -> None:
+        import jax
+
+        if target not in self._locks:
+            raise MPIError(ERR_WIN, f"Unlock without Lock on {target}")
+        jax.block_until_ready(self.array)  # epoch-closing completion
+        del self._locks[target]
+
+    def Lock_all(self) -> None:
+        if self._lock_all:
+            raise MPIError(ERR_WIN, "Lock_all inside Lock_all")
+        if self._locks:
+            raise MPIError(ERR_WIN,
+                           "Lock_all while per-target locks held "
+                           "(MPI-4 §12.5.3)")
+        self._lock_all = True
+
+    def Unlock_all(self) -> None:
+        import jax
+
+        if not self._lock_all:
+            raise MPIError(ERR_WIN, "Unlock_all without Lock_all")
+        jax.block_until_ready(self.array)
+        self._lock_all = False
+
+    # ------------------------------------------------------ completion
+    def Flush(self, target: Optional[int] = None) -> None:
+        """Remote completion == device readiness under one controller."""
+        import jax
+
+        jax.block_until_ready(self.array)
+
+    Flush_all = Flush
+    Flush_local = Flush
+    Flush_local_all = Flush
+
+    def Sync(self) -> None:
+        """Memory-model sync (no separate public/private copies here)."""

