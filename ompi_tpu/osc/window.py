@@ -728,10 +728,17 @@ class MeshWin:
         self.Accumulate(data, target, op)
         return JaxRequest(self.array)
 
+    def _check_index(self, index: int) -> None:
+        if not 0 <= index < self.array.shape[1]:
+            raise MPIError(ERR_RANK,
+                           f"element index {index} out of range "
+                           f"(same silent-scatter hazard as a bad rank)")
+
     def Fetch_and_op(self, value, target: int, index: int = 0,
                      op: _op.Op = _op.SUM):
         """Atomic under the single controller: returns the old element."""
         self._check_epoch(target)
+        self._check_index(index)
         old = self.array[target, index]
         if op is _op.SUM:
             self.array = self.array.at[target, index].add(value)
@@ -745,6 +752,7 @@ class MeshWin:
         import jax.numpy as jnp
 
         self._check_epoch(target)
+        self._check_index(index)
         old = self.array[target, index]
         self.array = self.array.at[target, index].set(
             jnp.where(old == compare, value, old))

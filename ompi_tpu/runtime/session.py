@@ -1,13 +1,27 @@
 """MPI-4 Sessions.
 
-Reference: ompi/instance (1,671 LoC — ompi_mpi_instance_init owns the real
-bring-up; MPI_Session_init is a thin veneer). Sessions expose named process
-sets ("mpi://WORLD", "mpi://SELF") from which groups and communicators are
-built without MPI_Init's global state.
+Reference: ompi/instance (1,671 LoC — ompi_mpi_instance_init owns the
+real bring-up behind a refcount, instance.c:127-136; MPI_Session_init is
+a veneer over it). The session model implemented here:
+
+- every Session takes its OWN reference on the shared instance
+  (runtime/state.acquire_instance); the world model (MPI_Init) holds
+  another. The runtime stays up until the last holder finalizes — a
+  session created before MPI_Init works, and one finalized after
+  MPI_Finalize tears the runtime down itself (the isolation the
+  reference's careful init/finalize ordering exists for).
+- objects derived from a session are TRACKED: finalizing a session with
+  live derived communicators is erroneous (MPI-4 §11.2.2) and raises,
+  instead of silently leaving comms on a torn-down runtime.
+- process sets: mpi://WORLD, mpi://SELF, plus mpix://NODE (the ranks
+  sharing this host, derived from which endpoints bound the sm/self
+  btl — the reference publishes the same node-local pset from PMIx
+  locality).
 """
 
 from __future__ import annotations
 
+import weakref
 from typing import List, Optional
 
 from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_SESSION
@@ -17,34 +31,53 @@ from ompi_tpu.core.info import Info
 
 class Session:
     def __init__(self, info: Optional[Info] = None):
-        # sessions share the instance the same way the reference's
-        # instances refcount one ompi_mpi_instance (instance.c)
         from ompi_tpu.runtime import state
 
-        state.Init()
         self.info = info or Info()
-        self._world = state.get_world()
+        self._world = state.acquire_instance()  # my instance reference
         self._finalized = False
+        self._derived: "weakref.WeakSet" = weakref.WeakSet()
 
     @staticmethod
     def Init(info: Optional[Info] = None) -> "Session":
         return Session(info)
 
     def Finalize(self) -> None:
+        """Release this session's instance reference. Erroneous (and
+        raising) while communicators derived from it are still alive."""
+        from ompi_tpu.runtime import state
+
+        if self._finalized:
+            return
+        live = [c for c in self._derived if c.coll is not None]
+        if live:
+            raise MPIError(
+                ERR_SESSION,
+                f"session finalize with {len(live)} live derived "
+                f"communicator(s) ({', '.join(c.name for c in live)}): "
+                "free them first (MPI-4 §11.2.2)")
         self._finalized = True
+        state.release_instance()
 
     def _check(self) -> None:
         if self._finalized:
             raise MPIError(ERR_SESSION, "session finalized")
 
+    def Get_info(self) -> Info:
+        self._check()
+        return self.info
+
     # ------------------------------------------------------- process sets
+    def _psets(self) -> List[str]:
+        return ["mpi://WORLD", "mpi://SELF", "mpix://NODE"]
+
     def Get_num_psets(self) -> int:
         self._check()
-        return 2
+        return len(self._psets())
 
     def Get_nth_pset(self, n: int) -> str:
         self._check()
-        psets = ["mpi://WORLD", "mpi://SELF"]
+        psets = self._psets()
         if not 0 <= n < len(psets):
             raise MPIError(ERR_ARG, f"pset index {n}")
         return psets[n]
@@ -56,10 +89,20 @@ class Session:
 
     def Group_from_pset(self, name: str) -> Group:
         self._check()
+        me = self._world.pml.my_rank
         if name == "mpi://WORLD":
             return self._world.Get_group()
         if name == "mpi://SELF":
-            return Group([self._world.pml.my_rank])
+            return Group([me])
+        if name == "mpix://NODE":
+            # node-local membership = the endpoint selection already made
+            # by bml/r2 ordering: self/sm bind only same-host peers
+            node = []
+            for r in self._world.group.ranks:
+                btl = self._world.pml.endpoints.get(r)
+                if r == me or type(btl).__name__ in ("SmBtl", "SelfBtl"):
+                    node.append(r)
+            return Group(node)
         raise MPIError(ERR_ARG, f"unknown pset {name!r}")
 
     def Comm_create_from_group(self, group: Group, tag: str = "",
@@ -74,5 +117,7 @@ class Session:
         import zlib
 
         base = zlib.crc32(tag.encode()) % 100000 + 50000
-        return ProcComm(group, base, self._world.pml,
+        comm = ProcComm(group, base, self._world.pml,
                         name=f"session-comm-{tag or base}")
+        self._derived.add(comm)
+        return comm

@@ -40,11 +40,17 @@ _NOT_INITIALIZED = 0
 _INITIALIZED = 1
 _FINALIZED = 2
 
-_lock = threading.Lock()
+_lock = threading.RLock()
 _state = _NOT_INITIALIZED
 _world: Optional[ProcComm] = None
 _self_comm: Optional[ProcComm] = None
 _thread_level = THREAD_MULTIPLE
+# Instance refcount (reference: ompi_mpi_instance_init/_finalize,
+# instance.c:127-136 — the world model AND every MPI-4 session each hold
+# one reference to the ONE shared instance; the last release tears the
+# runtime down). MPI_Init holds a ref until MPI_Finalize; Session.Init
+# holds one until Session.Finalize.
+_instance_refs = 0
 _log = get_logger("runtime")
 
 # import side effect: register built-in components
@@ -60,9 +66,54 @@ import ompi_tpu.coll.han  # noqa: F401,E402
 import ompi_tpu.hook.comm_method  # noqa: F401,E402
 
 
+def _instance_up() -> None:
+    """Idempotent instance bring-up (the body of the reference's
+    ompi_mpi_instance_init: RTE init, framework opens, PML select,
+    modex, add_procs)."""
+    global _world, _self_comm
+    if _world is not None:
+        return
+    if os.environ.get("OMPI_TPU_RANK") is not None:
+        from ompi_tpu.runtime.wireup import init_process_mode
+
+        _world = init_process_mode()
+    else:
+        _world = _init_singleton()
+    me = _world.pml.my_rank
+    _self_comm = ProcComm(Group([me]), cid=1, pml=_world.pml,
+                          name="MPI_COMM_SELF")
+
+
+def acquire_instance() -> ProcComm:
+    """Take one reference on the shared instance (bring it up on the
+    first). Sessions use this WITHOUT touching the world-model state
+    machine — MPI-4 allows sessions before/without/after MPI_Init."""
+    global _instance_refs
+    with _lock:
+        _instance_up()  # refcount only a SUCCESSFUL bring-up: a raise
+        _instance_refs += 1  # here must not leak an unreleasable ref
+        return _world
+
+
+def release_instance() -> None:
+    """Drop one reference; the last one tears the runtime down
+    (instance.c finalize ordering: the teardown runs exactly once, when
+    neither the world model nor any session needs the instance)."""
+    global _instance_refs, _world, _self_comm
+    with _lock:
+        _instance_refs -= 1
+        if _instance_refs > 0 or _world is None:
+            return
+        from ompi_tpu.runtime import wireup
+
+        wireup.shutdown()
+        _world = None
+        _self_comm = None
+
+
 def Init(required: int = THREAD_MULTIPLE) -> int:
     """MPI_Init / MPI_Init_thread. Returns the provided thread level."""
-    global _state, _world, _self_comm, _thread_level
+    global _state, _thread_level
     with _lock:
         if _state == _FINALIZED:
             show_help("runtime", "already-finalized")
@@ -74,15 +125,7 @@ def Init(required: int = THREAD_MULTIPLE) -> int:
         from ompi_tpu.hook import run_hooks
 
         run_hooks("init_top")
-        if os.environ.get("OMPI_TPU_RANK") is not None:
-            from ompi_tpu.runtime.wireup import init_process_mode
-
-            _world = init_process_mode()
-        else:
-            _world = _init_singleton()
-        me = _world.pml.my_rank
-        _self_comm = ProcComm(Group([me]), cid=1, pml=_world.pml,
-                              name="MPI_COMM_SELF")
+        acquire_instance()  # the world model's reference
         _thread_level = THREAD_MULTIPLE if required is None else required
         _state = _INITIALIZED
         run_hooks("init_bottom")
@@ -124,7 +167,7 @@ def _init_singleton() -> ProcComm:
 
 
 def Finalize() -> None:
-    global _state, _world, _self_comm
+    global _state
     with _lock:
         if _state != _INITIALIZED:
             return
@@ -151,11 +194,9 @@ def Finalize() -> None:
                                    or bool(known_failed() & members))
             except Exception:
                 pass
-            from ompi_tpu.runtime import wireup
-
-            wireup.shutdown()
-        _world = None
-        _self_comm = None
+        # drop the world model's instance reference; live sessions keep
+        # the runtime up until their own Finalize (instance refcounting)
+        release_instance()
         _state = _FINALIZED
         run_hooks("finalize_bottom")
 

@@ -1,0 +1,58 @@
+"""MPI-4 Sessions at real ranks: sessions-only programs, the NODE pset,
+and instance-refcount isolation (a session outliving MPI_Finalize).
+
+Reference: ompi/instance refcounting (instance.c:127-136) + the
+sessions chapter (MPI-4 §11)."""
+
+import sys
+
+import numpy as np
+
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.runtime.session import Session
+
+
+def main() -> int:
+    mode = sys.argv[1]
+
+    if mode == "sessions_only":
+        # no MPI_Init anywhere: the session brings the instance up
+        s = Session.Init()
+        g = s.Group_from_pset("mpi://WORLD")
+        comm = s.Comm_create_from_group(g, tag="ring")
+        r, n = comm.Get_rank(), comm.Get_size()
+        out = np.zeros(1, np.int64)
+        comm.Allreduce(np.array([r + 1], np.int64), out)
+        assert out[0] == n * (n + 1) // 2, out
+        # node pset: single host in the test harness -> everyone
+        node = s.Group_from_pset("mpix://NODE")
+        assert node.size == n, (node.size, n)
+        comm.Free()
+        s.Finalize()
+        sys.stdout.write(f"rank {r}: SESS-OK\n")
+    elif mode == "outlives_world":
+        # the isolation the refcount exists for: MPI_Finalize while a
+        # session is alive must leave the session fully usable
+        import ompi_tpu
+        from ompi_tpu import COMM_WORLD
+
+        r = COMM_WORLD.Get_rank()
+        n = COMM_WORLD.Get_size()
+        s = Session.Init()
+        g = s.Group_from_pset("mpi://WORLD")
+        comm = s.Comm_create_from_group(g, tag="survivor")
+        ompi_tpu.Finalize()  # world model goes away...
+        out = np.zeros(1, np.int64)
+        comm.Allreduce(np.array([10 + r], np.int64), out)  # ...this works
+        assert out[0] == sum(10 + i for i in range(n)), out
+        comm.Free()
+        s.Finalize()  # last reference: the runtime tears down HERE
+        sys.stdout.write(f"rank {r}: SESS-OK\n")
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+    sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -153,10 +153,13 @@ def test_session():
     comm = s.Comm_create_from_group(g, tag="test-tag")
     assert comm.Get_size() == g.size
     comm.Barrier()
-    s.Finalize()
     import pytest as _p
     from ompi_tpu.core.errors import MPIError
 
+    with _p.raises(MPIError):
+        s.Finalize()  # live derived comm: erroneous (MPI-4 11.2.2)
+    comm.Free()
+    s.Finalize()
     with _p.raises(MPIError):
         s.Get_num_psets()
 

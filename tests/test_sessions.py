@@ -1,0 +1,21 @@
+"""MPI-4 Sessions: instance refcounting, derived-object tracking, psets.
+
+Reference: ompi/instance + MPI-4 §11."""
+
+from tests.test_process_mode import run_mpi
+
+
+def test_sessions_only_program():
+    r = run_mpi(3, "tests/procmode/check_sessions.py", "sessions_only",
+                timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("SESS-OK") == 3
+
+
+def test_session_outlives_world_model():
+    """MPI_Finalize with a live session: the session's instance
+    reference keeps the runtime up; its comm still communicates."""
+    r = run_mpi(2, "tests/procmode/check_sessions.py", "outlives_world",
+                timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("SESS-OK") == 2
