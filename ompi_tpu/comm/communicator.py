@@ -586,10 +586,21 @@ class ProcComm(Intracomm):
         _bump_local_cid(int(agreed[0]))
         return int(agreed[0])
 
+    def _propagate_session(self, new: "ProcComm") -> None:
+        """Comms derived from a session-derived comm stay tracked by the
+        session (MPI-4 11.2.2 liveness at Session.Finalize is
+        transitive)."""
+        sref = getattr(self, "_session", None)
+        if sref is not None:
+            s = sref()
+            if s is not None and not s._finalized:
+                s.track(new)
+
     def Dup(self) -> "ProcComm":
         cid = self._alloc_cid()
         new = ProcComm(self.group, cid, self.pml, name=f"{self.name}-dup")
         self._copy_attrs_to(new)
+        self._propagate_session(new)
         return new
 
     def Split(self, color: int, key: int = 0) -> Optional["ProcComm"]:
@@ -605,14 +616,18 @@ class ProcComm(Intracomm):
         members = [t for t in triples if t[0] == color]
         members.sort(key=lambda t: (int(t[1]), int(t[2])))
         ranks = [self.group.world_rank(int(t[2])) for t in members]
-        return ProcComm(Group(ranks), cid, self.pml,
-                        name=f"{self.name}-split{color}")
+        new = ProcComm(Group(ranks), cid, self.pml,
+                       name=f"{self.name}-split{color}")
+        self._propagate_session(new)
+        return new
 
     def Create_group(self, group: Group, tag: int = 0) -> Optional["ProcComm"]:
         cid = self._alloc_cid()
         if group.rank_of(self.pml.my_rank) < 0:
             return None
-        return ProcComm(group, cid, self.pml, name=f"{self.name}-sub")
+        new = ProcComm(group, cid, self.pml, name=f"{self.name}-sub")
+        self._propagate_session(new)
+        return new
 
     def Create(self, group: Group) -> Optional["ProcComm"]:
         return self.Create_group(group)

@@ -95,12 +95,29 @@ class Session:
         if name == "mpi://SELF":
             return Group([me])
         if name == "mpix://NODE":
-            # node-local membership = the endpoint selection already made
-            # by bml/r2 ordering: self/sm bind only same-host peers
+            # node-local membership from the PUBLISHED node identity
+            # (modex key btl.sm.node — the PMIx locality analog). The
+            # endpoint selection must NOT be used here: sm-vs-tcp can be
+            # asymmetric (unreachable /dev/shm is per-direction), and a
+            # pset must be identical on every member or comms built
+            # from it hang in their first collective.
+            from ompi_tpu.runtime import wireup
+
+            ctx = getattr(wireup, "_ctx", None)
+            if ctx is None:
+                return Group([me])  # singleton: alone on the node
+            modex = ctx["modex"]
+            try:
+                my_node = modex.get(me, "btl.sm.node", timeout=0.0)
+            except Exception:
+                return Group([me])  # no sm card published: unknowable
             node = []
             for r in self._world.group.ranks:
-                btl = self._world.pml.endpoints.get(r)
-                if r == me or type(btl).__name__ in ("SmBtl", "SelfBtl"):
+                try:
+                    peer_node = modex.get(r, "btl.sm.node", timeout=0.0)
+                except Exception:
+                    continue
+                if peer_node == my_node:
                     node.append(r)
             return Group(node)
         raise MPIError(ERR_ARG, f"unknown pset {name!r}")
@@ -119,5 +136,13 @@ class Session:
         base = zlib.crc32(tag.encode()) % 100000 + 50000
         comm = ProcComm(group, base, self._world.pml,
                         name=f"session-comm-{tag or base}")
-        self._derived.add(comm)
+        self.track(comm)
         return comm
+
+    def track(self, comm) -> None:
+        """Register a communicator as derived from this session; comms
+        created FROM a tracked comm (Dup/Split/Create_group) register
+        here too via ProcComm's propagation — tracking is transitive, or
+        Finalize's liveness check would miss grandchildren."""
+        self._derived.add(comm)
+        comm._session = weakref.ref(self)

@@ -51,6 +51,7 @@ _thread_level = THREAD_MULTIPLE
 # runtime down). MPI_Init holds a ref until MPI_Finalize; Session.Init
 # holds one until Session.Finalize.
 _instance_refs = 0
+_torn_down = False  # teardown already ran in this process
 _log = get_logger("runtime")
 
 # import side effect: register built-in components
@@ -74,6 +75,14 @@ def _instance_up() -> None:
     if _world is not None:
         return
     if os.environ.get("OMPI_TPU_RANK") is not None:
+        if _torn_down:
+            # the job's other ranks fenced out of the modex during the
+            # previous teardown; a fresh wireup would wait on a fence
+            # no one else will ever reach (the reference's instance
+            # init runs exactly once for the same reason)
+            raise MPIError(ERR_OTHER,
+                           "instance already torn down: sessions must "
+                           "be created before the last holder finalizes")
         from ompi_tpu.runtime.wireup import init_process_mode
 
         _world = init_process_mode()
@@ -99,7 +108,7 @@ def release_instance() -> None:
     """Drop one reference; the last one tears the runtime down
     (instance.c finalize ordering: the teardown runs exactly once, when
     neither the world model nor any session needs the instance)."""
-    global _instance_refs, _world, _self_comm
+    global _instance_refs, _world, _self_comm, _torn_down
     with _lock:
         _instance_refs -= 1
         if _instance_refs > 0 or _world is None:
@@ -109,6 +118,7 @@ def release_instance() -> None:
         wireup.shutdown()
         _world = None
         _self_comm = None
+        _torn_down = True
 
 
 def Init(required: int = THREAD_MULTIPLE) -> int:

@@ -1,0 +1,161 @@
+"""Host hardware topology + rank binding (hwloc analog).
+
+Reference: opal/mca/hwloc (hwloc-internal.h — topology discovery and
+cpuset binding behind the hwloc library) and prte's rank-binding
+policies. Redesign for this runtime's needs: discovery reads the Linux
+sysfs NUMA/cpu inventory directly, accelerator inventory comes from jax
+(lazily — importing jax is heavy and host-only tools must not pay it),
+and binding partitions the ALLOWED cpuset (the affinity mask we
+inherited, not the machine's raw core list) round-robin across ranks —
+the --bind-to core policy the reference launcher applies.
+
+Enable launcher-side binding with ``--mca topo_bind_ranks 1``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+from typing import Dict, List, Optional
+
+from ompi_tpu.mca.var import register_var, get_var
+
+register_var("topo", "bind_ranks", False,
+             help="Bind each launched rank to its share of the allowed "
+                  "cpuset (reference: prte --bind-to core)", level=4)
+
+
+def _parse_cpulist(text: str) -> List[int]:
+    """'0-3,8,10-11' -> [0,1,2,3,8,10,11] (sysfs cpulist format)."""
+    cpus: List[int] = []
+    for part in text.strip().split(","):
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-")
+            cpus.extend(range(int(lo), int(hi) + 1))
+        else:
+            cpus.append(int(part))
+    return cpus
+
+
+@dataclasses.dataclass
+class NumaNode:
+    id: int
+    cpus: List[int]
+    mem_kb: int
+
+
+@dataclasses.dataclass
+class HostTopology:
+    allowed_cpus: List[int]          # our affinity mask (what we may use)
+    numa: List[NumaNode]
+    total_mem_kb: int
+
+    @property
+    def ncpus(self) -> int:
+        return len(self.allowed_cpus)
+
+    def numa_of_cpu(self, cpu: int) -> int:
+        for node in self.numa:
+            if cpu in node.cpus:
+                return node.id
+        return -1
+
+    def accelerators(self) -> List[dict]:
+        """jax-visible devices (lazy: host-only callers never pay the
+        import). Each entry: {id, kind, coords?} — the hwloc osdev
+        analog for TPU chips."""
+        try:
+            import jax
+
+            out = []
+            for d in jax.devices():
+                out.append({
+                    "id": d.id,
+                    "kind": getattr(d, "device_kind", "unknown"),
+                    "coords": getattr(d, "coords", None),
+                })
+            return out
+        except Exception:
+            return []
+
+    def summary(self) -> str:
+        lines = [f"cpus(allowed): {self.ncpus}   "
+                 f"mem: {self.total_mem_kb // 1024} MB   "
+                 f"numa nodes: {len(self.numa)}"]
+        for node in self.numa:
+            allowed = sorted(set(node.cpus) & set(self.allowed_cpus))
+            lines.append(f"  numa{node.id}: cpus={allowed} "
+                         f"mem={node.mem_kb // 1024}MB")
+        return "\n".join(lines)
+
+
+def discover() -> HostTopology:
+    """Read the sysfs inventory (reference: hwloc's linux backend)."""
+    try:
+        allowed = sorted(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        allowed = list(range(os.cpu_count() or 1))
+    numa: List[NumaNode] = []
+    for path in sorted(glob.glob("/sys/devices/system/node/node[0-9]*")):
+        nid = int(os.path.basename(path)[4:])
+        try:
+            cpus = _parse_cpulist(open(f"{path}/cpulist").read())
+        except OSError:
+            cpus = []
+        mem_kb = 0
+        try:
+            for line in open(f"{path}/meminfo"):
+                if "MemTotal" in line:
+                    mem_kb = int(line.split()[-2])
+                    break
+        except OSError:
+            pass
+        numa.append(NumaNode(nid, cpus, mem_kb))
+    total = 0
+    try:
+        for line in open("/proc/meminfo"):
+            if line.startswith("MemTotal"):
+                total = int(line.split()[1])
+                break
+    except OSError:
+        pass
+    if not numa:  # single implicit node
+        numa = [NumaNode(0, allowed, total)]
+    return HostTopology(allowed, numa, total)
+
+
+def rank_cpuset(rank: int, size: int,
+                topo: Optional[HostTopology] = None) -> List[int]:
+    """The cpus rank ``rank`` of ``size`` should bind to: a contiguous
+    slice of the allowed set, every rank nonempty (oversubscription
+    wraps round-robin — the reference's overload-allowed mode)."""
+    topo = topo or discover()
+    cpus = topo.allowed_cpus
+    if size <= 0 or not cpus:
+        return cpus
+    if size >= len(cpus):
+        return [cpus[rank % len(cpus)]]
+    per = len(cpus) // size
+    extra = len(cpus) % size
+    start = rank * per + min(rank, extra)
+    return cpus[start: start + per + (1 if rank < extra else 0)]
+
+
+def bind_rank(rank: int, size: int) -> List[int]:
+    """Apply the binding (sched_setaffinity); returns the cpuset."""
+    cpus = rank_cpuset(rank, size)
+    try:
+        os.sched_setaffinity(0, cpus)
+    except (AttributeError, OSError):
+        pass
+    return cpus
+
+
+def maybe_bind(rank: int, size: int) -> Optional[List[int]]:
+    """Wireup hook: bind when topo_bind_ranks is set."""
+    if not get_var("topo", "bind_ranks"):
+        return None
+    return bind_rank(rank, size)

@@ -38,6 +38,18 @@ def init_process_mode():
     job = int(os.environ.get("OMPI_TPU_JOB", "0"))
     urank = base + rank
 
+    # optional rank->cpuset binding (hwloc analog; reference: prte's
+    # --bind-to core applied at launch) — before any threads start so
+    # the mask is inherited by the progress/detector threads. Universe
+    # coordinates (urank over base+size) so a spawned job's ranks don't
+    # re-partition from zero onto the parent's cpus; a multi-job
+    # universe still approximates (the parent's slices were fixed when
+    # its smaller universe was the whole world — documented limit vs
+    # the reference launcher's host-global view).
+    from ompi_tpu.runtime.topology import maybe_bind
+
+    maybe_bind(urank, base + size)
+
     pml = Ob1Pml(my_rank=urank)
     # optional interpositions (reference: pml/monitoring and pml/v win
     # selection then forward to the real pml); v wraps closest to the

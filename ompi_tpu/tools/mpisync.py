@@ -1,0 +1,75 @@
+"""mpisync — cross-rank clock offset measurement.
+
+Reference: ompi/tools/mpisync (Hunold/Traeff-style clock sync used to
+align per-rank trace timestamps). The classic midpoint estimator: rank
+0 ping-pongs a timestamp with every peer; for the minimum-RTT exchange
+(least queueing noise), offset_r = t_peer - (t_send + t_recv)/2.
+CLOCK_MONOTONIC is machine-wide on Linux, so same-host offsets measure
+the method's own error bar; cross-host offsets measure real skew.
+
+Run:  mpirun -np N ompi_tpu/tools/mpisync.py [iters]
+
+Output (rank 0): one line per rank — offset seconds + min RTT — the
+same table the reference tool feeds to its trace-alignment scripts.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+SYNC_TAG = 42
+
+
+def measure_offsets(comm, iters: int = 25):
+    """rank 0 -> {rank: (offset_s, min_rtt_s)}; peers serve echoes."""
+    me = comm.Get_rank()
+    n = comm.Get_size()
+    if me == 0:
+        table = {0: (0.0, 0.0)}
+        buf = np.zeros(1, np.float64)
+        for peer in range(1, n):
+            best_rtt = float("inf")
+            best_off = 0.0
+            for _ in range(iters):
+                t0 = time.monotonic()
+                comm.Send(np.array([t0], np.float64), dest=peer,
+                          tag=SYNC_TAG)
+                comm.Recv(buf, source=peer, tag=SYNC_TAG)
+                t1 = time.monotonic()
+                rtt = t1 - t0
+                if rtt < best_rtt:
+                    best_rtt = rtt
+                    best_off = float(buf[0]) - (t0 + t1) / 2.0
+            table[peer] = (best_off, best_rtt)
+        return table
+    echo = np.zeros(1, np.float64)
+    for _ in range(iters):
+        comm.Recv(echo, source=0, tag=SYNC_TAG)
+        comm.Send(np.array([time.monotonic()], np.float64), dest=0,
+                  tag=SYNC_TAG)
+    return None
+
+
+def main() -> int:
+    import ompi_tpu
+    from ompi_tpu import COMM_WORLD
+
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 25
+    table = measure_offsets(COMM_WORLD, iters)
+    if table is not None:
+        for rank in sorted(table):
+            off, rtt = table[rank]
+            sys.stdout.write(
+                f"mpisync rank {rank}: offset {off:+.6e} s  "
+                f"rtt {rtt:.6e} s\n")
+        sys.stdout.flush()
+    COMM_WORLD.Barrier()
+    ompi_tpu.Finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

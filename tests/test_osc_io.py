@@ -158,7 +158,11 @@ def test_session():
 
     with _p.raises(MPIError):
         s.Finalize()  # live derived comm: erroneous (MPI-4 11.2.2)
+    dup = comm.Dup()  # tracking is transitive
     comm.Free()
+    with _p.raises(MPIError):
+        s.Finalize()  # the grandchild is still alive
+    dup.Free()
     s.Finalize()
     with _p.raises(MPIError):
         s.Get_num_psets()

@@ -1,0 +1,55 @@
+"""hwloc-analog topology discovery + rank binding + mpisync.
+
+Reference: opal/mca/hwloc, ompi/tools/mpisync."""
+
+import os
+import re
+
+from ompi_tpu.runtime import topology
+from tests.test_process_mode import run_mpi
+
+
+def test_discover_matches_this_host():
+    topo = topology.discover()
+    assert topo.ncpus >= 1
+    assert topo.total_mem_kb > 0
+    assert topo.numa and topo.numa[0].cpus
+    assert topo.numa_of_cpu(topo.allowed_cpus[0]) >= 0
+    assert "cpus(allowed)" in topo.summary()
+
+
+def test_parse_cpulist():
+    assert topology._parse_cpulist("0-3,8,10-11") == [0, 1, 2, 3, 8,
+                                                      10, 11]
+    assert topology._parse_cpulist("") == []
+
+
+def test_rank_cpuset_partition():
+    topo = topology.HostTopology(list(range(8)), [], 0)
+    sets = [topology.rank_cpuset(r, 4, topo) for r in range(4)]
+    assert [len(s) for s in sets] == [2, 2, 2, 2]
+    assert sorted(c for s in sets for c in s) == list(range(8))
+    # oversubscription wraps, never empty
+    sets = [topology.rank_cpuset(r, 16, topo) for r in range(16)]
+    assert all(len(s) == 1 for s in sets)
+
+
+def test_bind_rank_applies_affinity():
+    before = os.sched_getaffinity(0)
+    try:
+        got = topology.bind_rank(0, len(before))
+        assert os.sched_getaffinity(0) == set(got)
+    finally:
+        os.sched_setaffinity(0, before)
+
+
+def test_mpisync_three_ranks():
+    r = run_mpi(3, "ompi_tpu/tools/mpisync.py", "10", timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = re.findall(r"mpisync rank (\d+): offset ([+-][\d.e+-]+) s",
+                       r.stdout)
+    assert len(lines) == 3, r.stdout
+    # same host, same CLOCK_MONOTONIC: offsets bound the method's own
+    # error (generous bound for a loaded CI box)
+    for _rank, off in lines:
+        assert abs(float(off)) < 0.5, (off, r.stdout)
