@@ -1,0 +1,40 @@
+"""Pairwise connectivity check (reference: examples/connectivity_c.c —
+every rank exchanges a token with every other; '-v' prints each pair).
+
+Run:  python -m ompi_tpu.tools.mpirun -np 4 examples/connectivity.py [-v]
+"""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+
+
+def main() -> int:
+    verbose = "-v" in sys.argv[1:]
+    rank = COMM_WORLD.Get_rank()
+    size = COMM_WORLD.Get_size()
+    token = np.zeros(1, np.int32)
+    for i in range(size):
+        for j in range(i + 1, size):
+            if rank == i:
+                COMM_WORLD.Send(np.array([rank], np.int32), dest=j)
+                COMM_WORLD.Recv(token, source=j)
+                if verbose:
+                    print(f"Checking connection between rank {i} and "
+                          f"rank {j}", flush=True)
+            elif rank == j:
+                COMM_WORLD.Recv(token, source=i)
+                COMM_WORLD.Send(np.array([rank], np.int32), dest=i)
+    COMM_WORLD.Barrier()
+    if rank == 0:
+        print(f"Connectivity test on {size} processes PASSED.",
+              flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

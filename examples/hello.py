@@ -1,0 +1,23 @@
+"""Hello world (reference: examples/hello_c.c).
+
+Run:  python -m ompi_tpu.tools.mpirun -np 4 examples/hello.py
+"""
+
+import sys
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+
+
+def main() -> int:
+    rank = COMM_WORLD.Get_rank()
+    size = COMM_WORLD.Get_size()
+    print(f"Hello, world, I am {rank} of {size} "
+          f"(ompi_tpu {ompi_tpu.__version__})", flush=True)
+    COMM_WORLD.Barrier()
+    ompi_tpu.Finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""OpenSHMEM tour: symmetric data, circular-shift puts, max reduction,
+atomics, and a distributed lock (reference: examples/hello_oshmem_c.c,
+oshmem_circular_shift.c, oshmem_max_reduction.c, oshmem_shmalloc.c).
+
+Run:  python -m ompi_tpu.tools.mpirun -np 4 examples/hello_oshmem.py
+"""
+
+import sys
+
+import numpy as np
+
+from ompi_tpu import shmem
+
+
+def main() -> int:
+    shmem.init()
+    me = shmem.my_pe()
+    n = shmem.n_pes()
+    print(f"Hello, world, I am {me} of {n} (oshmem-style PGAS)",
+          flush=True)
+
+    # circular shift: put my id into my right neighbor's slot
+    src = shmem.zeros(1, np.int64)
+    shmem.barrier_all()
+    shmem.p(src, me, pe=(me + 1) % n)
+    shmem.barrier_all()
+    assert src.local[0] == (me - 1) % n
+
+    # max reduction over every PE's value
+    val = shmem.zeros(1, np.int64)
+    out = shmem.zeros(1, np.int64)
+    val.local[0] = (me + 1) * 10
+    shmem.barrier_all()
+    shmem.max_to_all(out, val)
+    assert out.local[0] == n * 10
+
+    # atomics: shared counter on PE 0
+    ctr = shmem.zeros(1, np.int64)
+    shmem.barrier_all()
+    shmem.atomic_add(ctr, 1, pe=0)
+    shmem.barrier_all()
+    if me == 0:
+        print(f"counter on PE 0: {int(ctr.local[0])} (= n_pes)",
+              flush=True)
+
+    # lock-guarded read-modify-write
+    lock = shmem.zeros(1, np.int64)
+    total = shmem.zeros(1, np.int64)
+    shmem.barrier_all()
+    shmem.set_lock(lock)
+    v = shmem.g(total, pe=0)
+    shmem.p(total, v + me, pe=0)
+    shmem.quiet()
+    shmem.clear_lock(lock)
+    shmem.barrier_all()
+    if me == 0:
+        print(f"lock-guarded sum: {int(total.local[0])} "
+              f"(= sum of ranks)", flush=True)
+    shmem.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""OSU-style microbenchmarks: pt2pt latency, pt2pt bandwidth, and
+allreduce latency over a size sweep (reference: the OSU benchmark suite
+the reference's CI runs; same measurement shapes).
+
+Run:  python -m ompi_tpu.tools.mpirun -np 2 examples/osu_latency_bw.py
+      (allreduce section accepts any np)
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+
+SIZES = [8, 64, 1024, 16 * 1024, 256 * 1024, 1 << 20]
+WARMUP, ITERS = 5, 30
+
+
+def latency(rank):
+    if rank == 0:
+        print(f"{'bytes':>10} {'latency_us':>12}", flush=True)
+    for nbytes in SIZES:
+        buf = np.zeros(nbytes, np.uint8)
+        COMM_WORLD.Barrier()
+        t0 = 0.0
+        for it in range(WARMUP + ITERS):
+            if it == WARMUP:
+                t0 = time.perf_counter()
+            if rank == 0:
+                COMM_WORLD.Send(buf, dest=1, tag=1)
+                COMM_WORLD.Recv(buf, source=1, tag=1)
+            else:
+                COMM_WORLD.Recv(buf, source=0, tag=1)
+                COMM_WORLD.Send(buf, dest=0, tag=1)
+        dt = time.perf_counter() - t0
+        if rank == 0:
+            print(f"{nbytes:>10} {dt / ITERS / 2 * 1e6:>12.2f}",
+                  flush=True)
+
+
+def bandwidth(rank):
+    if rank == 0:
+        print(f"{'bytes':>10} {'bw_MB_s':>12}", flush=True)
+    window = 16
+    for nbytes in SIZES:
+        buf = np.zeros(nbytes, np.uint8)
+        ack = np.zeros(1, np.uint8)
+        COMM_WORLD.Barrier()
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            if rank == 0:
+                reqs = [COMM_WORLD.Isend(buf, dest=1, tag=2)
+                        for _ in range(window)]
+                for q in reqs:
+                    q.Wait()
+                COMM_WORLD.Recv(ack, source=1, tag=3)
+            else:
+                reqs = [COMM_WORLD.Irecv(buf, source=0, tag=2)
+                        for _ in range(window)]
+                for q in reqs:
+                    q.Wait()
+                COMM_WORLD.Send(ack, dest=0, tag=3)
+        dt = time.perf_counter() - t0
+        if rank == 0:
+            mb = nbytes * window * ITERS / 1e6
+            print(f"{nbytes:>10} {mb / dt:>12.1f}", flush=True)
+
+
+def allreduce_latency(rank):
+    if rank == 0:
+        print(f"{'bytes':>10} {'allreduce_us':>14}", flush=True)
+    for nbytes in SIZES:
+        src = np.zeros(nbytes // 8 or 1, np.float64)
+        dst = np.zeros_like(src)
+        COMM_WORLD.Barrier()
+        t0 = 0.0
+        for it in range(WARMUP + ITERS):
+            if it == WARMUP:
+                t0 = time.perf_counter()
+            COMM_WORLD.Allreduce(src, dst)
+        dt = time.perf_counter() - t0
+        if rank == 0:
+            print(f"{nbytes:>10} {dt / ITERS * 1e6:>14.2f}", flush=True)
+
+
+def main() -> int:
+    rank = COMM_WORLD.Get_rank()
+    size = COMM_WORLD.Get_size()
+    if size >= 2:
+        if rank == 0:
+            print("# osu-style pt2pt latency (ranks 0-1)", flush=True)
+        if rank < 2:
+            latency(rank)
+        COMM_WORLD.Barrier()
+        if rank == 0:
+            print("# osu-style pt2pt bandwidth (ranks 0-1)", flush=True)
+        if rank < 2:
+            bandwidth(rank)
+        COMM_WORLD.Barrier()
+    if rank == 0:
+        print(f"# osu-style allreduce latency ({size} ranks)",
+              flush=True)
+    allreduce_latency(rank)
+    COMM_WORLD.Barrier()
+    ompi_tpu.Finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

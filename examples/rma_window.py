@@ -1,0 +1,61 @@
+"""One-sided communication tour: fence epochs, PSCW, passive-target
+locks, Fetch_and_op (reference: the osc surface of MPI-3 §11; the
+reference ships this pattern across its osc test programs).
+
+Run:  python -m ompi_tpu.tools.mpirun -np 4 examples/rma_window.py
+"""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.osc.window import Win, LOCK_EXCLUSIVE
+
+
+def main() -> int:
+    rank = COMM_WORLD.Get_rank()
+    size = COMM_WORLD.Get_size()
+
+    base = np.zeros(size, np.float64)
+    win = Win.Create(base, COMM_WORLD)
+
+    # fence epoch: everyone puts its id into slot `rank` of its right
+    # neighbor's window
+    win.Fence()
+    nxt = (rank + 1) % size
+    win.Put(np.array([float(rank)], np.float64), nxt, target_disp=rank)
+    win.Fence()
+    assert base[(rank - 1) % size] == float((rank - 1) % size)
+
+    # passive target: lock rank 0's window, fetch-and-add a counter
+    old = np.zeros(1, np.float64)
+    win.Lock(0, LOCK_EXCLUSIVE)
+    win.Fetch_and_op(np.array([1.0]), old, target=0, target_disp=0,
+                     op=mpi_op.SUM)
+    win.Unlock(0)
+    COMM_WORLD.Barrier()
+    if rank == 0:
+        print(f"fetch-and-op counter: {base[0] + 0:.0f} "
+              f"(expected around {size} increments total)", flush=True)
+
+    # request-based RMA with explicit flush
+    win.Lock(nxt, LOCK_EXCLUSIVE)
+    req = win.Rput(np.array([100.0 + rank]), nxt, target_disp=size - 1)
+    req.Wait()
+    win.Flush(nxt)
+    win.Unlock(nxt)
+    COMM_WORLD.Barrier()
+    assert base[size - 1] == 100.0 + (rank - 1) % size
+
+    win.Free()
+    if rank == 0:
+        print("RMA example PASSED.", flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+"""Dynamic processes: spawn children, talk over the intercomm, merge
+(reference: the dpm surface — MPI_Comm_spawn / get_parent / merge).
+
+Run:  python -m ompi_tpu.tools.mpirun -np 2 examples/spawn.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+
+
+def child() -> int:
+    from ompi_tpu import Comm_get_parent
+
+    parent = Comm_get_parent()
+    rank = COMM_WORLD.Get_rank()
+    total = np.zeros(1, np.float64)
+    parent.Allreduce(np.full(1, 100.0 + rank), total)
+    print(f"child {rank}: parents contributed {total[0]:.0f}",
+          flush=True)
+    parent.Merge(high=True)  # collective with the parents' Merge
+    ompi_tpu.Finalize()
+    return 0
+
+
+def parent() -> int:
+    rank = COMM_WORLD.Get_rank()
+    inter = COMM_WORLD.Spawn(os.path.abspath(__file__), args=["--child"],
+                             maxprocs=2, root=0)
+    total = np.zeros(1, np.float64)
+    inter.Allreduce(np.full(1, float(rank + 1)), total)
+    print(f"parent {rank}: children contributed {total[0]:.0f}",
+          flush=True)
+    merged = inter.Merge(high=False)
+    print(f"parent {rank}: merged world has {merged.Get_size()} procs",
+          flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(child() if "--child" in sys.argv[1:] else parent())

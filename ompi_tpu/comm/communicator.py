@@ -118,6 +118,7 @@ class Communicator:
         self.revoked = False  # ULFM (reference: communicator.h:360-363)
         self.coll = None  # CollTable, set by subclasses after selection
         self.topo = None  # topology module (cart/graph), set by topo layer
+        self._freed = False  # session liveness tracking (MPI-4 11.2.2)
         from ompi_tpu.mpit import emit  # MPI_T event (mpit.py)
 
         emit("comm", "created", name=self.name, cid=cid,
@@ -197,6 +198,17 @@ class Communicator:
     def _check_usable(self) -> None:
         if self.revoked:
             raise MPIError(ERR_REVOKED, self.name)
+
+    def _propagate_session(self, new) -> None:
+        """Comms derived from a session-derived comm stay tracked by the
+        session (MPI-4 11.2.2 liveness at Session.Finalize is
+        transitive)."""
+        sref = getattr(self, "_session", None)
+        if sref is not None:
+            s = sref()
+            if s is not None and not s._finalized:
+                s.track(new)
+
 
     # --------------------------------------------- topology (shared core)
     # Reference: ompi/mca/topo base accessors; the rank-specific pieces
@@ -586,16 +598,6 @@ class ProcComm(Intracomm):
         _bump_local_cid(int(agreed[0]))
         return int(agreed[0])
 
-    def _propagate_session(self, new: "ProcComm") -> None:
-        """Comms derived from a session-derived comm stay tracked by the
-        session (MPI-4 11.2.2 liveness at Session.Finalize is
-        transitive)."""
-        sref = getattr(self, "_session", None)
-        if sref is not None:
-            s = sref()
-            if s is not None and not s._finalized:
-                s.track(new)
-
     def Dup(self) -> "ProcComm":
         cid = self._alloc_cid()
         new = ProcComm(self.group, cid, self.pml, name=f"{self.name}-dup")
@@ -635,6 +637,7 @@ class ProcComm(Intracomm):
     def Free(self) -> None:
         self._delete_all_attrs()
         self.coll = None
+        self._freed = True
 
     # ------------------------------------------------------------ topology
     # Reference: ompi/mca/topo + the MPI cart/graph surface

@@ -530,11 +530,14 @@ class Intercomm(Communicator):
         _bump_local_cid(cid)
         merged = (self.remote_ranks + local) if high else \
             (local + self.remote_ranks)
-        return ProcComm(Group(merged), cid, self.pml,
-                        name=f"{self.name}-merged")
+        out = ProcComm(Group(merged), cid, self.pml,
+                       name=f"{self.name}-merged")
+        self._propagate_session(out)
+        return out
 
     def Free(self) -> None:
         self._delete_all_attrs()
+        self._freed = True
 
 
 def _check_inter_root(root) -> None:
@@ -604,7 +607,9 @@ def intercomm_create(local_comm: ProcComm, local_leader: int,
     local_comm.Bcast(buf, root=local_leader)
     info = json.loads(buf.tobytes()[: int(size_arr[0])].decode())
     _bump_local_cid(int(info["cid"]))
-    return Intercomm(local_comm, info["remote"], int(info["cid"]))
+    inter = Intercomm(local_comm, info["remote"], int(info["cid"]))
+    local_comm._propagate_session(inter)  # session tracking spans bridges
+    return inter
 
 
 def Intercomm_create(local_comm: ProcComm, local_leader: int,

@@ -445,6 +445,7 @@ class XlaComm(Intracomm):
 
     def Free(self) -> None:
         self._delete_all_attrs()
+        self._freed = True
         self._jit_cache.clear()
         self._fast_allreduce.clear()
         self.coll = None

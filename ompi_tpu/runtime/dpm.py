@@ -26,7 +26,13 @@ _parent_intercomm = None
 
 def Comm_get_parent():
     """The intercomm to the spawning job, or None (MPI_COMM_NULL) if this
-    process was not spawned (reference: dpm.c ompi_dpm_dyn_init)."""
+    process was not spawned (reference: dpm.c ompi_dpm_dyn_init).
+    Auto-initializes like the rest of the surface: the parent handshake
+    runs inside Init, so calling this first must not return None in a
+    spawned child."""
+    from ompi_tpu.runtime import state
+
+    state.Init()
     return _parent_intercomm
 
 

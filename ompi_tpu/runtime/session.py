@@ -14,9 +14,9 @@ a veneer over it). The session model implemented here:
   live derived communicators is erroneous (MPI-4 §11.2.2) and raises,
   instead of silently leaving comms on a torn-down runtime.
 - process sets: mpi://WORLD, mpi://SELF, plus mpix://NODE (the ranks
-  sharing this host, derived from which endpoints bound the sm/self
-  btl — the reference publishes the same node-local pset from PMIx
-  locality).
+  sharing this host, read from the node identity every rank publishes
+  to the modex — the PMIx-locality analog; endpoint selection is NOT
+  used because sm-vs-tcp binding can be asymmetric across a pair).
 """
 
 from __future__ import annotations
@@ -49,7 +49,8 @@ class Session:
 
         if self._finalized:
             return
-        live = [c for c in self._derived if c.coll is not None]
+        live = [c for c in self._derived
+                if not getattr(c, "_freed", False)]
         if live:
             raise MPIError(
                 ERR_SESSION,

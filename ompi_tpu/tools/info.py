@@ -25,7 +25,11 @@ def _load_everything() -> None:
     import ompi_tpu.coll.xla  # mesh collectives
     import ompi_tpu.coll.neighbor  # topology collectives
     import ompi_tpu.runtime.spc  # spc vars
+    import ompi_tpu.runtime.topology  # topo binding vars
     import ompi_tpu.pml.ob1  # pml vars
+    import ompi_tpu.pml.vprotocol  # pml_v message-logging vars
+    import ompi_tpu.io.file  # collective-IO aggregator vars
+    import ompi_tpu.ft.era  # agreement vars
 
 
 def print_header(out) -> None:

@@ -1,0 +1,26 @@
+"""Every shipped example runs green under the launcher (reference:
+examples/ is part of the reference's release checks)."""
+
+import pytest
+
+from tests.test_process_mode import run_mpi
+
+
+@pytest.mark.parametrize("example,np_,needle", [
+    ("hello", 3, "Hello, world, I am"),
+    ("connectivity", 4, "PASSED"),
+    ("hello_oshmem", 3, "counter on PE 0: 3"),
+    ("hello_sessions", 3, "via sessions"),
+    ("rma_window", 3, "RMA example PASSED"),
+])
+def test_example(example, np_, needle):
+    r = run_mpi(np_, f"examples/{example}.py", timeout=150)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert needle in r.stdout, r.stdout
+
+
+def test_example_spawn():
+    r = run_mpi(2, "examples/spawn.py", timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "children contributed 201" in r.stdout, r.stdout
+    assert "parents contributed 3" in r.stdout, r.stdout
