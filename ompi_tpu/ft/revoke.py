@@ -164,4 +164,6 @@ def shrink_comm(comm):
     cid = _agree_max_alive(comm.pml, alive, comm.cid,
                            _next_local_cid() + 1000)
     _bump_local_cid(cid)
-    return ProcComm(newgrp, cid, comm.pml, name=f"{comm.name}-shrunk")
+    shrunk = ProcComm(newgrp, cid, comm.pml, name=f"{comm.name}-shrunk")
+    comm._propagate_session(shrunk)  # session tracking survives shrink
+    return shrunk

@@ -1,0 +1,174 @@
+"""Checkpoint / resume.
+
+Reference: the reference's checkpoint story is split across its FT
+stack (vprotocol message logging for replay; SURVEY.md §5 lists
+checkpoint/resume as an aux subsystem the framework must provide).
+Redesign TPU-first in two halves:
+
+- **Mesh mode** (the training path): orbax-backed pytree checkpoints of
+  the full training state (params, optimizer state, step). Restore
+  re-places every leaf onto the caller's mesh shardings — a checkpoint
+  written on one topology restores onto another (the orbax + jax
+  idiom; this is what makes TPU preemption survivable).
+- **Process mode**: rank-partitioned two-phase-commit checkpoints —
+  every rank stages its state to a temp file, a barrier establishes
+  global completeness, rank 0 commits a manifest, and a second barrier
+  publishes it. A crash at ANY point leaves either the previous
+  complete checkpoint or a fully-committed new one (never a torn one);
+  restore validates the manifest against the job geometry. Combined
+  with pml/v's deterministic replay this is the rollback-recovery pair
+  the reference's vprotocol literature assumes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIError, ERR_FILE, ERR_OTHER
+
+
+# ------------------------------------------------------------ mesh mode
+class MeshCheckpointer:
+    """Orbax-backed training-state checkpoints with retention.
+
+    ``specs`` (a pytree of PartitionSpec matching ``state``) + ``mesh``
+    re-place restored leaves; omit both to restore host-side."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = True) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: Optional[int] = None, template: Any = None,
+                mesh=None, specs=None) -> Any:
+        import jax
+        import orbax.checkpoint as ocp
+
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise MPIError(ERR_FILE, f"no checkpoint in {self._dir}")
+        if template is not None:
+            state = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(template))
+        else:
+            state = self._mgr.restore(step)
+        if mesh is not None and specs is not None:
+            from jax.sharding import NamedSharding
+
+            state = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                state, specs)
+        return state
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+# --------------------------------------------------------- process mode
+_MANIFEST = "MANIFEST.json"
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{step:010d}")
+
+
+def save_ranked(comm, directory: str, step: int,
+                state: Dict[str, np.ndarray]) -> None:
+    """Two-phase-commit rank-partitioned checkpoint: (retract any prior
+    commit of this step ->) stage -> barrier -> manifest -> barrier.
+    Collective over ``comm``."""
+    from ompi_tpu.runtime import spc
+
+    d = _step_dir(directory, step)
+    os.makedirs(d, exist_ok=True)
+    rank, size = comm.Get_rank(), comm.Get_size()
+    if os.path.exists(os.path.join(d, _MANIFEST)):
+        # re-saving an already-committed step: retract the commit FIRST
+        # (and fence it) or a crash mid-stage would leave the old
+        # manifest pointing at mixed old/new rank files — the torn state
+        # the two-phase protocol exists to prevent
+        if rank == 0:
+            os.unlink(os.path.join(d, _MANIFEST))
+        with spc.suppressed():
+            comm.Barrier()
+    tmp = os.path.join(d, f"rank_{rank}.npz.tmp")
+    final = os.path.join(d, f"rank_{rank}.npz")
+    with open(tmp, "wb") as f:
+        np.savez(f, **state)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    with spc.suppressed():
+        comm.Barrier()          # phase 1: every rank staged
+    if rank == 0:
+        mtmp = os.path.join(d, _MANIFEST + ".tmp")
+        with open(mtmp, "w") as f:
+            json.dump({"step": step, "size": size,
+                       "keys": sorted(state)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(mtmp, os.path.join(d, _MANIFEST))
+    with spc.suppressed():
+        comm.Barrier()          # phase 2: the commit is published
+
+
+def latest_ranked_step(directory: str) -> Optional[int]:
+    """Newest step with a COMMITTED manifest (torn attempts are
+    invisible by construction)."""
+    best = None
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    for name in names:
+        suffix = name[len("step_"):] if name.startswith("step_") else ""
+        if not suffix.isdigit():
+            continue  # foreign entries (backups etc.) are not ours
+        if not os.path.exists(os.path.join(directory, name, _MANIFEST)):
+            continue
+        step = int(suffix)
+        best = step if best is None else max(best, step)
+    return best
+
+
+def restore_ranked(comm, directory: str,
+                   step: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Load this rank's partition of the committed checkpoint."""
+    if step is None:
+        step = latest_ranked_step(directory)
+        if step is None:
+            raise MPIError(ERR_FILE, f"no checkpoint in {directory}")
+    d = _step_dir(directory, step)
+    try:
+        manifest = json.load(open(os.path.join(d, _MANIFEST)))
+    except OSError:
+        raise MPIError(ERR_FILE, f"step {step} has no committed manifest")
+    if manifest["size"] != comm.Get_size():
+        raise MPIError(
+            ERR_OTHER,
+            f"checkpoint was taken by {manifest['size']} ranks, "
+            f"restoring with {comm.Get_size()} (repartitioning is the "
+            "application's job)")
+    path = os.path.join(d, f"rank_{comm.Get_rank()}.npz")
+    with np.load(path) as z:
+        return {k: z[k].copy() for k in z.files}

@@ -29,10 +29,12 @@ def Comm_get_parent():
     process was not spawned (reference: dpm.c ompi_dpm_dyn_init).
     Auto-initializes like the rest of the surface: the parent handshake
     runs inside Init, so calling this first must not return None in a
-    spawned child."""
+    spawned child. After Finalize it answers from the stored state
+    (teardown guards may legitimately ask) instead of raising."""
     from ompi_tpu.runtime import state
 
-    state.Init()
+    if not state.Is_finalized():
+        state.Init()
     return _parent_intercomm
 
 

@@ -1,0 +1,118 @@
+"""Checkpoint/resume: orbax mesh training state + rank-partitioned
+process-mode checkpoints (SURVEY §5 aux subsystem)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tests.test_process_mode import run_mpi
+
+W = 8
+
+
+@pytest.fixture(scope="module")
+def mesh_bits():
+    from jax.sharding import Mesh
+
+    from ompi_tpu.models.transformer import (
+        Config, init_params, make_train_step, param_specs)
+
+    assert jax.device_count() >= W
+    cfg = Config(vocab=64, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                 seq_len=32)
+    mesh = Mesh(np.asarray(jax.devices()[:W]).reshape(2, 2, 2),
+                ("dp", "sp", "tp"))
+    step_fn, place = make_train_step(mesh, cfg)
+    return cfg, mesh, step_fn, place
+
+
+def _data(cfg, seed, batch=4):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab, (batch, cfg.seq_len),
+                        dtype=np.int32)
+    return toks, np.roll(toks, -1, axis=1)
+
+
+def test_mesh_train_checkpoint_resume_identical(tmp_path, mesh_bits):
+    from ompi_tpu.models.transformer import init_params, param_specs
+    from ompi_tpu.runtime.checkpoint import MeshCheckpointer
+
+    cfg, mesh, step_fn, place = mesh_bits
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    toks, tgts = _data(cfg, 7)
+    params, dtoks, dtgts = place(params, toks, tgts)
+
+    # uninterrupted: 5 steps (keep the last two losses as ground truth)
+    ref = params
+    ref_losses = []
+    for i in range(5):
+        loss, ref = step_fn(ref, dtoks, dtgts)
+        if i >= 3:
+            ref_losses.append(float(loss))
+
+    # interrupted: 3 steps, checkpoint, "restart", 2 more steps
+    ck = MeshCheckpointer(str(tmp_path / "mesh_ck"))
+    p = params
+    for _ in range(3):
+        _, p = step_fn(p, dtoks, dtgts)
+    ck.save(3, jax.tree.map(np.asarray, p))
+    assert ck.latest_step() == 3
+
+    restored = ck.restore(mesh=mesh, specs=param_specs(cfg))
+    resumed_losses = []
+    for _ in range(2):
+        loss, restored = step_fn(restored, dtoks, dtgts)
+        resumed_losses.append(float(loss))
+    ck.close()
+    assert resumed_losses == ref_losses  # step-for-step identical
+
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_mesh_checkpoint_retention(tmp_path):
+    from ompi_tpu.runtime.checkpoint import MeshCheckpointer
+
+    ck = MeshCheckpointer(str(tmp_path / "ret"), max_to_keep=2)
+    for s in (1, 2, 3):
+        ck.save(s, {"a": np.full(2, float(s))})
+    assert ck.latest_step() == 3
+    got = ck.restore()
+    np.testing.assert_array_equal(got["a"], [3.0, 3.0])
+    ck.close()
+
+
+def test_procmode_checkpoint_restart(tmp_path):
+    ckdir = str(tmp_path / "ranked")
+    r = run_mpi(3, "tests/procmode/check_checkpoint.py", ckdir, "save",
+                timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("CKPT-SAVED") == 3
+    r2 = run_mpi(3, "tests/procmode/check_checkpoint.py", ckdir,
+                 "resume", timeout=120)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert r2.stdout.count("CKPT-RESUMED") == 3
+
+
+def test_procmode_checkpoint_size_mismatch(tmp_path):
+    ckdir = str(tmp_path / "ranked2")
+    r = run_mpi(2, "tests/procmode/check_checkpoint.py", ckdir, "save",
+                timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r2 = run_mpi(3, "tests/procmode/check_checkpoint.py", ckdir,
+                 "resume", timeout=120)
+    assert r2.returncode != 0
+    assert "repartitioning" in (r2.stdout + r2.stderr)
+
+
+def test_torn_attempt_is_invisible(tmp_path):
+    """A step dir without a committed manifest is never restored."""
+    import os
+
+    from ompi_tpu.runtime.checkpoint import latest_ranked_step
+
+    d = tmp_path / "torn" / "step_0000000007"
+    os.makedirs(d)
+    (d / "rank_0.npz").write_bytes(b"partial")
+    assert latest_ranked_step(str(tmp_path / "torn")) is None
