@@ -92,44 +92,59 @@ def _step_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step:010d}")
 
 
+def _read_manifest(d: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(d, _MANIFEST)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def save_ranked(comm, directory: str, step: int,
                 state: Dict[str, np.ndarray]) -> None:
-    """Two-phase-commit rank-partitioned checkpoint: (retract any prior
-    commit of this step ->) stage -> barrier -> manifest -> barrier.
-    Collective over ``comm``."""
+    """Two-phase-commit rank-partitioned checkpoint, attempt-versioned:
+    rank files carry an attempt id and the manifest names the committed
+    attempt, so re-saving a step NEVER invalidates the previous commit —
+    a crash at any point leaves the old manifest pointing at intact old
+    files, or the new manifest fully committed. The attempt id is
+    chosen by rank 0 and broadcast (one collective decision; per-rank
+    filesystem probes would race). Collective over ``comm``."""
     from ompi_tpu.runtime import spc
 
     d = _step_dir(directory, step)
     os.makedirs(d, exist_ok=True)
     rank, size = comm.Get_rank(), comm.Get_size()
-    if os.path.exists(os.path.join(d, _MANIFEST)):
-        # re-saving an already-committed step: retract the commit FIRST
-        # (and fence it) or a crash mid-stage would leave the old
-        # manifest pointing at mixed old/new rank files — the torn state
-        # the two-phase protocol exists to prevent
-        if rank == 0:
-            os.unlink(os.path.join(d, _MANIFEST))
-        with spc.suppressed():
-            comm.Barrier()
-    tmp = os.path.join(d, f"rank_{rank}.npz.tmp")
-    final = os.path.join(d, f"rank_{rank}.npz")
+    attempt = np.zeros(1, np.int64)
+    if rank == 0:
+        prev = _read_manifest(d)
+        attempt[0] = (prev["attempt"] + 1) if prev else 0
+    with spc.suppressed():
+        comm.Bcast(attempt, root=0)
+    a = int(attempt[0])
+    tmp = os.path.join(d, f"rank_{rank}.a{a}.npz.tmp")
+    final = os.path.join(d, f"rank_{rank}.a{a}.npz")
     with open(tmp, "wb") as f:
         np.savez(f, **state)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, final)
     with spc.suppressed():
-        comm.Barrier()          # phase 1: every rank staged
+        comm.Barrier()          # phase 1: every rank staged attempt a
     if rank == 0:
         mtmp = os.path.join(d, _MANIFEST + ".tmp")
         with open(mtmp, "w") as f:
-            json.dump({"step": step, "size": size,
+            json.dump({"step": step, "size": size, "attempt": a,
                        "keys": sorted(state)}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(mtmp, os.path.join(d, _MANIFEST))
     with spc.suppressed():
         comm.Barrier()          # phase 2: the commit is published
+    if a > 0:                   # post-commit cleanup (crash-harmless)
+        try:
+            os.unlink(os.path.join(d, f"rank_{rank}.a{a - 1}.npz"))
+        except OSError:
+            pass
 
 
 def latest_ranked_step(directory: str) -> Optional[int]:
@@ -159,9 +174,8 @@ def restore_ranked(comm, directory: str,
         if step is None:
             raise MPIError(ERR_FILE, f"no checkpoint in {directory}")
     d = _step_dir(directory, step)
-    try:
-        manifest = json.load(open(os.path.join(d, _MANIFEST)))
-    except OSError:
+    manifest = _read_manifest(d)
+    if manifest is None:
         raise MPIError(ERR_FILE, f"step {step} has no committed manifest")
     if manifest["size"] != comm.Get_size():
         raise MPIError(
@@ -169,6 +183,7 @@ def restore_ranked(comm, directory: str,
             f"checkpoint was taken by {manifest['size']} ranks, "
             f"restoring with {comm.Get_size()} (repartitioning is the "
             "application's job)")
-    path = os.path.join(d, f"rank_{comm.Get_rank()}.npz")
+    a = manifest.get("attempt", 0)
+    path = os.path.join(d, f"rank_{comm.Get_rank()}.a{a}.npz")
     with np.load(path) as z:
         return {k: z[k].copy() for k in z.files}

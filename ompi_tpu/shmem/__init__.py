@@ -195,14 +195,42 @@ def g(arr: SymArray, pe: int, offset: int = 0):
 
 
 # ------------------------------------------------- nonblocking put/get
+def _rput_nbi(reqs: list, arr: SymArray, src, pe: int,
+              offset: int) -> None:
+    ctx = _need()
+    src = np.ascontiguousarray(np.asarray(src, dtype=arr.dtype))
+    reqs.append(ctx["win"].Rput(src, pe, target_disp=arr._disp(offset)))
+
+
+def _rget_nbi(reqs: list, arr: SymArray, out: np.ndarray, pe: int,
+              offset: int) -> None:
+    ctx = _need()
+    if out.dtype != arr.dtype:
+        raise MPIError(ERR_OTHER,
+                       f"get_nbi dtype mismatch: {out.dtype} vs "
+                       f"{arr.dtype}")
+    if not out.flags["C_CONTIGUOUS"]:
+        raise MPIError(ERR_OTHER, "get_nbi needs a contiguous out array")
+    reqs.append(ctx["win"].Rget(out, pe, target_disp=arr._disp(offset)))
+
+
+def _drain(reqs: list) -> None:
+    """Wait every request, dropping none even on failure."""
+    err = None
+    for r in reqs:
+        try:
+            r.Wait()
+        except MPIError as e:
+            err = err or e
+    if err is not None:
+        raise err
+
+
 def put_nbi(arr: SymArray, src, pe: int, offset: int = 0) -> None:
     """shmem_put_nbi: neither local nor remote completion at return —
     both at quiet() (reference: oshmem/shmem/c/shmem_put_nb.c; the src
     buffer must stay unmodified until quiet)."""
-    ctx = _need()
-    src = np.ascontiguousarray(np.asarray(src, dtype=arr.dtype))
-    ctx["nbi"].append(ctx["win"].Rput(src, pe,
-                                      target_disp=arr._disp(offset)))
+    _rput_nbi(_need()["nbi"], arr, src, pe, offset)
 
 
 def get_nbi(arr: SymArray, out: np.ndarray, pe: int,
@@ -211,15 +239,7 @@ def get_nbi(arr: SymArray, out: np.ndarray, pe: int,
     be a contiguous array of the symmetric dtype — the landing callback
     writes through a flat view, which would silently fill a temporary
     for a strided destination."""
-    ctx = _need()
-    if out.dtype != arr.dtype:
-        raise MPIError(ERR_OTHER,
-                       f"get_nbi dtype mismatch: {out.dtype} vs "
-                       f"{arr.dtype}")
-    if not out.flags["C_CONTIGUOUS"]:
-        raise MPIError(ERR_OTHER, "get_nbi needs a contiguous out array")
-    ctx["nbi"].append(ctx["win"].Rget(out, pe,
-                                      target_disp=arr._disp(offset)))
+    _rget_nbi(_need()["nbi"], arr, out, pe, offset)
 
 
 # -------------------------------------------------------- strided iput
@@ -370,14 +390,7 @@ def quiet() -> None:
     including the _nbi ones (their requests complete here)."""
     ctx = _need()
     reqs, ctx["nbi"] = ctx["nbi"], []
-    err = None
-    for r in reqs:
-        try:
-            r.Wait()
-        except MPIError as e:
-            err = err or e  # keep draining: no request may be dropped
-    if err is not None:
-        raise err
+    _drain(reqs)
     ctx["win"].Flush()
 
 
@@ -393,40 +406,171 @@ def barrier_all() -> None:
 
 
 # --------------------------------------------------- collectives (scoll)
+def _bcast_impl(comm, arr: SymArray, root: int) -> None:
+    comm.Bcast([arr.local, arr.count, _dt_of(arr.dtype)], root=root)
+
+
+def _reduce_impl(comm, target: SymArray, source: SymArray, op) -> None:
+    comm.Allreduce(
+        [source.local, source.count, _dt_of(source.dtype)],
+        [target.local, target.count, _dt_of(target.dtype)], op=op)
+
+
+def _collect_impl(comm, arr: SymArray) -> np.ndarray:
+    n = comm.Get_size()
+    out = np.zeros(arr.count * n, arr.dtype)
+    comm.Allgather(
+        [arr.local, arr.count, _dt_of(arr.dtype)],
+        [out, arr.count * n, _dt_of(arr.dtype)])
+    return out
+
+
 def broadcast(arr: SymArray, root: int = 0) -> None:
     """shmem_broadcast over the symmetric block (scoll/mpi pattern:
     delegate to the MPI collective)."""
-    ctx = _need()
-    ctx["comm"].Bcast([arr.local, arr.count,
-                       _dt_of(arr.dtype)], root=root)
+    _bcast_impl(_need()["comm"], arr, root)
 
 
 def sum_to_all(target: SymArray, source: SymArray) -> None:
-    ctx = _need()
-    ctx["comm"].Allreduce(
-        [source.local, source.count, _dt_of(source.dtype)],
-        [target.local, target.count, _dt_of(target.dtype)], op=_op.SUM)
+    _reduce_impl(_need()["comm"], target, source, _op.SUM)
 
 
 def max_to_all(target: SymArray, source: SymArray) -> None:
-    ctx = _need()
-    ctx["comm"].Allreduce(
-        [source.local, source.count, _dt_of(source.dtype)],
-        [target.local, target.count, _dt_of(target.dtype)], op=_op.MAX)
+    _reduce_impl(_need()["comm"], target, source, _op.MAX)
 
 
 def collect(arr: SymArray) -> np.ndarray:
     """shmem_collect (fixed size): every PE's block, concatenated."""
-    ctx = _need()
-    n = ctx["comm"].Get_size()
-    out = np.zeros(arr.count * n, arr.dtype)
-    ctx["comm"].Allgather(
-        [arr.local, arr.count, _dt_of(arr.dtype)],
-        [out, arr.count * n, _dt_of(arr.dtype)])
-    return out
+    return _collect_impl(_need()["comm"], arr)
 
 
 def _dt_of(np_dtype):
     from ompi_tpu.core.datatype import from_numpy_dtype
 
     return from_numpy_dtype(np_dtype)
+
+
+# ----------------------------------------------------- teams (OpenSHMEM 1.5)
+# Reference: oshmem/shmem/c/shmem_team_*.c + the scoll team collectives.
+# A team is a PE subset with its own rank space; split_strided is
+# collective over the parent team, and team collectives delegate to a
+# sub-communicator of the world comm (the scoll/mpi pattern, same as the
+# module-level collectives).
+class Team:
+    """A PE team. ``pes`` lists world PEs in team-rank order; ``comm``
+    is the member-side sub-communicator (None on non-members)."""
+
+    def __init__(self, pes, comm):
+        self.pes = list(pes)
+        self._comm = comm
+
+    def my_pe(self) -> int:
+        me = my_pe()
+        return self.pes.index(me) if me in self.pes else -1
+
+    def n_pes(self) -> int:
+        return len(self.pes)
+
+    def translate_pe(self, pe: int, dest: "Team") -> int:
+        """shmem_team_translate_pe: my-team rank -> dest-team rank."""
+        world = self.pes[pe]
+        return dest.pes.index(world) if world in dest.pes else -1
+
+    def split_strided(self, start: int, stride: int,
+                      size: int) -> Optional["Team"]:
+        """Collective over THIS team; returns the new team (None =
+        SHMEM_TEAM_INVALID on non-members)."""
+        from ompi_tpu.core.group import Group
+        from ompi_tpu.runtime import spc
+
+        ctx = _need()
+        pes = [self.pes[start + i * stride] for i in range(size)]
+        parent = self._comm if self._comm is not None else ctx["comm"]
+        with spc.suppressed():
+            sub = parent.Create_group(Group(pes))
+        team = Team(pes, sub)
+        return team if sub is not None else None
+
+    # team-relative RMA: translate then delegate
+    def put(self, arr: SymArray, src, pe: int, offset: int = 0) -> None:
+        put(arr, src, self.pes[pe], offset)
+
+    def get(self, arr: SymArray, count: int, pe: int,
+            offset: int = 0) -> np.ndarray:
+        return get(arr, count, self.pes[pe], offset)
+
+    # --------------------------------------------- team collectives
+    def sync(self) -> None:
+        """shmem_team_sync: quiet + team barrier."""
+        from ompi_tpu.runtime import spc
+
+        quiet()
+        with spc.suppressed():
+            self._comm.Barrier()
+
+    # user collectives are NOT spc-suppressed (they are user activity,
+    # same as the module-level equivalents)
+    def broadcast(self, arr: SymArray, root: int = 0) -> None:
+        _bcast_impl(self._comm, arr, root)
+
+    def sum_to_all(self, target: SymArray, source: SymArray) -> None:
+        _reduce_impl(self._comm, target, source, _op.SUM)
+
+    def max_to_all(self, target: SymArray, source: SymArray) -> None:
+        _reduce_impl(self._comm, target, source, _op.MAX)
+
+    def collect(self, arr: SymArray) -> np.ndarray:
+        return _collect_impl(self._comm, arr)
+
+
+def team_world() -> Team:
+    """SHMEM_TEAM_WORLD."""
+    ctx = _need()
+    return Team(list(range(n_pes())), ctx["comm"])
+
+
+# ------------------------------------------------ contexts (OpenSHMEM 1.5)
+class Context:
+    """shmem_ctx: an independent ordering/completion domain — quiet on
+    one context completes ONLY that context's operations (reference:
+    oshmem ctx_create over spml contexts). EVERY operation issued on a
+    context — including plain put — goes through a tracked request, so
+    ctx.quiet() waits exactly this context's acks and nothing else (no
+    window-global flush; the isolation is real, not over-completion)."""
+
+    def __init__(self):
+        _need()
+        self._nbi = []
+
+    def put(self, arr: SymArray, src, pe: int, offset: int = 0) -> None:
+        """Local completion at return (the payload is copied at post);
+        remote completion at this context's quiet."""
+        _rput_nbi(self._nbi, arr, src, pe, offset)
+
+    def get(self, arr: SymArray, count: int, pe: int,
+            offset: int = 0) -> np.ndarray:
+        return get(arr, count, pe, offset)  # blocking: self-completing
+
+    def put_nbi(self, arr: SymArray, src, pe: int,
+                offset: int = 0) -> None:
+        _rput_nbi(self._nbi, arr, src, pe, offset)
+
+    def get_nbi(self, arr: SymArray, out: np.ndarray, pe: int,
+                offset: int = 0) -> None:
+        _rget_nbi(self._nbi, arr, out, pe, offset)
+
+    def quiet(self) -> None:
+        """Complete THIS context's operations only."""
+        reqs, self._nbi = self._nbi, []
+        _drain(reqs)
+
+    def fence(self) -> None:
+        self.quiet()
+
+    def destroy(self) -> None:
+        """shmem_ctx_destroy: implicit quiet."""
+        self.quiet()
+
+
+def ctx_create() -> Context:
+    return Context()

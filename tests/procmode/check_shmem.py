@@ -125,6 +125,44 @@ def main() -> int:
         shmem.clear_lock(lock)
     shmem.barrier_all()
 
+    # ---- teams (OpenSHMEM 1.5): split world into the even-PE team
+    world_team = shmem.team_world()
+    assert world_team.n_pes() == n and world_team.my_pe() == me
+    n_even = (n + 1) // 2
+    even = world_team.split_strided(0, 2, n_even)
+    if me % 2 == 0:
+        assert even is not None
+        assert even.my_pe() == me // 2 and even.n_pes() == n_even
+        assert even.translate_pe(even.my_pe(), world_team) == me
+        tsrc = shmem.zeros(2, np.float64)
+        tdst = shmem.zeros(2, np.float64)
+        tsrc.local[:] = me + 1
+        even.sync()
+        even.sum_to_all(tdst, tsrc)
+        assert tdst.local[0] == sum(i + 1 for i in range(0, n, 2))
+        coll_t = even.collect(tsrc)
+        assert coll_t.size == 2 * n_even
+        even.sync()
+    else:
+        assert even is None
+        shmem.zeros(2, np.float64)  # keep the symmetric alloc sequence
+        shmem.zeros(2, np.float64)
+    shmem.barrier_all()
+
+    # ---- contexts: independent completion domains
+    c1, c2 = shmem.ctx_create(), shmem.ctx_create()
+    ca = shmem.zeros(2, np.float64)
+    cb = shmem.zeros(2, np.float64)
+    shmem.barrier_all()
+    c1.put_nbi(ca, np.full(2, 50.0 + me), pe=nxt)
+    c2.put_nbi(cb, np.full(2, 70.0 + me), pe=nxt)
+    c2.quiet()   # completes ONLY c2's op...
+    c1.quiet()
+    shmem.barrier_all()
+    assert ca.local[0] == 50.0 + prv and cb.local[0] == 70.0 + prv
+    c1.destroy()
+    c2.destroy()
+
     # ---- allocator: free + coalesce + reuse (symmetric sequence)
     big1 = shmem.zeros(1000, np.float64)
     big2 = shmem.zeros(1000, np.float64)
