@@ -32,6 +32,7 @@ from collections import deque
 from typing import Callable, Dict, Optional, Tuple
 
 from ompi_tpu.btl.base import Btl, btl_framework
+from ompi_tpu.core.errors import MPIError, ERR_OTHER
 from ompi_tpu.mca.component import Component
 from ompi_tpu.mca.var import register_var, get_var
 from ompi_tpu.native.ring import SmRing, HDR_BYTES
@@ -42,6 +43,10 @@ register_var("btl_sm", "ring_bytes", 1 << 22,
              help="Per-sender ring size in the receiver's segment", level=4)
 register_var("btl_sm", "eager_limit", 1 << 16,
              help="SM eager/rendezvous threshold in bytes", level=4)
+register_var("btl_sm", "fail_after", -1,
+             help="Fault injection for the bml failover tests: sends "
+                  "start raising after N successful ones (-1 = off)",
+             level=9)
 register_var("btl_sm", "use_native", 1,
              help="Use the C++ ring data plane (0 = Python fallback)",
              level=7)
@@ -76,6 +81,8 @@ class SmBtl(Btl):
         self.eager_limit = get_var("btl_sm", "eager_limit")
         self.ring_bytes = int(get_var("btl_sm", "ring_bytes"))
         self.use_native = bool(get_var("btl_sm", "use_native"))
+        self.fail_after = int(get_var("btl_sm", "fail_after"))
+        self._sends_done = 0
         self.log = get_logger("btl.sm")
 
         # My segment: one inbound ring slot per potential sender, indexed
@@ -148,6 +155,11 @@ class SmBtl(Btl):
     _OVERFLOW = struct.pack("<Q", 1)
 
     def send(self, peer: int, header: bytes, payload) -> None:
+        if self.fail_after >= 0:  # fault injection (off = -1, no cost)
+            self._sends_done += 1
+            if self._sends_done > self.fail_after:
+                raise MPIError(ERR_OTHER,
+                               "btl/sm fault injection: channel down")
         ring = self._out_ring(peer)
         plen = (payload.nbytes if hasattr(payload, "nbytes")
                 else len(payload) if isinstance(payload, (bytes, bytearray))
@@ -172,6 +184,31 @@ class SmBtl(Btl):
                 payload = bytes(memoryview(payload).cast("B")) \
                     if not hasattr(payload, "tobytes") else payload.tobytes()
             pend.append((self._INLINE + header, payload))
+
+    def drain_pending(self, peer: int):
+        """Hand undelivered queued frames for ``peer`` to the bml
+        failover re-drive (pml._send_frame). Overflow markers are
+        reconstituted into real payloads — the replacement transport
+        knows nothing of the spill-file convention."""
+        with self._out_lock:
+            pend = self._pending.pop(peer, None)
+        out = []
+        if not pend:
+            return out
+        for flagged, payload in pend:
+            flag, hdr = flagged[:8], flagged[8:]
+            if flag == self._OVERFLOW:
+                path = bytes(payload).decode()
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                    os.unlink(path)
+                except OSError:
+                    continue
+                out.append((hdr, data))
+            else:
+                out.append((hdr, payload))
+        return out
 
     def _spill(self, payload) -> bytes:
         """Write payload to a side file; return the path (marker body)."""

@@ -61,6 +61,7 @@ class Ob1Pml:
         self._msgid = itertools.count(1)
         self._pending_sends: Dict[int, SendRequest] = {}  # msgid -> req
         self._active_recvs: Dict[int, RecvRequest] = {}  # msgid -> req
+        self.fallbacks: Dict[int, list] = {}  # rank -> ordered btl alts
         # system-message plane: tags <= SYSTEM_TAG_BASE bypass matching and
         # dispatch to registered handlers (ULFM revoke notices, heartbeats —
         # reference analog: the PMIx event plane + ob1's internal hdr types)
@@ -80,6 +81,43 @@ class Ob1Pml:
     def add_endpoint(self, rank: int, btl) -> None:
         """BML add_procs analog: bind the best transport for a peer."""
         self.endpoints[rank] = btl
+
+    def set_fallbacks(self, rank: int, btls) -> None:
+        """bml/r2 failover order: alternates to try when the bound
+        transport fails (reference: bml_r2's btl_send array — the next
+        eligible BTL takes over when one is ejected)."""
+        self.fallbacks[rank] = list(btls)
+
+    def _send_frame(self, dst: int, hdr: bytes, payload) -> None:
+        """Every outbound frame funnels here: on transport failure the
+        peer is rebound to the next fallback and the frame retried ONCE
+        (reference: mca_bml_r2_del_btl ejecting a failed module). The
+        matching engine is transport-agnostic, so a message stream may
+        switch transports mid-protocol."""
+        btl = self._btl_for(dst)
+        try:
+            btl.send(dst, hdr, payload)
+            return
+        except Exception as first:
+            alts = [b for b in self.fallbacks.get(dst, ())
+                    if b is not btl]
+            if not alts:
+                raise
+            self.log.warning(
+                "transport %s to rank %d failed (%s); failing over to %s",
+                type(btl).__name__, dst, first, type(alts[0]).__name__)
+            new = alts[0]
+            self.endpoints[dst] = new
+            self.fallbacks[dst] = alts
+            # re-drive frames the dead transport accepted but never
+            # delivered (its per-peer queue) BEFORE the current frame,
+            # or they are lost/reordered and the matching engine has no
+            # seq recovery
+            drain = getattr(btl, "drain_pending", None)
+            if drain is not None:
+                for qhdr, qpayload in drain(dst):
+                    new.send(dst, qhdr, qpayload)
+            new.send(dst, hdr, payload)
 
     # Lazy endpoint resolution for peers outside the initial add_procs
     # set (spawned jobs, connect/accept) — set by wireup (reference:
@@ -113,7 +151,7 @@ class Ob1Pml:
             hdr = pack_header(EAGER, self.my_rank, cid, tag, next(self._seq),
                               conv.packed_size, 0, 0)
             payload = conv.pack_frag(conv.packed_size)
-            btl.send(dst, hdr, payload)
+            self._send_frame(dst, hdr, payload)
             req.status._nbytes = conv.packed_size
             req._set_complete(0)
         else:
@@ -121,7 +159,7 @@ class Ob1Pml:
             self._pending_sends[req.msgid] = req
             hdr = pack_header(RNDV_RTS, self.my_rank, cid, tag,
                               next(self._seq), conv.packed_size, 0, req.msgid)
-            btl.send(dst, hdr, b"")
+            self._send_frame(dst, hdr, b"")
         return req
 
     def irecv(self, buf, count: int, datatype: Datatype, src: int,
@@ -242,7 +280,7 @@ class Ob1Pml:
             cts = pack_header(RNDV_CTS, self.my_rank, hdr.cid, hdr.tag, 0,
                               hdr.nbytes, hdr.msgid, recv_id)
             try:
-                self._btl_for(hdr.src).send(hdr.src, cts, b"")
+                self._send_frame(hdr.src, cts, b"")
             except MPIError as e:
                 # dead transport: fail the receive instead of leaving it
                 # matched-but-incomplete (Wait would spin forever)
@@ -265,7 +303,6 @@ class Ob1Pml:
             return
         conv = sreq.convertor
         frag_size = get_var("pml", "frag_size")
-        btl = self._btl_for(hdr.src)
         offset = 0
         try:
             while conv.remaining > 0:
@@ -273,7 +310,7 @@ class Ob1Pml:
                 dhdr = pack_header(RNDV_DATA, self.my_rank, sreq.cid,
                                    sreq.tag, 0, sreq.nbytes, offset,
                                    hdr.msgid)
-                btl.send(hdr.src, dhdr, frag)
+                self._send_frame(hdr.src, dhdr, frag)
                 offset += frag.nbytes
         except MPIError as e:
             # transport died mid-rendezvous: fail the send request so the

@@ -117,7 +117,9 @@ def save_ranked(comm, directory: str, step: int,
     attempt = np.zeros(1, np.int64)
     if rank == 0:
         prev = _read_manifest(d)
-        attempt[0] = (prev["attempt"] + 1) if prev else 0
+        # pre-attempt-format manifests count as attempt -1 (their rank
+        # files are unversioned; see restore's legacy fallback)
+        attempt[0] = (prev.get("attempt", -1) + 1) if prev else 0
     with spc.suppressed():
         comm.Bcast(attempt, root=0)
     a = int(attempt[0])
@@ -183,7 +185,12 @@ def restore_ranked(comm, directory: str,
             f"checkpoint was taken by {manifest['size']} ranks, "
             f"restoring with {comm.Get_size()} (repartitioning is the "
             "application's job)")
-    a = manifest.get("attempt", 0)
-    path = os.path.join(d, f"rank_{comm.Get_rank()}.a{a}.npz")
+    if "attempt" in manifest:
+        path = os.path.join(
+            d, f"rank_{comm.Get_rank()}.a{manifest['attempt']}.npz")
+    else:  # legacy pre-attempt format: unversioned rank files
+        path = os.path.join(d, f"rank_{comm.Get_rank()}.npz")
+    if not os.path.exists(path):
+        raise MPIError(ERR_FILE, f"missing rank file {path}")
     with np.load(path) as z:
         return {k: z[k].copy() for k in z.files}

@@ -116,6 +116,9 @@ def init_process_mode():
             continue
         if r in sm_peers:
             pml.add_endpoint(r, sm)
+            if tcp is not None:
+                # bml/r2 failover order: a dead sm channel rebinds to tcp
+                pml.set_fallbacks(r, [sm, tcp])
         elif tcp is not None:
             pml.add_endpoint(r, tcp)
 

@@ -1,0 +1,52 @@
+"""bml/r2 transport failover: the sm channel dies mid-job and traffic
+continues over tcp, transparently to the application.
+
+Reference: mca_bml_r2_del_btl — a failed BTL module is ejected and the
+next eligible one takes over. Fault injection: btl_sm_fail_after makes
+sm sends raise after N successes."""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+
+
+def main() -> int:
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+    nxt, prv = (r + 1) % n, (r - 1) % n
+    buf = np.zeros(4, np.int64)
+
+    # phase 1: rides sm (fail_after budget still unspent)
+    for i in range(3):
+        COMM_WORLD.Send(np.full(4, r * 100 + i, np.int64), dest=nxt,
+                        tag=i)
+        COMM_WORLD.Recv(buf, source=prv, tag=i)
+        assert buf[0] == prv * 100 + i, (i, buf)
+
+    # phase 2: the injection budget is exhausted mid-loop; the pml
+    # rebinds to tcp and the SAME traffic pattern keeps working —
+    # including a rendezvous-sized message after the switch
+    for i in range(10, 16):
+        COMM_WORLD.Send(np.full(4, r * 100 + i, np.int64), dest=nxt,
+                        tag=i)
+        COMM_WORLD.Recv(buf, source=prv, tag=i)
+        assert buf[0] == prv * 100 + i, (i, buf)
+    big = np.arange(200_000, dtype=np.float64) + r  # > eager limit
+    out = np.zeros_like(big)
+    rr = COMM_WORLD.Irecv(out, source=prv, tag=99)
+    COMM_WORLD.Send(big, dest=nxt, tag=99)
+    rr.Wait()
+    assert out[0] == prv and out[-1] == 199_999 + prv
+
+    COMM_WORLD.Barrier()
+    sys.stdout.write(f"rank {r}: FAILOVER-OK\n")
+    sys.stdout.flush()
+    ompi_tpu.Finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,3 +174,13 @@ def test_sm_selected_by_default_over_tcp():
     r = run_mpi(2, "tests/procmode/check_sm.py")
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("SM-OK") == 2
+
+
+def test_bml_failover_sm_to_tcp():
+    """The sm channel dies mid-job; the pml rebinds the peer to tcp and
+    eager + rendezvous traffic keeps flowing (reference:
+    mca_bml_r2_del_btl ejecting a failed module)."""
+    r = run_mpi(2, "tests/procmode/check_failover.py",
+                mca=(("btl_sm_fail_after", "8"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("FAILOVER-OK") == 2
