@@ -66,9 +66,13 @@ def _sds(shape, dtype, vma):
 
 
 def _pick_blocks(tq: int, tk: int) -> Tuple[int, int]:
-    """Largest power-of-two tiles <= (512, 1024) that divide the shards
-    (MXU-friendly: multiples of 128 when the sequence allows)."""
-    bq = 512
+    """Largest power-of-two tiles <= (1024, 1024) that divide the shards
+    (MXU-friendly: multiples of 128 when the sequence allows). Measured
+    on v5e at T=1024: the single 1024x1024 tile beats 512x1024 by ~15%
+    in-kernel (~+0.9 MFU points on the flagship step) — fewer grid
+    invocations amortize the VPU softmax epilogue; the f32 score tile
+    (4MB) still fits VMEM comfortably."""
+    bq = 1024
     while bq > 1 and tq % bq:
         bq //= 2
     bk = 1024
