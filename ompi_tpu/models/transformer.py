@@ -120,9 +120,12 @@ def _mm(a, w):
                       preferred_element_type=jnp.float32)
 
 
-def forward_local(params, tokens, cfg: Config, tp: int = 1, sp: int = 1,
-                  in_mesh: bool = False, causal_ring: bool = True):
-    """Forward on local shards. Inside shard_map (``in_mesh=True``): tokens
+
+
+def features_local(params, tokens, cfg: Config, tp: int = 1, sp: int = 1,
+                   in_mesh: bool = False, causal_ring: bool = True):
+    """Forward on local shards up to the final layernorm (pre-logits
+    features [B, T, D]). Inside shard_map (``in_mesh=True``): tokens
     [B/dp, S/sp]; tp-sharded weights arrive as local slices; activations
     psum over 'tp' after every row-parallel matmul (emitted even when
     tp == 1 — a size-1 psum is free and lets shard_map prove the loss is
@@ -131,6 +134,7 @@ def forward_local(params, tokens, cfg: Config, tp: int = 1, sp: int = 1,
     """
     import jax.numpy as jnp
 
+    from ompi_tpu.ops.mxu import einsum_bf16
     from ompi_tpu.ops.ring_attention import ring_attention
     from ompi_tpu.parallel import axes
 
@@ -154,12 +158,15 @@ def forward_local(params, tokens, cfg: Config, tp: int = 1, sp: int = 1,
         # layer on v5e); separate slices of the weight are free
         hb = h.astype(jnp.bfloat16)
         wb = w_qkv.astype(jnp.bfloat16)
-        q = jnp.einsum("btd,dhf->bhtf", hb, wb[..., :hd],
-                       preferred_element_type=jnp.float32)
-        k = jnp.einsum("btd,dhf->bhtf", hb, wb[..., hd:2 * hd],
-                       preferred_element_type=jnp.float32)
-        v = jnp.einsum("btd,dhf->bhtf", hb, wb[..., 2 * hd:],
-                       preferred_element_type=jnp.float32)
+        # bf16 q/k/v via einsum_bf16: the attention kernel consumes bf16
+        # tiles anyway, and keeping the projections (= the kernel's saved
+        # residuals) in bf16 halves their HBM footprint — at the flagship
+        # shape the f32 version sat on the 15.75GB ceiling and XLA
+        # spilled (r4 ablation: attention cost 178ms in-model vs 87ms
+        # isolated); the backward transpose dots still accumulate f32
+        q = einsum_bf16("btd,dhf->bhtf", hb, wb[..., :hd])
+        k = einsum_bf16("btd,dhf->bhtf", hb, wb[..., hd:2 * hd])
+        v = einsum_bf16("btd,dhf->bhtf", hb, wb[..., 2 * hd:])
         if in_mesh:
             # full-tile chunk: the flash/recompute backward keeps the
             # dense tile memory-safe; long-seq configs shrink the tile
@@ -183,7 +190,14 @@ def forward_local(params, tokens, cfg: Config, tp: int = 1, sp: int = 1,
         x = x + out
 
         h2 = _ln(x, blk["ln2"])
-        ff = _mm(jnp.maximum(_mm(h2, blk["w1"]), 0.0), blk["w2"])
+        # the saved relu residual ([B,T,d_ff], the layer's largest
+        # activation) is stored bf16 (half-size) with f32-accumulated
+        # backward via einsum_bf16
+        ff1 = jnp.maximum(einsum_bf16("btd,df->btf",
+                                      h2.astype(jnp.bfloat16),
+                                      blk["w1"].astype(jnp.bfloat16)),
+                          jnp.bfloat16(0))
+        ff = _mm(ff1, blk["w2"])
         if in_mesh:
             ff = axes.allreduce(ff, "tp")
         return x + ff
@@ -195,11 +209,19 @@ def forward_local(params, tokens, cfg: Config, tp: int = 1, sp: int = 1,
     for blk in params["blocks"]:
         x = block(x, blk)
 
-    x = _ln(x, params["ln_f"])
-    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.bfloat16),
-                        params["embed"].astype(jnp.bfloat16),
-                        preferred_element_type=jnp.float32)
-    return logits
+    return _ln(x, params["ln_f"])
+
+
+def forward_local(params, tokens, cfg: Config, tp: int = 1, sp: int = 1,
+                  in_mesh: bool = False, causal_ring: bool = True):
+    """Forward to logits [B, T, vocab] (dense — for inference/tests; the
+    training loss streams the vocab projection instead, see
+    ops/softmax_xent.py)."""
+    from ompi_tpu.ops.softmax_xent import logits_matmul
+
+    x = features_local(params, tokens, cfg, tp=tp, sp=sp, in_mesh=in_mesh,
+                       causal_ring=causal_ring)
+    return logits_matmul(x, params["embed"])
 
 
 def forward(params, tokens, cfg: Config):
@@ -209,14 +231,11 @@ def forward(params, tokens, cfg: Config):
 
 def _loss_local(params, tokens, targets, cfg: Config, tp: int, sp: int,
                 denom: float):
-    import jax.numpy as jnp
+    from ompi_tpu.ops.softmax_xent import softmax_xent_sum
 
-    logits = forward_local(params, tokens, cfg, tp=tp, sp=sp, in_mesh=True)
-    logz = jnp.log(jnp.sum(jnp.exp(
-        logits - jnp.max(logits, -1, keepdims=True)), -1)) + \
-        jnp.max(logits, -1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.sum(logz - gold) / denom
+    x = features_local(params, tokens, cfg, tp=tp, sp=sp, in_mesh=True)
+    return softmax_xent_sum(x, params["embed"], targets, 128,
+                            ("dp", "sp")) / denom
 
 
 def make_train_step(mesh, cfg: Config):

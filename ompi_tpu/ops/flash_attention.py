@@ -346,7 +346,10 @@ def _flash_fwd(q, k, v, kf, kt, sm_scale, interpret, layout):
     v3 = _to3(v, layout)
     o3, lse8 = _fwd_call(q3, k3, v3, kf, kt, sm_scale, bq, bk, interpret)
     out = (_from3(o3, B, H, layout), lse8[:, 0, :].reshape(B, H, Tq))
-    return out, (q3, k3, v3, kf, kt, o3, lse8, B, H)
+    # the saved output rides in bf16: delta = rowsum(dO·O) tolerates the
+    # rounding, and the f32 buffer would otherwise live across the whole
+    # backward (134MB/layer at the flagship shape)
+    return out, (q3, k3, v3, kf, kt, o3.astype(jnp.bfloat16), lse8, B, H)
 
 
 def _flash_bwd(sm_scale, interpret, layout, res, g):

@@ -1,0 +1,57 @@
+"""MXU matmul helpers: bf16-stored activations, f32-accumulated grads.
+
+``einsum_bf16`` emits a bf16 result (so the activation XLA saves for
+backward is half-size) while its backward re-derives the transpose dots
+from an f32-preferred einsum — accumulation over the (huge) token
+reduction stays f32 and only the final cotangent is bf16-rounded. A
+plain ``preferred_element_type=bfloat16`` einsum would round the
+backward accumulation too; a plain f32 einsum + astype makes XLA keep
+the f32 buffer alive as the saved residual (measured +2GB at the
+flagship shape, tools/profile_mfu.py r4).
+
+Replication bookkeeping under shard_map: the backward runs ``jax.vjp``
+of a plain einsum *inside* the shard_map trace, so the pvary/psum
+machinery applies to it exactly as it would to the original einsum —
+cotangents of mesh-invariant operands come back correctly psummed (a
+first cut psummed them again explicitly and double-counted; caught by
+the tp>1 loss-trajectory tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def einsum_bf16(pattern: str, a, b):
+    """jnp.einsum(pattern, a, b) with bf16 output, f32 MXU accumulation,
+    and f32-accumulated backward."""
+    out, _ = _mm_fwd(pattern, a, b)
+    return out
+
+
+def _mm_fwd(pattern, a, b):
+    out = jnp.einsum(pattern, a, b, preferred_element_type=jnp.float32
+                     ).astype(jnp.bfloat16)
+    return out, (a, b)
+
+
+def _mm_bwd(pattern, res, g):
+    a, b = res
+
+    def f(aa, bb):
+        return jnp.einsum(pattern, aa, bb,
+                          preferred_element_type=jnp.float32)
+
+    # jax.vjp re-traces the primal but its output is unused here, so XLA
+    # dead-code-eliminates the forward dot; only the two transpose dots
+    # (f32 accumulation) remain.
+    _, vjp = jax.vjp(f, a, b)
+    da, db = vjp(g.astype(jnp.float32))
+    return da, db
+
+
+einsum_bf16.defvjp(_mm_fwd, _mm_bwd)

@@ -163,6 +163,31 @@ def ring_attention(q, k, v, axis_name: str, sp_size: int,
         sm_scale = 1.0 / float(np.sqrt(D))
     if use_flash is None:
         use_flash = use_flash_default(q.shape, k.shape, layout)
+    def one_block(k_blk, v_blk, keep_full, keep_tri):
+        """One Q-shard x KV-shard block pair -> (out, lse), via the
+        Pallas kernel or the chunked lax fallback."""
+        if use_flash:
+            from ompi_tpu.ops.flash_attention import flash_block
+
+            return flash_block(q, k_blk, v_blk, keep_full, keep_tri,
+                               sm_scale, layout=layout)
+        if layout == "bhtd":
+            # lax fallback is bthd-native; transpose at the boundary
+            tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+            o_p, lse_p = _lax_block(tr(q), tr(k_blk), tr(v_blk),
+                                    keep_full, keep_tri, sm_scale,
+                                    mxu_dtype, chunk)
+            return tr(o_p), lse_p
+        return _lax_block(q, k_blk, v_blk, keep_full, keep_tri, sm_scale,
+                          mxu_dtype, chunk)
+
+    if sp_size == 1:
+        # degenerate ring: one block pair, already normalized — skip the
+        # (out, lse) merge entirely (its exp/logaddexp chain costs real
+        # HBM traffic and makes g_lse live in backward for nothing)
+        o, _ = one_block(k, v, jnp.bool_(not causal), jnp.bool_(causal))
+        return o.astype(q.dtype)
+
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
 
@@ -190,21 +215,7 @@ def ring_attention(q, k, v, axis_name: str, sp_size: int,
         else:
             keep_full = jnp.bool_(True)
             keep_tri = jnp.bool_(False)
-        if use_flash:
-            from ompi_tpu.ops.flash_attention import flash_block
-
-            o_p, lse_p = flash_block(q, k_blk, v_blk, keep_full, keep_tri,
-                                     sm_scale, layout=layout)
-        elif layout == "bhtd":
-            # lax fallback is bthd-native; transpose at the boundary
-            tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
-            o_p, lse_p = _lax_block(tr(q), tr(k_blk), tr(v_blk),
-                                    keep_full, keep_tri, sm_scale,
-                                    mxu_dtype, chunk)
-            o_p = tr(o_p)
-        else:
-            o_p, lse_p = _lax_block(q, k_blk, v_blk, keep_full, keep_tri,
-                                    sm_scale, mxu_dtype, chunk)
+        o_p, lse_p = one_block(k_blk, v_blk, keep_full, keep_tri)
         # log-sum-exp merge of normalized partials (all finite: -1e30
         # sentinel keeps the exps and their gradients NaN-free)
         lse_new = jnp.logaddexp(lse, lse_p)
