@@ -78,7 +78,13 @@ class XlaComm(Intracomm):
         super().__init__(Group(range(self.world_size)), cid,
                          name or f"mesh-comm-{cid}")
         self._jit_cache = {}
-        self._fast_allreduce = {}  # op.uid -> compiled fn (hot path)
+        # (verb, args...) -> compiled-executable thunk: the per-comm
+        # resolved fn table (reference: the comm->c_coll pointer chase of
+        # ompi/mpi/c/allreduce.c.in:115, resolved once per verb+args).
+        # Populated by each verb's first (slow) call; a hot call is ONE
+        # dict hit + the dispatch. Fast paths skip argument validation —
+        # the first call through the slow path did it.
+        self._fast = {}
         from ompi_tpu.coll.base import select_coll
 
         self.coll = select_coll(self)
@@ -142,13 +148,20 @@ class XlaComm(Intracomm):
         spc.record(name)  # allreduce records in its own fast path instead
         return self.coll.get(name)
 
+    def _promote(self, fast_key, exec_key, wrap=None):
+        """After a slow call, resolve the compiled executable into the
+        fast table (no-op when a non-xla coll module owns the verb and
+        didn't populate the shared _jit_cache layout)."""
+        fn = self._jit_cache.get(exec_key)
+        if fn is not None:
+            self._fast[fast_key] = wrap(fn) if wrap is not None else fn
+
     def allreduce(self, x, op: _op.Op = _op.SUM):
-        # hot path: ONE plain-int dict hit to the compiled executable (the
-        # per-comm fn-table pointer chase of the reference, minus
-        # everything else) — the r2 bench showed the 32KB point paying
-        # ~9us of Python prologue per call, so everything else (usability
-        # check, tuple key build, module imports) lives on the miss path
-        fn = self._fast_allreduce.get(op.uid)
+        # hot path: ONE dict hit to the compiled executable — the r2
+        # bench showed the 32KB point paying ~9us of Python prologue per
+        # call, so everything else (usability check, tuple key build,
+        # module imports) lives on the miss path
+        fn = self._fast.get(("allreduce", op.uid))
         if fn is not None and not self.revoked:
             spc.record("allreduce")
             if op.name in _op.PAIR_OPS:
@@ -168,27 +181,89 @@ class XlaComm(Intracomm):
             # contract must hold on every call, not just the first
             _check_device_op(op, x)
         out = self.coll.get("allreduce")(self, x, op)
-        fn = self._jit_cache.get(cache_key("allreduce", op))
-        if fn is not None:
-            self._fast_allreduce[op.uid] = fn
+        self._promote(("allreduce", op.uid), cache_key("allreduce", op))
         return out
 
     def reduce(self, x, op: _op.Op = _op.SUM, root: int = 0):
+        # the mesh schedule computes the reduction on every group row, so
+        # XlaColl.reduce shares allreduce's executable — but the fast key
+        # is reduce's own, populated only by reduce's slow path (another
+        # coll module may implement reduce differently)
+        fn = self._fast.get(("reduce", op.uid, root))
+        if fn is not None and not self.revoked:
+            spc.record("reduce")
+            if op.name in _op.PAIR_OPS:
+                from ompi_tpu.coll.xla import _check_device_op
+
+                _check_device_op(op, x)
+            return fn(x)
+        self._check_usable()
         self._check_root(root)
-        return self._slot("reduce")(self, x, op, root)
+        from ompi_tpu.coll.xla import cache_key
+
+        spc.record("reduce")
+        out = self.coll.get("reduce")(self, x, op, root)
+        self._promote(("reduce", op.uid, root),
+                      cache_key("allreduce", op))
+        return out
 
     def bcast(self, x, root: int = 0):
+        fn = self._fast.get(("bcast", root))
+        if fn is not None and not self.revoked:
+            spc.record("bcast")
+            return fn(x)
+        self._check_usable()
         self._check_root(root)
-        return self._slot("bcast")(self, x, root)
+        from ompi_tpu.coll.xla import cache_key
+
+        spc.record("bcast")
+        out = self.coll.get("bcast")(self, x, root)
+        import jax.numpy as jnp
+
+        r = jnp.int32(root)
+        self._promote(("bcast", root), cache_key("bcast"),
+                      wrap=lambda f: (lambda a, _f=f, _r=r: _f(a, _r)))
+        return out
 
     def allgather(self, x):
-        return self._slot("allgather")(self, x)
+        fn = self._fast.get(("allgather",))
+        if fn is not None and not self.revoked:
+            spc.record("allgather")
+            return fn(x)
+        self._check_usable()
+        from ompi_tpu.coll.xla import cache_key
+
+        spc.record("allgather")
+        out = self.coll.get("allgather")(self, x)
+        self._promote(("allgather",), cache_key("allgather"))
+        return out
 
     def alltoall(self, x):
-        return self._slot("alltoall")(self, x)
+        fn = self._fast.get(("alltoall",))
+        if fn is not None and not self.revoked:
+            spc.record("alltoall")
+            return fn(x)
+        self._check_usable()
+        from ompi_tpu.coll.xla import cache_key
+
+        spc.record("alltoall")
+        out = self.coll.get("alltoall")(self, x)
+        self._promote(("alltoall",), cache_key("alltoall"))
+        return out
 
     def reduce_scatter(self, x, op: _op.Op = _op.SUM):
-        return self._slot("reduce_scatter_block")(self, x, op)
+        fn = self._fast.get(("reduce_scatter", op.uid))
+        if fn is not None and not self.revoked:
+            spc.record("reduce_scatter_block")
+            return fn(x)
+        self._check_usable()
+        from ompi_tpu.coll.xla import cache_key
+
+        spc.record("reduce_scatter_block")
+        out = self.coll.get("reduce_scatter_block")(self, x, op)
+        self._promote(("reduce_scatter", op.uid),
+                      cache_key("reduce_scatter_block", op))
+        return out
 
     def scan(self, x, op: _op.Op = _op.SUM):
         return self._slot("scan")(self, x, op)
@@ -447,7 +522,7 @@ class XlaComm(Intracomm):
         self._delete_all_attrs()
         self._freed = True
         self._jit_cache.clear()
-        self._fast_allreduce.clear()
+        self._fast.clear()
         self.coll = None
 
 

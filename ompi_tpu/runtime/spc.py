@@ -55,11 +55,15 @@ def _enabled() -> bool:
 
 
 def record(name: str, value: int = 1) -> None:
-    """SPC_RECORD analog (reference: the inline macro in every binding)."""
-    if not _enabled():
-        return
-    with _lock:
-        _counters[name] += value
+    """SPC_RECORD analog (reference: the inline macro in every binding).
+
+    Rides every collective's fast path, so the gate is inlined: one
+    attribute load off the live Var (no property or extra frame) + the
+    suppress-depth check. set_var('spc', 'enable', ...) stays live
+    because _value is the same slot the property reads."""
+    if _enable_var._value and not getattr(_suppress, "depth", 0):
+        with _lock:
+            _counters[name] += value
 
 
 def record_bytes(name: str, nbytes: int) -> None:

@@ -13,15 +13,12 @@ import time
 import numpy as np
 
 
-def _scalar_time(fn, *args, iters=3):
-    float(fn(*args))
-    ts = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        float(fn(*args))
-        ts.append(time.perf_counter() - t0)
-    ts.sort()
-    return ts[len(ts) // 2]
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+from bench import _scalar_time  # one shared timing primitive
 
 
 def main() -> int:
