@@ -224,6 +224,7 @@ class Intercomm(Communicator):
     def Bcast(self, buf, root) -> None:
         """root group: the root passes ROOT, others PROC_NULL; receiving
         group passes the root's rank WITHIN THE REMOTE GROUP."""
+        _check_inter_root(self, root)
         if root == PROC_NULL:
             return
         obj, count, dt = parse_buffer(buf)
@@ -287,7 +288,7 @@ class Intercomm(Communicator):
         contributions are reduced and land at the root-group rank that
         passed ROOT; source members pass the root's REMOTE rank, root-
         group non-roots pass PROC_NULL."""
-        _check_inter_root(root)
+        _check_inter_root(self, root)
         if root == PROC_NULL:
             return
         if root == ROOT:
@@ -314,7 +315,7 @@ class Intercomm(Communicator):
 
     def Gatherv(self, sendbuf, recvbuf, counts=None, displs=None,
                 root=None) -> None:
-        _check_inter_root(root)
+        _check_inter_root(self, root)
         if root == PROC_NULL:
             return
         if root == ROOT:
@@ -360,7 +361,7 @@ class Intercomm(Communicator):
     def Scatterv(self, sendbuf, recvbuf, counts=None, displs=None,
                  root=None) -> None:
         """The ROOT's blocks scatter over the REMOTE group."""
-        _check_inter_root(root)
+        _check_inter_root(self, root)
         if root == PROC_NULL:
             return
         if root == ROOT:
@@ -540,16 +541,24 @@ class Intercomm(Communicator):
         self._freed = True
 
 
-def _check_inter_root(root) -> None:
+def _check_inter_root(comm, root) -> None:
     """Inter rooted ops have NO default root: every rank must pass
     ROOT, PROC_NULL, or the root's remote rank (MPI-3 §5; a forgotten
     root would otherwise route a root-group rank into the source branch
-    and strand the remote side)."""
+    and strand the remote side). Plain ints are range-checked against
+    the remote group HERE, at argument-validation time (r3 advisor):
+    an out-of-range root must fail uniformly on every rank, not only on
+    the leader that eventually indexes remote_ranks."""
     if root is None or (root not in (ROOT, PROC_NULL)
                         and not isinstance(root, int)):
         raise MPIError(ERR_ARG,
                        "inter collective needs root=ROOT, PROC_NULL, "
                        "or a remote-group rank")
+    if root not in (ROOT, PROC_NULL) and \
+            not 0 <= root < len(comm.remote_ranks):
+        raise MPIError(ERR_ARG,
+                       f"inter root {root} out of range for remote group "
+                       f"of size {len(comm.remote_ranks)}")
 
 
 def _dt_np(np_dtype):

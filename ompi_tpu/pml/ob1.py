@@ -62,6 +62,9 @@ class Ob1Pml:
         self._pending_sends: Dict[int, SendRequest] = {}  # msgid -> req
         self._active_recvs: Dict[int, RecvRequest] = {}  # msgid -> req
         self.fallbacks: Dict[int, list] = {}  # rank -> ordered btl alts
+        # rank -> frames ACKED by a now-dead transport, preserved across
+        # a total-transport-failure episode for the next send attempt
+        self.dead_letter: Dict[int, list] = {}
         # system-message plane: tags <= SYSTEM_TAG_BASE bypass matching and
         # dispatch to registered handlers (ULFM revoke notices, heartbeats —
         # reference analog: the PMIx event plane + ob1's internal hdr types)
@@ -95,29 +98,68 @@ class Ob1Pml:
         matching engine is transport-agnostic, so a message stream may
         switch transports mid-protocol."""
         btl = self._btl_for(dst)
-        try:
-            btl.send(dst, hdr, payload)
-            return
-        except Exception as first:
-            alts = [b for b in self.fallbacks.get(dst, ())
-                    if b is not btl]
-            if not alts:
-                raise
-            self.log.warning(
-                "transport %s to rank %d failed (%s); failing over to %s",
-                type(btl).__name__, dst, first, type(alts[0]).__name__)
-            new = alts[0]
-            self.endpoints[dst] = new
-            self.fallbacks[dst] = alts
-            # re-drive frames the dead transport accepted but never
-            # delivered (its per-peer queue) BEFORE the current frame,
-            # or they are lost/reordered and the matching engine has no
-            # seq recovery
-            drain = getattr(btl, "drain_pending", None)
-            if drain is not None:
-                for qhdr, qpayload in drain(dst):
-                    new.send(dst, qhdr, qpayload)
-            new.send(dst, hdr, payload)
+        stashed = self.dead_letter.pop(dst, None)
+        last = None
+        if stashed is None:
+            # fast path: no backlog for this peer
+            try:
+                btl.send(dst, hdr, payload)
+                return
+            except Exception as e:
+                stashed = []
+                last = e  # btl just failed: don't retry it below
+        # Failover (or backlog) path. The frames list keeps every
+        # undelivered frame — frames a previous all-transports-down
+        # episode stashed, frames the dead transport accepted but never
+        # delivered (its per-peer queue), then the current frame — and a
+        # frame is popped only AFTER a transport actually accepts it, so
+        # a fallback that dies mid-drain leaves the remainder for the
+        # next fallback, and total failure stashes them for the next
+        # attempt instead of dropping already-acked frames (r3 advisor).
+        frames = stashed
+        drain = getattr(btl, "drain_pending", None)
+        if drain is not None:
+            frames.extend(drain(dst))
+        cur = (hdr, payload)
+        frames.append(cur)
+        head = [] if last is not None else [btl]
+        candidates = head + [b for b in self.fallbacks.get(dst, ())
+                             if b is not btl]
+        if not candidates:
+            return self._stash_and_raise(dst, frames, cur, last)
+        for i, t in enumerate(candidates):
+            if t is not btl:
+                self.log.warning(
+                    "transport %s to rank %d failed (%s); failing over "
+                    "to %s", type(btl).__name__, dst, last,
+                    type(t).__name__)
+                self.endpoints[dst] = t
+                self.fallbacks[dst] = candidates[i:]
+            try:
+                while frames:
+                    qhdr, qpayload = frames[0]
+                    t.send(dst, qhdr, qpayload)
+                    frames.pop(0)
+                return
+            except Exception as e:
+                last = e
+                # frames the failed transport itself accepted but
+                # queued come FIRST in the stream order
+                nd = getattr(t, "drain_pending", None)
+                if nd is not None:
+                    frames[:0] = list(nd(dst))
+        return self._stash_and_raise(dst, frames, cur, last)
+
+    def _stash_and_raise(self, dst, frames, cur, exc):
+        """Every transport is down: keep the previously-ACKED backlog
+        for the next send attempt to this peer, but NOT the current
+        frame — its failure is reported to the caller (stashing it too
+        would duplicate it if the caller retries)."""
+        if frames and frames[-1] is cur:
+            frames.pop()
+        if frames:
+            self.dead_letter[dst] = frames
+        raise exc
 
     # Lazy endpoint resolution for peers outside the initial add_procs
     # set (spawned jobs, connect/accept) — set by wireup (reference:

@@ -11,8 +11,10 @@ through the exact same receive sequence from its peers' payload logs.
 Redesign as an interposition PML (the pml/monitoring.py pattern):
 
 - live mode: ``isend`` appends (dst, tag, cid, payload) to this rank's
-  sender-based log; ``irecv`` completion appends (src, tag, cid, nbytes)
-  to the event log, flushed per record (the pessimist property).
+  sender-based log; ``irecv`` completion appends (seq, src, tag, cid,
+  nbytes) to the event log, flushed per record (the pessimist property).
+  ``seq`` is the receive's POSTING order — completion order differs with
+  concurrent outstanding irecvs, and replay consumes in posting order.
 - replay mode (``--mca pml_v_replay 1`` after a restart): receives are
   served from the peers' sender logs in the order dictated by this
   rank's own event log — per-source FIFO cursors resolve the payload,
@@ -40,6 +42,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ompi_tpu.core.request import Request as _BaseRequest
 from ompi_tpu.mca.var import register_var, get_var, register_pvar
 
 register_var("pml_v", "enable", False,
@@ -57,6 +60,12 @@ register_var("pml_v", "replay_rank", -1,
                   "world is rebuilt from the logged metadata)", level=6)
 
 _HDR = struct.Struct("<qqqq")  # four int64 words
+# event records carry a 5th word: the receive's POSTING-sequence index.
+# Events are appended at completion time, which can differ from posting
+# order with concurrent outstanding irecvs — replay consumes in posting
+# order, so pairing by seq (not log position) keeps them matched
+# (r3 advisor finding).
+_EVHDR = struct.Struct("<qqqqq")
 
 
 def _append(f, a: int, b: int, c: int, d: int, payload: bytes = b"") -> None:
@@ -65,6 +74,47 @@ def _append(f, a: int, b: int, c: int, d: int, payload: bytes = b"") -> None:
         f.write(payload)
     f.flush()
     os.fsync(f.fileno())  # pessimist: stable BEFORE delivery/completion
+
+
+# magic first record identifying the 5-word event format: a log written
+# by a different build must fail LOUDLY at open, not misparse record
+# boundaries into wrong-source events
+_EV_MAGIC = (-0x564C4F47, 2, 0, 0, 0)  # 'VLOG', version 2
+
+
+def _append_event(f, seq: int, src: int, tag: int, cid: int,
+                  nbytes: int) -> None:
+    if f.tell() == 0:
+        f.write(_EVHDR.pack(*_EV_MAGIC))
+    f.write(_EVHDR.pack(seq, src, tag, cid, nbytes))
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _read_events(path: str) -> Dict[int, Tuple[int, int, int, int]]:
+    """seq -> (src, tag, cid, nbytes); torn tail records dropped."""
+    from ompi_tpu.core.errors import MPIError, ERR_INTERN
+
+    out: Dict[int, Tuple[int, int, int, int]] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, "rb") as f:
+        first = f.read(_EVHDR.size)
+        if not first:
+            return out
+        if len(first) < _EVHDR.size or \
+                _EVHDR.unpack(first) != _EV_MAGIC:
+            raise MPIError(
+                ERR_INTERN,
+                f"pml_v: {path} is not a version-2 event log (written "
+                "by an older build?) — replay would misparse it")
+        while True:
+            hdr = f.read(_EVHDR.size)
+            if len(hdr) < _EVHDR.size:
+                break
+            seq, src, tag, cid, nbytes = _EVHDR.unpack(hdr)
+            out[seq] = (src, tag, cid, nbytes)
+    return out
 
 
 def _read_records(path: str, with_payload: bool):
@@ -90,8 +140,9 @@ class VprotocolPml:
     """Pessimist-logging interposition wrapper around the selected pml."""
 
     _OWN = ("_inner", "_lock", "_sb", "_ev", "_replay", "_events",
-            "_ev_pos", "_peer_logs", "_send_log", "_send_pos",
-            "logged_send_bytes", "logged_events")
+            "_ev_pos", "_max_seq", "_peer_logs", "_send_log",
+            "_send_pos", "_post_seq", "logged_send_bytes",
+            "logged_events")
 
     def __init__(self, inner, logdir: str, replay: bool):
         self._inner = inner
@@ -100,6 +151,7 @@ class VprotocolPml:
         # isend still holds the lock for its append+send critical section
         self._lock = threading.RLock()
         self._replay = replay
+        self._post_seq = 0  # posting-sequence of logged receives
         self.logged_send_bytes = 0
         self.logged_events = 0
         os.makedirs(logdir, exist_ok=True)
@@ -108,9 +160,10 @@ class VprotocolPml:
             self._sb = self._ev = None
             # my event log dictates the receive sequence; peers' sender
             # logs hold the payloads, filtered to records addressed to me
-            self._events = _read_records(
-                os.path.join(logdir, f"events_{me}.log"), False)
-            self._ev_pos = 0
+            self._events = _read_events(
+                os.path.join(logdir, f"events_{me}.log"))
+            self._ev_pos = 0  # posting-sequence counter during replay
+            self._max_seq = max(self._events, default=-1)
             self._peer_logs: Dict[int, list] = {}
             for fn in os.listdir(logdir):
                 if fn.startswith("sender_") and fn.endswith(".log"):
@@ -167,14 +220,20 @@ class VprotocolPml:
             return self._inner.irecv(buf, count, datatype, src, tag, cid)
         if self._replay:
             return self._replay_recv(buf, count, datatype, src, tag, cid)
+        # the posting-sequence index is assigned NOW (deterministic in a
+        # deterministic app); the event is written at completion, which
+        # may be out of posting order with concurrent outstanding irecvs
+        with self._lock:
+            seq = self._post_seq
+            self._post_seq += 1
         req = self._inner.irecv(buf, count, datatype, src, tag, cid)
 
         def done(r):
             if r.status.cancelled or r.status.source < 0:
                 return
             with self._lock:
-                _append(self._ev, r.status.source, r.status.tag, cid,
-                        r.status._nbytes)
+                _append_event(self._ev, seq, r.status.source,
+                              r.status.tag, cid, r.status._nbytes)
                 self.logged_events += 1
 
         req.add_completion_callback(done)
@@ -213,12 +272,23 @@ class VprotocolPml:
         # atomic or concurrent replayed receives pair events with the
         # wrong sender-log records
         with self._lock:
-            if self._ev_pos >= len(self._events):
+            seq = self._ev_pos
+            ev = self._events.get(seq)
+            if ev is None:
+                if seq <= self._max_seq:
+                    # seq GAP below logged events: this receive never
+                    # completed in the original execution (cancelled, or
+                    # still outstanding at the crash) while later posts
+                    # did — hand back a never-completing (cancellable)
+                    # request so the later logged events stay replayable
+                    self._ev_pos += 1
+                    return _NeverDeliveredRequest()
+                # truly past the log's end: the crash point is reached
                 raise MPIError(
                     ERR_INTERN,
                     "pml_v replay: receive past the end of the event log "
                     "(restart reached the crash point)")
-            esrc, etag, ecid, enbytes, _ = self._events[self._ev_pos]
+            esrc, etag, ecid, enbytes = ev
             if src not in (_ANY, esrc):
                 raise MPIError(
                     ERR_INTERN,
@@ -301,3 +371,15 @@ def maybe_wrap(pml):
 
     register_hook("finalize_bottom", wrapped.close_logs)
     return wrapped
+
+
+class _NeverDeliveredRequest(_BaseRequest):
+    """Replay stand-in for a receive with no logged event below later
+    logged seqs: the original execution never delivered it (cancelled or
+    outstanding at the crash), so it must not complete here either —
+    but it stays cancellable, matching an app that cancels and moves
+    on."""
+
+    def Cancel(self) -> None:
+        self.status.cancelled = True
+        self._set_complete()

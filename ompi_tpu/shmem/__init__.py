@@ -85,6 +85,7 @@ def init() -> None:
             # allocator analog (reference: oshmem/mca/memheap ptmalloc/
             # buddy); symmetric because every PE runs the same sequence
             "free": [(0, heap.nbytes)],
+            "live": {},  # off -> nbytes of live allocations
             "nbi": [],  # outstanding nonblocking put/get requests
         }
 
@@ -138,6 +139,7 @@ def zeros(count: int, dtype=np.float64) -> SymArray:
         if tail:
             repl.append((off + nbytes, tail))
         ctx["free"][i: i + 1] = repl
+        ctx["live"][off] = nbytes
         local = ctx["heap"][off: off + nbytes].view(dt)
         local[:] = 0
         return SymArray(off, count, dt, local)
@@ -154,6 +156,16 @@ def free(arr: SymArray) -> None:
     nbytes = arr.count * arr.dtype.itemsize
     if nbytes == 0:
         return
+    # a free must name an exact live span: a double-free or a stale /
+    # foreign SymArray would insert an overlapping span and, after
+    # coalescing, the allocator would hand the same heap bytes to two
+    # live allocations on every PE — corrupting symmetric data silently
+    if ctx["live"].get(arr.off) != nbytes:
+        raise MPIError(
+            ERR_OTHER,
+            f"shmem_free: [{arr.off}, {arr.off + nbytes}) is not a live "
+            "allocation (double free, or a stale/foreign SymArray)")
+    del ctx["live"][arr.off]
     spans = ctx["free"]
     spans.append((arr.off, nbytes))
     spans.sort()

@@ -99,3 +99,115 @@ def test_pml_v_self_send_no_deadlock(tmp_path):
                        env=env)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "SELF-OK" in r.stdout
+
+
+def test_event_pairing_out_of_posting_order(tmp_path):
+    """Completion order != posting order must not break replay: events
+    carry the posting-sequence index (r3 advisor finding). Two
+    outstanding irecvs complete B-then-A; replay posts A-then-B."""
+    import numpy as np
+
+    from ompi_tpu.mca.var import set_var
+    from ompi_tpu.pml import vprotocol as vp
+
+    logdir = str(tmp_path / "vlogs")
+
+    class _Status:
+        def __init__(self, source, tag, nbytes):
+            self.cancelled = False
+            self.source = source
+            self.tag = tag
+            self._nbytes = nbytes
+
+    class _Req:
+        def __init__(self):
+            self._cbs = []
+
+        def add_completion_callback(self, cb):
+            self._cbs.append(cb)
+
+        def complete(self, source, tag, nbytes):
+            self.status = _Status(source, tag, nbytes)
+            for cb in self._cbs:
+                cb(self)
+
+    class _Inner:
+        my_rank = 0
+
+        def irecv(self, buf, count, datatype, src, tag, cid):
+            return _Req()
+
+    from ompi_tpu.core.datatype import UINT8
+
+    live = vp.VprotocolPml(_Inner(), logdir, replay=False)
+    buf_a = np.zeros(4, np.uint8)
+    buf_b = np.zeros(4, np.uint8)
+    ra = live.irecv(buf_a, 4, UINT8, 1, 7, 0)   # posted first
+    rb = live.irecv(buf_b, 4, UINT8, 2, 7, 0)   # posted second
+    rb.complete(2, 7, 4)                        # completes FIRST
+    ra.complete(1, 7, 4)
+    live.close_logs()
+
+    # peers' sender logs: the payloads addressed to rank 0
+    for src, payload in ((1, b"\x01\x01\x01\x01"),
+                         (2, b"\x02\x02\x02\x02")):
+        with open(os.path.join(logdir, f"sender_{src}.log"), "ab") as f:
+            vp._append(f, 0, 7, 0, 4, payload)
+
+    replay = vp.VprotocolPml(_Inner(), logdir, replay=True)
+    out_a = np.zeros(4, np.uint8)
+    out_b = np.zeros(4, np.uint8)
+    replay.irecv(out_a, 4, UINT8, 1, 7, 0)      # same posting order
+    replay.irecv(out_b, 4, UINT8, 2, 7, 0)
+    assert bytes(out_a) == b"\x01\x01\x01\x01"
+    assert bytes(out_b) == b"\x02\x02\x02\x02"
+
+
+def test_seq_gap_keeps_later_events_replayable(tmp_path):
+    """A receive with no logged event (cancelled/outstanding at crash)
+    below later logged seqs must not strand the rest of the log."""
+    import numpy as np
+
+    from ompi_tpu.pml import vprotocol as vp
+    from ompi_tpu.core.datatype import UINT8
+
+    logdir = str(tmp_path / "vlogs")
+    os.makedirs(logdir)
+    # hand-written event log: seq 0 missing, seq 1 present
+    with open(os.path.join(logdir, "events_0.log"), "ab") as f:
+        vp._append_event(f, 1, 2, 7, 0, 4)
+    with open(os.path.join(logdir, "sender_2.log"), "ab") as f:
+        vp._append(f, 0, 7, 0, 4, b"\x09\x09\x09\x09")
+
+    class _Inner:
+        my_rank = 0
+
+    replay = vp.VprotocolPml(_Inner(), logdir, replay=True)
+    hole = np.zeros(4, np.uint8)
+    r0 = replay.irecv(hole, 4, UINT8, 1, 7, 0)   # the gap: never completes
+    assert not r0.is_complete
+    r0.Cancel()
+    assert r0.is_complete and r0.status.cancelled
+    out = np.zeros(4, np.uint8)
+    replay.irecv(out, 4, UINT8, 2, 7, 0)         # seq 1 still replayable
+    assert bytes(out) == b"\x09\x09\x09\x09"
+
+
+def test_old_format_event_log_fails_loudly(tmp_path):
+    """A 4-word (pre-seq) event log must raise a clear error, not
+    misparse record boundaries."""
+    import pytest
+
+    from ompi_tpu.pml import vprotocol as vp
+    from ompi_tpu.core.errors import MPIError
+
+    logdir = str(tmp_path / "vlogs")
+    os.makedirs(logdir)
+    with open(os.path.join(logdir, "events_0.log"), "ab") as f:
+        vp._append(f, 1, 7, 0, 4)  # old 4-word framing, no magic
+
+    class _Inner:
+        my_rank = 0
+
+    with pytest.raises(MPIError):
+        vp.VprotocolPml(_Inner(), logdir, replay=True)

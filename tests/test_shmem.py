@@ -44,3 +44,29 @@ def test_shmem_procmode_3_pes():
     r = run_mpi(3, "tests/procmode/check_shmem.py")
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("SHMEM-OK") == 3
+
+
+def test_free_rejects_double_free_and_foreign_spans():
+    """r3 advisor: free() must validate the span is live — a double
+    free (or stale handle) would coalesce into overlap and the heap
+    would hand the same bytes out twice."""
+    import numpy as np
+    import pytest
+
+    import ompi_tpu.shmem as shmem
+    from ompi_tpu.core.errors import MPIError
+
+    shmem.init()
+    try:
+        a = shmem.zeros(8, np.int32)
+        shmem.free(a)
+        with pytest.raises(MPIError):
+            shmem.free(a)  # double free
+        b = shmem.zeros(4, np.int32)
+        fake = shmem.SymArray(b.off + 4, 4, np.dtype(np.int32),
+                              np.zeros(4, np.int32))
+        with pytest.raises(MPIError):
+            shmem.free(fake)  # foreign span inside a live block
+        shmem.free(b)
+    finally:
+        shmem.finalize()
