@@ -1,0 +1,307 @@
+"""coll/sm — single-segment shared-memory collectives (coll/xhc analog).
+
+Reference: ompi/mca/coll/xhc (coll_xhc_allreduce.c, 5,841 LoC) — when
+every member of a communicator lives on one node, collectives should be
+segment-resident memcpys plus flag rotation, not per-message pml frames
+through the transport stack. This component claims barrier / bcast /
+allreduce for all-local ProcComms at a priority above tuned/han; every
+other slot falls through the per-slot table as usual.
+
+Design (flat xhc, sized for the <=16-rank single-host shape):
+
+- ONE mmap segment per communicator, created lazily inside the first
+  collective by rank 0 and announced over the pml (the same
+  first-collective-is-symmetric property han uses for its subcomms).
+- Synchronization is monotonic TICKETS: every rank derives the same
+  ticket sequence from the (identical) sequence of collective calls, so
+  flags never reset and reuse is guarded by comparing per-rank counters
+  against the ticket that last used a buffer. arrive[i]/ack[i] live in
+  their own cache lines.
+- bcast: root streams the payload through two chunk-sized halves
+  (double buffering — readers drain half A while the root fills half
+  B); readers spin on the published ticket.
+- allreduce: contributions land in per-rank slots; each rank reduces
+  its contiguous ELEMENT SLICE across all slots (in ascending rank
+  order — non-commutative ops stay correct) into the result area; after
+  a flag phase every rank copies the full reduced chunk out. Per-rank
+  segment traffic is ~3x the message size, independent of N.
+
+Memory model note: flag-after-data ordering relies on total-store-order
+(x86) plus the GIL serializing each rank's numpy stores; on weaker
+architectures a real fence would be needed (the reference uses
+opal_atomic_wmb() at exactly these two points).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import tempfile
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ompi_tpu.coll.base import CollModule, coll_framework
+from ompi_tpu.coll.basic import (
+    BasicColl,
+    _ccid,
+    _np_reduce_typed,
+    _typed_view,
+)
+from ompi_tpu.comm.communicator import parse_buffer
+from ompi_tpu.core import op as _op
+from ompi_tpu.core.convertor import pack as cv_pack, unpack as cv_unpack
+from ompi_tpu.core.datatype import BYTE
+from ompi_tpu.core.errors import MPIError, ERR_INTERN
+from ompi_tpu.core.request import _MULTICORE
+from ompi_tpu.mca.component import Component
+from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.runtime import spc
+from ompi_tpu.runtime.progress import progress
+
+register_var("coll_sm", "enable", True,
+             help="Shared-memory collectives for all-local communicators "
+                  "(reference: ompi/mca/coll/xhc)", level=4)
+register_var("coll_sm", "chunk_bytes", 1 << 20,
+             help="Segment chunk size: bcast double-buffers 2 chunks, "
+                  "allreduce stages one chunk per rank", level=6)
+
+_TAG_BOOT = -31  # segment announcement (coll cid plane; -30 is TAG_TUNED)
+_SPIN_TIMEOUT = 120.0
+
+
+class SmColl(CollModule):
+    """Segment-resident barrier/bcast/allreduce for one-node comms."""
+
+    def __init__(self):
+        self._flat = BasicColl()
+        self._mm: Optional[mmap.mmap] = None
+        self._flags: Optional[np.ndarray] = None  # int64 header view
+        self._ticket = 0
+        self._half_ticket = [0, 0]  # last ticket using each bcast half
+        self._path = None
+
+    # ----------------------------------------------------------- bootstrap
+    def _segment(self, comm):
+        """Map the comm's segment, creating+announcing it on first use."""
+        if self._mm is not None:
+            return
+        n = comm.size
+        chunk = int(get_var("coll_sm", "chunk_bytes"))
+        hdr = 2 * n * 8 + 64          # arrive[n] + ack[n] lines + pub line
+        hdr = (hdr + 4095) & ~4095    # page-align the data area
+        size = hdr + n * chunk + 2 * chunk
+        with spc.suppressed():
+            if comm.rank == 0:
+                d = "/dev/shm" if os.path.isdir("/dev/shm") else None
+                fd, path = tempfile.mkstemp(prefix=f"ompi_tpu_collsm_"
+                                                   f"{comm.cid}_", dir=d)
+                os.ftruncate(fd, size)
+                self._mm = mmap.mmap(fd, size)
+                os.close(fd)
+                msg = path.encode()
+                payload = np.frombuffer(msg, np.uint8)
+                reqs = [comm.pml.isend(payload, len(msg), BYTE,
+                                       comm.group.world_rank(r),
+                                       _TAG_BOOT, _ccid(comm))
+                        for r in range(1, n)]
+                for q in reqs:
+                    q.Wait()
+                self._path = path
+            else:
+                buf = np.empty(512, np.uint8)
+                req = comm.pml.irecv(buf, 512, BYTE,
+                                     comm.group.world_rank(0),
+                                     _TAG_BOOT, _ccid(comm))
+                req.Wait()
+                path = bytes(buf[: req.status._nbytes]).decode()
+                fd = os.open(path, os.O_RDWR)
+                self._mm = mmap.mmap(fd, size)
+                os.close(fd)
+            # all mapped before the creator unlinks (the file then frees
+            # itself when the last process exits, crash included)
+            self._flat.barrier(comm)
+            if comm.rank == 0:
+                os.unlink(path)
+        self._n = n
+        self._chunk = chunk
+        self._hdr = hdr
+        self._flags = np.frombuffer(self._mm, np.int64, hdr // 8)
+        self._data = np.frombuffer(self._mm, np.uint8,
+                                   size - hdr, offset=hdr)
+
+    # arrive[i] at flag index 8*i; ack[i] at 8*(n+i); pub at 8*2n
+    def _spin(self, cond) -> None:
+        """Wait for a segment flag condition. Multicore: tight spin
+        (peers make progress in parallel; the condition resolves in
+        microseconds), polling the progress engine occasionally. Single
+        core: yield the CPU EVERY miss — a spinning rank burns the
+        whole scheduler quantum the peer needs to arrive (this host's
+        1-core CI showed 7ms flat barriers under a 256-spin cadence)."""
+        deadline = time.monotonic() + _SPIN_TIMEOUT
+        spins = 0
+        while not cond():
+            spins += 1
+            if _MULTICORE:
+                if spins & 1023 == 0:
+                    progress()  # keep unrelated transports moving
+                    if time.monotonic() > deadline:
+                        raise MPIError(ERR_INTERN,
+                                       "coll/sm: peer never arrived "
+                                       "(flag spin timed out)")
+            else:
+                progress()
+                time.sleep(0)  # hand the CPU to the peer
+                if spins & 255 == 0 and time.monotonic() > deadline:
+                    raise MPIError(ERR_INTERN,
+                                   "coll/sm: peer never arrived "
+                                   "(flag spin timed out)")
+
+    def _phase(self, comm, t) -> None:
+        """Flat all-see-all flag round: publish my arrival, wait for
+        everyone's."""
+        f, n, r = self._flags, self._n, comm.rank
+        f[8 * r] = t
+        arrive = f[0: 8 * n: 8]  # strided view: one vectorized compare
+        self._spin(lambda: bool((arrive >= t).all()))
+
+    # --------------------------------------------------------- collectives
+    def barrier(self, comm) -> None:
+        self._segment(comm)
+        self._ticket += 1
+        self._phase(comm, self._ticket)
+
+    def bcast(self, comm, buf, root: int) -> None:
+        self._segment(comm)
+        obj, count, dt = parse_buffer(buf)
+        nbytes = count * dt.size
+        if nbytes == 0:
+            return
+        n, r = self._n, comm.rank
+        f = self._flags
+        data = self._data
+        base = self._n * self._chunk  # bcast halves after the slots
+        if r == root:
+            packed = np.ascontiguousarray(cv_pack(obj, count, dt)
+                                          ).view(np.uint8).reshape(-1)
+        else:
+            packed = np.empty(nbytes, np.uint8)
+        for k, off in enumerate(range(0, nbytes, self._chunk)):
+            ln = min(self._chunk, nbytes - off)
+            half = k & 1
+            hoff = base + half * self._chunk
+            self._ticket += 1
+            t = self._ticket
+            if r == root:
+                # reuse guard: everyone acked this half's previous use
+                prev = self._half_ticket[half]
+                acks = f[8 * n: 16 * n: 8]
+                self._spin(lambda: bool((acks >= prev).all()))
+                data[hoff: hoff + ln] = packed[off: off + ln]
+                f[8 * 2 * n] = t          # publish AFTER the payload
+                f[8 * (n + r)] = t        # root's own ack
+            else:
+                self._spin(lambda: f[8 * 2 * n] >= t)
+                packed[off: off + ln] = data[hoff: hoff + ln]
+                f[8 * (n + r)] = t
+            self._half_ticket[half] = t
+        if r != root:
+            cv_unpack(packed, obj, count, dt)
+
+    def allreduce(self, comm, sendbuf, recvbuf, op: _op.Op) -> None:
+        self._segment(comm)
+        src_buf = recvbuf if sendbuf is None else sendbuf  # IN_PLACE
+        obj_s, count, dt = parse_buffer(src_buf)
+        obj_r, rcount, rdt = parse_buffer(recvbuf)
+        nbytes = count * dt.size
+        if nbytes == 0:
+            return
+        packed = np.ascontiguousarray(cv_pack(obj_s, count, dt)
+                                      ).view(np.uint8).reshape(-1)
+        try:
+            probe = _typed_view(packed[:dt.size], dt)
+        except MPIError:
+            # heterogeneous derived type: no typed segment view possible
+            return self._flat.allreduce(comm, sendbuf, recvbuf, op)
+        item = probe.dtype.itemsize
+        n, r = self._n, comm.rank
+        data = self._data
+        out = np.empty(nbytes, np.uint8)
+        # chunk on element boundaries
+        chunk = max((self._chunk // item) * item, item)
+        res_off = n * self._chunk  # result area (bcast half A)
+        f = self._flags
+        for off in range(0, nbytes, chunk):
+            ln = min(chunk, nbytes - off)
+            t1 = self._ticket + 1
+            t2 = self._ticket + 2
+            self._ticket += 2
+            slot = r * self._chunk
+            data[slot: slot + ln] = packed[off: off + ln]
+            self._phase(comm, t1)       # all contributions visible
+            # my element slice of this chunk, reduced in rank order
+            nelem = ln // item
+            q, rem = divmod(nelem, n)
+            lo = (r * q + min(r, rem)) * item
+            hi = lo + (q + (1 if r < rem else 0)) * item
+            if hi > lo:
+                acc = _typed_view(data[lo: hi].copy(), dt)  # rank-0 slot
+                for j in range(1, n):
+                    b = _typed_view(data[j * self._chunk + lo:
+                                         j * self._chunk + hi].copy(), dt)
+                    acc = _np_reduce_typed(op, acc, b)
+                data[res_off + lo: res_off + hi] = \
+                    np.ascontiguousarray(acc).view(np.uint8).reshape(-1)
+            self._phase(comm, t2)       # full reduced chunk visible
+            out[off: off + ln] = data[res_off: res_off + ln]
+            # no third phase: any later slot/result write happens only
+            # after a subsequent _phase or ack-guard, which transitively
+            # requires every rank to have passed this copy-out. The ack
+            # below hands that guard to bcast's half-A reuse check.
+            f[8 * (n + r)] = t2
+            self._half_ticket[0] = t2
+        cv_unpack(out, obj_r, rcount, rdt)
+
+    def reduce(self, comm, sendbuf, recvbuf, op: _op.Op, root: int) -> None:
+        """Segment allreduce, result kept at the root only (free
+        strengthening — one extra local copy vs the pml fan-in)."""
+        if comm.rank == root:
+            return self.allreduce(comm, sendbuf, recvbuf, op)
+        obj_s, count, dt = parse_buffer(
+            recvbuf if sendbuf is None else sendbuf)
+        scratch = np.empty(count * dt.size, np.uint8)  # discarded
+        self.allreduce(comm, sendbuf if sendbuf is not None else recvbuf,
+                       scratch, op)
+
+    def __del__(self):  # pragma: no cover
+        try:
+            if self._mm is not None:
+                self._mm.close()
+        except Exception:
+            pass
+
+
+class SmCollComponent(Component):
+    NAME = "sm"
+    PRIORITY = 50  # above tuned(30)/han(45), below self(75) — the
+    # reference runs xhc above tuned for all-local comms the same way
+
+    def query(self, comm=None, **ctx: Any) -> Optional[SmColl]:
+        from ompi_tpu.comm.communicator import ProcComm
+
+        if not get_var("coll_sm", "enable"):
+            return None
+        if not isinstance(comm, ProcComm) or comm.size < 2:
+            return None
+        if int(get_var("coll_han", "fake_nodes")) > 1:
+            return None  # the fake multi-node hierarchy must win
+        from ompi_tpu.coll.han import HanCollComponent
+
+        node_of = HanCollComponent._modex_node_map(comm)
+        if node_of is None or len(set(node_of)) != 1:
+            return None
+        return SmColl()
+
+
+coll_framework.register(SmCollComponent())

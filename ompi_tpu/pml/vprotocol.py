@@ -178,6 +178,16 @@ class VprotocolPml:
                 os.path.join(logdir, f"sender_{me}.log"), True)
             self._send_pos = 0
         else:
+            # a FRESH live run must not append to a previous generation's
+            # logs: seqs would collide (replay's _read_events silently
+            # keeps the last) while the per-source payload FIFOs still
+            # serve the OLD run's bytes first — wrong-data replay. Move
+            # stale logs aside instead (kept for forensics).
+            for fn in (f"sender_{me}.log", f"events_{me}.log",
+                       f"meta_{me}.log"):
+                p = os.path.join(logdir, fn)
+                if os.path.exists(p) and os.path.getsize(p):
+                    os.replace(p, p + ".stale")
             self._sb = open(os.path.join(logdir, f"sender_{me}.log"),
                             "ab")
             self._ev = open(os.path.join(logdir, f"events_{me}.log"),

@@ -204,21 +204,27 @@ def init_process_mode():
             lambda hdr, payload: hb.note_heartbeat(hdr.src))
         hb.start()
 
-    world = ProcComm(Group(job_peers), cid=0, pml=pml,
-                     name="MPI_COMM_WORLD")
-    if hasattr(pml, "note_world"):  # pml/v live mode: record geometry
-        pml.note_world(size, base)
+    # _ctx goes live BEFORE the world comm exists: ProcComm.__init__
+    # runs coll selection, and locality-aware components (coll/sm,
+    # coll/han) read the modex node map through _ctx — created after,
+    # they would silently decline on MPI_COMM_WORLD (r4 bug: coll/sm
+    # never selected on the world comm)
     _ctx = {
         "modex": modex,
         "btls": [mod for _, _, mod in modules],
         "progress_thread": pthread,
         "detector": hb,
-        "world": world,
+        "world": None,
         "job": job,
         "base": base,
         "size": size,
         "spawned": [],
     }
+    world = ProcComm(Group(job_peers), cid=0, pml=pml,
+                     name="MPI_COMM_WORLD")
+    _ctx["world"] = world
+    if hasattr(pml, "note_world"):  # pml/v live mode: record geometry
+        pml.note_world(size, base)
     # the pre-activation barrier (ompi_mpi_init.c:451-505 modex barrier)
     modex.fence()
     # spawned jobs bridge back to their parent during init (reference:

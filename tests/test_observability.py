@@ -15,7 +15,7 @@ import pytest
 import ompi_tpu
 from ompi_tpu import COMM_WORLD
 from ompi_tpu.runtime import spc
-from tests.test_process_mode import REPO, run_mpi
+from tests.test_process_mode import REPO, run_mpi, subprocess_env
 
 
 def test_spc_records_collectives_and_bytes():
@@ -77,7 +77,7 @@ def test_info_cli():
     r = subprocess.run(
         [sys.executable, "-m", "ompi_tpu.tools.info", "--all"],
         cwd=REPO, capture_output=True, text=True, timeout=120,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        env=subprocess_env())
     assert r.returncode == 0, r.stderr
     out = r.stdout
     assert "frameworks / components" in out
@@ -97,7 +97,7 @@ def test_info_cli_param_filter():
         [sys.executable, "-m", "ompi_tpu.tools.info", "--param", "spc",
          "--level", "9"],
         cwd=REPO, capture_output=True, text=True, timeout=120,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        env=subprocess_env())
     assert r.returncode == 0, r.stderr
     assert "spc_enable" in r.stdout
     assert "btl_sm_ring_bytes" not in r.stdout

@@ -7,7 +7,7 @@ import os
 import subprocess
 import sys
 
-from tests.test_process_mode import REPO, run_mpi
+from tests.test_process_mode import REPO, run_mpi, subprocess_env
 
 FT = (("ft_enable", "1"),
       ("ft_heartbeat_period", "0.25"),
@@ -15,9 +15,7 @@ FT = (("ft_enable", "1"),
 
 
 def _replay_env(logdir):
-    env = dict(os.environ)
-    env.pop("OMPI_TPU_RANK", None)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env = subprocess_env()
     env.update({
         "OMPI_TPU_MCA_pml_v_enable": "1",
         "OMPI_TPU_MCA_pml_v_logdir": logdir,

@@ -13,15 +13,31 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def subprocess_env():
+    """Environment for test subprocesses (launcher + ranks).
+
+    Strips device-tunnel site dirs (e.g. the axon sitecustomize) from
+    PYTHONPATH and forces the cpu backend: those site hooks import jax
+    at interpreter start (~8s/process), and the r3 suite spent most of
+    its 25-minute wall time paying that per rank per test. Rank
+    processes in these tests are host-transport only; the few that use
+    jax get the cpu backend lazily (~1s)."""
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)  # never inherit rank identity
+    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+          if p and not any("axon" in part for part in p.split(os.sep))]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + pp)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 def run_mpi(np_, script, *args, timeout=120, mca=()):
     cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", str(np_)]
     for k, v in mca:
         cmd += ["--mca", k, str(v)]
     cmd += [script, *args]
-    env = dict(os.environ)
-    env.pop("OMPI_TPU_RANK", None)  # never inherit rank identity
     return subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
-                          timeout=timeout, env=env)
+                          timeout=timeout, env=subprocess_env())
 
 
 def test_ring_4_ranks():
