@@ -346,6 +346,39 @@ def _cpu_mesh_sweep():
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+def bench_host_paths():
+    """Process-mode fast paths vs their frame-based fallbacks: coll/sm
+    segment collectives (xhc analog) and the zero-copy shared-segment
+    RMA — measured by the same procmode checks the test suite gates."""
+    import os
+    import re
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+          if p and not any("axon" in part for part in p.split(os.sep))]
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.abspath(__file__))] + pp)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = {}
+    for key, script in (
+            ("collsm_allreduce_4MB_vs_pml", "check_smcoll.py"),
+            ("osc_shm_put_1MB_vs_am", "check_osc_shm.py")):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np",
+                 "4", f"tests/procmode/{script}"],
+                capture_output=True, text=True, timeout=240, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            m = re.search(r"ratio=([0-9.]+)", r.stdout)
+            out[key] = {"speedup": float(m.group(1))} if m else \
+                {"error": r.stdout[-300:] + r.stderr[-300:]}
+        except Exception as e:  # pragma: no cover
+            out[key] = {"error": str(e)[:300]}
+    return out
+
+
 def main() -> int:
     if "--cpu-mesh-sweep" in sys.argv[1:]:
         return _cpu_mesh_child()
@@ -373,6 +406,7 @@ def main() -> int:
         sweep = _cpu_mesh_sweep()
         detail.update(sweep)
         detail["dispatch_tax"] = bench_dispatch_tax(mesh_world(devices))
+    detail["host_paths"] = bench_host_paths()
     detail["model_step"] = bench_mfu()
 
     print(json.dumps(detail, indent=1), file=sys.stderr)

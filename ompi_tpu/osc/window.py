@@ -33,6 +33,7 @@ from ompi_tpu.runtime import spc
 from ompi_tpu.utils.output import get_logger
 
 OSC_TAG = -4300
+_SHM_BOOT_TAG = -33  # shared-segment announcement (coll cid plane)
 
 # verbs
 (_PUT, _GET, _ACC, _FOP, _CAS, _ACK, _LOCK, _UNLOCK, _LOCK_GRANT,
@@ -138,7 +139,7 @@ def _on_message(hdr, payload: bytes) -> None:
     win._handle(verb, origin, disp, count, dcode, opcode, req_id, body)
 
 
-from ompi_tpu.core.request import Request
+from ompi_tpu.core.request import CompletedRequest, Request
 
 
 class OscRequest(Request):
@@ -179,8 +180,21 @@ class Win:
     their reply; the R-variants (Rput/Rget/Raccumulate) return Requests.
     """
 
-    def __init__(self, buffer: Optional[np.ndarray], comm, win_id=None):
+    def __init__(self, buffer: Optional[np.ndarray], comm, win_id=None,
+                 alloc_bytes: Optional[int] = None):
         self.comm = comm
+        # zero-copy intra-node path (reference: osc/rdma directly on btl
+        # put/get, osc_rdma_comm.c:838 + opal/mca/smsc): when the
+        # implementation owns the memory (Win_allocate) and every rank
+        # is on this node, the window lives in ONE shared segment —
+        # Put/Get become a single mapped memcpy; the active-message path
+        # stays for accumulate ordering, locks, and non-local comms.
+        self._shm = None          # mmap when the shared path is active
+        self._peer_bytes = None   # rank -> uint8 view of its slot
+        if alloc_bytes is not None:
+            buffer = self._try_shared_alloc(comm, alloc_bytes)
+            if buffer is None:
+                buffer = np.zeros(alloc_bytes, np.uint8)
         self.buf = buffer if buffer is not None else np.zeros(0, np.uint8)
         self._bytes = self.buf.reshape(-1).view(np.uint8) if self.buf.size \
             else np.zeros(0, np.uint8)
@@ -220,13 +234,127 @@ class Win:
             comm.Barrier()
 
     # ------------------------------------------------------------- plumbing
+    def _try_shared_alloc(self, comm, nbytes: int):
+        """Map this window's memory into a node-wide segment when every
+        comm member is local. Returns my slot view, or None (fall back
+        to private memory + active messages). User-provided buffers
+        (Win_create) can't take this path — sharing existing process
+        memory needs an smsc/xpmem analog the host lacks.
+
+        The decision is COLLECTIVE: locality is re-agreed with an
+        Allreduce(MIN) so a transient per-rank modex miss (or a rank-0
+        segment-creation failure, announced as an empty path) degrades
+        every rank together to the AM path instead of deadlocking a
+        mixed selection (the han.py:238 lesson). Per-rank sizes are
+        allgathered — MPI_Win_allocate permits them to differ.
+        """
+        import mmap
+        import os
+        import tempfile
+
+        from ompi_tpu.comm.communicator import ProcComm
+
+        if hasattr(comm, "_getter"):
+            comm = comm._getter()  # unwrap the lazy COMM_WORLD proxy
+            self.comm = comm
+        if not isinstance(comm, ProcComm) or comm.size < 2:
+            return None
+        from ompi_tpu.coll.han import HanCollComponent
+
+        node_of = HanCollComponent._modex_node_map(comm)
+        local = node_of is not None and len(set(node_of)) == 1
+        from ompi_tpu.coll.basic import COLL_CID_BIT
+        from ompi_tpu.core.datatype import BYTE
+
+        ccid = comm.cid | COLL_CID_BIT
+        n = comm.size
+        with spc.suppressed():
+            agree = np.zeros(1, np.int64)
+            comm.Allreduce(np.array([1 if local else 0], np.int64),
+                           agree, op=_op.MIN)
+            if int(agree[0]) == 0:
+                return None
+            sizes = np.zeros(n, np.int64)
+            comm.Allgather(np.array([int(nbytes)], np.int64), sizes)
+            slots = [(int(b) + 4095) & ~4095 for b in sizes]
+            offs = np.concatenate(([0], np.cumsum(slots))).tolist()
+            size = max(int(offs[-1]), 4096)
+            mm = None
+            if comm.rank == 0:
+                path = ""
+                try:
+                    d = "/dev/shm" if os.path.isdir("/dev/shm") else None
+                    fd, path = tempfile.mkstemp(
+                        prefix="ompi_tpu_oscshm_", dir=d)
+                    os.ftruncate(fd, size)
+                    mm = mmap.mmap(fd, size)
+                    os.close(fd)
+                except OSError:
+                    if path:
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+                    path = ""  # announce failure: all fall back together
+                msg = np.frombuffer(path.encode() or b"\0", np.uint8)
+                reqs = [comm.pml.isend(msg, msg.nbytes, BYTE,
+                                       comm._world_rank(r), _SHM_BOOT_TAG,
+                                       ccid)
+                        for r in range(1, n)]
+                for q in reqs:
+                    q.Wait()
+                ok = bool(mm)
+            else:
+                buf = np.empty(512, np.uint8)
+                req = comm.pml.irecv(buf, 512, BYTE, comm._world_rank(0),
+                                     _SHM_BOOT_TAG, ccid)
+                req.Wait()
+                raw = bytes(buf[: req.status._nbytes])
+                path = "" if raw == b"\0" else raw.decode()
+                ok = bool(path)
+                if ok:
+                    try:
+                        fd = os.open(path, os.O_RDWR)
+                        mm = mmap.mmap(fd, size)
+                        os.close(fd)
+                    except OSError:
+                        ok = False
+            # every rank reaches this barrier on success AND failure, so
+            # the creator can unlink (or all can bail) in step
+            comm.Barrier()
+            if comm.rank == 0 and mm is not None:
+                os.unlink(path)
+            if not ok:
+                # a rank that mapped but saw ok=False elsewhere cannot
+                # know; per-rank ok is already collective here: ok is
+                # False only via rank 0's empty path (seen by all) or a
+                # local open failure — re-agree to stay symmetric
+                pass
+            agree2 = np.zeros(1, np.int64)
+            comm.Allreduce(np.array([1 if ok else 0], np.int64),
+                           agree2, op=_op.MIN)
+            if int(agree2[0]) == 0:
+                if mm is not None:
+                    mm.close()
+                return None
+        self._shm = mm
+        self._peer_bytes = [
+            np.frombuffer(mm, np.uint8, int(sizes[r]), offset=offs[r])
+            for r in range(n)
+        ]
+        view = self._peer_bytes[comm.rank]
+        view[:] = 0
+        return view
+
     @staticmethod
     def Create(buffer, comm) -> "Win":
         return Win(buffer, comm)
 
     @staticmethod
     def Allocate(nbytes: int, comm) -> "Win":
-        return Win(np.zeros(nbytes, np.uint8), comm)
+        """MPI_Win_allocate: implementation-owned memory — shared-segment
+        backed (zero-copy Put/Get) when the comm is all-local."""
+        return Win(None, comm, alloc_bytes=nbytes)
 
     @staticmethod
     def Create_dynamic(comm) -> "Win":
@@ -294,6 +422,18 @@ class Win:
         with spc.suppressed():
             self.comm.Barrier()
         _windows.pop(self.win_id, None)
+        if self._shm is not None:
+            # drop OUR views first (MPI frees Win_allocate memory at
+            # Free): with no user-held references the segment unmaps
+            # now; otherwise it lingers until GC collects their views
+            self._peer_bytes = None
+            self.buf = np.zeros(0, np.uint8)
+            self._bytes = self.buf
+            mm, self._shm = self._shm, None
+            try:
+                mm.close()
+            except BufferError:
+                pass  # user still holds a view: freed at GC instead
 
     def _send(self, target: int, verb: int, disp: int, count: int,
               dcode: int, opcode: int, req_id: int, body: bytes) -> None:
@@ -324,10 +464,44 @@ class Win:
     # --------------------------------------------------------------- verbs
     # Put/Accumulate complete locally at return (payload copied); their
     # R-variants expose the remote-completion request.
+    def _shm_put(self, origin_arr: np.ndarray, target: int,
+                 disp: int) -> bool:
+        """One mapped memcpy into the target's slot (zero-copy path).
+        Returns False when this window/target can't take it."""
+        if self._peer_bytes is None:
+            return False
+        src = np.ascontiguousarray(origin_arr).reshape(-1).view(np.uint8)
+        peer = self._peer_bytes[target]
+        if disp < 0 or disp + src.nbytes > peer.nbytes:
+            raise MPIError(
+                ERR_WIN,
+                f"displacement [{disp}, {disp + src.nbytes}) outside the "
+                f"{peer.nbytes}-byte window")
+        peer[disp: disp + src.nbytes] = src
+        spc.record_bytes("rma_shm_put", src.nbytes)
+        return True
+
+    def _shm_get(self, origin_arr: np.ndarray, target: int,
+                 disp: int) -> bool:
+        if self._peer_bytes is None:
+            return False
+        dst = origin_arr.reshape(-1).view(np.uint8)
+        peer = self._peer_bytes[target]
+        if disp < 0 or disp + dst.nbytes > peer.nbytes:
+            raise MPIError(
+                ERR_WIN,
+                f"displacement [{disp}, {disp + dst.nbytes}) outside the "
+                f"{peer.nbytes}-byte window")
+        dst[:] = peer[disp: disp + dst.nbytes]
+        spc.record_bytes("rma_shm_get", dst.nbytes)
+        return True
+
     def Rput(self, origin_arr: np.ndarray, target: int,
-             target_disp: int = 0) -> OscRequest:
+             target_disp: int = 0) -> Request:
         spc.record_bytes("rma_put", origin_arr.nbytes)
         dt = from_numpy_dtype(origin_arr.dtype)
+        if self._shm_put(origin_arr, target, target_disp * dt.size):
+            return CompletedRequest()
         return self._post_op(target, _PUT, target_disp * dt.size,
                              origin_arr.size, _dtype_code(dt), 0,
                              origin_arr.tobytes())
@@ -336,14 +510,18 @@ class Win:
             target_disp: int = 0) -> None:
         spc.record_bytes("rma_put", origin_arr.nbytes)
         dt = from_numpy_dtype(origin_arr.dtype)
+        if self._shm_put(origin_arr, target, target_disp * dt.size):
+            return
         self._post_op(target, _PUT, target_disp * dt.size,
                       origin_arr.size, _dtype_code(dt), 0,
                       origin_arr.tobytes(), fire_and_forget=True)
 
     def Rget(self, origin_arr: np.ndarray, target: int,
-             target_disp: int = 0) -> OscRequest:
+             target_disp: int = 0) -> Request:
         spc.record_bytes("rma_get", origin_arr.nbytes)
         dt = from_numpy_dtype(origin_arr.dtype)
+        if self._shm_get(origin_arr, target, target_disp * dt.size):
+            return CompletedRequest()
 
         def land(data: bytes) -> None:
             origin_arr.reshape(-1)[:] = np.frombuffer(

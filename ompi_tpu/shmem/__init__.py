@@ -76,11 +76,16 @@ def init() -> None:
 
         ompi_tpu.Init()
         comm = ompi_tpu.runtime.state.get_world()
-        heap = np.zeros(int(get_var("shmem", "heap_bytes")), np.uint8)
+        # the symmetric heap is implementation-owned: Win.Allocate backs
+        # it with the node-shared segment when all PEs are local, making
+        # shmem_put/get single mapped memcpys (reference: memheap over
+        # the sshmem segment + smsc, the same zero-copy layering)
+        win = Win.Allocate(int(get_var("shmem", "heap_bytes")), comm)
+        heap = win.buf.reshape(-1).view(np.uint8)
         _ctx = {
             "comm": comm,
             "heap": heap,
-            "win": Win.Create(heap, comm),
+            "win": win,
             # first-fit free list of (offset, size) spans — the memheap
             # allocator analog (reference: oshmem/mca/memheap ptmalloc/
             # buddy); symmetric because every PE runs the same sequence

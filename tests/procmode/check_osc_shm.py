@@ -1,0 +1,99 @@
+"""Zero-copy intra-node RMA: shared-segment Win.Allocate path
+(reference: osc_rdma_comm.c:838 direct btl put/get + opal/mca/smsc)."""
+
+import time
+
+import numpy as np
+
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.osc.window import Win, LOCK_EXCLUSIVE
+from ompi_tpu.runtime import spc
+
+comm = COMM_WORLD
+r = comm.Get_rank()
+n = comm.Get_size()
+
+NB = 1 << 20  # 1MB window per rank
+win = Win.Allocate(NB, comm)
+assert win._peer_bytes is not None, "shared path not selected on all-local comm"
+
+# direct puts: ring neighbor writes its rank pattern into my first KB
+pattern = np.full(1024, r + 1, np.uint8)
+win.Fence()
+win.Put(pattern, (r + 1) % n, target_disp=0)
+win.Fence()
+mine = np.asarray(win.buf[:1024])
+assert np.all(mine == ((r - 1) % n) + 1), mine[:4]
+
+# the counter proves the one-copy path ran (VERDICT r3 next #5)
+assert spc.get("rma_shm_put_bytes") >= 1024, spc.snapshot()
+
+# direct get under lock
+out = np.zeros(1024, np.uint8)
+tgt = (r + 1) % n
+win.Lock(tgt, LOCK_EXCLUSIVE)
+win.Get(out, tgt, target_disp=0)
+win.Unlock(tgt)
+assert np.all(out == r + 1), out[:4]  # tgt's slot holds (tgt-1)+1 = r+1
+
+# accumulate still works (AM path) against the shared buffer
+acc = np.ones(16, np.float64)
+f64 = np.zeros(16, np.float64)
+win.Fence()
+if r == 0:
+    for j in range(n):
+        win.Accumulate(acc, j, target_disp=32)
+win.Fence()
+got = np.asarray(win.buf[256: 256 + 128]).view(np.float64)
+assert np.all(got == 1.0), got[:4]
+
+# bounds violation raises locally
+try:
+    win.Put(np.zeros(NB + 16, np.uint8), tgt)
+    raise SystemExit("bounds check missing")
+except Exception:
+    pass
+
+# per-rank sizes are legal for MPI_Win_allocate: slots/offsets come
+# from an allgather, and bounds are checked against the TARGET's size
+vw = Win.Allocate((r + 1) * 4096, comm)
+assert vw._peer_bytes is not None
+vw.Fence()
+vw.Put(np.full(64, 10 + r, np.uint8), tgt, target_disp=0)
+vw.Fence()
+got = np.asarray(vw.buf[:64])
+assert np.all(got == 10 + (r - 1) % n), got[:4]
+try:
+    vw.Put(np.zeros(2 * 4096, np.uint8), 0)  # rank 0's slot is 4096
+    raise SystemExit("per-rank bounds check missing")
+except Exception:
+    pass
+vw.Free()
+
+print(f"OSCSHM-CORRECT rank {r}", flush=True)
+
+# ---- speed vs the active-message path (private window, 1MB puts)
+priv = Win.Create(np.zeros(NB, np.uint8), comm)
+payload = np.ones(NB, np.uint8)
+
+def bench(w, iters=6):
+    w.Fence()
+    w.Put(payload, tgt)
+    w.Flush()
+    comm.Barrier()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        w.Put(payload, tgt)
+        w.Flush()
+    dt = (time.perf_counter() - t0) / iters
+    comm.Barrier()
+    return dt
+
+t_shm = bench(win)
+t_am = bench(priv)
+if r == 0:
+    print(f"OSCSHM-SPEED shm={t_shm*1e6:.0f}us am={t_am*1e6:.0f}us "
+          f"ratio={t_am/t_shm:.2f}", flush=True)
+win.Free()
+priv.Free()
+print(f"OSCSHM-OK rank {r}", flush=True)
