@@ -95,6 +95,14 @@ PROC_NULL = -2
 ROOT = -3
 UNDEFINED = -32766
 
+from ompi_tpu.core.external32 import (
+    mpi_pack as Pack,
+    mpi_unpack as Unpack,
+    pack_size as Pack_size,
+    pack_external as Pack_external,
+    unpack_external as Unpack_external,
+    pack_external_size as Pack_external_size,
+)
 from ompi_tpu.accelerator import DeviceBuffer
 from ompi_tpu.comm.communicator import Communicator, Intracomm
 from ompi_tpu.comm.intercomm import Intercomm, Intercomm_create

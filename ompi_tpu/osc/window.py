@@ -282,13 +282,13 @@ class Win:
             mm = None
             if comm.rank == 0:
                 path = ""
+                fd = -1
                 try:
                     d = "/dev/shm" if os.path.isdir("/dev/shm") else None
                     fd, path = tempfile.mkstemp(
                         prefix="ompi_tpu_oscshm_", dir=d)
                     os.ftruncate(fd, size)
                     mm = mmap.mmap(fd, size)
-                    os.close(fd)
                 except OSError:
                     if path:
                         try:
@@ -296,6 +296,9 @@ class Win:
                         except OSError:
                             pass
                     path = ""  # announce failure: all fall back together
+                finally:
+                    if fd >= 0:
+                        os.close(fd)
                 msg = np.frombuffer(path.encode() or b"\0", np.uint8)
                 reqs = [comm.pml.isend(msg, msg.nbytes, BYTE,
                                        comm._world_rank(r), _SHM_BOOT_TAG,
@@ -313,23 +316,23 @@ class Win:
                 path = "" if raw == b"\0" else raw.decode()
                 ok = bool(path)
                 if ok:
+                    fd = -1
                     try:
                         fd = os.open(path, os.O_RDWR)
                         mm = mmap.mmap(fd, size)
-                        os.close(fd)
                     except OSError:
                         ok = False
+                    finally:
+                        if fd >= 0:
+                            os.close(fd)
             # every rank reaches this barrier on success AND failure, so
             # the creator can unlink (or all can bail) in step
             comm.Barrier()
             if comm.rank == 0 and mm is not None:
                 os.unlink(path)
-            if not ok:
-                # a rank that mapped but saw ok=False elsewhere cannot
-                # know; per-rank ok is already collective here: ok is
-                # False only via rank 0's empty path (seen by all) or a
-                # local open failure — re-agree to stay symmetric
-                pass
+            # re-agree on success so a rank-local open failure (or the
+            # creator's empty-path announcement) degrades every rank
+            # together to the AM fallback
             agree2 = np.zeros(1, np.int64)
             comm.Allreduce(np.array([1 if ok else 0], np.int64),
                            agree2, op=_op.MIN)
@@ -470,6 +473,8 @@ class Win:
         Returns False when this window/target can't take it."""
         if self._peer_bytes is None:
             return False
+        if not 0 <= target < len(self._peer_bytes):
+            raise MPIError(ERR_RANK, f"target rank {target} out of range")
         src = np.ascontiguousarray(origin_arr).reshape(-1).view(np.uint8)
         peer = self._peer_bytes[target]
         if disp < 0 or disp + src.nbytes > peer.nbytes:
@@ -485,6 +490,8 @@ class Win:
                  disp: int) -> bool:
         if self._peer_bytes is None:
             return False
+        if not 0 <= target < len(self._peer_bytes):
+            raise MPIError(ERR_RANK, f"target rank {target} out of range")
         dst = origin_arr.reshape(-1).view(np.uint8)
         peer = self._peer_bytes[target]
         if disp < 0 or disp + dst.nbytes > peer.nbytes:

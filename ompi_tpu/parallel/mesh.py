@@ -374,6 +374,20 @@ class XlaComm(Intracomm):
     Scan_init = scan_init
     Exscan_init = exscan_init
 
+    # ---------------------------------------- partitioned pt2pt (MPI-4)
+    def Psend_init(self, x, perm: Sequence[Tuple[int, int]],
+                   partitions: int):
+        """Partitioned transfer: [W, K, ...] buffer, K split into
+        ``partitions`` segments, each dispatched by Pready as its own
+        segment of the ppermute schedule (reference: part.h:163; see
+        parallel/partitioned.py)."""
+        from ompi_tpu.parallel.partitioned import MeshPartitionedRequest
+
+        return MeshPartitionedRequest(self, x, perm, partitions)
+
+    # single-controller collapse: one request serves both endpoints
+    Precv_init = Psend_init
+
     # ------------------------------------------------------------- pt2pt
     def permute(self, x, perm: Sequence[Tuple[int, int]]):
         """Tag-free pt2pt: move rank-rows along (src, dst) pairs in comm

@@ -1,0 +1,105 @@
+"""Mesh-mode partitioned communication (MPI-4 Psend/Precv on XlaComm).
+
+Reference: ompi/mca/part/part.h:163,227 (Psend_init/Precv_init,
+Pready/Parrived). SURVEY.md §5 maps partitioned comm on the mesh to
+SEGMENTED ppermute schedules, and that is literally the implementation:
+
+- the buffer is [W, P, ...] — rank rows over the mesh axis, P partitions;
+- ``Pready(p)`` dispatches partition p's ppermute immediately (its own
+  cached XLA executable; jax dispatch is asynchronous, so partitions
+  overlap on ICI in ready order, not index order);
+- ``Parrived(p)`` polls the partition's device readiness
+  (jax.Array.is_ready — the transfer's completion flag);
+- ``Wait`` assembles the permuted partitions back into [W, P, ...].
+
+Single-controller collapse: the driver holds both endpoints, so one
+request object serves the Psend/Precv pair — Precv_init returns the
+same machinery (the host pml/partitioned.py keeps the two-process
+protocol for process mode).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_PENDING
+
+
+class MeshPartitionedRequest:
+    """Persistent partitioned transfer over a mesh communicator."""
+
+    def __init__(self, comm, x, perm: Sequence[Tuple[int, int]],
+                 partitions: int):
+        if partitions <= 0:
+            raise MPIError(ERR_ARG, "partitions must be positive")
+        if x.ndim < 2 or x.shape[1] % partitions:
+            raise MPIError(
+                ERR_ARG,
+                f"buffer [W, K, ...] needs K divisible by partitions: "
+                f"{tuple(x.shape)} vs {partitions}")
+        self.comm = comm
+        self.perm = tuple((int(s), int(d)) for s, d in perm)
+        self.partitions = partitions
+        self._seg = x.shape[1] // partitions
+        self._x = x
+        self._parts: List[Optional[object]] = [None] * partitions
+        self.result = None
+
+    # ------------------------------------------------------ MPI verbs
+    def Start(self) -> "MeshPartitionedRequest":
+        """Re-arm (persistent semantics); partition state clears."""
+        self._parts = [None] * self.partitions
+        self.result = None
+        return self
+
+    def Pready(self, partition: int) -> None:
+        """Dispatch partition ``partition``'s segment of the ppermute
+        schedule — any order, each its own async device dispatch."""
+        p = int(partition)
+        if not 0 <= p < self.partitions:
+            raise MPIError(ERR_ARG, f"partition {p} out of range")
+        if self._parts[p] is not None:
+            raise MPIError(ERR_ARG, f"partition {p} already ready")
+        lo = p * self._seg
+        self._parts[p] = self.comm.permute(
+            self._x[:, lo: lo + self._seg], self.perm)
+
+    def Pready_range(self, lo: int, hi: int) -> None:
+        for p in range(int(lo), int(hi) + 1):
+            self.Pready(p)
+
+    def Parrived(self, partition: int) -> bool:
+        """Has partition ``partition`` completed on device?"""
+        r = self._parts[int(partition)]
+        if r is None:
+            return False
+        try:
+            return bool(r.is_ready())
+        except AttributeError:  # non-jax array (cpu fallback): done
+            return True
+
+    def Wait(self):
+        """Complete the whole transfer: every partition must have been
+        made ready; returns (and stores) the permuted [W, P*seg, ...]
+        array."""
+        missing = [i for i, r in enumerate(self._parts) if r is None]
+        if missing:
+            raise MPIError(
+                ERR_PENDING,
+                f"Wait before Pready of partitions {missing[:8]}")
+        import jax
+        import jax.numpy as jnp
+
+        out = jnp.concatenate(self._parts, axis=1)
+        jax.block_until_ready(out)
+        self.result = out
+        return out
+
+    def Test(self) -> bool:
+        return all(r is not None for r in self._parts) and \
+            all(self.Parrived(i) for i in range(self.partitions))
+
+    def Free(self) -> None:
+        self._parts = [None] * self.partitions
+        self._x = None
+        self.result = None

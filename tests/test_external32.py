@@ -1,0 +1,142 @@
+"""MPI_Pack/Unpack + external32 canonical representation.
+
+Reference: ompi/datatype/ompi_datatype_external32.c,
+opal/datatype/opal_copy_functions_heterogeneous.c."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import (
+    Pack,
+    Pack_external,
+    Pack_external_size,
+    Pack_size,
+    Unpack,
+    Unpack_external,
+    DOUBLE,
+    FLOAT,
+    INT32,
+    INT64,
+    MPIError,
+)
+from ompi_tpu.core.datatype import from_numpy_dtype
+
+
+def test_native_pack_roundtrip_contiguous():
+    src = np.arange(10, dtype=np.float64)
+    out = np.zeros(Pack_size(10, DOUBLE), np.uint8)
+    pos = Pack(src, 10, DOUBLE, out, 0)
+    assert pos == 80
+    back = np.zeros(10, np.float64)
+    assert Unpack(out, 0, back, 10, DOUBLE) == 80
+    np.testing.assert_array_equal(back, src)
+
+
+def test_native_pack_appends_at_position():
+    a = np.array([7], np.int64)
+    b = np.array([1.5], np.float64)
+    out = np.zeros(16, np.uint8)
+    pos = Pack(a, 1, INT64, out, 0)
+    pos = Pack(b, 1, DOUBLE, out, pos)
+    assert pos == 16
+    ra = np.zeros(1, np.int64)
+    rb = np.zeros(1, np.float64)
+    pos = Unpack(out, 0, ra, 1, INT64)
+    Unpack(out, pos, rb, 1, DOUBLE)
+    assert ra[0] == 7 and rb[0] == 1.5
+
+
+def test_external32_is_big_endian():
+    src = np.array([0x01020304], np.uint32)
+    dt = from_numpy_dtype(np.uint32)
+    out = np.zeros(Pack_external_size("external32", 1, dt), np.uint8)
+    Pack_external("external32", src, 1, dt, out, 0)
+    assert bytes(out) == b"\x01\x02\x03\x04"  # canonical network order
+
+
+def test_external32_roundtrip_scalars():
+    for npdt in (np.int32, np.int64, np.float32, np.float64,
+                 np.complex64, np.complex128, np.int8):
+        dt = from_numpy_dtype(npdt)
+        src = (np.arange(5) + 1).astype(npdt)
+        out = np.zeros(Pack_external_size("external32", 5, dt), np.uint8)
+        end = Pack_external("external32", src, 5, dt, out, 0)
+        assert end == 5 * dt.size
+        back = np.zeros(5, npdt)
+        assert Unpack_external("external32", out, 0, back, 5, dt) == end
+        np.testing.assert_array_equal(back, src)
+
+
+def test_external32_byteswapped_fixture():
+    """A stream written by a BIG-endian peer (hand-built fixture) must
+    unpack to native values — the heterogeneous-receive case."""
+    vals = np.array([1.0, -2.5, 3e10], np.float64)
+    fixture = vals.astype(">f8").tobytes()  # what a BE writer produces
+    back = np.zeros(3, np.float64)
+    Unpack_external("external32", np.frombuffer(fixture, np.uint8),
+                    0, back, 3, DOUBLE)
+    np.testing.assert_array_equal(back, vals)
+
+    ints = np.array([-7, 1 << 40], np.int64)
+    fixture = ints.astype(">i8").tobytes()
+    iback = np.zeros(2, np.int64)
+    Unpack_external("external32", np.frombuffer(fixture, np.uint8),
+                    0, iback, 2, INT64)
+    np.testing.assert_array_equal(iback, ints)
+
+
+def test_external32_complex_swaps_components():
+    z = np.array([1.0 + 2.0j], np.complex128)
+    dt = from_numpy_dtype(np.complex128)
+    out = np.zeros(16, np.uint8)
+    Pack_external("external32", z, 1, dt, out, 0)
+    # each 8-byte component is independently big-endian
+    re = np.frombuffer(bytes(out[:8]), ">f8")[0]
+    im = np.frombuffer(bytes(out[8:]), ">f8")[0]
+    assert re == 1.0 and im == 2.0
+
+
+def test_external32_derived_vector():
+    """Strided vector: canonical stream is dense and BE; holes survive a
+    roundtrip untouched."""
+    base = from_numpy_dtype(np.int32)
+    vec = base.Create_vector(3, 1, 2).Commit()  # every other int32
+    src = np.arange(6, dtype=np.int32)
+    n = Pack_external_size("external32", 1, vec)
+    assert n == 3 * 4
+    out = np.zeros(n, np.uint8)
+    Pack_external("external32", src, 1, vec, out, 0)
+    np.testing.assert_array_equal(
+        np.frombuffer(bytes(out), ">i4"), [0, 2, 4])
+    dstbuf = np.full(6, -1, np.int32)
+    Unpack_external("external32", out, 0, dstbuf, 1, vec)
+    np.testing.assert_array_equal(dstbuf, [0, -1, 2, -1, 4, -1])
+
+
+def test_external32_struct_mixed_fields():
+    base_i = from_numpy_dtype(np.int32)
+    base_d = from_numpy_dtype(np.float64)
+    st = base_i.Create_struct([1, 1], [0, 8], [base_i, base_d]).Commit()
+    buf = np.zeros(16, np.uint8)
+    buf[:4] = np.frombuffer(np.array([9], np.int32).tobytes(), np.uint8)
+    buf[8:] = np.frombuffer(np.array([2.5], np.float64).tobytes(),
+                            np.uint8)
+    out = np.zeros(Pack_external_size("external32", 1, st), np.uint8)
+    Pack_external("external32", buf, 1, st, out, 0)
+    assert np.frombuffer(bytes(out[:4]), ">i4")[0] == 9
+    assert np.frombuffer(bytes(out[4:12]), ">f8")[0] == 2.5
+    back = np.zeros(16, np.uint8)
+    Unpack_external("external32", out, 0, back, 1, st)
+    np.testing.assert_array_equal(back, buf)
+
+
+def test_bad_datarep_and_bounds():
+    src = np.zeros(4, np.float32)
+    out = np.zeros(64, np.uint8)
+    with pytest.raises(MPIError):
+        Pack_external("native", src, 4, FLOAT, out, 0)
+    with pytest.raises(MPIError):
+        Pack_external("external32", src, 4, FLOAT, np.zeros(8, np.uint8))
+    with pytest.raises(MPIError):
+        Unpack_external("external32", np.zeros(4, np.uint8), 0,
+                        np.zeros(4, np.int32), 4, INT32)
