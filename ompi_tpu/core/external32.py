@@ -7,10 +7,12 @@ pack/unpack kernels that byteswap per predefined type, not per byte
 run — a complex number swaps each component, not the whole 16 bytes).
 
 Built on the byte-map engine of core/convertor.py: native Pack/Unpack
-reuse it directly; the external32 variants walk the typemap ENTRIES
-(displacement-sorted, matching the byte-map's packed order) so each
-field is gathered, endian-converted as a unit, and placed at its
-canonical offset. Our predefined types all have external32 sizes equal
+reuse it directly; the external32 variants walk the typemap ENTRIES in
+DECLARATION order — the canonical stream follows the typemap as
+declared (MPI external32 contract), which for a struct with
+out-of-order displacements differs from the byte-map's
+displacement-sorted internal wire format — so each field is gathered,
+endian-converted as a unit, and placed at its canonical offset. Our predefined types all have external32 sizes equal
 to their native sizes (IEEE floats, two's-complement ints), so
 conversion is pure byte reordering — the fixed-size table of
 ompi_datatype_external32.c collapses to the typemap itemsizes.
@@ -20,7 +22,11 @@ from __future__ import annotations
 
 import numpy as np
 
-from ompi_tpu.core.convertor import _as_byte_view
+from ompi_tpu.core.convertor import (
+    _as_byte_view,
+    pack as _native_pack,
+    unpack as _native_unpack,
+)
 from ompi_tpu.core.datatype import Datatype
 from ompi_tpu.core.errors import (
     MPIError,
@@ -40,14 +46,26 @@ def _check_rep(datarep: str) -> None:
 
 
 def _entries(dt: Datatype):
-    """(packed_offset, disp, np.dtype) per typemap entry, displacement-
-    sorted — the same order the byte-map packs fields in."""
+    """(packed_offset, disp, np.dtype) per typemap entry, in typemap
+    DECLARATION order — external32 streams fields as declared, so other
+    MPI implementations decode them identically."""
     out = []
     pos = 0
-    for disp, d in sorted((disp, d) for d, disp in dt.typemap):
+    for d, disp in dt.typemap:
         out.append((pos, disp, d))
         pos += d.itemsize
     return out
+
+
+def _check_data_extent(view: np.ndarray, count: int, dt: Datatype,
+                       what: str) -> None:
+    """The data buffer must span count elements of the datatype's
+    extent (same rule as convertor.pack) — undersized buffers raise
+    MPIError, not a raw numpy IndexError."""
+    need = (count - 1) * dt.extent + dt.true_lb + dt.true_extent
+    if count and view.nbytes < need:
+        raise MPIError(ERR_BUFFER,
+                       f"{what} too small: {view.nbytes} < {need}")
 
 
 def _swap_fields(block: np.ndarray, d: np.dtype) -> np.ndarray:
@@ -79,6 +97,7 @@ def pack_external(datarep: str, inbuf, count: int, datatype: Datatype,
     _check_rep(datarep)
     src = _as_byte_view(inbuf)
     dst = _as_byte_view(outbuf)
+    _check_data_extent(src, count, datatype, "inbuf")
     total = count * datatype.size
     if position + total > dst.nbytes:
         raise MPIError(ERR_BUFFER,
@@ -115,6 +134,7 @@ def unpack_external(datarep: str, inbuf, position: int, outbuf,
     _check_rep(datarep)
     src = _as_byte_view(inbuf)
     dst = _as_byte_view(outbuf)
+    _check_data_extent(dst, count, datatype, "outbuf")
     total = count * datatype.size
     if position + total > src.nbytes:
         raise MPIError(ERR_TRUNCATE,
@@ -150,10 +170,8 @@ def pack_size(count: int, datatype: Datatype) -> int:
 def mpi_pack(inbuf, count: int, datatype: Datatype, outbuf,
              position: int = 0) -> int:
     """MPI_Pack: append `count` native-representation elements."""
-    from ompi_tpu.core.convertor import pack as _pack
-
     dst = _as_byte_view(outbuf)
-    data = _pack(inbuf, count, datatype)
+    data = _native_pack(inbuf, count, datatype)
     if position + data.nbytes > dst.nbytes:
         raise MPIError(ERR_BUFFER,
                        f"outbuf too small: {dst.nbytes} < "
@@ -165,13 +183,12 @@ def mpi_pack(inbuf, count: int, datatype: Datatype, outbuf,
 def mpi_unpack(inbuf, position: int, outbuf, count: int,
                datatype: Datatype) -> int:
     """MPI_Unpack: scatter `count` native elements from `inbuf`."""
-    from ompi_tpu.core.convertor import unpack as _unpack
-
     src = _as_byte_view(inbuf)
     total = count * datatype.size
     if position + total > src.nbytes:
         raise MPIError(ERR_TRUNCATE,
                        f"packed stream {src.nbytes} < expected "
                        f"{position + total}")
-    _unpack(src[position: position + total], outbuf, count, datatype)
+    _native_unpack(src[position: position + total], outbuf, count,
+                   datatype)
     return position + total

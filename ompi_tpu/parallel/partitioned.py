@@ -70,7 +70,10 @@ class MeshPartitionedRequest:
 
     def Parrived(self, partition: int) -> bool:
         """Has partition ``partition`` completed on device?"""
-        r = self._parts[int(partition)]
+        p = int(partition)
+        if not 0 <= p < self.partitions:
+            raise MPIError(ERR_ARG, f"partition {p} out of range")
+        r = self._parts[p]
         if r is None:
             return False
         try:

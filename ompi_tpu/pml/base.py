@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import struct
 import threading
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -108,7 +109,7 @@ class UnexpectedFrag:
     """An eager message or RTS that arrived before its receive was posted
     (reference: the unexpected queue of match_one)."""
 
-    __slots__ = ("hdr", "payload")
+    __slots__ = ("hdr", "payload", "_aseq")
 
     def __init__(self, hdr: Header, payload: Optional[bytes]):
         self.hdr = hdr
@@ -116,32 +117,135 @@ class UnexpectedFrag:
 
 
 class MatchingEngine:
-    """Posted-recv and unexpected queues with MPI matching semantics."""
+    """Posted-recv and unexpected queues with MPI matching semantics.
+
+    Hash-bucketed (reference: the vectorized custom match engines of
+    ompi/mca/pml/ob1/custommatch/ — the linear list scan of the base
+    engine is a scale wall at hundreds of pending requests): fully-
+    specified receives and every incoming fragment live in
+    (cid, src, tag)-keyed deques, so an arrival matches in O(1);
+    wildcard receives (ANY_SOURCE / ANY_TAG) ride a separate ordered
+    overflow list. MPI's ordering rule — an arrival matches the
+    EARLIEST-posted eligible receive, a receive matches the earliest-
+    arrived eligible fragment — is kept across the two structures with
+    monotonic posting / arrival sequence numbers: a bucket hit still
+    loses to an older matching wildcard, and vice versa.
+    """
 
     def __init__(self):
         self.lock = threading.RLock()
-        self.posted: List[RecvRequest] = []
-        self.unexpected: List[UnexpectedFrag] = []
+        self._posted_exact: Dict[Tuple[int, int, int], deque] = {}
+        self._posted_wild: List[RecvRequest] = []
+        self._unexpected: Dict[Tuple[int, int, int], deque] = {}
+        self._pseq = 0  # posting order
+        self._aseq = 0  # arrival order
+        self._n_posted = 0
+        self._n_unexpected = 0
+
+    # ------------------------------------------------------------ counters
+    @property
+    def n_posted(self) -> int:
+        return self._n_posted
+
+    @property
+    def n_unexpected(self) -> int:
+        return self._n_unexpected
 
     # Called with lock held -----------------------------------------------
+    def post(self, req: RecvRequest) -> None:
+        req._pseq = self._pseq
+        self._pseq += 1
+        self._n_posted += 1
+        if req.src == ANY_SOURCE or req.tag == ANY_TAG:
+            self._posted_wild.append(req)
+        else:
+            self._posted_exact.setdefault(
+                (req.cid, req.src, req.tag), deque()).append(req)
+
+    def cancel_posted(self, req: RecvRequest) -> bool:
+        """Remove a still-pending posted receive; False if already
+        matched/absent."""
+        if req.matched:
+            return False
+        if req.src == ANY_SOURCE or req.tag == ANY_TAG:
+            try:
+                self._posted_wild.remove(req)
+            except ValueError:
+                return False
+        else:
+            q = self._posted_exact.get((req.cid, req.src, req.tag))
+            if q is None or req not in q:
+                return False
+            q.remove(req)
+            if not q:
+                del self._posted_exact[(req.cid, req.src, req.tag)]
+        self._n_posted -= 1
+        return True
+
     def match_posted(self, hdr: Header) -> Optional[RecvRequest]:
-        for i, req in enumerate(self.posted):
-            if not req.matched and req.matches(hdr):
-                req.matched = True
-                req.status.source = hdr.src
-                req.status.tag = hdr.tag
-                del self.posted[i]
-                return req
-        return None
+        q = self._posted_exact.get((hdr.cid, hdr.src, hdr.tag))
+        exact = q[0] if q else None
+        wild = None
+        for cand in self._posted_wild:
+            if cand.matches(hdr):
+                wild = cand
+                break
+        req = None
+        if exact is not None and (wild is None
+                                  or exact._pseq < wild._pseq):
+            req = q.popleft()
+            if not q:
+                del self._posted_exact[(hdr.cid, hdr.src, hdr.tag)]
+        elif wild is not None:
+            req = wild
+            self._posted_wild.remove(wild)
+        if req is None:
+            return None
+        self._n_posted -= 1
+        req.matched = True
+        req.status.source = hdr.src
+        req.status.tag = hdr.tag
+        return req
+
+    def add_unexpected(self, frag: UnexpectedFrag) -> None:
+        frag._aseq = self._aseq
+        self._aseq += 1
+        self._n_unexpected += 1
+        h = frag.hdr
+        self._unexpected.setdefault((h.cid, h.src, h.tag),
+                                    deque()).append(frag)
 
     def match_unexpected(self, req: RecvRequest,
                          remove: bool = True) -> Optional[UnexpectedFrag]:
-        for i, frag in enumerate(self.unexpected):
-            if req.matches(frag.hdr):
-                if remove:
-                    del self.unexpected[i]
-                return frag
-        return None
+        """Earliest-arrived fragment matching ``req`` (which may carry
+        wildcards — fragments never do)."""
+        if req.src != ANY_SOURCE and req.tag != ANY_TAG:
+            key = (req.cid, req.src, req.tag)
+            q = self._unexpected.get(key)
+            if not q:
+                return None
+            frag = q.popleft() if remove else q[0]
+            if remove:
+                if not q:
+                    del self._unexpected[key]
+                self._n_unexpected -= 1
+            return frag
+        best_key = None
+        best = None
+        for key, q in self._unexpected.items():
+            head = q[0]
+            if (best is None or head._aseq < best._aseq) and \
+                    req.matches(head.hdr):
+                best, best_key = head, key
+        if best is None:
+            return None
+        if remove:
+            q = self._unexpected[best_key]
+            q.popleft()
+            if not q:
+                del self._unexpected[best_key]
+            self._n_unexpected -= 1
+        return best
 
     def find_unexpected(self, src: int, tag: int, cid: int) -> Optional[UnexpectedFrag]:
         probe = RecvRequest(None, 0, None, src, tag, cid)  # matcher only

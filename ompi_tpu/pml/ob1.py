@@ -74,10 +74,10 @@ class Ob1Pml:
         from ompi_tpu.mca.var import register_pvar
 
         register_pvar("pml", "unexpected_queue_length",
-                      lambda: len(self.engine.unexpected),
+                      lambda: self.engine.n_unexpected,
                       help="Unexpected-message queue depth")
         register_pvar("pml", "posted_recv_queue_length",
-                      lambda: len(self.engine.posted),
+                      lambda: self.engine.n_posted,
                       help="Posted-receive queue depth")
 
     # ------------------------------------------------------------- wiring
@@ -210,7 +210,7 @@ class Ob1Pml:
         with self.engine.lock:
             frag = self.engine.match_unexpected(req)
             if frag is None:
-                self.engine.posted.append(req)
+                self.engine.post(req)
                 return req
         # matched an already-arrived message
         self._deliver_matched(req, frag.hdr, frag.payload)
@@ -254,8 +254,7 @@ class Ob1Pml:
 
     def cancel_recv(self, req: RecvRequest) -> bool:
         with self.engine.lock:
-            if req in self.engine.posted:
-                self.engine.posted.remove(req)
+            if self.engine.cancel_posted(req):
                 req.status.cancelled = True
                 req._set_complete(0)
                 return True
@@ -291,7 +290,7 @@ class Ob1Pml:
         with self.engine.lock:
             req = self.engine.match_posted(hdr)
             if req is None:
-                self.engine.unexpected.append(
+                self.engine.add_unexpected(
                     UnexpectedFrag(hdr, bytes(payload)))
                 return
         self._deliver_matched(req, hdr, payload)
@@ -334,7 +333,7 @@ class Ob1Pml:
         with self.engine.lock:
             req = self.engine.match_posted(hdr)
             if req is None:
-                self.engine.unexpected.append(UnexpectedFrag(hdr, None))
+                self.engine.add_unexpected(UnexpectedFrag(hdr, None))
                 return
         self._deliver_matched(req, hdr, None)
 

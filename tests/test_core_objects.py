@@ -210,3 +210,76 @@ def test_alltoallw_singleton_mixed_types():
                          sendcounts=[1], sdispls=[0], sendtypes=[FLOAT64],
                          recvcounts=[1], rdispls=[8], recvtypes=[FLOAT64])
     assert np.frombuffer(recv[8:16].tobytes(), np.float64)[0] == 2.5
+
+
+# ---------------------------------------------------------------------------
+# Hash-bucketed matching engine (reference: pml/ob1/custommatch)
+def _hdr(src, tag, cid, seq=0):
+    from ompi_tpu.pml.base import Header, pack_header, EAGER
+
+    return Header(pack_header(EAGER, src, cid, tag, seq, 0, 0, 0))
+
+
+def test_match_ordering_wildcard_vs_exact():
+    """An arrival must match the EARLIEST-posted eligible receive, even
+    across the exact-bucket/wildcard split."""
+    from ompi_tpu.pml.base import (ANY_SOURCE, ANY_TAG, MatchingEngine,
+                                   RecvRequest)
+
+    eng = MatchingEngine()
+    wild = RecvRequest(None, 0, None, ANY_SOURCE, 7, 0)   # posted first
+    exact = RecvRequest(None, 0, None, 3, 7, 0)           # posted second
+    eng.post(wild)
+    eng.post(exact)
+    got = eng.match_posted(_hdr(3, 7, 0))
+    assert got is wild                                    # older wins
+    got2 = eng.match_posted(_hdr(3, 7, 0))
+    assert got2 is exact
+    assert eng.match_posted(_hdr(3, 7, 0)) is None
+
+    # reversed posting order: the exact bucket wins
+    exact2 = RecvRequest(None, 0, None, 5, 1, 0)
+    wild2 = RecvRequest(None, 0, None, ANY_SOURCE, ANY_TAG, 0)
+    eng.post(exact2)
+    eng.post(wild2)
+    assert eng.match_posted(_hdr(5, 1, 0)) is exact2
+    assert eng.match_posted(_hdr(5, 1, 0)) is wild2
+
+
+def test_unexpected_wildcard_takes_earliest_arrival():
+    from ompi_tpu.pml.base import (ANY_SOURCE, ANY_TAG, MatchingEngine,
+                                   RecvRequest, UnexpectedFrag)
+
+    eng = MatchingEngine()
+    eng.add_unexpected(UnexpectedFrag(_hdr(2, 9, 0), b"second-src"))
+    eng.add_unexpected(UnexpectedFrag(_hdr(1, 9, 0), b"later"))
+    probe = RecvRequest(None, 0, None, ANY_SOURCE, 9, 0)
+    frag = eng.match_unexpected(probe)
+    assert frag.hdr.src == 2                              # earliest arrival
+    frag2 = eng.match_unexpected(probe)
+    assert frag2.hdr.src == 1
+    assert eng.match_unexpected(probe) is None
+    assert eng.n_unexpected == 0
+
+
+def test_matching_scales_to_10k_pending_posts():
+    """10k fully-specified pending receives: each arrival matches in
+    O(1) — the r3 linear scan was quadratic here (VERDICT next #10)."""
+    import time
+
+    from ompi_tpu.pml.base import MatchingEngine, RecvRequest
+
+    eng = MatchingEngine()
+    N = 10_000
+    for i in range(N):
+        eng.post(RecvRequest(None, 0, None, i % 97, i, 0))
+    assert eng.n_posted == N
+    t0 = time.perf_counter()
+    for i in range(N):
+        got = eng.match_posted(_hdr(i % 97, i, 0))
+        assert got is not None and got.tag == i
+    dt = time.perf_counter() - t0
+    assert eng.n_posted == 0
+    # linear-scan behavior was O(N^2) ~ tens of seconds; O(1) per match
+    # finishes in well under a second even on a loaded 1-core host
+    assert dt < 5.0, f"matching degraded: {dt:.1f}s for {N} matches"

@@ -140,3 +140,24 @@ def test_bad_datarep_and_bounds():
     with pytest.raises(MPIError):
         Unpack_external("external32", np.zeros(4, np.uint8), 0,
                         np.zeros(4, np.int32), 4, INT32)
+
+
+def test_external32_struct_declaration_order():
+    """The canonical stream follows TYPEMAP (declaration) order even
+    when displacements are out of order — interop contract."""
+    base_i = from_numpy_dtype(np.int32)
+    base_f = from_numpy_dtype(np.float64)
+    # int32 declared FIRST but placed at disp 8
+    st = base_i.Create_struct([1, 1], [8, 0], [base_i, base_f]).Commit()
+    buf = np.zeros(12, np.uint8)
+    buf[8:] = np.frombuffer(np.array([5], np.int32).tobytes(), np.uint8)
+    buf[:8] = np.frombuffer(np.array([1.5], np.float64).tobytes(),
+                            np.uint8)
+    out = np.zeros(12, np.uint8)
+    Pack_external("external32", buf, 1, st, out, 0)
+    # stream: int32 first (declared first), then the double
+    assert np.frombuffer(bytes(out[:4]), ">i4")[0] == 5
+    assert np.frombuffer(bytes(out[4:]), ">f8")[0] == 1.5
+    back = np.zeros(12, np.uint8)
+    Unpack_external("external32", out, 0, back, 1, st)
+    np.testing.assert_array_equal(back, buf)
