@@ -364,11 +364,13 @@ def bench_host_paths():
     out = {}
     for key, script in (
             ("collsm_allreduce_4MB_vs_pml", "check_smcoll.py"),
-            ("osc_shm_put_1MB_vs_am", "check_osc_shm.py")):
+            ("osc_shm_put_1MB_vs_am", "check_osc_shm.py"),
+            ("stripe_rendezvous_32MB_vs_single", "check_stripe.py")):
         try:
+            ranks = "2" if script == "check_stripe.py" else "4"
             r = subprocess.run(
                 [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np",
-                 "4", f"tests/procmode/{script}"],
+                 ranks, f"tests/procmode/{script}"],
                 capture_output=True, text=True, timeout=240, env=env,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
             m = re.search(r"ratio=([0-9.]+)", r.stdout)

@@ -67,6 +67,10 @@ def node_id() -> str:
 
 
 class SmBtl(Btl):
+    # relative stripe weight for multi-btl rendezvous scheduling
+    # (reference: opal btl_bandwidth; shared memory >> loopback tcp)
+    bandwidth = 8
+
     NAME = "sm"
 
     def __init__(self, deliver: Callable[[bytes, bytes], None],

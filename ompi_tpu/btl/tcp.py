@@ -55,6 +55,8 @@ class _Conn:
 
 
 class TcpBtl(Btl):
+    bandwidth = 1  # stripe weight (reference: opal btl_bandwidth)
+
     NAME = "tcp"
 
     def __init__(self, deliver: Callable[[bytes, bytes], None], my_rank: int):

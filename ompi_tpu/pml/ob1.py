@@ -49,6 +49,15 @@ register_var("pml", "eager_limit", 65536,
 register_var("pml", "frag_size", 1 << 20,
              help="Rendezvous DATA fragment size (reference: the RDMA "
                   "pipeline frag knobs, btl.h:1183-1186)", level=5)
+from ompi_tpu.core.request import _MULTICORE as _MC  # noqa: E402
+
+register_var("pml", "stripe", bool(_MC),
+             help="Stripe large rendezvous DATA across every live "
+                  "transport to the peer by bandwidth weight "
+                  "(reference: pml_ob1_sendreq.c:73 multi-btl "
+                  "scheduling). Default on only with multiple cores: "
+                  "on one core the extra rail just burns the same CPU "
+                  "at a worse per-byte rate (measured 0.64x)", level=5)
 
 
 class Ob1Pml:
@@ -337,6 +346,21 @@ class Ob1Pml:
                 return
         self._deliver_matched(req, hdr, None)
 
+    def _stripe_btls(self, dst: int, nbytes: int):
+        """Transports carrying this rendezvous' DATA frags. Large
+        messages stripe across EVERY live transport to the peer by
+        bandwidth weight (reference: pml_ob1_sendreq.c:73 scheduling
+        over the bml endpoint's btl array; opal btl_bandwidth) — the
+        matching engine completes on byte count, so cross-transport
+        interleave is safe."""
+        primary = self._btl_for(dst)
+        if not get_var("pml", "stripe") or \
+                nbytes < 2 * get_var("pml", "frag_size"):
+            return [primary]
+        btls = [primary] + [b for b in self.fallbacks.get(dst, ())
+                            if b is not primary]
+        return btls
+
     def _incoming_cts(self, hdr: Header) -> None:
         # hdr.offset carries the sender msgid; hdr.msgid the receiver reqid.
         sreq = self._pending_sends.pop(int(hdr.offset), None)
@@ -344,6 +368,10 @@ class Ob1Pml:
             return
         conv = sreq.convertor
         frag_size = get_var("pml", "frag_size")
+        btls = self._stripe_btls(hdr.src, sreq.nbytes)
+        weights = [max(int(getattr(b, "bandwidth", 1)), 1) for b in btls]
+        total_w = sum(weights)
+        credits = [0] * len(btls)
         offset = 0
         try:
             while conv.remaining > 0:
@@ -351,7 +379,23 @@ class Ob1Pml:
                 dhdr = pack_header(RNDV_DATA, self.my_rank, sreq.cid,
                                    sreq.tag, 0, sreq.nbytes, offset,
                                    hdr.msgid)
-                self._send_frame(hdr.src, dhdr, frag)
+                if len(btls) == 1:
+                    self._send_frame(hdr.src, dhdr, frag)
+                else:
+                    # smooth weighted round-robin across the live set
+                    for i, w in enumerate(weights):
+                        credits[i] += w
+                    pick = max(range(len(btls)),
+                               key=lambda i: credits[i])
+                    credits[pick] -= total_w
+                    try:
+                        btls[pick].send(hdr.src, dhdr, frag)
+                    except Exception:
+                        # stripe member died: the failover funnel
+                        # re-drives (and ejects) as usual
+                        self._send_frame(hdr.src, dhdr, frag)
+                        btls = [self._btl_for(hdr.src)]
+                        weights, credits, total_w = [1], [0], 1
                 offset += frag.nbytes
         except MPIError as e:
             # transport died mid-rendezvous: fail the send request so the
@@ -366,16 +410,20 @@ class Ob1Pml:
         req = self._active_recvs.get(hdr.msgid)
         if req is None:
             return
-        conv = req.convertor
-        conv.set_position(int(hdr.offset))
-        conv.unpack_frag(payload)
-        # Completion when every byte landed (frags may arrive in any order
-        # across transports; count via the convertor's high-water mark).
-        if conv.position >= hdr.nbytes and self._recv_done(req, hdr):
-            del self._active_recvs[hdr.msgid]
+        # striped rendezvous interleaves frags across transports (and
+        # their progress contexts): serialize per-message delivery and
+        # complete on BYTE COUNT, not the position high-water mark — a
+        # late middle frag from the slower transport must still land
+        # before completion fires
+        with self.engine.lock:
+            conv = req.convertor
+            conv.set_position(int(hdr.offset))
+            conv.unpack_frag(payload)
+            req._recv_bytes = getattr(req, "_recv_bytes", 0) + \
+                (payload.nbytes if hasattr(payload, "nbytes")
+                 else len(payload))
+            done = req._recv_bytes >= hdr.nbytes
+            if done:
+                del self._active_recvs[hdr.msgid]
+        if done:
             req._set_complete(0)
-
-    def _recv_done(self, req: RecvRequest, hdr: Header) -> bool:
-        # In-order transports (tcp per-connection, self, shm fifo) deliver
-        # sequentially, so position==nbytes ⇔ done.
-        return req.convertor.position >= hdr.nbytes

@@ -1,0 +1,21 @@
+"""Multi-rail rendezvous striping (reference: pml_ob1_sendreq.c:73)."""
+
+import os
+import re
+
+from tests.test_process_mode import run_mpi
+
+
+def test_stripe_procmode_2ranks():
+    r = run_mpi(2, "tests/procmode/check_stripe.py", timeout=160)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("STRIPE-OK") == 2, r.stdout
+    assert r.stdout.count("STRIPE-CORRECT") == 2, r.stdout
+    m = re.search(r"ratio=([0-9.]+)", r.stdout)
+    assert m, r.stdout
+    cores = len(os.sched_getaffinity(0)) \
+        if hasattr(os, "sched_getaffinity") else os.cpu_count()
+    if cores and cores > 1:
+        # two live rails must not be slower than one when they can
+        # actually run in parallel
+        assert float(m.group(1)) >= 1.0, r.stdout
