@@ -130,6 +130,36 @@ class MultiSliceComm:
         mine = combined[self.slice_id * D:(self.slice_id + 1) * D]
         return self.slice.shard(np.ascontiguousarray(mine))
 
+    def alltoall(self, x):
+        """[D, W, ...] per slice (W = world_size chunks per device row)
+        -> [D, W, ...]: chunk j of world position i lands as chunk i of
+        world position j. Two-level: slice-to-slice blocks ride one
+        bridge Alltoall over the DCN; the within-block transpose is
+        driver-local (the single controller already holds the slice's
+        rows)."""
+        from ompi_tpu.runtime import spc
+
+        D = self.slice.world_size
+        S = self.n_slices
+        arr = np.asarray(x)
+        if arr.ndim < 2 or arr.shape[0] != D or \
+                arr.shape[1] != self.world_size:
+            raise MPIError(
+                ERR_ARG,
+                f"alltoall expects [slice_devices={D}, "
+                f"world={self.world_size}, ...], got {tuple(arr.shape)}")
+        # block for target slice t: my rows' chunks t*D..(t+1)*D
+        sendblocks = np.ascontiguousarray(
+            arr.reshape((D, S, D) + arr.shape[2:]).transpose(
+                (1, 0, 2) + tuple(range(3, arr.ndim + 1))))
+        recvblocks = np.zeros_like(sendblocks)  # [S, Dsrc, Dmine, ...]
+        with spc.suppressed():
+            self.bridge.Alltoall(sendblocks, recvblocks)
+        # out[d_mine, s*D + d_src] = recvblocks[s, d_src, d_mine]
+        out = recvblocks.transpose(
+            (2, 0, 1) + tuple(range(3, arr.ndim + 1))).reshape(arr.shape)
+        return self.slice.shard(np.ascontiguousarray(out))
+
     def barrier(self) -> None:
         from ompi_tpu.runtime import spc
 
@@ -137,7 +167,62 @@ class MultiSliceComm:
         with spc.suppressed():
             self.bridge.Barrier()
 
+    # ------------------------------------------ nonblocking (MPI_I*)
+    # The DCN hop is host-blocking, so the I* variants run the whole
+    # two-level schedule on a worker thread (the io/file.py nonblocking
+    # pattern); the returned Request completes when the sharded result
+    # is placed. Single worker: bridge verbs must stay ordered — every
+    # rank dispatches its I* calls in the same program order, and a
+    # second thread could reorder two in-flight bridge collectives.
+    def _ireq(self, fn, *args, **kw):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ompi_tpu.core.request import Request
+
+        if not hasattr(self, "_pool"):
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="multislice-nbc")
+
+        class _FutureRequest(Request):
+            pass
+
+        req = _FutureRequest()
+
+        def run():
+            try:
+                req.result = fn(*args, **kw)
+                req._set_complete(0)
+            except MPIError as e:
+                req._set_complete(e.code)
+
+        self._pool.submit(run)
+        return req
+
+    def iallreduce(self, x, op: _op.Op = _op.SUM):
+        return self._ireq(self.allreduce, x, op)
+
+    def ibcast(self, x, root_slice: int = 0, root: int = 0):
+        return self._ireq(self.bcast, x, root_slice, root)
+
+    def iallgather(self, x):
+        return self._ireq(self.allgather, x)
+
+    def ialltoall(self, x):
+        return self._ireq(self.alltoall, x)
+
+    def ireduce_scatter(self, x, op: _op.Op = _op.SUM):
+        return self._ireq(self.reduce_scatter, x, op)
+
+    def ibarrier(self):
+        return self._ireq(self.barrier)
+
     Allreduce = allreduce
     Bcast = bcast
     Allgather = allgather
+    Alltoall = alltoall
     Barrier = barrier
+    Iallreduce = iallreduce
+    Ibcast = ibcast
+    Iallgather = iallgather
+    Ialltoall = ialltoall
+    Ibarrier = ibarrier
