@@ -82,6 +82,18 @@ def _pick_blocks(tq: int, tk: int) -> Tuple[int, int]:
 
 
 # --------------------------------------------------------------- forward
+def _tile_bounds(kfull, ktri, qi, block_q: int, block_k: int, n_kv: int):
+    """Dynamic KV-tile loop bound for one Q tile: all of them when fully
+    attending, only tiles touching the causal triangle when diagonal,
+    none otherwise. A DYNAMIC fori_loop bound skips irrelevant tiles
+    outright — the r3 kernel wrapped every tile in lax.cond and still
+    paid the full T^2 tile walk."""
+    tri_hi = (qi * block_q + block_q + block_k - 1) // block_k
+    hi = jnp.where(kfull, n_kv, jnp.where(ktri,
+                                          jnp.minimum(tri_hi, n_kv), 0))
+    return hi.astype(jnp.int32)
+
+
 def _fwd_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 block_q: int, block_k: int, n_kv: int, sm_scale: float):
     qi = pl.program_id(1)
@@ -93,19 +105,21 @@ def _fwd_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     base_cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     D = q_ref.shape[-1]
 
-    def compute(i, carry):
-        acc, m, den = carry
+    def scores(i):
         kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.bfloat16)
         vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.bfloat16)
         s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
-        cols = i * block_k + base_cols
-        keep = kfull | (ktri & (cols <= rows))
-        s = jnp.where(keep, s, NEG_BIG)
+        return s, vb
+
+    def accumulate(s, vb, carry):
+        acc, m, den = carry
         m_p = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_p)
+        # no second where: masked entries hold NEG_BIG and every row of
+        # an aligned diagonal tile keeps >= 1 column, so exp underflows
+        # masked entries to exactly 0
         p = jnp.exp(s - m_new)
-        p = jnp.where(keep, p, 0.0)
         alpha = jnp.exp(m - m_new)
         acc = acc * alpha + lax.dot_general(
             p.astype(jnp.bfloat16), vb, (((1,), (0,)), ((), ())),
@@ -114,17 +128,20 @@ def _fwd_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         return acc, m_new, den
 
     def body(i, carry):
-        # skip tiles wholly above the causal diagonal (and everything when
-        # neither flag is set) — the 2x saving causal flash exists for
-        relevant = kfull | (ktri &
-                            (i * block_k <= qi * block_q + block_q - 1))
-        return lax.cond(relevant, lambda c: compute(i, c), lambda c: c,
-                        carry)
+        # one body for every tile: a per-tile lax.cond(full/masked)
+        # measured SLOWER on v5e than just masking (the mask compare is
+        # cheap next to the branch overhead; r4 sweep) — the win comes
+        # from the dynamic loop bound skipping irrelevant tiles
+        s, vb = scores(i)
+        cols = i * block_k + base_cols
+        s = jnp.where(kfull | (cols <= rows), s, NEG_BIG)
+        return accumulate(s, vb, carry)
 
     acc0 = jnp.zeros((block_q, D), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_BIG, jnp.float32)
     den0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, den = lax.fori_loop(0, n_kv, body, (acc0, m0, den0))
+    hi = _tile_bounds(kfull, ktri, qi, block_q, block_k, n_kv)
+    acc, m, den = lax.fori_loop(0, hi, body, (acc0, m0, den0))
     o_ref[0] = acc / jnp.maximum(den, 1e-30)
     lse = jnp.where(den[:, 0] > 0.0, m[:, 0] + jnp.log(den[:, 0]), NEG_BIG)
     # lse rides in an 8-sublane broadcast layout (BH, 8, Tq): a (1, BQ)
@@ -183,14 +200,17 @@ def _dq_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     base_cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     D = q_ref.shape[-1]
 
-    def compute(i, dq):
+    def compute(i, dq, mask: bool):
         kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.bfloat16)
         vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.bfloat16)
         s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
-        cols = i * block_k + base_cols
-        keep = kfull | (ktri & (cols <= rows))
-        p = jnp.where(keep, jnp.exp(s - lse), 0.0)
+        if mask:
+            cols = i * block_k + base_cols
+            s = jnp.where(kfull | (cols <= rows), s, NEG_BIG)
+        # exp(NEG_BIG - lse) underflows to 0: masked entries need no
+        # second where (lse rows are finite wherever a row attends)
+        p = jnp.exp(s - lse)
         dp = lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -199,11 +219,10 @@ def _dq_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                     preferred_element_type=jnp.float32)
 
     def body(i, dq):
-        relevant = kfull | (ktri &
-                            (i * block_k <= qi * block_q + block_q - 1))
-        return lax.cond(relevant, lambda d: compute(i, d), lambda d: d, dq)
+        return compute(i, dq, True)
 
-    dq = lax.fori_loop(0, n_kv, body, jnp.zeros((block_q, D), jnp.float32))
+    hi = _tile_bounds(kfull, ktri, qi, block_q, block_k, n_kv)
+    dq = lax.fori_loop(0, hi, body, jnp.zeros((block_q, D), jnp.float32))
     dq_ref[0] = dq * sm_scale
 
 
@@ -220,7 +239,7 @@ def _dkv_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                                (block_q, block_k), 1)
     D = kb.shape[-1]
 
-    def compute(i, carry):
+    def compute(i, carry, mask: bool):
         dk, dv = carry
         qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.bfloat16)
         dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.bfloat16)
@@ -228,9 +247,10 @@ def _dkv_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
         s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
-        rows = i * block_q + base_rows
-        keep = kfull | (ktri & (cols <= rows))
-        p = jnp.where(keep, jnp.exp(s - lse), 0.0)
+        if mask:
+            rows = i * block_q + base_rows
+            s = jnp.where(kfull | (cols <= rows), s, NEG_BIG)
+        p = jnp.exp(s - lse)  # masked entries underflow to exactly 0
         pb = p.astype(jnp.bfloat16)
         dv = dv + lax.dot_general(pb, dob, (((0,), (0,)), ((), ())),
                                   preferred_element_type=jnp.float32)
@@ -243,14 +263,16 @@ def _dkv_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         return dk, dv
 
     def body(i, carry):
-        relevant = kfull | (ktri &
-                            (i * block_q + block_q - 1 >= ki * block_k))
-        return lax.cond(relevant, lambda c: compute(i, c), lambda c: c,
-                        carry)
+        return compute(i, carry, True)
 
+    # dynamic LOWER bound: q tiles wholly above the diagonal contribute
+    # nothing to this kv tile's dk/dv
+    lo_tri = (ki * block_k) // block_q
+    lo = jnp.where(kfull, 0,
+                   jnp.where(ktri, lo_tri, n_q)).astype(jnp.int32)
     dk0 = jnp.zeros((block_k, D), jnp.float32)
     dv0 = jnp.zeros((block_k, D), jnp.float32)
-    dk, dv = lax.fori_loop(0, n_q, body, (dk0, dv0))
+    dk, dv = lax.fori_loop(lo, n_q, body, (dk0, dv0))
     dk_ref[0] = dk * sm_scale
     dv_ref[0] = dv
 

@@ -10,6 +10,7 @@ def test_two_slices_of_four_devices():
     r = run_mpi(2, "tests/procmode/check_multislice.py", timeout=240)
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("MS-OK") == 2
+    assert "MS-DCN" in r.stdout  # the DCN hop is measured
 
 
 def test_four_slices_of_four_devices():
