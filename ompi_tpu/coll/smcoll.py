@@ -35,8 +35,6 @@ opal_atomic_wmb() at exactly these two points).
 from __future__ import annotations
 
 import mmap
-import os
-import tempfile
 import time
 from typing import Any, Optional
 
@@ -77,6 +75,7 @@ class SmColl(CollModule):
     def __init__(self):
         self._flat = BasicColl()
         self._mm: Optional[mmap.mmap] = None
+        self._seg = None
         self._flags: Optional[np.ndarray] = None  # int64 header view
         self._ticket = 0
         self._half_ticket = [0, 0]  # last ticket using each bcast half
@@ -92,15 +91,13 @@ class SmColl(CollModule):
         hdr = 2 * n * 8 + 64          # arrive[n] + ack[n] lines + pub line
         hdr = (hdr + 4095) & ~4095    # page-align the data area
         size = hdr + n * chunk + 2 * chunk
+        from ompi_tpu.runtime import mpool
+
         with spc.suppressed():
             if comm.rank == 0:
-                d = "/dev/shm" if os.path.isdir("/dev/shm") else None
-                fd, path = tempfile.mkstemp(prefix=f"ompi_tpu_collsm_"
-                                                   f"{comm.cid}_", dir=d)
-                os.ftruncate(fd, size)
-                self._mm = mmap.mmap(fd, size)
-                os.close(fd)
-                msg = path.encode()
+                self._seg = mpool.create_segment(
+                    size, prefix=f"ompi_tpu_collsm_{comm.cid}_")
+                msg = self._seg.path.encode()
                 payload = np.frombuffer(msg, np.uint8)
                 reqs = [comm.pml.isend(payload, len(msg), BYTE,
                                        comm.group.world_rank(r),
@@ -108,7 +105,7 @@ class SmColl(CollModule):
                         for r in range(1, n)]
                 for q in reqs:
                     q.Wait()
-                self._path = path
+                self._path = self._seg.path
             else:
                 buf = np.empty(512, np.uint8)
                 req = comm.pml.irecv(buf, 512, BYTE,
@@ -116,20 +113,18 @@ class SmColl(CollModule):
                                      _TAG_BOOT, _ccid(comm))
                 req.Wait()
                 path = bytes(buf[: req.status._nbytes]).decode()
-                fd = os.open(path, os.O_RDWR)
-                self._mm = mmap.mmap(fd, size)
-                os.close(fd)
+                self._seg = mpool.attach_segment(path, size)
             # all mapped before the creator unlinks (the file then frees
             # itself when the last process exits, crash included)
             self._flat.barrier(comm)
             if comm.rank == 0:
-                os.unlink(path)
+                self._seg.unlink()
+        self._mm = self._seg.mm
         self._n = n
         self._chunk = chunk
         self._hdr = hdr
-        self._flags = np.frombuffer(self._mm, np.int64, hdr // 8)
-        self._data = np.frombuffer(self._mm, np.uint8,
-                                   size - hdr, offset=hdr)
+        self._flags = self._seg.view(0, (hdr // 8) * 8, np.int64)
+        self._data = self._seg.view(hdr, size - hdr)
 
     # arrive[i] at flag index 8*i; ack[i] at 8*(n+i); pub at 8*2n
     def _spin(self, cond) -> None:
@@ -276,8 +271,8 @@ class SmColl(CollModule):
 
     def __del__(self):  # pragma: no cover
         try:
-            if self._mm is not None:
-                self._mm.close()
+            if self._seg is not None:
+                self._seg.close()
         except Exception:
             pass
 

@@ -248,10 +248,6 @@ class Win:
         mixed selection (the han.py:238 lesson). Per-rank sizes are
         allgathered — MPI_Win_allocate permits them to differ.
         """
-        import mmap
-        import os
-        import tempfile
-
         from ompi_tpu.comm.communicator import ProcComm
 
         if hasattr(comm, "_getter"):
@@ -265,6 +261,7 @@ class Win:
         local = node_of is not None and len(set(node_of)) == 1
         from ompi_tpu.coll.basic import COLL_CID_BIT
         from ompi_tpu.core.datatype import BYTE
+        from ompi_tpu.runtime import mpool
 
         ccid = comm.cid | COLL_CID_BIT
         n = comm.size
@@ -279,26 +276,15 @@ class Win:
             slots = [(int(b) + 4095) & ~4095 for b in sizes]
             offs = np.concatenate(([0], np.cumsum(slots))).tolist()
             size = max(int(offs[-1]), 4096)
-            mm = None
+            seg = None
             if comm.rank == 0:
                 path = ""
-                fd = -1
                 try:
-                    d = "/dev/shm" if os.path.isdir("/dev/shm") else None
-                    fd, path = tempfile.mkstemp(
-                        prefix="ompi_tpu_oscshm_", dir=d)
-                    os.ftruncate(fd, size)
-                    mm = mmap.mmap(fd, size)
+                    seg = mpool.create_segment(
+                        size, prefix="ompi_tpu_oscshm_")
+                    path = seg.path
                 except OSError:
-                    if path:
-                        try:
-                            os.unlink(path)
-                        except OSError:
-                            pass
                     path = ""  # announce failure: all fall back together
-                finally:
-                    if fd >= 0:
-                        os.close(fd)
                 msg = np.frombuffer(path.encode() or b"\0", np.uint8)
                 reqs = [comm.pml.isend(msg, msg.nbytes, BYTE,
                                        comm._world_rank(r), _SHM_BOOT_TAG,
@@ -306,7 +292,7 @@ class Win:
                         for r in range(1, n)]
                 for q in reqs:
                     q.Wait()
-                ok = bool(mm)
+                ok = seg is not None
             else:
                 buf = np.empty(512, np.uint8)
                 req = comm.pml.irecv(buf, 512, BYTE, comm._world_rank(0),
@@ -316,20 +302,15 @@ class Win:
                 path = "" if raw == b"\0" else raw.decode()
                 ok = bool(path)
                 if ok:
-                    fd = -1
                     try:
-                        fd = os.open(path, os.O_RDWR)
-                        mm = mmap.mmap(fd, size)
+                        seg = mpool.attach_segment(path, size)
                     except OSError:
                         ok = False
-                    finally:
-                        if fd >= 0:
-                            os.close(fd)
             # every rank reaches this barrier on success AND failure, so
             # the creator can unlink (or all can bail) in step
             comm.Barrier()
-            if comm.rank == 0 and mm is not None:
-                os.unlink(path)
+            if comm.rank == 0 and seg is not None:
+                seg.unlink()
             # re-agree on success so a rank-local open failure (or the
             # creator's empty-path announcement) degrades every rank
             # together to the AM fallback
@@ -337,14 +318,12 @@ class Win:
             comm.Allreduce(np.array([1 if ok else 0], np.int64),
                            agree2, op=_op.MIN)
             if int(agree2[0]) == 0:
-                if mm is not None:
-                    mm.close()
+                if seg is not None:
+                    seg.close()
                 return None
-        self._shm = mm
-        self._peer_bytes = [
-            np.frombuffer(mm, np.uint8, int(sizes[r]), offset=offs[r])
-            for r in range(n)
-        ]
+        self._shm = seg
+        self._peer_bytes = [seg.view(offs[r], int(sizes[r]))
+                            for r in range(n)]
         view = self._peer_bytes[comm.rank]
         view[:] = 0
         return view
@@ -432,11 +411,8 @@ class Win:
             self._peer_bytes = None
             self.buf = np.zeros(0, np.uint8)
             self._bytes = self.buf
-            mm, self._shm = self._shm, None
-            try:
-                mm.close()
-            except BufferError:
-                pass  # user still holds a view: freed at GC instead
+            seg, self._shm = self._shm, None
+            seg.close()
 
     def _send(self, target: int, verb: int, disp: int, count: int,
               dcode: int, opcode: int, req_id: int, body: bytes) -> None:

@@ -1,0 +1,143 @@
+"""mpool/rcache — shared-segment pool and registration cache.
+
+Reference: opal/mca/mpool (memory pools handing out registered regions)
++ opal/mca/rcache (the registration cache that makes repeated
+lookups of the same region free). On TPU hosts there are no NIC
+registrations; what IS repeatedly created, mapped, sliced, and torn
+down are /dev/shm mmap segments — by btl/sm (rings), coll/sm (segment
+collectives), and osc (shared windows). This module owns that dance:
+
+- ``create_segment`` / ``attach_segment``: one place for the
+  mkstemp-ftruncate-mmap (resp. open-mmap) sequence with fd hygiene on
+  every failure path.
+- ``Segment.view(offset, nbytes[, dtype])``: the rcache analog — numpy
+  views over a mapped region are memoized per (offset, nbytes, dtype),
+  so hot paths re-resolving the same slot pay a dict hit instead of a
+  frombuffer construction.
+- a live-segment registry exported as pvars (mpool_segments,
+  mpool_bytes) for observability, mirroring the reference's rcache
+  stats.
+
+Unlink discipline stays with the callers (they know when every peer
+has attached); ``Segment.close`` drops cached views first so the map
+actually releases unless user code still holds one.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import tempfile
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.mca.var import register_pvar
+
+_lock = threading.Lock()
+_live: Dict[int, "Segment"] = {}
+_next_id = [1]
+
+
+class Segment:
+    """One mapped shared-memory region with a view registration cache."""
+
+    def __init__(self, mm: mmap.mmap, path: str, size: int, owner: bool):
+        self.mm = mm
+        self.path = path
+        self.size = size
+        self.owner = owner
+        self._views: Dict[Tuple[int, int, str], np.ndarray] = {}
+        with _lock:
+            self.sid = _next_id[0]
+            _next_id[0] += 1
+            _live[self.sid] = self
+
+    # --------------------------------------------------------- rcache
+    def view(self, offset: int = 0, nbytes: Optional[int] = None,
+             dtype=np.uint8) -> np.ndarray:
+        """Memoized numpy view of [offset, offset+nbytes) as ``dtype``
+        (the rcache hit path: repeated lookups are one dict access)."""
+        if nbytes is None:
+            nbytes = self.size - offset
+        dt = np.dtype(dtype)
+        key = (int(offset), int(nbytes), dt.str)
+        v = self._views.get(key)
+        if v is None:
+            if offset < 0 or offset + nbytes > self.size:
+                raise ValueError(
+                    f"view [{offset}, {offset + nbytes}) outside the "
+                    f"{self.size}-byte segment")
+            count = nbytes // dt.itemsize
+            v = np.frombuffer(self.mm, dt, count, offset=offset)
+            self._views[key] = v
+        return v
+
+    # ------------------------------------------------------ lifecycle
+    def unlink(self) -> None:
+        """Remove the backing file (creator calls this once every peer
+        attached; the kernel frees the memory with the last unmap)."""
+        if self.path:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+            self.path = ""
+
+    def close(self) -> None:
+        with _lock:
+            _live.pop(self.sid, None)
+        self._views.clear()
+        try:
+            self.mm.close()
+        except BufferError:
+            pass  # external views still exported: freed at GC
+
+
+def create_segment(size: int, prefix: str = "ompi_tpu_seg_") -> Segment:
+    """Create + map a new shared segment (the mpool alloc path).
+    Raises OSError on resource exhaustion — fds are closed on every
+    path."""
+    d = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    fd = -1
+    path = ""
+    try:
+        fd, path = tempfile.mkstemp(prefix=prefix, dir=d)
+        os.ftruncate(fd, size)
+        mm = mmap.mmap(fd, size)
+    except OSError:
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        raise
+    finally:
+        if fd >= 0:
+            os.close(fd)
+    return Segment(mm, path, size, owner=True)
+
+
+def attach_segment(path: str, size: int) -> Segment:
+    """Map a peer's segment (the mpool attach path)."""
+    fd = -1
+    try:
+        fd = os.open(path, os.O_RDWR)
+        mm = mmap.mmap(fd, size)
+    finally:
+        if fd >= 0:
+            os.close(fd)
+    return Segment(mm, path, size, owner=False)
+
+
+def stats() -> Tuple[int, int]:
+    with _lock:
+        segs = list(_live.values())
+    return len(segs), sum(s.size for s in segs)
+
+
+register_pvar("mpool", "segments", lambda: stats()[0],
+              help="Live shared-memory segments (rcache stats analog)")
+register_pvar("mpool", "bytes", lambda: stats()[1],
+              help="Bytes mapped across live shared segments")

@@ -1,0 +1,45 @@
+"""mpool/rcache: shared-segment pool + view registration cache
+(reference: opal/mca/mpool + opal/mca/rcache)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.runtime import mpool
+
+
+def test_create_view_cache_and_close():
+    seg = mpool.create_segment(8192)
+    n0, b0 = mpool.stats()
+    assert n0 >= 1 and b0 >= 8192
+    v1 = seg.view(0, 4096)
+    v2 = seg.view(0, 4096)
+    assert v1 is v2  # rcache hit: same registration object
+    v3 = seg.view(4096, 4096, np.int64)
+    assert v3.dtype == np.int64 and v3.size == 512
+    v1[:4] = [1, 2, 3, 4]
+    assert bytes(seg.view(0, 4)) == b"\x01\x02\x03\x04"
+    with pytest.raises(ValueError):
+        seg.view(4096, 8192)  # outside the segment
+    path = seg.path
+    import os
+
+    assert os.path.exists(path)
+    seg.unlink()
+    assert not os.path.exists(path)
+    seg.close()
+    assert mpool.stats()[0] == n0 - 1
+
+
+def test_attach_shares_memory():
+    seg = mpool.create_segment(4096)
+    peer = mpool.attach_segment(seg.path, 4096)
+    seg.view(0, 16)[:] = 7
+    assert np.all(peer.view(0, 16) == 7)
+    seg.unlink()
+    peer.close()
+    seg.close()
+
+
+def test_attach_missing_raises():
+    with pytest.raises(OSError):
+        mpool.attach_segment("/dev/shm/ompi_tpu_does_not_exist", 4096)
