@@ -267,7 +267,7 @@ def bench_mfu():
         tfm.Config(vocab=1024, d_model=128, n_heads=8, n_layers=2,
                    d_ff=512, seq_len=128)
     batch = 32 if on_tpu else 2
-    ksteps = 8 if on_tpu else 2
+    ksteps = 12 if on_tpu else 2
 
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1),
                 ("dp", "sp", "tp"))

@@ -31,6 +31,9 @@ from ompi_tpu.utils.output import get_logger
 
 register_var("btl_tcp", "eager_limit", 1 << 20,
              help="TCP eager/rendezvous threshold in bytes", level=4)
+# default stays loopback for the single-host launcher; multi-host
+# deployments set bind_host (or rely on ifaces.best_local_addr in the
+# wireup card) — reference: btl_tcp_if_include
 register_var("btl_tcp", "bind_host", "127.0.0.1",
              help="Interface to bind/advertise (reference: btl_tcp_if_*)",
              level=4)
@@ -92,9 +95,19 @@ class TcpBtl(Btl):
         addr = self.peers[peer]
         host, port = addr.rsplit(":", 1)
         deadline = time.monotonic() + 30.0
+        # multi-homed hosts: dial from the best-weighted local interface
+        # for this peer (reference: opal/mca/reachable weighted scoring)
+        from ompi_tpu.runtime.ifaces import pick_source
+
+        try:
+            src = pick_source(socket.gethostbyname(host))
+        except OSError:
+            src = None
         while True:
             try:
-                s = socket.create_connection((host, int(port)), timeout=30.0)
+                s = socket.create_connection(
+                    (host, int(port)), timeout=30.0,
+                    source_address=(src, 0) if src else None)
                 break
             except OSError:
                 if time.monotonic() > deadline:

@@ -76,7 +76,14 @@ def _xent_fwd(x, w, targets, chunk_t: int, psum_axes: tuple = ()):
         logits = logits_matmul(xb, w)  # [B, Tc, V]
         m = jnp.max(logits, axis=-1)
         lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1))
-        gold = jnp.take_along_axis(logits, tb[..., None], axis=-1)[..., 0]
+        # gold logit via the gathered embedding ROW (a [B,Tc,D] gather +
+        # rowwise dot), not take_along_axis over the [B,Tc,V] logits —
+        # one fewer full pass over the chunk's largest tensor. Matmul
+        # in the same bf16/f32-accum regime as logits_matmul so the
+        # values agree bit-for-bit in spirit (tested to bf16 tolerance).
+        wrows = w[tb].astype(jnp.bfloat16)  # [B, Tc, D]
+        gold = jnp.einsum("btd,btd->bt", xb.astype(jnp.bfloat16), wrows,
+                          preferred_element_type=jnp.float32)
         return tot + jnp.sum(lse - gold), lse
 
     vzero = x.reshape(-1)[0].astype(jnp.float32) * 0.0

@@ -1,0 +1,38 @@
+"""if/reachable: interface inventory + weighted peer reachability
+(reference: opal/mca/if + opal/mca/reachable)."""
+
+import socket
+
+import pytest
+
+from ompi_tpu.runtime import ifaces
+
+
+def test_list_interfaces_sees_loopback():
+    lst = ifaces.list_interfaces()
+    assert lst, "no interfaces discovered"
+    lo = [i for i in lst if i.loopback]
+    assert lo and lo[0].addr.startswith("127."), lst
+
+
+def test_weight_ordering():
+    lo = ifaces.Iface("lo", "127.0.0.1", "255.0.0.0", True, True)
+    eth = ifaces.Iface("eth0", "10.1.2.3", "255.255.255.0", True, False)
+    down = ifaces.Iface("eth1", "10.9.9.9", "255.255.255.0", False, False)
+    # same subnet wins over routable; loopback only matches loopback
+    assert ifaces.weight(eth, "10.1.2.50") > ifaces.weight(eth, "8.8.8.8")
+    assert ifaces.weight(lo, "127.0.0.1") > 0
+    assert ifaces.weight(lo, "10.1.2.50") == 0
+    assert ifaces.weight(eth, "127.0.0.1") == 0
+    assert ifaces.weight(down, "10.9.9.1") < 0
+
+
+def test_pick_source_loopback_peer():
+    src = ifaces.pick_source("127.0.0.1")
+    assert src is None or src.startswith("127."), src
+
+
+def test_best_local_addr_resolves():
+    addr = ifaces.best_local_addr()
+    assert addr is not None
+    socket.inet_aton(addr)  # parseable IPv4
