@@ -46,6 +46,15 @@ register_var("coll_tuned", "allreduce_segsize", 1 << 20,
              level=6)
 register_var("coll_tuned", "allgather_small_msg", 65536,
              help="Total bytes below which allgather uses bruck", level=6)
+register_var("coll_tuned", "use_dynamic_rules", False,
+             help="Consult the dynamic rules file before the fixed "
+                  "heuristics (reference: coll_tuned_use_dynamic_rules)",
+             level=6)
+register_var("coll_tuned", "dynamic_rules_filename", "",
+             help="Rules file: lines of '<coll> <comm_size_min> "
+                  "<msg_bytes_min> <algorithm>'; the most specific "
+                  "matching rule wins (reference: "
+                  "coll_tuned_dynamic_rules_filename)", level=6)
 
 TAG_TUNED = -30  # dedicated tag inside the collective CID plane
 
@@ -59,6 +68,78 @@ def _msg_bytes(buf) -> int:
     return count * dt.size
 
 
+# --------------------------------------------------- dynamic rule files
+_KNOWN_ALGOS = {
+    "allreduce": ("linear", "recursive_doubling", "ring",
+                  "ring_segmented"),
+    "allgather": ("ring", "bruck"),
+    "reduce": ("linear", "binomial"),
+}
+_rules_cache = {"path": None, "mtime": None, "rules": []}
+
+
+def _load_rules(path: str):
+    """[(coll, comm_size_min, msg_bytes_min, algo)] from the rules file
+    (parsed once per mtime; bad lines are skipped with a warning —
+    reference: ompi_coll_tuned_read_rules_config_file)."""
+    import os
+
+    from ompi_tpu.utils.output import get_logger
+
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return []
+    if _rules_cache["path"] == path and _rules_cache["mtime"] == mtime:
+        return _rules_cache["rules"]
+    rules = []
+    log = get_logger("coll.tuned")
+    try:
+        with open(path) as f:
+            for ln, line in enumerate(f, 1):
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                if len(parts) != 4:
+                    log.warning("rules %s:%d: want 4 fields, got %r",
+                                path, ln, line)
+                    continue
+                coll, cs, ms, algo = parts
+                if algo not in _KNOWN_ALGOS.get(coll, ()):
+                    log.warning("rules %s:%d: unknown %s algorithm %r",
+                                path, ln, coll, algo)
+                    continue
+                try:
+                    rules.append((coll, int(cs), int(ms), algo))
+                except ValueError:
+                    log.warning("rules %s:%d: non-integer bounds in %r",
+                                path, ln, line)
+    except OSError as e:
+        log.warning("cannot read rules file %s: %s", path, e)
+        return []
+    _rules_cache.update(path=path, mtime=mtime, rules=rules)
+    return rules
+
+
+def dynamic_choice(coll: str, comm_size: int, nbytes: int):
+    """The algorithm the dynamic rules select, or None (fall through to
+    the fixed heuristics). Most specific match wins: largest
+    (comm_size_min, msg_bytes_min) pair that is <= the actual values."""
+    if not get_var("coll_tuned", "use_dynamic_rules"):
+        return None
+    path = get_var("coll_tuned", "dynamic_rules_filename")
+    if not path:
+        return None
+    best = None
+    best_key = (-1, -1)
+    for c, cs, ms, algo in _load_rules(path):
+        if c == coll and cs <= comm_size and ms <= nbytes and \
+                (cs, ms) > best_key:
+            best, best_key = algo, (cs, ms)
+    return best
+
+
 class TunedColl(CollModule):
     """Decision slots; inherits nothing — undecided ops fall through to the
     lower-priority basic module via per-slot table selection."""
@@ -67,6 +148,10 @@ class TunedColl(CollModule):
     def allreduce(self, comm, sendbuf, recvbuf, op: _op.Op) -> None:
         choice = get_var("coll_tuned", "allreduce_algorithm")
         nbytes = _msg_bytes(recvbuf)
+        if choice == "auto":
+            dyn = dynamic_choice("allreduce", comm.size, nbytes)
+            if dyn is not None and (op.commutative or dyn == "linear"):
+                choice = dyn
         if choice == "auto":
             if not op.commutative or comm.size == 1:
                 choice = "linear"
@@ -92,6 +177,11 @@ class TunedColl(CollModule):
     # ------------------------------------------------------------ allgather
     def allgather(self, comm, sendbuf, recvbuf) -> None:
         choice = get_var("coll_tuned", "allgather_algorithm")
+        if choice == "auto":
+            total = _msg_bytes(recvbuf)
+            dyn = dynamic_choice("allgather", comm.size, total)
+            if dyn is not None:
+                choice = dyn
         if choice == "auto":
             total = _msg_bytes(recvbuf)
             choice = ("bruck"

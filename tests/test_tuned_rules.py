@@ -1,0 +1,52 @@
+"""coll/tuned dynamic rule files (reference:
+coll_tuned_dynamic_rules_filename / use_dynamic_rules)."""
+
+from ompi_tpu.coll.tuned import dynamic_choice, _load_rules
+from ompi_tpu.mca.var import set_var
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "rules.conf"
+    p.write_text(text)
+    return str(p)
+
+
+def test_most_specific_rule_wins(tmp_path):
+    path = _write(tmp_path, """
+# coll  comm_min  msg_min  algo
+allreduce 2 0       recursive_doubling
+allreduce 2 8192    ring
+allreduce 16 1048576 ring_segmented
+allgather 2 0       bruck
+""")
+    set_var("coll_tuned", "use_dynamic_rules", True)
+    set_var("coll_tuned", "dynamic_rules_filename", path)
+    try:
+        assert dynamic_choice("allreduce", 4, 100) == "recursive_doubling"
+        assert dynamic_choice("allreduce", 4, 10000) == "ring"
+        assert dynamic_choice("allreduce", 32, 2 << 20) == "ring_segmented"
+        assert dynamic_choice("allreduce", 4, 2 << 20) == "ring"
+        assert dynamic_choice("allgather", 4, 10) == "bruck"
+        assert dynamic_choice("reduce", 4, 10) is None  # no rule
+    finally:
+        set_var("coll_tuned", "use_dynamic_rules", False)
+        set_var("coll_tuned", "dynamic_rules_filename", "")
+
+
+def test_bad_lines_and_unknown_algos_skipped(tmp_path):
+    path = _write(tmp_path, """
+allreduce 2 0 warp_drive        # unknown algorithm
+allreduce not_a_number 0 ring
+allgather 2 0 ring
+""")
+    rules = _load_rules(path)
+    assert rules == [("allgather", 2, 0, "ring")]
+
+
+def test_disabled_returns_none(tmp_path):
+    path = _write(tmp_path, "allreduce 2 0 ring\n")
+    set_var("coll_tuned", "dynamic_rules_filename", path)
+    try:
+        assert dynamic_choice("allreduce", 4, 10) is None  # not enabled
+    finally:
+        set_var("coll_tuned", "dynamic_rules_filename", "")
