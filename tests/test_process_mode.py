@@ -28,6 +28,11 @@ def subprocess_env():
           if p and not any("axon" in part for part in p.split(os.sep))]
     env["PYTHONPATH"] = os.pathsep.join([REPO] + pp)
     env["JAX_PLATFORMS"] = "cpu"
+    # rank processes share the persistent XLA compile cache (conftest
+    # only configures the in-process jax; the env var reaches children)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.environ.get("OMPI_TPU_TEST_JAX_CACHE",
+                                  "/tmp/ompi_tpu_jax_cache"))
     return env
 
 
