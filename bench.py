@@ -165,11 +165,17 @@ def bench_dispatch_tax(world):
         jax.block_until_ready(raw(x))
     ta, tb = [], []
     for _ in range(60):
+        # time the DISPATCH only — that is what the tax is — and drain
+        # the queue outside the timed region: block_until_ready itself
+        # costs one tunnel round trip with 100us-10ms load jitter, which
+        # swamped the r4 in-region measurement (149us "overhead" that a
+        # dispatch-only probe put at ~2us)
         t0 = _t.perf_counter()
-        jax.block_until_ready(world.allreduce(x))
+        a = world.allreduce(x)
         t1 = _t.perf_counter()
-        jax.block_until_ready(raw(x))
+        b = raw(x)
         t2 = _t.perf_counter()
+        jax.block_until_ready((a, b))
         ta.append(t1 - t0)
         tb.append(t2 - t1)
     d_ours, d_raw = min(ta), min(tb)
@@ -361,7 +367,11 @@ def bench_host_paths():
     env["PYTHONPATH"] = os.pathsep.join(
         [os.path.dirname(os.path.abspath(__file__))] + pp)
     env["JAX_PLATFORMS"] = "cpu"
-    out = {}
+    cores = len(os.sched_getaffinity(0)) \
+        if hasattr(os, "sched_getaffinity") else os.cpu_count()
+    # single-core hosts serialize both rails/paths: the stripe ratio in
+    # particular only shows its gain with real parallelism
+    out = {"host_cores": cores}
     for key, script in (
             ("collsm_allreduce_4MB_vs_pml", "check_smcoll.py"),
             ("osc_shm_put_1MB_vs_am", "check_osc_shm.py"),
