@@ -80,6 +80,9 @@ class Datatype:
         self.committed = False
         self._byte_map: Optional[np.ndarray] = None
         self._runs: Optional[List[Tuple[int, int]]] = None
+        # construction metadata for Get_envelope/Get_contents
+        # (reference: ompi_datatype_get_args.c); None = predefined/NAMED
+        self._contents: Optional[tuple] = None
 
     # ------------------------------------------------------------------ info
     @property
@@ -135,6 +138,27 @@ class Datatype:
             )
         return self._byte_map
 
+    def Get_envelope(self):
+        """(num_integers, num_addresses, num_datatypes, combiner) —
+        MPI_Type_get_envelope (reference: ompi_datatype_get_args.c)."""
+        if self._contents is None:
+            return 0, 0, 0, "NAMED"
+        comb, ints, addrs, dts = self._contents
+        return len(ints), len(addrs), len(dts), comb
+
+    def Get_contents(self):
+        """(integers, addresses, datatypes) the constructor was called
+        with — MPI_Type_get_contents; errors on NAMED types per MPI."""
+        if self._contents is None:
+            raise MPIError(ERR_ARG,
+                           "Get_contents on a predefined (NAMED) type")
+        comb, ints, addrs, dts = self._contents
+        return list(ints), list(addrs), list(dts)
+
+    def _with_contents(self, comb, ints=(), addrs=(), dts=()):
+        self._contents = (comb, list(ints), list(addrs), list(dts))
+        return self
+
     def Commit(self) -> "Datatype":
         self._compute_byte_map()
         self.committed = True
@@ -159,11 +183,13 @@ class Datatype:
             extent=self.extent * count,
             name=f"contig({count})x{self.name}",
             np_dtype=self.np_dtype if self.is_contiguous else None,
-        )
+        )._with_contents("CONTIGUOUS", [count], [], [self])
 
     def Create_vector(self, count: int, blocklength: int, stride: int) -> "Datatype":
         """stride in units of this type's extent (MPI_Type_vector)."""
-        return self.Create_hvector(count, blocklength, stride * self.extent)
+        t = self.Create_hvector(count, blocklength, stride * self.extent)
+        return t._with_contents("VECTOR", [count, blocklength, stride],
+                                [], [self])
 
     def Create_hvector(self, count: int, blocklength: int, stride_bytes: int) -> "Datatype":
         tm = []
@@ -173,15 +199,21 @@ class Datatype:
                 for d, disp in self.typemap:
                     tm.append((d, base + j * self.extent + disp))
         ub = (count - 1) * stride_bytes + blocklength * self.extent
-        return Datatype(tm, lb=0, extent=ub, name=f"vector{count}x{blocklength}")
+        return Datatype(tm, lb=0, extent=ub,
+                        name=f"vector{count}x{blocklength}")._with_contents(
+            "HVECTOR", [count, blocklength], [stride_bytes], [self])
 
     def Create_indexed(
         self, blocklengths: Sequence[int], displacements: Sequence[int]
     ) -> "Datatype":
         """displacements in units of this type's extent (MPI_Type_indexed)."""
-        return self.Create_hindexed(
+        t = self.Create_hindexed(
             blocklengths, [d * self.extent for d in displacements]
         )
+        return t._with_contents(
+            "INDEXED",
+            [len(blocklengths)] + list(blocklengths) + list(displacements),
+            [], [self])
 
     def Create_hindexed(
         self, blocklengths: Sequence[int], displacements_bytes: Sequence[int]
@@ -195,7 +227,9 @@ class Datatype:
                 for d, disp in self.typemap:
                     tm.append((d, db + j * self.extent + disp))
             ub = max(ub, db + bl * self.extent)
-        return Datatype(tm, lb=0, extent=ub, name="hindexed")
+        return Datatype(tm, lb=0, extent=ub, name="hindexed")._with_contents(
+            "HINDEXED", [len(blocklengths)] + list(blocklengths),
+            list(displacements_bytes), [self])
 
     @staticmethod
     def Create_struct(
@@ -214,7 +248,10 @@ class Datatype:
                     tm.append((d, db + j * t.extent + disp))
             ub = max(ub, db + bl * t.extent)
             lb = db if lb is None else min(lb, db)
-        return Datatype(tm, lb=lb or 0, extent=ub - (lb or 0), name="struct")
+        return Datatype(tm, lb=lb or 0, extent=ub - (lb or 0),
+                        name="struct")._with_contents(
+            "STRUCT", [len(blocklengths)] + list(blocklengths),
+            list(displacements_bytes), list(types))
 
     def Create_subarray(
         self,
@@ -239,15 +276,24 @@ class Datatype:
             for d, disp in self.typemap
         ]
         total = int(np.prod(np.asarray(sizes, dtype=np.int64)))
-        return Datatype(tm, lb=0, extent=total * self.extent, name="subarray")
+        return Datatype(tm, lb=0, extent=total * self.extent,
+                        name="subarray")._with_contents(
+            "SUBARRAY",
+            [len(sizes)] + list(sizes) + list(subsizes) + list(starts),
+            [], [self])
 
     def Create_resized(self, lb: int, extent: int) -> "Datatype":
         return Datatype(self.typemap, lb=lb, extent=extent,
-                        name=f"resized:{self.name}", np_dtype=self.np_dtype)
+                        name=f"resized:{self.name}",
+                        np_dtype=self.np_dtype)._with_contents(
+            "RESIZED", [], [lb, extent], [self])
 
     def Dup(self) -> "Datatype":
-        return Datatype(self.typemap, lb=self.lb, extent=self.extent,
-                        name=self.name, np_dtype=self.np_dtype)
+        t = Datatype(self.typemap, lb=self.lb, extent=self.extent,
+                     name=self.name, np_dtype=self.np_dtype)
+        t._contents = self._contents if self._contents is None else \
+            ("DUP", [], [], [self])
+        return t
 
 
 # --------------------------------------------------------------- predefined

@@ -157,3 +157,35 @@ def test_hvector_gap_layout():
     src = np.arange(16, dtype=np.uint8)
     got = cv.pack(src, 1, t)
     np.testing.assert_array_equal(got, [0, 1, 2, 8, 9, 10])
+
+
+def test_envelope_and_contents():
+    """MPI_Type_get_envelope / get_contents (reference:
+    ompi_datatype_get_args.c)."""
+    import pytest
+
+    from ompi_tpu import INT32, MPIError
+
+    assert INT32.Get_envelope() == (0, 0, 0, "NAMED")
+    with pytest.raises(MPIError):
+        INT32.Get_contents()
+
+    vec = INT32.Create_vector(3, 2, 4)
+    ni, na, nd, comb = vec.Get_envelope()
+    assert comb == "VECTOR" and (ni, na, nd) == (3, 0, 1)
+    ints, addrs, dts = vec.Get_contents()
+    assert ints == [3, 2, 4] and addrs == [] and dts[0] is INT32
+
+    st = INT32.Create_struct([1, 2], [0, 8], [INT32, INT32])
+    ni, na, nd, comb = st.Get_envelope()
+    assert comb == "STRUCT" and nd == 2
+    ints, addrs, _ = st.Get_contents()
+    assert ints == [2, 1, 2] and addrs == [0, 8]
+
+    dup = vec.Dup()
+    assert dup.Get_envelope()[3] == "DUP"
+    assert dup.Get_contents()[2][0] is vec
+
+    sub = INT32.Create_subarray([4, 4], [2, 2], [1, 1])
+    assert sub.Get_envelope()[3] == "SUBARRAY"
+    assert sub.Get_contents()[0] == [2, 4, 4, 2, 2, 1, 1]

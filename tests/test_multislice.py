@@ -3,6 +3,10 @@
 Reference: ompi/mca/coll/han applied to mesh mode — slice-local XLA
 collective + leader exchange over the host btl + slice placement."""
 
+import os
+
+import pytest
+
 from tests.test_process_mode import run_mpi
 
 
@@ -13,6 +17,10 @@ def test_two_slices_of_four_devices():
     assert "MS-DCN" in r.stdout  # the DCN hop is measured
 
 
+@pytest.mark.skipif(not os.environ.get("OMPI_TPU_TEST_SOAK"),
+                    reason="soak variant (set OMPI_TPU_TEST_SOAK=1): "
+                           "the 2-slice test covers the mechanism; 4 "
+                           "slices quadruples the compile bill")
 def test_four_slices_of_four_devices():
     r = run_mpi(4, "tests/procmode/check_multislice.py", timeout=240)
     assert r.returncode == 0, r.stdout + r.stderr
