@@ -32,10 +32,32 @@ def _as_byte_view(buf) -> np.ndarray:
     return np.frombuffer(mv, dtype=np.uint8)
 
 
+# native runs engine (opal_pack_general.c analog): plain memcpy over the
+# datatype's coalesced runs — no 8x index-matrix materialization. Worth
+# the two ctypes array handoffs above this payload size; numpy below it.
+_NATIVE_MIN_BYTES = 4096
+
+
+def _runs_arrays(datatype: Datatype):
+    arrs = getattr(datatype, "_run_arrays", None)
+    if arrs is None:
+        runs = datatype._compute_runs()
+        arrs = (np.array([o for o, _ in runs], np.int64),
+                np.array([n for _, n in runs], np.int64))
+        datatype._run_arrays = arrs
+    return arrs
+
+
+def _native_lib():
+    from ompi_tpu.native import get_lib
+
+    return get_lib()
+
+
 def pack(buf, count: int, datatype: Datatype) -> np.ndarray:
     """Pack `count` elements of `datatype` from `buf` into a dense uint8
     array (the wire format). Contiguous fast path is a zero-copy view when
-    possible."""
+    possible; large derived types run the native runs engine."""
     src = _as_byte_view(buf)
     need = (count - 1) * datatype.extent + datatype.true_lb + datatype.true_extent
     if count and src.nbytes < need:
@@ -43,6 +65,19 @@ def pack(buf, count: int, datatype: Datatype) -> np.ndarray:
                        f"buffer too small: {src.nbytes} < {need}")
     if datatype.is_contiguous:
         return src[: count * datatype.size]
+    total = count * datatype.size
+    if total >= _NATIVE_MIN_BYTES and src.flags.c_contiguous:
+        lib = _native_lib()
+        if lib is not None:
+            import ctypes
+
+            off, ln = _runs_arrays(datatype)
+            out = np.empty(total, np.uint8)
+            lib.ompi_tpu_pack_runs(
+                src.ctypes.data, out.ctypes.data,
+                off.ctypes.data, ln.ctypes.data,
+                len(off), count, datatype.extent)
+            return out
     bm = datatype._compute_byte_map()
     # element origins x per-element byte map → full gather index
     origins = np.arange(count, dtype=np.int64) * datatype.extent
@@ -61,6 +96,16 @@ def unpack(packed, buf, count: int, datatype: Datatype) -> None:
     if datatype.is_contiguous:
         dst[:total] = src[:total]
         return
+    if total >= _NATIVE_MIN_BYTES and src.flags.c_contiguous and \
+            dst.flags.c_contiguous and dst.flags.writeable:
+        lib = _native_lib()
+        if lib is not None:
+            off, ln = _runs_arrays(datatype)
+            lib.ompi_tpu_unpack_runs(
+                src.ctypes.data, dst.ctypes.data,
+                off.ctypes.data, ln.ctypes.data,
+                len(off), count, datatype.extent)
+            return
     bm = datatype._compute_byte_map()
     origins = np.arange(count, dtype=np.int64) * datatype.extent
     idx = (origins[:, None] + bm[None, :]).reshape(-1)

@@ -21,7 +21,8 @@ from typing import Optional
 from ompi_tpu.utils.output import get_logger
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "sm_ring.cpp")
+_SRCS = [os.path.join(_HERE, "sm_ring.cpp"),
+         os.path.join(_HERE, "convertor.cpp")]
 _SO = os.path.join(_HERE, "_ompi_tpu_native.so")
 
 _lock = threading.Lock()
@@ -39,7 +40,7 @@ def _build() -> bool:
     try:
         subprocess.run(
             ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-             "-o", tmp, _SRC],
+             "-o", tmp] + _SRCS,
             check=True, capture_output=True, text=True, timeout=120,
         )
         os.rename(tmp, _SO)
@@ -62,7 +63,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_tried:
             return _lib
         _lib_tried = True
-        src_mtime = os.path.getmtime(_SRC)
+        src_mtime = max(os.path.getmtime(p) for p in _SRCS)
         if not os.path.exists(_SO) or os.path.getmtime(_SO) < src_mtime:
             if not _build():
                 return None
@@ -87,6 +88,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.smr_peek.argtypes = [ctypes.c_void_p,
                                  ctypes.POINTER(ctypes.c_uint64)]
         lib.smr_advance.restype = None
+        for fn in (lib.ompi_tpu_pack_runs, lib.ompi_tpu_unpack_runs):
+            fn.restype = None
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.c_void_p,
+                           ctypes.c_int64, ctypes.c_int64,
+                           ctypes.c_int64]
         lib.smr_advance.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.smr_used.restype = ctypes.c_uint64
         lib.smr_used.argtypes = [ctypes.c_void_p]

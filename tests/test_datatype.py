@@ -189,3 +189,31 @@ def test_envelope_and_contents():
     sub = INT32.Create_subarray([4, 4], [2, 2], [1, 1])
     assert sub.Get_envelope()[3] == "SUBARRAY"
     assert sub.Get_contents()[0] == [2, 4, 4, 2, 2, 1, 1]
+
+
+def test_native_pack_matches_numpy_paths():
+    """The C runs engine (native/convertor.cpp) and the numpy byte-map
+    path must agree bit-for-bit; both roundtrip."""
+    import numpy as np
+
+    from ompi_tpu.core import convertor as cv
+    from ompi_tpu.core.datatype import from_numpy_dtype
+
+    base = from_numpy_dtype(np.float64)
+    vec = base.Create_vector(2048, 2, 4).Commit()
+    src = np.arange(2048 * 4 + 8, dtype=np.float64)
+    saved = cv._NATIVE_MIN_BYTES
+    try:
+        cv._NATIVE_MIN_BYTES = 1  # force native (when the lib built)
+        p_native = np.array(cv.pack(src, 1, vec))
+        cv._NATIVE_MIN_BYTES = 1 << 60  # force numpy
+        p_np = np.array(cv.pack(src, 1, vec))
+        np.testing.assert_array_equal(p_native, p_np)
+        out_a = np.zeros_like(src)
+        out_b = np.zeros_like(src)
+        cv.unpack(p_np, out_a, 1, vec)
+        cv._NATIVE_MIN_BYTES = 1
+        cv.unpack(p_np, out_b, 1, vec)
+        np.testing.assert_array_equal(out_a, out_b)
+    finally:
+        cv._NATIVE_MIN_BYTES = saved
