@@ -291,9 +291,10 @@ class Datatype:
     def Dup(self) -> "Datatype":
         t = Datatype(self.typemap, lb=self.lb, extent=self.extent,
                      name=self.name, np_dtype=self.np_dtype)
-        t._contents = self._contents if self._contents is None else \
-            ("DUP", [], [], [self])
-        return t
+        # MPI_Type_dup always reports COMBINER_DUP — including dups of
+        # predefined types (reference: ompi_datatype_get_args.c records
+        # DUP args unconditionally)
+        return t._with_contents("DUP", [], [], [self])
 
 
 # --------------------------------------------------------------- predefined

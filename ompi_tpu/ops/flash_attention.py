@@ -200,14 +200,13 @@ def _dq_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     base_cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     D = q_ref.shape[-1]
 
-    def compute(i, dq, mask: bool):
+    def compute(i, dq):
         kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.bfloat16)
         vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.bfloat16)
         s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
-        if mask:
-            cols = i * block_k + base_cols
-            s = jnp.where(kfull | (cols <= rows), s, NEG_BIG)
+        cols = i * block_k + base_cols
+        s = jnp.where(kfull | (cols <= rows), s, NEG_BIG)
         # exp(NEG_BIG - lse) underflows to 0: masked entries need no
         # second where (lse rows are finite wherever a row attends)
         p = jnp.exp(s - lse)
@@ -218,8 +217,7 @@ def _dq_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                     (((1,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32)
 
-    def body(i, dq):
-        return compute(i, dq, True)
+    body = compute
 
     hi = _tile_bounds(kfull, ktri, qi, block_q, block_k, n_kv)
     dq = lax.fori_loop(0, hi, body, jnp.zeros((block_q, D), jnp.float32))
@@ -239,7 +237,7 @@ def _dkv_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                                (block_q, block_k), 1)
     D = kb.shape[-1]
 
-    def compute(i, carry, mask: bool):
+    def compute(i, carry):
         dk, dv = carry
         qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.bfloat16)
         dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.bfloat16)
@@ -247,9 +245,8 @@ def _dkv_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
         s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
-        if mask:
-            rows = i * block_q + base_rows
-            s = jnp.where(kfull | (cols <= rows), s, NEG_BIG)
+        rows = i * block_q + base_rows
+        s = jnp.where(kfull | (cols <= rows), s, NEG_BIG)
         p = jnp.exp(s - lse)  # masked entries underflow to exactly 0
         pb = p.astype(jnp.bfloat16)
         dv = dv + lax.dot_general(pb, dob, (((0,), (0,)), ((), ())),
@@ -262,8 +259,7 @@ def _dkv_kernel(kf_ref, kt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                   preferred_element_type=jnp.float32)
         return dk, dv
 
-    def body(i, carry):
-        return compute(i, carry, True)
+    body = compute
 
     # dynamic LOWER bound: q tiles wholly above the diagonal contribute
     # nothing to this kv tile's dk/dv

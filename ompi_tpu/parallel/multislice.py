@@ -70,7 +70,7 @@ class MultiSliceComm:
             self.bridge.Allreduce(np.ascontiguousarray(row), out, op=op)
         return out
 
-    def allreduce(self, x, op: _op.Op = _op.SUM):
+    def _do_allreduce(self, x, op: _op.Op = _op.SUM):
         """[D, ...] per slice -> every device of every slice holds the
         global reduction (han two-level: reduce/ICI, exchange/DCN,
         bcast/ICI)."""
@@ -81,7 +81,7 @@ class MultiSliceComm:
             combined, (self.slice.world_size,) + combined.shape)
         return self.slice.shard(np.ascontiguousarray(full))  # ICI place
 
-    def bcast(self, x, root_slice: int = 0, root: int = 0):
+    def _do_bcast(self, x, root_slice: int = 0, root: int = 0):
         """Broadcast device-row ``root`` of slice ``root_slice`` to
         every device of every slice."""
         from ompi_tpu.runtime import spc
@@ -99,7 +99,7 @@ class MultiSliceComm:
                                (self.slice.world_size,) + row.shape)
         return self.slice.shard(np.ascontiguousarray(full))
 
-    def allgather(self, x):
+    def _do_allgather(self, x):
         """[D, ...] per slice -> [D, S*D, ...]: every device row holds
         all S*D contributions, slice-major (slice id, device pos)."""
         from ompi_tpu.runtime import spc
@@ -115,7 +115,7 @@ class MultiSliceComm:
             flat, (self.slice.world_size,) + flat.shape)
         return self.slice.shard(np.ascontiguousarray(full))
 
-    def reduce_scatter(self, x, op: _op.Op = _op.SUM):
+    def _do_reduce_scatter(self, x, op: _op.Op = _op.SUM):
         """[D, ...] -> each device row d of slice s holds the global
         reduction of block index s*D + d (block layout over the row's
         leading dim, which must equal world_size)."""
@@ -130,7 +130,7 @@ class MultiSliceComm:
         mine = combined[self.slice_id * D:(self.slice_id + 1) * D]
         return self.slice.shard(np.ascontiguousarray(mine))
 
-    def alltoall(self, x):
+    def _do_alltoall(self, x):
         """[D, W, ...] per slice (W = world_size chunks per device row)
         -> [D, W, ...]: chunk j of world position i lands as chunk i of
         world position j. Two-level: slice-to-slice blocks ride one
@@ -160,7 +160,7 @@ class MultiSliceComm:
             (2, 0, 1) + tuple(range(3, arr.ndim + 1))).reshape(arr.shape)
         return self.slice.shard(np.ascontiguousarray(out))
 
-    def barrier(self) -> None:
+    def _do_barrier(self) -> None:
         from ompi_tpu.runtime import spc
 
         self.slice.barrier()
@@ -172,8 +172,10 @@ class MultiSliceComm:
     # two-level schedule on a worker thread (the io/file.py nonblocking
     # pattern); the returned Request completes when the sharded result
     # is placed. Single worker: bridge verbs must stay ordered — every
-    # rank dispatches its I* calls in the same program order, and a
-    # second thread could reorder two in-flight bridge collectives.
+    # rank dispatches its calls in the same program order, and a second
+    # thread could reorder two in-flight bridge collectives. BLOCKING
+    # verbs funnel through the SAME worker queue (submit + Wait), so a
+    # blocking call issued while an I* is in flight cannot overtake it.
     def _ireq(self, fn, *args, **kw):
         from concurrent.futures import ThreadPoolExecutor
 
@@ -189,32 +191,64 @@ class MultiSliceComm:
         req = _FutureRequest()
 
         def run():
+            from ompi_tpu.core.errors import ERR_INTERN
+
             try:
                 req.result = fn(*args, **kw)
                 req._set_complete(0)
             except MPIError as e:
                 req._set_complete(e.code)
+            except Exception:  # noqa: BLE001 — a swallowed worker
+                # exception would leave Wait() spinning forever
+                req._set_complete(ERR_INTERN)
 
         self._pool.submit(run)
         return req
 
     def iallreduce(self, x, op: _op.Op = _op.SUM):
-        return self._ireq(self.allreduce, x, op)
+        return self._ireq(self._do_allreduce, x, op)
 
     def ibcast(self, x, root_slice: int = 0, root: int = 0):
-        return self._ireq(self.bcast, x, root_slice, root)
+        return self._ireq(self._do_bcast, x, root_slice, root)
 
     def iallgather(self, x):
-        return self._ireq(self.allgather, x)
+        return self._ireq(self._do_allgather, x)
 
     def ialltoall(self, x):
-        return self._ireq(self.alltoall, x)
+        return self._ireq(self._do_alltoall, x)
 
     def ireduce_scatter(self, x, op: _op.Op = _op.SUM):
-        return self._ireq(self.reduce_scatter, x, op)
+        return self._ireq(self._do_reduce_scatter, x, op)
 
     def ibarrier(self):
-        return self._ireq(self.barrier)
+        return self._ireq(self._do_barrier)
+
+    def _ordered(self, fn, *args, **kw):
+        """Run a blocking verb through the worker queue so it cannot
+        overtake an in-flight nonblocking one (cross-rank bridge
+        collectives match by program order)."""
+        req = self._ireq(fn, *args, **kw)
+        req.Wait()
+        return req.result
+
+    # public blocking verbs: same worker queue as the I* variants
+    def allreduce(self, x, op: _op.Op = _op.SUM):
+        return self._ordered(self._do_allreduce, x, op)
+
+    def bcast(self, x, root_slice: int = 0, root: int = 0):
+        return self._ordered(self._do_bcast, x, root_slice, root)
+
+    def allgather(self, x):
+        return self._ordered(self._do_allgather, x)
+
+    def reduce_scatter(self, x, op: _op.Op = _op.SUM):
+        return self._ordered(self._do_reduce_scatter, x, op)
+
+    def alltoall(self, x):
+        return self._ordered(self._do_alltoall, x)
+
+    def barrier(self) -> None:
+        self._ordered(self._do_barrier)
 
     Allreduce = allreduce
     Bcast = bcast

@@ -103,15 +103,18 @@ def weight(iface: Iface, peer_addr: str) -> int:
 
 
 def pick_source(peer_addr: str) -> Optional[str]:
-    """Best local source address for dialing ``peer_addr``, or None to
-    let the kernel route (single-homed hosts, resolution failures)."""
+    """Local source address for dialing ``peer_addr``, or None to let
+    the kernel route. Pins ONLY on a confident match — same subnet, or
+    loopback-to-loopback: for an off-subnet peer every routable
+    interface ties and an arbitrary pin (e.g. a container bridge) can
+    blackhole the SYN where the kernel's route would work."""
     best = None
     best_w = 0
     for iface in list_interfaces():
         w = weight(iface, peer_addr)
         if w > best_w:
             best, best_w = iface.addr, w
-    return best
+    return best if best_w in (400, 200) else None
 
 
 def best_local_addr() -> Optional[str]:

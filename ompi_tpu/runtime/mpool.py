@@ -69,6 +69,10 @@ class Segment:
                 raise ValueError(
                     f"view [{offset}, {offset + nbytes}) outside the "
                     f"{self.size}-byte segment")
+            if nbytes % dt.itemsize:
+                raise ValueError(
+                    f"view of {nbytes} bytes is not a whole number of "
+                    f"{dt} elements")
             count = nbytes // dt.itemsize
             v = np.frombuffer(self.mm, dt, count, offset=offset)
             self._views[key] = v

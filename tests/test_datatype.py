@@ -185,6 +185,11 @@ def test_envelope_and_contents():
     dup = vec.Dup()
     assert dup.Get_envelope()[3] == "DUP"
     assert dup.Get_contents()[2][0] is vec
+    from ompi_tpu import INT64
+
+    d2 = INT64.Dup()  # dup of a NAMED type still reports DUP (MPI)
+    assert d2.Get_envelope()[3] == "DUP"
+    assert d2.Get_contents()[2][0] is INT64
 
     sub = INT32.Create_subarray([4, 4], [2, 2], [1, 1])
     assert sub.Get_envelope()[3] == "SUBARRAY"
