@@ -16,6 +16,7 @@ across *multiple* btls; with one link per peer ordering is structural).
 from __future__ import annotations
 
 import errno
+import os
 import selectors
 import socket
 import struct
@@ -31,11 +32,12 @@ from ompi_tpu.utils.output import get_logger
 
 register_var("btl_tcp", "eager_limit", 1 << 20,
              help="TCP eager/rendezvous threshold in bytes", level=4)
-# default stays loopback for the single-host launcher; multi-host
-# deployments set bind_host (or rely on ifaces.best_local_addr in the
-# wireup card) — reference: btl_tcp_if_include
-register_var("btl_tcp", "bind_host", "127.0.0.1",
-             help="Interface to bind/advertise (reference: btl_tcp_if_*)",
+# empty = auto: loopback for single-host jobs, all-interfaces bound +
+# best non-loopback address advertised when the launcher flags a
+# multi-host job (OMPI_TPU_MULTIHOST) — reference: btl_tcp_if_include
+register_var("btl_tcp", "bind_host", "",
+             help="Interface to bind/advertise (empty=auto; "
+                  "reference: btl_tcp_if_*)",
              level=4)
 
 _LEN = struct.Struct("<I")
@@ -68,12 +70,27 @@ class TcpBtl(Btl):
         self.my_rank = my_rank
         self.log = get_logger("btl.tcp")
         host = get_var("btl_tcp", "bind_host")
+        if not host:
+            if os.environ.get("OMPI_TPU_MULTIHOST"):
+                host = "0.0.0.0"
+            else:
+                host = "127.0.0.1"
+        bind = host
+        if host == "0.0.0.0":
+            # listen everywhere, advertise the best-scored non-loopback
+            # address in the modex card (reference: opal/mca/reachable —
+            # the endpoint blob carries routable addresses, see
+            # ifaces.best_local_addr)
+            from ompi_tpu.runtime.ifaces import best_local_addr
+
+            host = best_local_addr() or "127.0.0.1"
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.listener.bind((host, 0))
+        self.listener.bind((bind, 0))
         self.listener.listen(64)
         self.listener.setblocking(False)
-        self.host, self.port = self.listener.getsockname()
+        self.host = host
+        self.port = self.listener.getsockname()[1]
         self.peers: Dict[int, str] = {}
         self.conns: Dict[int, _Conn] = {}  # peer rank -> connection
         self._conn_lock = threading.Lock()

@@ -117,11 +117,36 @@ def pick_source(peer_addr: str) -> Optional[str]:
     return best if best_w in (400, 200) else None
 
 
+# interface-name prefixes that are almost never the fabric NIC
+# (container bridges, virt taps, VPN tunnels) — deprioritized when no
+# default route disambiguates (reference: btl_tcp_if_exclude defaults)
+_VIRTUAL_PREFIXES = ("docker", "virbr", "veth", "br-", "tun", "tap",
+                    "vnet", "wg")
+
+
 def best_local_addr() -> Optional[str]:
-    """The address to publish in the modex card: highest-weighted
-    non-loopback up interface, else loopback."""
+    """The address to publish in the modex card.
+
+    Primary signal: the source address the kernel's default route would
+    use (a connected UDP socket sends no packets — this is a pure route
+    lookup). Fallback when there is no default route: the first up
+    non-loopback interface whose name doesn't look like a container
+    bridge/VPN (if_nameindex order is NOT priority order — a dev box
+    often enumerates docker0 before the fabric NIC)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("203.0.113.1", 9))  # TEST-NET-3: never sent to
+            addr = s.getsockname()[0]
+        if not addr.startswith("127."):
+            return addr
+    except OSError:
+        pass
     ifaces = [i for i in list_interfaces() if i.up]
-    for i in ifaces:
-        if not i.loopback:
-            return i.addr
-    return ifaces[0].addr if ifaces else None
+    physical = [i for i in ifaces if not i.loopback
+                and not i.name.startswith(_VIRTUAL_PREFIXES)]
+    virtual = [i for i in ifaces if not i.loopback
+               and i.name.startswith(_VIRTUAL_PREFIXES)]
+    for pool in (physical, virtual, ifaces):
+        if pool:
+            return pool[0].addr
+    return None

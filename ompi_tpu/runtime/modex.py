@@ -35,8 +35,13 @@ from ompi_tpu.utils.output import get_logger
 class ModexServer:
     """Runs inside the launcher (reference analog: prted's PMIx server)."""
 
-    def __init__(self, size: int, host: str = "127.0.0.1"):
+    def __init__(self, size: int, host: str = "127.0.0.1",
+                 advertise: Optional[str] = None):
+        # `advertise` overrides the address ranks are told to dial —
+        # needed when binding 0.0.0.0 for off-host ranks (reference: the
+        # PMIx server URI prted publishes is a routable address)
         self.size = size
+        self.advertise = advertise
         self.kv: Dict[Tuple[int, str], Any] = {}
         self.kv_cond = threading.Condition()
         # per-job fence domains; job 0 is the initial world
@@ -60,7 +65,7 @@ class ModexServer:
 
     @property
     def address(self) -> str:
-        return f"{self.host}:{self.port}"
+        return f"{self.advertise or self.host}:{self.port}"
 
     def _accept_loop(self) -> None:
         self.sock.settimeout(0.2)

@@ -7,8 +7,16 @@ process per rank with the launch-contract env:
 
     OMPI_TPU_RANK, OMPI_TPU_SIZE, OMPI_TPU_MODEX
 
+Multi-host jobs (reference: prte's plm/ssh daemon launch): ``--hostfile``
+or ``--host`` place ranks onto nodes; remote ranks are started through a
+pluggable launch agent (``--launch-agent``, default ssh — the
+plm_ssh_agent analog; ``fake`` is the in-tree CI shim) with the launch
+contract marshalled into the remote command line, and the modex server
+listens on all interfaces advertising its best non-loopback address.
+
 Usage:
     python -m ompi_tpu.tools.mpirun -np 4 [--mca k v]... script.py [args...]
+    python -m ompi_tpu.tools.mpirun -np 4 --host n1:2,n2:2 script.py
 """
 
 from __future__ import annotations
@@ -18,8 +26,9 @@ import os
 import signal
 import subprocess
 import sys
-from typing import List
+from typing import List, Optional
 
+from ompi_tpu.runtime import plm
 from ompi_tpu.runtime.modex import ModexServer
 
 
@@ -32,6 +41,14 @@ def main(argv: List[str] | None = None) -> int:
                         help="set an MCA variable (framework_name value)")
     parser.add_argument("--timeout", type=float, default=600.0,
                         help="job wall-clock limit in seconds")
+    parser.add_argument("--hostfile", "--machinefile", default=None,
+                        help="hostfile: one 'node [slots=N]' per line")
+    parser.add_argument("--host", "-H", default=None,
+                        help="inline host list: n1[:slots],n2[:slots]")
+    parser.add_argument("--launch-agent", default="ssh",
+                        help="remote-exec agent for non-local hosts "
+                             "(argv contract: AGENT HOST COMMAND; 'fake' "
+                             "= in-tree local shim for CI)")
     parser.add_argument("--with-tpu", action="store_true",
                         help="let ranks claim TPU devices (default: ranks "
                              "are host-only; the device path belongs to "
@@ -40,10 +57,31 @@ def main(argv: List[str] | None = None) -> int:
     parser.add_argument("args", nargs=argparse.REMAINDER)
     opts = parser.parse_args(argv)
 
-    server = ModexServer(opts.np)
+    placement: Optional[List[str]] = None
+    if opts.hostfile:
+        placement = plm.assign_ranks(plm.parse_hostfile(opts.hostfile),
+                                     opts.np)
+    elif opts.host:
+        placement = plm.assign_ranks(plm.parse_host_list(opts.host),
+                                     opts.np)
+
+    multihost = placement is not None and any(
+        not plm.is_local(h) for h in placement)
+    if multihost:
+        # remote ranks dial back over the network: listen everywhere,
+        # advertise the best non-loopback address (if/reachable analog)
+        from ompi_tpu.runtime.ifaces import best_local_addr
+
+        adv = best_local_addr() or "127.0.0.1"
+        server = ModexServer(opts.np, host="0.0.0.0", advertise=adv)
+    else:
+        server = ModexServer(opts.np)
     env_base = dict(os.environ)
     env_base["OMPI_TPU_SIZE"] = str(opts.np)
     env_base["OMPI_TPU_MODEX"] = server.address
+    if multihost:
+        # ranks bind/advertise their own non-loopback addresses too
+        env_base["OMPI_TPU_MULTIHOST"] = "1"
     # ranks run `python script.py`, which puts the script's dir (not our
     # cwd) on sys.path — propagate the launcher's import environment so
     # `import ompi_tpu` resolves the same way it did for the launcher
@@ -68,8 +106,10 @@ def main(argv: List[str] | None = None) -> int:
         for rank in range(opts.np):
             env = dict(env_base)
             env["OMPI_TPU_RANK"] = str(rank)
-            procs.append(subprocess.Popen(
-                [sys.executable, opts.program, *opts.args], env=env))
+            host = placement[rank] if placement else None
+            procs.append(plm.spawn_rank(host, opts.launch_agent, env,
+                                        opts.program, opts.args,
+                                        os.getcwd()))
         # Poll ALL children: the first abnormal exit tears down the whole
         # job immediately (reference: prterun kills the job on abnormal
         # termination) — waiting rank-by-rank would let a peer blocked on

@@ -1,0 +1,99 @@
+"""Multi-host launch path: hostfile parsing, rank placement, and a real
+job launched through the remote-exec agent with non-loopback wireup.
+
+Reference analog: the plm/ssh two-node smoke (mpirun --hostfile + btl/tcp)
+— exercised here via the in-tree `fake` launch agent, which obeys the ssh
+argv contract but executes locally with a scrubbed environment, proving
+the command-line marshalling carries the whole launch contract.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ompi_tpu.runtime import plm
+from tests.test_process_mode import REPO, subprocess_env
+
+
+# ------------------------------------------------------- placement logic
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("# cluster\n"
+                  "node1 slots=2\n"
+                  "node2\n"
+                  "\n"
+                  "node3 slots=3  # trailing comment\n")
+    hosts = plm.parse_hostfile(str(hf))
+    assert hosts == [plm.HostSpec("node1", 2), plm.HostSpec("node2", 1),
+                     plm.HostSpec("node3", 3)]
+
+
+def test_parse_hostfile_bad_slots(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("node1 slots=x\n")
+    with pytest.raises(ValueError):
+        plm.parse_hostfile(str(hf))
+
+
+def test_parse_host_list():
+    assert plm.parse_host_list("a:2,b") == [plm.HostSpec("a", 2),
+                                            plm.HostSpec("b", 1)]
+
+
+def test_assign_ranks_fill_and_wrap():
+    hosts = [plm.HostSpec("a", 2), plm.HostSpec("b", 1)]
+    assert plm.assign_ranks(hosts, 3) == ["a", "a", "b"]
+    # oversubscription wraps in slot order
+    assert plm.assign_ranks(hosts, 5) == ["a", "a", "b", "a", "a"]
+
+
+def test_is_local():
+    assert plm.is_local("localhost")
+    assert plm.is_local("127.0.0.1")
+    assert not plm.is_local("definitely-not-this-host")
+
+
+def test_remote_command_marshals_contract():
+    env = {"OMPI_TPU_RANK": "3", "OMPI_TPU_MODEX": "10.0.0.1:5000",
+           "PYTHONPATH": "/x:/y", "HOME": "/root", "SECRET": "no"}
+    cmd = plm.remote_command(env, "prog.py", ["--a", "b c"], "/work")
+    assert "OMPI_TPU_RANK=3" in cmd and "PYTHONPATH=/x:/y" in cmd
+    assert "HOME" not in cmd and "SECRET" not in cmd
+    assert cmd.startswith("cd /work && exec env ")
+    assert "'b c'" in cmd
+
+
+# ----------------------------------------------------------- end to end
+def _run_multihost(script, np_=2, extra=(), timeout=150):
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", str(np_),
+           "--host", ",".join(f"fakenode{i}" for i in range(np_)),
+           "--launch-agent", "fake",
+           "--mca", "btl_btl", "^sm",  # force the DCN (tcp) path
+           *extra, script]
+    return subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                          timeout=timeout, env=subprocess_env())
+
+
+def test_multihost_fake_agent_nonloopback_wireup():
+    """Ranks launched through the agent path (scrubbed env, command-line
+    contract) wire over non-loopback addresses and pass ring +
+    collectives + a rendezvous-size message."""
+    r = _run_multihost("tests/procmode/check_multihost.py")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("MULTIHOST-OK") == 2
+
+
+def test_multihost_hostfile(tmp_path):
+    """The --hostfile spelling of the same launch."""
+    hf = tmp_path / "hosts"
+    hf.write_text("fakenodeA slots=2\nfakenodeB slots=2\n")
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "3",
+           "--hostfile", str(hf), "--launch-agent", "fake",
+           "--mca", "btl_btl", "^sm",
+           "tests/procmode/check_collectives.py"]
+    r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                       timeout=150, env=subprocess_env())
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("COLLECTIVES-OK") == 3
