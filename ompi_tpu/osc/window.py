@@ -198,6 +198,16 @@ class Win:
         self.buf = buffer if buffer is not None else np.zeros(0, np.uint8)
         self._bytes = self.buf.reshape(-1).view(np.uint8) if self.buf.size \
             else np.zeros(0, np.uint8)
+        # single-copy path for USER memory (Win_create): the smsc/cma
+        # analog — peers process_vm_readv/writev straight into this
+        # window's existing buffer (reference: opal/mca/smsc/cma killing
+        # osc's two-copy active-message fallback for on-node windows)
+        self._cma_peers = None    # rank -> (pid, addr, nbytes)
+        # the gate must be rank-symmetric (buffer CONTENT may differ per
+        # rank — a size-0 contribution is legal Win_create): eligibility
+        # of this rank's buffer is decided INSIDE the collective
+        if buffer is not None and alloc_bytes is None and win_id is None:
+            self._try_cma_map()
         self.lock = threading.RLock()
         self._outstanding: Dict[int, tuple] = {}  # rid -> (pending, target)
         self._lock_state = 0  # >0 shared count, -1 exclusive
@@ -250,15 +260,9 @@ class Win:
         """
         from ompi_tpu.comm.communicator import ProcComm
 
-        if hasattr(comm, "_getter"):
-            comm = comm._getter()  # unwrap the lazy COMM_WORLD proxy
-            self.comm = comm
+        comm, local = self._local_proc_comm()
         if not isinstance(comm, ProcComm) or comm.size < 2:
             return None
-        from ompi_tpu.coll.han import HanCollComponent
-
-        node_of = HanCollComponent._modex_node_map(comm)
-        local = node_of is not None and len(set(node_of)) == 1
         from ompi_tpu.coll.basic import COLL_CID_BIT
         from ompi_tpu.core.datatype import BYTE
         from ompi_tpu.runtime import mpool
@@ -327,6 +331,57 @@ class Win:
         view = self._peer_bytes[comm.rank]
         view[:] = 0
         return view
+
+    def _local_proc_comm(self):
+        """(unwrapped comm, all-ranks-node-local?) — the shared preamble
+        of every intra-node fast-path agreement."""
+        from ompi_tpu.comm.communicator import ProcComm
+
+        comm = self.comm
+        if hasattr(comm, "_getter"):
+            comm = comm._getter()  # unwrap the lazy COMM_WORLD proxy
+            self.comm = comm
+        if not isinstance(comm, ProcComm) or comm.size < 2:
+            return comm, False
+        from ompi_tpu.coll.han import HanCollComponent
+
+        node_of = HanCollComponent._modex_node_map(comm)
+        return comm, node_of is not None and len(set(node_of)) == 1
+
+    def _try_cma_map(self) -> None:
+        """Exchange (pid, addr, nbytes) cards for single-copy access to
+        USER window memory (Win_create) when every rank is node-local
+        and cma-capable. The smsc/cma analog (reference:
+        opal/mca/smsc/smsc.h:74-105 map/copy contract,
+        smsc_cma_module.c:71-115 process_vm_readv/writev): Put/Get
+        become one kernel-mediated copy straight into the peer's
+        existing buffer; accumulate/locks/CAS stay on active messages
+        for target-side ordering. Agreement is COLLECTIVE (MIN) so one
+        ineligible rank (size-0 or read-only buffer included) degrades
+        everyone to the AM path together."""
+        from ompi_tpu.runtime import smsc
+
+        comm, local = self._local_proc_comm()
+        from ompi_tpu.comm.communicator import ProcComm
+
+        if not isinstance(comm, ProcComm) or comm.size < 2:
+            return
+        handle = None
+        if local and smsc.available() and self._bytes.nbytes > 0 \
+                and self._bytes.flags.writeable:
+            handle = smsc.buffer_handle(self._bytes)
+        with spc.suppressed():
+            agree = np.zeros(1, np.int64)
+            comm.Allreduce(
+                np.array([1 if handle is not None else 0], np.int64),
+                agree, op=_op.MIN)
+            if int(agree[0]) == 0:
+                return
+            cards = np.zeros(3 * comm.size, np.int64)
+            comm.Allgather(np.array(handle, np.int64), cards)
+        self._cma_peers = [(int(cards[3 * r]), int(cards[3 * r + 1]),
+                            int(cards[3 * r + 2]))
+                           for r in range(comm.size)]
 
     @staticmethod
     def Create(buffer, comm) -> "Win":
@@ -466,6 +521,10 @@ class Win:
                  disp: int) -> bool:
         if self._peer_bytes is None:
             return False
+        if not origin_arr.flags.c_contiguous:
+            # reshape(-1) of a non-contiguous array COPIES — the write
+            # below would land in the copy, not the caller's memory
+            return False
         if not 0 <= target < len(self._peer_bytes):
             raise MPIError(ERR_RANK, f"target rank {target} out of range")
         dst = origin_arr.reshape(-1).view(np.uint8)
@@ -479,11 +538,68 @@ class Win:
         spc.record_bytes("rma_shm_get", dst.nbytes)
         return True
 
+    def _cma_put(self, origin_arr: np.ndarray, target: int,
+                 disp: int) -> bool:
+        """One process_vm_writev into the target's user buffer
+        (Win_create single-copy path). Returns False to fall back."""
+        if self._cma_peers is None:
+            return False
+        if not 0 <= target < len(self._cma_peers):
+            raise MPIError(ERR_RANK, f"target rank {target} out of range")
+        pid, addr, winbytes = self._cma_peers[target]
+        src = np.ascontiguousarray(origin_arr).reshape(-1).view(np.uint8)
+        if disp < 0 or disp + src.nbytes > winbytes:
+            raise MPIError(
+                ERR_WIN,
+                f"displacement [{disp}, {disp + src.nbytes}) outside the "
+                f"{winbytes}-byte window")
+        from ompi_tpu.runtime import smsc
+
+        try:
+            smsc.copy_to(pid, addr + disp, src)
+        except OSError as e:
+            # kernel said no (ptrace policy changed, peer raced exit):
+            # disable the path for this window and let AM take over
+            get_logger("osc").warning("cma put failed (%s); window falls "
+                                      "back to active messages", e)
+            self._cma_peers = None
+            return False
+        spc.record_bytes("rma_cma_put", src.nbytes)
+        return True
+
+    def _cma_get(self, origin_arr: np.ndarray, target: int,
+                 disp: int) -> bool:
+        if self._cma_peers is None:
+            return False
+        if not 0 <= target < len(self._cma_peers):
+            raise MPIError(ERR_RANK, f"target rank {target} out of range")
+        if not origin_arr.flags.c_contiguous:
+            return False  # reshape(-1) would copy; see _shm_get
+        pid, addr, winbytes = self._cma_peers[target]
+        dst = origin_arr.reshape(-1).view(np.uint8)
+        if disp < 0 or disp + dst.nbytes > winbytes:
+            raise MPIError(
+                ERR_WIN,
+                f"displacement [{disp}, {disp + dst.nbytes}) outside the "
+                f"{winbytes}-byte window")
+        from ompi_tpu.runtime import smsc
+
+        try:
+            smsc.copy_from(pid, addr + disp, dst)
+        except OSError as e:
+            get_logger("osc").warning("cma get failed (%s); window falls "
+                                      "back to active messages", e)
+            self._cma_peers = None
+            return False
+        spc.record_bytes("rma_cma_get", dst.nbytes)
+        return True
+
     def Rput(self, origin_arr: np.ndarray, target: int,
              target_disp: int = 0) -> Request:
         spc.record_bytes("rma_put", origin_arr.nbytes)
         dt = from_numpy_dtype(origin_arr.dtype)
-        if self._shm_put(origin_arr, target, target_disp * dt.size):
+        if self._shm_put(origin_arr, target, target_disp * dt.size) or \
+                self._cma_put(origin_arr, target, target_disp * dt.size):
             return CompletedRequest()
         return self._post_op(target, _PUT, target_disp * dt.size,
                              origin_arr.size, _dtype_code(dt), 0,
@@ -493,7 +609,8 @@ class Win:
             target_disp: int = 0) -> None:
         spc.record_bytes("rma_put", origin_arr.nbytes)
         dt = from_numpy_dtype(origin_arr.dtype)
-        if self._shm_put(origin_arr, target, target_disp * dt.size):
+        if self._shm_put(origin_arr, target, target_disp * dt.size) or \
+                self._cma_put(origin_arr, target, target_disp * dt.size):
             return
         self._post_op(target, _PUT, target_disp * dt.size,
                       origin_arr.size, _dtype_code(dt), 0,
@@ -503,12 +620,16 @@ class Win:
              target_disp: int = 0) -> Request:
         spc.record_bytes("rma_get", origin_arr.nbytes)
         dt = from_numpy_dtype(origin_arr.dtype)
-        if self._shm_get(origin_arr, target, target_disp * dt.size):
+        if self._shm_get(origin_arr, target, target_disp * dt.size) or \
+                self._cma_get(origin_arr, target, target_disp * dt.size):
             return CompletedRequest()
 
         def land(data: bytes) -> None:
-            origin_arr.reshape(-1)[:] = np.frombuffer(
-                data, dtype=origin_arr.dtype)
+            # [...] assignment writes through views of ANY layout;
+            # reshape(-1)[:] would silently target a copy for
+            # non-contiguous origins
+            origin_arr[...] = np.frombuffer(
+                data, dtype=origin_arr.dtype).reshape(origin_arr.shape)
 
         return self._post_op(target, _GET, target_disp * dt.size,
                              origin_arr.size, _dtype_code(dt), 0, b"",

@@ -43,11 +43,14 @@ SYSTEM_TAG_BASE = -4000
 def user_traffic(tag: int, cid: int) -> bool:
     return (cid & _PLANE_MASK) == 0 and tag > SYSTEM_TAG_BASE
 
-# Header kinds (reference: pml_ob1_hdr.h type enum)
+# Header kinds (reference: pml_ob1_hdr.h type enum — FIN and ACK are the
+# analogs of MCA_PML_OB1_HDR_TYPE_FIN / _ACK)
 EAGER = 1
 RNDV_RTS = 2
 RNDV_CTS = 3
 RNDV_DATA = 4
+RNDV_FIN = 5   # single-copy (cma) delivery complete — no DATA stream
+RNDV_ACK = 6   # receiver flow-control credit: hdr.nbytes = bytes landed
 
 _HDR = struct.Struct("<BiiqQQQQ")  # kind, src, cid, tag, seq, nbytes, offset, msgid
 HDR_SIZE = _HDR.size

@@ -19,8 +19,11 @@ over BTLs via the BML endpoint map (bml/r2). Re-design notes:
 from __future__ import annotations
 
 import itertools
+import struct
 import threading
 from typing import Dict, Optional
+
+import numpy as np
 
 from ompi_tpu.core.convertor import Convertor
 from ompi_tpu.core.datatype import Datatype
@@ -31,8 +34,10 @@ from ompi_tpu.pml.base import (
     ANY_SOURCE,
     ANY_TAG,
     EAGER,
+    RNDV_ACK,
     RNDV_CTS,
     RNDV_DATA,
+    RNDV_FIN,
     RNDV_RTS,
     Header,
     MatchingEngine,
@@ -58,6 +63,19 @@ register_var("pml", "stripe", bool(_MC),
                   "scheduling). Default on only with multiple cores: "
                   "on one core the extra rail just burns the same CPU "
                   "at a worse per-byte rate (measured 0.64x)", level=5)
+register_var("pml", "pipeline_depth", 16 << 20,
+             help="Max unacked rendezvous DATA bytes in flight per "
+                  "message; 0 = unbounded. Bounds sender-side queued "
+                  "frames for huge messages (reference: the RDMA "
+                  "pipeline depth knobs, btl.h:1183-1186 + ob1's "
+                  "incremental frag scheduling)", level=5)
+register_var("pml", "cma", True,
+             help="Single-copy on-node rendezvous via the smsc/cma "
+                  "analog (process_vm_writev straight into the posted "
+                  "receive buffer) when both sides are contiguous "
+                  "(reference: opal/mca/smsc/cma)", level=5)
+# cma-offer blob a receiver appends to its CTS: target pid + buffer addr
+_CMA_OFFER = struct.Struct("<qQ")
 
 
 class Ob1Pml:
@@ -66,10 +84,24 @@ class Ob1Pml:
         self.engine = MatchingEngine()
         self.endpoints: Dict[int, "Btl"] = {}  # world rank -> btl module
         self.log = get_logger("pml.ob1")
-        self._seq = itertools.count(1)
+        # Per-PEER sequence numbers on the MATCH plane (reference:
+        # pml_ob1_isend.c:288 per-proc send_sequence + the recvfrag
+        # ordering check). Sender stamps EAGER/RTS frames from a per-dst
+        # counter; the receiver enforces continuity per source — a
+        # duplicate redelivered by failover is DROPPED (at-least-once
+        # becomes exactly-once) and a gap (a frame lost by a dying
+        # transport) raises instead of silently reordering the stream.
+        self._seq_to: Dict[int, int] = {}
+        self._expect_seq: Dict[int, int] = {}
+        # per-dst send-order locks: seq assignment and handoff to the
+        # transport must be ATOMIC, or two app/progress threads sending
+        # to the same peer can hit the wire out of seq order and the
+        # receiver's gap check would drop a live frame
+        self._order_locks: Dict[int, threading.RLock] = {}
         self._msgid = itertools.count(1)
         self._pending_sends: Dict[int, SendRequest] = {}  # msgid -> req
         self._active_recvs: Dict[int, RecvRequest] = {}  # msgid -> req
+        self._flowing: Dict[int, SendRequest] = {}  # msgid -> throttled send
         self.fallbacks: Dict[int, list] = {}  # rank -> ordered btl alts
         # rank -> frames ACKED by a now-dead transport, preserved across
         # a total-transport-failure episode for the next send attempt
@@ -186,6 +218,13 @@ class Ob1Pml:
         return btl
 
     # -------------------------------------------------------------- verbs
+    def _order_lock(self, dst: int) -> threading.RLock:
+        lock = self._order_locks.get(dst)
+        if lock is None:
+            with self.engine.lock:
+                lock = self._order_locks.setdefault(dst, threading.RLock())
+        return lock
+
     def isend(self, buf, count: int, datatype: Datatype, dst: int,
               tag: int, cid: int) -> SendRequest:
         btl = self._btl_for(dst)
@@ -198,19 +237,29 @@ class Ob1Pml:
         # ship them in one frame (transports queue arbitrary frame sizes)
         if tag <= self.SYSTEM_TAG_BASE:
             eager_limit = None
+        # seq assignment + transport handoff under one per-dst lock:
+        # MATCH-plane wire order must equal seq order (reference: the
+        # per-proc send_sequence is taken under ob1's send lock). RLock
+        # because a self-btl delivery can re-enter isend for a reply.
         if eager_limit is None or conv.packed_size <= eager_limit:
-            hdr = pack_header(EAGER, self.my_rank, cid, tag, next(self._seq),
-                              conv.packed_size, 0, 0)
             payload = conv.pack_frag(conv.packed_size)
-            self._send_frame(dst, hdr, payload)
+            with self._order_lock(dst):
+                seq = self._seq_to.get(dst, 0) + 1
+                self._seq_to[dst] = seq
+                hdr = pack_header(EAGER, self.my_rank, cid, tag, seq,
+                                  conv.packed_size, 0, 0)
+                self._send_frame(dst, hdr, payload)
             req.status._nbytes = conv.packed_size
             req._set_complete(0)
         else:
             req.msgid = next(self._msgid)
             self._pending_sends[req.msgid] = req
-            hdr = pack_header(RNDV_RTS, self.my_rank, cid, tag,
-                              next(self._seq), conv.packed_size, 0, req.msgid)
-            self._send_frame(dst, hdr, b"")
+            with self._order_lock(dst):
+                seq = self._seq_to.get(dst, 0) + 1
+                self._seq_to[dst] = seq
+                hdr = pack_header(RNDV_RTS, self.my_rank, cid, tag, seq,
+                                  conv.packed_size, 0, req.msgid)
+                self._send_frame(dst, hdr, b"")
         return req
 
     def irecv(self, buf, count: int, datatype: Datatype, src: int,
@@ -279,6 +328,35 @@ class Ob1Pml:
         """Single entry point for every BTL's received frames (reference:
         the btl recv callbacks registered per hdr type in ob1)."""
         hdr = Header(raw_hdr)
+        # MATCH-plane continuity check (reference: the recvfrag ordering
+        # guard over per-proc sequence numbers). Only EAGER/RTS consume
+        # seqs — CTS/DATA/FIN/ACK order is protected by the msgid
+        # machinery. After a failover re-drive, a frame the dead rail
+        # already delivered comes around again with an old seq: drop it
+        # (exactly-once). A seq ABOVE expected means an in-order frame
+        # was lost with the dead transport — raise, don't reorder.
+        if hdr.kind in (EAGER, RNDV_RTS) and hdr.seq:
+            with self.engine.lock:
+                expect = self._expect_seq.get(hdr.src, 1)
+                if hdr.seq < expect:
+                    from ompi_tpu.runtime import spc
+
+                    spc.record_bytes("pml_dup_frame", 1)
+                    self.log.warning(
+                        "dropping duplicate frame from rank %d "
+                        "(seq %d < expected %d; failover redelivery)",
+                        hdr.src, hdr.seq, expect)
+                    return
+                if hdr.seq > expect:
+                    from ompi_tpu.runtime import spc
+
+                    spc.record_bytes("pml_seq_gap", 1)
+                    raise MPIError(
+                        ERR_INTERN,
+                        f"sequence gap from rank {hdr.src}: got seq "
+                        f"{hdr.seq}, expected {expect} — a MATCH frame "
+                        f"was lost in transport failover")
+                self._expect_seq[hdr.src] = expect + 1
         if hdr.tag <= self.SYSTEM_TAG_BASE:
             fn = self.system_handlers.get(hdr.tag)
             if fn is not None:
@@ -289,9 +367,13 @@ class Ob1Pml:
         elif hdr.kind == RNDV_RTS:
             self._incoming_rts(hdr)
         elif hdr.kind == RNDV_CTS:
-            self._incoming_cts(hdr)
+            self._incoming_cts(hdr, payload)
         elif hdr.kind == RNDV_DATA:
             self._incoming_data(hdr, payload)
+        elif hdr.kind == RNDV_FIN:
+            self._incoming_fin(hdr)
+        elif hdr.kind == RNDV_ACK:
+            self._incoming_ack(hdr)
         else:
             raise MPIError(ERR_INTERN, f"bad header kind {hdr.kind}")
 
@@ -325,12 +407,29 @@ class Ob1Pml:
                 return
             req.convertor = conv
             req.status._nbytes = hdr.nbytes
+            req._sender_msgid = hdr.msgid  # for flow-control ACKs
             recv_id = next(self._msgid)
             self._active_recvs[recv_id] = req
             cts = pack_header(RNDV_CTS, self.my_rank, hdr.cid, hdr.tag, 0,
                               hdr.nbytes, hdr.msgid, recv_id)
+            # single-copy offer (smsc/cma analog): when this receive
+            # lands in plain contiguous memory and the peer shares the
+            # node (it's behind the sm btl), tell the sender where to
+            # process_vm_writev directly — one copy instead of
+            # pack->ring->unpack (reference: smsc/cma/smsc_cma_module.c)
+            offer = b""
+            if get_var("pml", "cma") and \
+                    getattr(self.endpoints.get(hdr.src), "NAME", "") == "sm":
+                view = self._cma_view(conv, hdr.nbytes, writable=True)
+                if view is not None:
+                    from ompi_tpu.runtime import smsc
+
+                    if smsc.available():
+                        handle = smsc.buffer_handle(view)
+                        if handle is not None:
+                            offer = _CMA_OFFER.pack(handle[0], handle[1])
             try:
-                self._send_frame(hdr.src, cts, b"")
+                self._send_frame(hdr.src, cts, offer)
             except MPIError as e:
                 # dead transport: fail the receive instead of leaving it
                 # matched-but-incomplete (Wait would spin forever)
@@ -361,50 +460,164 @@ class Ob1Pml:
                             if b is not primary]
         return btls
 
-    def _incoming_cts(self, hdr: Header) -> None:
+    @staticmethod
+    def _cma_view(conv: Convertor, nbytes: int,
+                  writable: bool) -> Optional[np.ndarray]:
+        """Contiguous byte view covering packed bytes [0, nbytes) of this
+        convertor's buffer, or None when the message isn't single-copy
+        eligible (derived layout, non-contiguous array, or a read-only
+        buffer on the receive side)."""
+        if not conv.datatype.is_contiguous or conv.packed_size < nbytes:
+            return None
+        buf = conv.buf
+        if isinstance(buf, np.ndarray) and not buf.flags.c_contiguous:
+            # _as_byte_view would have copied: the view's address is not
+            # the caller's memory
+            return None
+        view = conv._bytes
+        if not isinstance(view, np.ndarray) or view.nbytes < nbytes:
+            return None
+        if writable and not view.flags.writeable:
+            return None
+        return view[:nbytes]
+
+    def _incoming_cts(self, hdr: Header, payload: bytes = b"") -> None:
         # hdr.offset carries the sender msgid; hdr.msgid the receiver reqid.
         sreq = self._pending_sends.pop(int(hdr.offset), None)
         if sreq is None:
             return
         conv = sreq.convertor
-        frag_size = get_var("pml", "frag_size")
-        btls = self._stripe_btls(hdr.src, sreq.nbytes)
-        weights = [max(int(getattr(b, "bandwidth", 1)), 1) for b in btls]
-        total_w = sum(weights)
-        credits = [0] * len(btls)
-        offset = 0
-        try:
-            while conv.remaining > 0:
-                frag = conv.pack_frag(frag_size)
-                dhdr = pack_header(RNDV_DATA, self.my_rank, sreq.cid,
-                                   sreq.tag, 0, sreq.nbytes, offset,
-                                   hdr.msgid)
-                if len(btls) == 1:
-                    self._send_frame(hdr.src, dhdr, frag)
-                else:
-                    # smooth weighted round-robin across the live set
-                    for i, w in enumerate(weights):
-                        credits[i] += w
-                    pick = max(range(len(btls)),
-                               key=lambda i: credits[i])
-                    credits[pick] -= total_w
+        # Single-copy path: the receiver's CTS carries (pid, addr) of its
+        # posted buffer — one process_vm_writev moves the whole message,
+        # then FIN completes the receive (reference: smsc/cma single-copy
+        # + ob1's FIN). Any failure (ptrace denied, raced exit) falls
+        # back to the DATA stream below.
+        if len(payload) == _CMA_OFFER.size and get_var("pml", "cma"):
+            src_view = self._cma_view(conv, sreq.nbytes, writable=False)
+            if src_view is not None:
+                from ompi_tpu.runtime import smsc, spc
+
+                if smsc.available():
+                    pid, addr = _CMA_OFFER.unpack(bytes(payload))
                     try:
-                        btls[pick].send(hdr.src, dhdr, frag)
-                    except Exception:
-                        # stripe member died: the failover funnel
-                        # re-drives (and ejects) as usual
-                        self._send_frame(hdr.src, dhdr, frag)
-                        btls = [self._btl_for(hdr.src)]
-                        weights, credits, total_w = [1], [0], 1
-                offset += frag.nbytes
-        except MPIError as e:
-            # transport died mid-rendezvous: fail the send request so the
-            # sender's Wait surfaces the loss instead of spinning
-            sreq.status._nbytes = offset
-            sreq._set_complete(e.code)
+                        smsc.copy_to(pid, addr, src_view)
+                    except OSError as e:
+                        self.log.debug("cma fallback to DATA stream: %s", e)
+                    else:
+                        spc.record_bytes("pml_cma_bytes", sreq.nbytes)
+                        fin = pack_header(RNDV_FIN, self.my_rank, sreq.cid,
+                                          sreq.tag, 0, sreq.nbytes, 0,
+                                          hdr.msgid)
+                        try:
+                            self._send_frame(hdr.src, fin, b"")
+                        except MPIError as e:
+                            sreq._set_complete(e.code)
+                            return
+                        sreq.status._nbytes = sreq.nbytes
+                        sreq._set_complete(0)
+                        return
+        # Streaming path, flow-controlled: at most pipeline_depth unacked
+        # bytes in flight per message so a 1GB rendezvous can't
+        # materialize 1GB of queued frames on a slow rail (reference:
+        # ob1 schedules frags incrementally as the pipeline drains).
+        sreq._peer = hdr.src
+        sreq._rmsgid = hdr.msgid
+        sreq._offset = 0
+        sreq._acked = 0
+        depth = int(get_var("pml", "pipeline_depth"))
+        frag_size = get_var("pml", "frag_size")
+        if depth:
+            depth = max(depth, 2 * frag_size)  # window >= ack cadence
+        sreq._depth = depth
+        sreq._frag_size = frag_size
+        sreq._btls = self._stripe_btls(hdr.src, sreq.nbytes)
+        sreq._weights = [max(int(getattr(b, "bandwidth", 1)), 1)
+                         for b in sreq._btls]
+        sreq._credits = [0] * len(sreq._btls)
+        sreq._pump_lock = threading.RLock()
+        if depth and sreq.nbytes > depth:
+            self._flowing[sreq.msgid] = sreq
+        self._pump(sreq)
+
+    def _pump(self, sreq: SendRequest) -> None:
+        """Drain the convertor into DATA frames while the flow-control
+        window is open. Re-entered from _incoming_ack as credits return."""
+        conv = sreq.convertor
+        with sreq._pump_lock:
+            if sreq._complete.is_set():
+                return
+            try:
+                while conv.remaining > 0 and (
+                        not sreq._depth
+                        or sreq._offset - sreq._acked < sreq._depth):
+                    frag = conv.pack_frag(sreq._frag_size)
+                    # seq slot carries MY window size so the receiver
+                    # paces ACKs to the sender's actual depth — config
+                    # skew (different pipeline_depth per process) must
+                    # not stall the pipeline
+                    dhdr = pack_header(RNDV_DATA, self.my_rank, sreq.cid,
+                                       sreq.tag, sreq._depth, sreq.nbytes,
+                                       sreq._offset, sreq._rmsgid)
+                    btls = sreq._btls
+                    if len(btls) == 1:
+                        self._send_frame(sreq._peer, dhdr, frag)
+                    else:
+                        # smooth weighted round-robin across the live set
+                        for i, w in enumerate(sreq._weights):
+                            sreq._credits[i] += w
+                        pick = max(range(len(btls)),
+                                   key=lambda i: sreq._credits[i])
+                        sreq._credits[pick] -= sum(sreq._weights)
+                        try:
+                            btls[pick].send(sreq._peer, dhdr, frag)
+                        except Exception:
+                            # stripe member died: the failover funnel
+                            # re-drives (and ejects) as usual
+                            self._send_frame(sreq._peer, dhdr, frag)
+                            sreq._btls = [self._btl_for(sreq._peer)]
+                            sreq._weights, sreq._credits = [1], [0]
+                    sreq._offset += frag.nbytes
+                    from ompi_tpu.runtime import spc
+
+                    # watermark proving the window held (check_pipeline)
+                    spc.record_max("pml_pipeline_inflight",
+                                   sreq._offset - sreq._acked)
+            except MPIError as e:
+                # transport died mid-rendezvous: fail the send request so
+                # the sender's Wait surfaces the loss instead of spinning
+                self._flowing.pop(sreq.msgid, None)
+                sreq.status._nbytes = sreq._offset
+                sreq._set_complete(e.code)
+                return
+            if conv.remaining == 0:
+                # all bytes queued: local completion (buffered-send
+                # semantics, matching the reference's send-side FIN-free
+                # completion for non-RDMA pipelines)
+                self._flowing.pop(sreq.msgid, None)
+                sreq.status._nbytes = sreq.nbytes
+                sreq._set_complete(0)
+
+    def _incoming_ack(self, hdr: Header) -> None:
+        """Receiver credit: hdr.nbytes = deduped bytes landed so far for
+        sender message hdr.msgid. Opens the pipeline window."""
+        sreq = self._flowing.get(hdr.msgid)
+        if sreq is None:
             return
-        sreq.status._nbytes = sreq.nbytes
-        sreq._set_complete(0)
+        if hdr.nbytes > sreq._acked:
+            sreq._acked = hdr.nbytes
+        self._pump(sreq)
+
+    def _incoming_fin(self, hdr: Header) -> None:
+        """Sender confirms a single-copy (cma) delivery: the whole
+        message is already in our posted buffer."""
+        req = self._active_recvs.pop(hdr.msgid, None)
+        if req is None:
+            return
+        from ompi_tpu.runtime import spc
+
+        spc.record_bytes("pml_cma_recv_bytes", hdr.nbytes)
+        req.status._nbytes = hdr.nbytes
+        req._set_complete(0)
 
     def _incoming_data(self, hdr: Header, payload: bytes) -> None:
         req = self._active_recvs.get(hdr.msgid)
@@ -412,18 +625,42 @@ class Ob1Pml:
             return
         # striped rendezvous interleaves frags across transports (and
         # their progress contexts): serialize per-message delivery and
-        # complete on BYTE COUNT, not the position high-water mark — a
-        # late middle frag from the slower transport must still land
-        # before completion fires
+        # complete on BYTE COUNT of DISTINCT offsets — failover re-drives
+        # frames whose delivery was unknown, so a frag can arrive twice
+        # and must not double-count (ADVICE r4); a re-driven frag carries
+        # identical bytes, so re-unpacking it is idempotent.
         with self.engine.lock:
-            conv = req.convertor
-            conv.set_position(int(hdr.offset))
-            conv.unpack_frag(payload)
-            req._recv_bytes = getattr(req, "_recv_bytes", 0) + \
-                (payload.nbytes if hasattr(payload, "nbytes")
-                 else len(payload))
+            nbytes = (payload.nbytes if hasattr(payload, "nbytes")
+                      else len(payload))
+            seen = getattr(req, "_recv_offsets", None)
+            if seen is None:
+                seen = req._recv_offsets = set()
+            if hdr.offset not in seen:
+                seen.add(hdr.offset)
+                conv = req.convertor
+                conv.set_position(int(hdr.offset))
+                conv.unpack_frag(payload)
+                req._recv_bytes = getattr(req, "_recv_bytes", 0) + nbytes
             done = req._recv_bytes >= hdr.nbytes
             if done:
                 del self._active_recvs[hdr.msgid]
+                req._recv_offsets = None  # free the dedup set
         if done:
             req._set_complete(0)
+            return
+        # flow-control credit back to the sender every half of ITS
+        # window (carried in hdr.seq — no dependence on this process's
+        # own MCA config, and no registry lookups on the hot path)
+        depth = hdr.seq
+        if depth:
+            interval = max(depth // 2, 1 << 16)
+            last = getattr(req, "_last_ack", 0)
+            if req._recv_bytes - last >= interval:
+                req._last_ack = req._recv_bytes
+                ack = pack_header(RNDV_ACK, self.my_rank, hdr.cid, hdr.tag,
+                                  0, req._recv_bytes, 0,
+                                  getattr(req, "_sender_msgid", 0))
+                try:
+                    self._send_frame(hdr.src, ack, b"")
+                except MPIError:
+                    pass  # sender side will surface the dead transport

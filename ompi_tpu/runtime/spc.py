@@ -74,6 +74,16 @@ def record_bytes(name: str, nbytes: int) -> None:
         _counters[name + "_bytes"] += int(nbytes)
 
 
+def record_max(name: str, value: int) -> None:
+    """High-water-mark counter (reference: the SPC watermark class,
+    e.g. OMPI_SPC_MAX_UNEXPECTED_IN_QUEUE)."""
+    if not _enabled():
+        return
+    with _lock:
+        if value > _counters[name + "_hwm"]:
+            _counters[name + "_hwm"] = int(value)
+
+
 class timer:
     """Context manager accumulating wall time in microseconds
     (reference: the SPC_TIMER watermark counters)."""

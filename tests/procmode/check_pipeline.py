@@ -1,0 +1,73 @@
+"""Rendezvous flow control: a huge message must stream with BOUNDED
+sender-side memory — pipeline_depth caps unacked DATA bytes, so the
+sender cannot materialize the whole message as queued frames on a slow
+rail (reference: the RDMA pipeline depth knobs, opal btl.h:1183-1186,
+and ob1's incremental frag scheduling).
+
+Forced to the tcp rail (no sm, so no cma single-copy shortcut) with
+``--mca btl_btl ^sm``; size via argv[1] MB (default 512).
+"""
+
+import resource
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.mca.var import get_var
+
+
+def rss_kb() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+
+def main() -> int:
+    mb = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    r = COMM_WORLD.Get_rank()
+    depth = int(get_var("pml", "pipeline_depth"))
+    assert depth > 0, "pipeline_depth must be bounded for this check"
+    nbytes = mb << 20
+
+    if r == 0:
+        buf = np.ones(nbytes, np.uint8)
+        buf[::4096] = 7  # touch every page so the baseline peak is real
+        COMM_WORLD.Barrier()
+        before = rss_kb()
+        COMM_WORLD.Send(buf, dest=1, tag=3)
+        COMM_WORLD.Barrier()
+        grew_mb = (rss_kb() - before) / 1024.0
+        # unbounded queuing would grow ~message size; the window bounds
+        # it to ~2x depth (pack frag + queued frame) plus slack
+        limit_mb = 2 * depth / (1 << 20) + 96
+        # the deterministic witness: the sender-side unacked high-water
+        # mark can never exceed the window (RSS alone can't prove the
+        # cap — a fast drain hides unbounded queuing)
+        from ompi_tpu.runtime import spc
+
+        hwm = spc.snapshot().get("pml_pipeline_inflight_hwm", 0)
+        frag = int(get_var("pml", "frag_size"))
+        print(f"PIPELINE-RSS sent={mb}MB depth={depth >> 20}MB "
+              f"sender_growth={grew_mb:.0f}MB limit={limit_mb:.0f}MB "
+              f"inflight_hwm={hwm >> 20}MB", flush=True)
+        assert 0 < hwm <= depth + frag, \
+            f"in-flight hwm {hwm} outside (0, {depth + frag}]"
+        assert grew_mb < limit_mb, \
+            f"sender RSS grew {grew_mb:.0f}MB (> {limit_mb:.0f}MB): " \
+            f"flow control not bounding the pipeline"
+    else:
+        buf = np.zeros(nbytes, np.uint8)
+        buf[::4096] = 1
+        COMM_WORLD.Barrier()
+        COMM_WORLD.Recv(buf, source=0, tag=3)
+        assert buf[0] == 7 and buf[1] == 1 and buf[4096] == 7 \
+            and buf[-1] == 1, (buf[0], buf[1], buf[4096], buf[-1])
+        COMM_WORLD.Barrier()
+
+    ompi_tpu.Finalize()
+    print(f"rank {r}: PIPELINE-OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,6 @@
 """Zero-copy intra-node RMA (shared-segment Win.Allocate path)."""
 
+import os
 import re
 
 from tests.test_process_mode import run_mpi
@@ -11,6 +12,11 @@ def test_osc_shm_procmode_4ranks():
     assert r.stdout.count("OSCSHM-OK") == 4, r.stdout
     m = re.search(r"ratio=([0-9.]+)", r.stdout)
     assert m, r.stdout
-    # one mapped memcpy vs frame copy + round trip: decisive even on a
-    # loaded single-core host (measured ~69x)
-    assert float(m.group(1)) >= 3.0, r.stdout
+    # performance-ratio floor only under the soak/bench gate: on the
+    # loaded shared CI host scheduler noise can flake it (ADVICE r4);
+    # the correctness assertions above are unconditional, and bench.py
+    # records the ratio every round
+    if os.environ.get("OMPI_TPU_TEST_SOAK"):
+        # one mapped memcpy vs frame copy + round trip: decisive even
+        # on a loaded single-core host (measured ~69x)
+        assert float(m.group(1)) >= 3.0, r.stdout
