@@ -362,12 +362,12 @@ class Win:
         from ompi_tpu.runtime import smsc
 
         comm, local = self._local_proc_comm()
-        from ompi_tpu.comm.communicator import ProcComm
-
-        if not isinstance(comm, ProcComm) or comm.size < 2:
+        if not local:
+            # symmetric fact (modex node map): every rank sees the same
+            # verdict, so skipping the agreement collective is safe
             return
         handle = None
-        if local and smsc.available() and self._bytes.nbytes > 0 \
+        if smsc.available() and self._bytes.nbytes > 0 \
                 and self._bytes.flags.writeable:
             handle = smsc.buffer_handle(self._bytes)
         with spc.suppressed():

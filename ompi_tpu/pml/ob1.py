@@ -93,6 +93,10 @@ class Ob1Pml:
         # transport) raises instead of silently reordering the stream.
         self._seq_to: Dict[int, int] = {}
         self._expect_seq: Dict[int, int] = {}
+        # reorder buffer for MATCH frames that legitimately arrive ahead
+        # of sequence (concurrent rails during failover re-drive):
+        # src -> {seq: (hdr, payload)}
+        self._ahead: Dict[int, Dict[int, tuple]] = {}
         # per-dst send-order locks: seq assignment and handoff to the
         # transport must be ATOMIC, or two app/progress threads sending
         # to the same peer can hit the wire out of seq order and the
@@ -243,24 +247,37 @@ class Ob1Pml:
         # because a self-btl delivery can re-enter isend for a reply.
         if eager_limit is None or conv.packed_size <= eager_limit:
             payload = conv.pack_frag(conv.packed_size)
-            with self._order_lock(dst):
-                seq = self._seq_to.get(dst, 0) + 1
-                self._seq_to[dst] = seq
-                hdr = pack_header(EAGER, self.my_rank, cid, tag, seq,
-                                  conv.packed_size, 0, 0)
-                self._send_frame(dst, hdr, payload)
+            self._send_match_frame(dst, EAGER, cid, tag,
+                                   conv.packed_size, 0, payload)
             req.status._nbytes = conv.packed_size
             req._set_complete(0)
         else:
             req.msgid = next(self._msgid)
             self._pending_sends[req.msgid] = req
-            with self._order_lock(dst):
-                seq = self._seq_to.get(dst, 0) + 1
-                self._seq_to[dst] = seq
-                hdr = pack_header(RNDV_RTS, self.my_rank, cid, tag, seq,
-                                  conv.packed_size, 0, req.msgid)
-                self._send_frame(dst, hdr, b"")
+            self._send_match_frame(dst, RNDV_RTS, cid, tag,
+                                   conv.packed_size, req.msgid, b"")
         return req
+
+    def _send_match_frame(self, dst: int, kind: int, cid: int, tag: int,
+                          nbytes: int, msgid: int, payload) -> None:
+        """Stamp + transmit one MATCH-plane frame. The seq is committed
+        BEFORE the send (a self-btl delivery can re-enter isend from the
+        handler — reading an uncommitted counter would stamp a duplicate
+        and the receiver would drop the reply as a redelivery), and
+        rolled back if the transport rejected the frame with no nested
+        send in between — a burned seq would otherwise poison the peer
+        stream with a permanent gap."""
+        with self._order_lock(dst):
+            seq = self._seq_to.get(dst, 0) + 1
+            self._seq_to[dst] = seq
+            hdr = pack_header(kind, self.my_rank, cid, tag, seq,
+                              nbytes, 0, msgid)
+            try:
+                self._send_frame(dst, hdr, payload)
+            except BaseException:
+                if self._seq_to.get(dst) == seq:
+                    self._seq_to[dst] = seq - 1
+                raise
 
     def irecv(self, buf, count: int, datatype: Datatype, src: int,
               tag: int, cid: int) -> RecvRequest:
@@ -328,35 +345,21 @@ class Ob1Pml:
         """Single entry point for every BTL's received frames (reference:
         the btl recv callbacks registered per hdr type in ob1)."""
         hdr = Header(raw_hdr)
-        # MATCH-plane continuity check (reference: the recvfrag ordering
+        # MATCH-plane continuity gate (reference: the recvfrag ordering
         # guard over per-proc sequence numbers). Only EAGER/RTS consume
         # seqs — CTS/DATA/FIN/ACK order is protected by the msgid
-        # machinery. After a failover re-drive, a frame the dead rail
-        # already delivered comes around again with an old seq: drop it
-        # (exactly-once). A seq ABOVE expected means an in-order frame
-        # was lost with the dead transport — raise, don't reorder.
+        # machinery. Semantics per frame:
+        #   seq < expected: a failover re-drive delivered it twice —
+        #       DROP (at-least-once becomes exactly-once).
+        #   seq > expected: concurrent rails during failover can
+        #       legitimately run ahead — park it in a bounded reorder
+        #       buffer; overflow means a frame is truly lost (raise).
+        #   seq == expected: accept, then drain any parked successors.
+        # Matching (request binding) happens INSIDE the same critical
+        # section so two progress threads can't bind frames out of
+        # arrival order; only unpack/completion runs outside the lock.
         if hdr.kind in (EAGER, RNDV_RTS) and hdr.seq:
-            with self.engine.lock:
-                expect = self._expect_seq.get(hdr.src, 1)
-                if hdr.seq < expect:
-                    from ompi_tpu.runtime import spc
-
-                    spc.record_bytes("pml_dup_frame", 1)
-                    self.log.warning(
-                        "dropping duplicate frame from rank %d "
-                        "(seq %d < expected %d; failover redelivery)",
-                        hdr.src, hdr.seq, expect)
-                    return
-                if hdr.seq > expect:
-                    from ompi_tpu.runtime import spc
-
-                    spc.record_bytes("pml_seq_gap", 1)
-                    raise MPIError(
-                        ERR_INTERN,
-                        f"sequence gap from rank {hdr.src}: got seq "
-                        f"{hdr.seq}, expected {expect} — a MATCH frame "
-                        f"was lost in transport failover")
-                self._expect_seq[hdr.src] = expect + 1
+            return self._incoming_match_plane(hdr, payload)
         if hdr.tag <= self.SYSTEM_TAG_BASE:
             fn = self.system_handlers.get(hdr.tag)
             if fn is not None:
@@ -376,6 +379,71 @@ class Ob1Pml:
             self._incoming_ack(hdr)
         else:
             raise MPIError(ERR_INTERN, f"bad header kind {hdr.kind}")
+
+    _AHEAD_LIMIT = 64  # parked frames per peer before declaring loss
+
+    def _incoming_match_plane(self, hdr: Header, payload) -> None:
+        from ompi_tpu.runtime import spc
+
+        deliveries = []
+        with self.engine.lock:
+            expect = self._expect_seq.get(hdr.src, 1)
+            if hdr.seq < expect:
+                spc.record("pml_dup_frame")
+                self.log.warning(
+                    "dropping duplicate frame from rank %d (seq %d < "
+                    "expected %d; failover redelivery)",
+                    hdr.src, hdr.seq, expect)
+                return
+            if hdr.seq > expect:
+                pend = self._ahead.setdefault(hdr.src, {})
+                if hdr.seq in pend:
+                    spc.record("pml_dup_frame")
+                    return
+                if len(pend) >= self._AHEAD_LIMIT:
+                    spc.record("pml_seq_gap")
+                    raise MPIError(
+                        ERR_INTERN,
+                        f"sequence gap from rank {hdr.src}: stuck at "
+                        f"expected {expect} with {len(pend)} frames "
+                        f"parked ahead — a MATCH frame was lost in "
+                        f"transport failover")
+                spc.record("pml_ooo_frame")
+                pend[hdr.seq] = (hdr, bytes(payload) if payload else b"")
+                return
+            ready = [(hdr, payload)]
+            self._expect_seq[hdr.src] = hdr.seq + 1
+            pend = self._ahead.get(hdr.src)
+            while pend:
+                nxt = self._expect_seq[hdr.src]
+                if nxt not in pend:
+                    break
+                ready.append(pend.pop(nxt))
+                self._expect_seq[hdr.src] = nxt + 1
+            for h, pl in ready:
+                if h.tag <= self.SYSTEM_TAG_BASE:
+                    deliveries.append((None, h, pl))
+                    continue
+                if h.kind == EAGER:
+                    req = self.engine.match_posted(h)
+                    if req is None:
+                        self.engine.add_unexpected(
+                            UnexpectedFrag(h, bytes(pl)))
+                    else:
+                        deliveries.append((req, h, pl))
+                else:  # RNDV_RTS
+                    req = self.engine.match_posted(h)
+                    if req is None:
+                        self.engine.add_unexpected(UnexpectedFrag(h, None))
+                    else:
+                        deliveries.append((req, h, None))
+        for req, h, pl in deliveries:
+            if req is None:
+                fn = self.system_handlers.get(h.tag)
+                if fn is not None:
+                    fn(h, pl)
+            else:
+                self._deliver_matched(req, h, pl)
 
     def _incoming_eager(self, hdr: Header, payload: bytes) -> None:
         with self.engine.lock:

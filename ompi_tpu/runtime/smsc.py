@@ -47,7 +47,6 @@ class _IoVec(ctypes.Structure):
                 ("iov_len", ctypes.c_size_t)]
 
 
-_state = threading.local()
 _lock = threading.Lock()
 _cached: Optional[bool] = None
 _libc = None

@@ -39,7 +39,8 @@ def main() -> int:
     dev = jax.devices()[0]
     print("device:", getattr(dev, "device_kind", dev), file=sys.stderr)
 
-    cfg = tfm.Config(vocab=32768, d_model=1024, n_heads=16,
+    # head_dim=128 (8 heads): fills the MXU contraction lanes (r5)
+    cfg = tfm.Config(vocab=32768, d_model=1024, n_heads=8,
                      n_layers=8, d_ff=4096, seq_len=1024)
     batch = 32
 
