@@ -47,6 +47,9 @@ class Op:
         # user ops never share an executable even with the same name
         _op_counter[0] += 1
         self.uid = _op_counter[0]
+        # precomputed for the mesh verbs' hot path: one attribute load
+        # instead of a name-in-tuple scan per call
+        self.is_pair = name in PAIR_OPS
 
     def jax_reduce(self, a, b):
         """Elementwise combine traceable by XLA (used by the gather path and

@@ -164,7 +164,7 @@ class XlaComm(Intracomm):
         fn = self._fast.get(("allreduce", op.uid))
         if fn is not None and not self.revoked:
             spc.record("allreduce")
-            if op.name in _op.PAIR_OPS:
+            if op.is_pair:
                 from ompi_tpu.coll.xla import _check_device_op
 
                 _check_device_op(op, x)
@@ -192,7 +192,7 @@ class XlaComm(Intracomm):
         fn = self._fast.get(("reduce", op.uid, root))
         if fn is not None and not self.revoked:
             spc.record("reduce")
-            if op.name in _op.PAIR_OPS:
+            if op.is_pair:
                 from ompi_tpu.coll.xla import _check_device_op
 
                 _check_device_op(op, x)
@@ -266,21 +266,101 @@ class XlaComm(Intracomm):
         return out
 
     def scan(self, x, op: _op.Op = _op.SUM):
-        return self._slot("scan")(self, x, op)
+        fn = self._fast.get(("scan", op.uid))
+        if fn is not None and not self.revoked:
+            spc.record("scan")
+            if op.is_pair:
+                from ompi_tpu.coll.xla import _check_device_op
+
+                _check_device_op(op, x)
+            return fn(x)
+        from ompi_tpu.coll.xla import cache_key
+
+        out = self._slot("scan")(self, x, op)
+        self._promote(("scan", op.uid), cache_key("scan", op, (False,)))
+        return out
 
     def exscan(self, x, op: _op.Op = _op.SUM):
-        return self._slot("exscan")(self, x, op)
+        fn = self._fast.get(("exscan", op.uid))
+        if fn is not None and not self.revoked:
+            spc.record("exscan")
+            if op.is_pair:
+                from ompi_tpu.coll.xla import _check_device_op
+
+                _check_device_op(op, x)
+            return fn(x)
+        from ompi_tpu.coll.xla import cache_key
+
+        out = self._slot("exscan")(self, x, op)
+        self._promote(("exscan", op.uid), cache_key("scan", op, (True,)))
+        return out
 
     def barrier(self) -> None:
+        fn = self._fast.get(("barrier",))
+        if fn is not None and not self.revoked:
+            spc.record("barrier")
+            fn()
+            return
         self._slot("barrier")(self)
+        from ompi_tpu.coll.xla import cache_key
+
+        f = self._jit_cache.get(cache_key("barrier"))
+        if f is not None:
+            import jax.numpy as jnp
+
+            # the tiny psum input is constant: device_put it once and
+            # close over it — a fast barrier is one dict hit + dispatch
+            x = self.shard(jnp.ones((self.world_size, 1), jnp.int32))
+            self._fast[("barrier",)] = \
+                lambda _f=f, _x=x: _f(_x).block_until_ready()
 
     def gather(self, x, root: int = 0):
+        fn = self._fast.get(("gather", root))
+        if fn is not None and not self.revoked:
+            spc.record("gather")
+            return fn(x)
         self._check_root(root)
-        return self._slot("gather")(self, x, root)
+        from ompi_tpu.coll.xla import cache_key, XlaColl
+
+        out = self._slot("gather")(self, x, root)
+        # the mesh gather is the allgather strengthening (xla.py gather)
+        # — a CROSS-verb exec key, so the promote must verify the xla
+        # module actually owns the gather slot (another module's gather
+        # could have real root-only semantics while a prior allgather
+        # call populated the allgather executable independently)
+        owner = getattr(self.coll.get("gather"), "__self__", None)
+        if isinstance(owner, XlaColl):
+            self._promote(("gather", root), cache_key("allgather"))
+        return out
 
     def scatter(self, x, root: int = 0):
+        fn = self._fast.get(("scatter", root))
+        if fn is not None and not self.revoked:
+            spc.record("scatter")
+            return fn(x)
         self._check_root(root)
-        return self._slot("scatter")(self, x, root)
+        from ompi_tpu.coll.xla import cache_key
+
+        out = self._slot("scatter")(self, x, root)
+        import jax.numpy as jnp
+
+        r = jnp.int32(root)
+        G = self.size
+
+        def wrap(f):
+            def fast(a, _f=f, _r=r, _G=G):
+                # the slow path's shape contract must hold on EVERY call
+                # (the cached jit would retrace and silently clamp)
+                if a.ndim < 2 or a.shape[1] != _G:
+                    raise MPIError(
+                        ERR_ARG,
+                        f"scatter expects [world, group_size={_G}, ...], "
+                        f"got {tuple(a.shape)}")
+                return _f(a, _r)
+            return fast
+
+        self._promote(("scatter", root), cache_key("scatter"), wrap=wrap)
+        return out
 
     # MPI-style aliases
     Allreduce = allreduce
@@ -402,7 +482,15 @@ class XlaComm(Intracomm):
                 if len(g) > 1
                 for s, d in perm
             )
-        return self._slot_permute()(self, x, global_perm)
+        fn = self._fast.get(("permute", global_perm))
+        if fn is not None and not self.revoked:
+            return fn(x)
+        out = self._slot_permute()(self, x, global_perm)
+        from ompi_tpu.coll.xla import cache_key
+
+        self._promote(("permute", global_perm),
+                      cache_key("permute", extra=(global_perm,)))
+        return out
 
     def _slot_permute(self):
         # permute is not one of the 17 standard slots; fetch the xla module
@@ -485,11 +573,43 @@ class XlaComm(Intracomm):
     def neighbor_allgather(self, x):
         """[W, ...] -> [W, K, ...]: slot k holds the k-th cart neighbor's
         row (zeros off non-periodic edges)."""
-        return self._slot("neighbor_allgather")(self, x)
+        fn = self._fast.get(("neighbor_allgather",))
+        if fn is not None and not self.revoked:
+            spc.record("neighbor_allgather")
+            return fn(x)
+        from ompi_tpu.coll.xla import cache_key
+
+        out = self._slot("neighbor_allgather")(self, x)
+        self._promote(("neighbor_allgather",),
+                      cache_key("neighbor_allgather"))
+        return out
 
     def neighbor_alltoall(self, x):
         """[W, K, ...] -> [W, K, ...]: block k goes to neighbor k."""
-        return self._slot("neighbor_alltoall")(self, x)
+        fn = self._fast.get(("neighbor_alltoall",))
+        if fn is not None and not self.revoked:
+            spc.record("neighbor_alltoall")
+            return fn(x)
+        from ompi_tpu.coll.xla import cache_key
+
+        out = self._slot("neighbor_alltoall")(self, x)
+        K = 2 * len(self._cart().dims)
+
+        def wrap(f):
+            def fast(a, _f=f, _K=K):
+                # slow path's K-block contract, re-checked per call (a
+                # wrong block count would retrace into garbage/IndexError)
+                if a.ndim < 2 or a.shape[1] != _K:
+                    raise MPIError(
+                        ERR_ARG,
+                        f"neighbor_alltoall expects [world, {_K}, ...], "
+                        f"got {tuple(a.shape)}")
+                return _f(a)
+            return fast
+
+        self._promote(("neighbor_alltoall",),
+                      cache_key("neighbor_alltoall"), wrap=wrap)
+        return out
 
     Neighbor_allgather = neighbor_allgather
     Neighbor_alltoall = neighbor_alltoall

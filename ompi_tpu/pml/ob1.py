@@ -380,9 +380,12 @@ class Ob1Pml:
         else:
             raise MPIError(ERR_INTERN, f"bad header kind {hdr.kind}")
 
-    _AHEAD_LIMIT = 64  # parked frames per peer before declaring loss
+    _AHEAD_LIMIT = 64   # parked frames per peer before declaring loss
+    _AHEAD_MAX_AGE = 30.0  # seconds a gap may stand before declaring loss
 
     def _incoming_match_plane(self, hdr: Header, payload) -> None:
+        import time as _time
+
         from ompi_tpu.runtime import spc
 
         deliveries = []
@@ -400,7 +403,15 @@ class Ob1Pml:
                 if hdr.seq in pend:
                     spc.record("pml_dup_frame")
                     return
-                if len(pend) >= self._AHEAD_LIMIT:
+                # two loss witnesses (sustained traffic fills the limit;
+                # a trickle trips the age check on the next arrival) —
+                # with neither, the gap may legitimately be a re-driven
+                # frame still in flight on the slower rail
+                now = _time.monotonic()
+                oldest = min((t for _, _, t in pend.values()),
+                             default=now)
+                if len(pend) >= self._AHEAD_LIMIT or \
+                        now - oldest > self._AHEAD_MAX_AGE:
                     spc.record("pml_seq_gap")
                     raise MPIError(
                         ERR_INTERN,
@@ -409,7 +420,13 @@ class Ob1Pml:
                         f"parked ahead — a MATCH frame was lost in "
                         f"transport failover")
                 spc.record("pml_ooo_frame")
-                pend[hdr.seq] = (hdr, bytes(payload) if payload else b"")
+                if not pend:
+                    self.log.warning(
+                        "frame from rank %d arrived ahead of sequence "
+                        "(got %d, expected %d); parking for reorder",
+                        hdr.src, hdr.seq, expect)
+                pend[hdr.seq] = (hdr,
+                                 bytes(payload) if payload else b"", now)
                 return
             ready = [(hdr, payload)]
             self._expect_seq[hdr.src] = hdr.seq + 1
@@ -418,7 +435,8 @@ class Ob1Pml:
                 nxt = self._expect_seq[hdr.src]
                 if nxt not in pend:
                     break
-                ready.append(pend.pop(nxt))
+                ph, ppl, _t = pend.pop(nxt)
+                ready.append((ph, ppl))
                 self._expect_seq[hdr.src] = nxt + 1
             for h, pl in ready:
                 if h.tag <= self.SYSTEM_TAG_BASE:

@@ -60,10 +60,13 @@ def record(name: str, value: int = 1) -> None:
     Rides every collective's fast path, so the gate is inlined: one
     attribute load off the live Var (no property or extra frame) + the
     suppress-depth check. set_var('spc', 'enable', ...) stays live
-    because _value is the same slot the property reads."""
+    because _value is the same slot the property reads. LOCK-FREE: the
+    GIL serializes each bytecode, so a racing += can at worst lose a
+    count — the same relaxed-atomic trade the reference's SPC_RECORD
+    makes outside MPI_THREAD_MULTIPLE (ompi_spc.c non-atomic adds);
+    the byte/watermark recorders below stay locked (multi-field)."""
     if _enable_var._value and not getattr(_suppress, "depth", 0):
-        with _lock:
-            _counters[name] += value
+        _counters[name] += value
 
 
 def record_bytes(name: str, nbytes: int) -> None:

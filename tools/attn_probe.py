@@ -100,9 +100,15 @@ def main() -> int:
             ts = (max(_scalar_time(jc, p, t_, g_) - rtt, 0)) / K
             n_params = sum(x.size for x in
                            jax.tree_util.tree_leaves(params))
-            fl = 6.0 * n_params * B * T + 12.0 * cfg.n_layers * T * 1024 * B * T
+            fl = 6.0 * n_params * B * T \
+                + 12.0 * cfg.n_layers * T * cfg.d_model * B * T
+            from bench import _PEAK_FLOPS
+
+            kind = getattr(dev, "device_kind", "")
+            peak = next((v for k, v in _PEAK_FLOPS.items()
+                         if kind.lower().startswith(k.lower())), 197e12)
             print(f"step {label}:  {ts*1e3:7.1f} ms  "
-                  f"mfu={fl/ts/197e12:.3f}  "
+                  f"mfu={fl/ts/peak:.3f}  "
                   f"temp={mem.temp_size_in_bytes/2**30:.2f}GB",
                   file=sys.stderr)
     return 0
