@@ -107,8 +107,10 @@ class SmColl(CollModule):
                     q.Wait()
                 self._path = self._seg.path
             else:
-                buf = np.empty(512, np.uint8)
-                req = comm.pml.irecv(buf, 512, BYTE,
+                # PATH_MAX-sized: a long TMPDIR mkstemp path must not
+                # truncate the announcement (ADVICE r4)
+                buf = np.empty(4096, np.uint8)
+                req = comm.pml.irecv(buf, 4096, BYTE,
                                      comm.group.world_rank(0),
                                      _TAG_BOOT, _ccid(comm))
                 req.Wait()
@@ -269,6 +271,132 @@ class SmColl(CollModule):
         self.allreduce(comm, sendbuf if sendbuf is not None else recvbuf,
                        scratch, op)
 
+    # ------------------------------------------- layout verbs (acoll set)
+    # Reference: ompi/mca/coll/acoll (5,610 LoC) extends the xhc verb set
+    # with single-node allgather/gather/scatter/alltoall. Same slot
+    # protocol as allreduce: contributions land in per-rank slots, one
+    # phase makes them visible, copy-out, one phase guards slot reuse.
+    def _slot_rounds(self, comm, nbytes: int):
+        """Yield (offset, length, t1, t2) chunk rounds over the per-rank
+        slots; tickets derive from the shared call sequence."""
+        for off in range(0, nbytes, self._chunk):
+            t1 = self._ticket + 1
+            t2 = self._ticket + 2
+            self._ticket += 2
+            yield off, min(self._chunk, nbytes - off), t1, t2
+
+    def allgather(self, comm, sendbuf, recvbuf) -> None:
+        self._segment(comm)
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        block = np.ascontiguousarray(cv_pack(sobj, scount, sdt)
+                                     ).view(np.uint8).reshape(-1)
+        nb = block.nbytes
+        if nb == 0:
+            return
+        n, r = self._n, comm.rank
+        data = self._data
+        out = np.empty(n * nb, np.uint8)
+        slot = r * self._chunk
+        for off, ln, t1, t2 in self._slot_rounds(comm, nb):
+            data[slot: slot + ln] = block[off: off + ln]
+            self._phase(comm, t1)       # all contributions visible
+            for j in range(n):
+                out[j * nb + off: j * nb + off + ln] = \
+                    data[j * self._chunk: j * self._chunk + ln]
+            self._phase(comm, t2)       # all copied: slots reusable
+        spc.record_bytes("collsm_allgather", n * nb)
+        cv_unpack(out, robj, rcount, rdt)
+
+    def gather(self, comm, sendbuf, recvbuf, root: int) -> None:
+        self._segment(comm)
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        block = np.ascontiguousarray(cv_pack(sobj, scount, sdt)
+                                     ).view(np.uint8).reshape(-1)
+        nb = block.nbytes
+        if nb == 0:
+            return
+        n, r = self._n, comm.rank
+        data = self._data
+        out = np.empty(n * nb, np.uint8) if r == root else None
+        slot = r * self._chunk
+        for off, ln, t1, t2 in self._slot_rounds(comm, nb):
+            data[slot: slot + ln] = block[off: off + ln]
+            self._phase(comm, t1)
+            if r == root:
+                for j in range(n):
+                    out[j * nb + off: j * nb + off + ln] = \
+                        data[j * self._chunk: j * self._chunk + ln]
+            self._phase(comm, t2)
+        if r == root:
+            robj, rcount, rdt = parse_buffer(recvbuf)
+            spc.record_bytes("collsm_gather", n * nb)
+            cv_unpack(out, robj, rcount, rdt)
+
+    def scatter(self, comm, sendbuf, recvbuf, root: int) -> None:
+        self._segment(comm)
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        nb = rcount * rdt.size
+        if nb == 0:
+            return
+        n, r = self._n, comm.rank
+        data = self._data
+        packed = None
+        if r == root:
+            sobj, scount, sdt = parse_buffer(sendbuf)
+            packed = np.ascontiguousarray(cv_pack(sobj, scount, sdt)
+                                          ).view(np.uint8).reshape(-1)
+        out = np.empty(nb, np.uint8)
+        slot = r * self._chunk
+        for off, ln, t1, t2 in self._slot_rounds(comm, nb):
+            if r == root:
+                # root deals piece i of each rank into slot i
+                for i in range(n):
+                    data[i * self._chunk: i * self._chunk + ln] = \
+                        packed[i * nb + off: i * nb + off + ln]
+            self._phase(comm, t1)
+            out[off: off + ln] = data[slot: slot + ln]
+            self._phase(comm, t2)
+        spc.record_bytes("collsm_scatter", nb)
+        cv_unpack(out, robj, rcount, rdt)
+
+    def alltoall(self, comm, sendbuf, recvbuf) -> None:
+        self._segment(comm)
+        if self._chunk < comm.size:
+            # a slot can't hold even 1 byte per destination: the n
+            # sub-block layout below would overflow into the next slot
+            return self._flat.alltoall(comm, sendbuf, recvbuf)
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        packed = np.ascontiguousarray(cv_pack(sobj, scount, sdt)
+                                      ).view(np.uint8).reshape(-1)
+        n, r = self._n, comm.rank
+        sz = packed.nbytes // n         # per-destination block
+        if sz == 0:
+            return
+        data = self._data
+        out = np.empty(packed.nbytes, np.uint8)
+        slot = r * self._chunk
+        per = max(self._chunk // n, 1)  # block bytes movable per round
+        for off in range(0, sz, per):
+            ln = min(per, sz - off)
+            t1 = self._ticket + 1
+            t2 = self._ticket + 2
+            self._ticket += 2
+            # my slot carries n sub-blocks: sub-block d goes to rank d
+            for d in range(n):
+                data[slot + d * ln: slot + (d + 1) * ln] = \
+                    packed[d * sz + off: d * sz + off + ln]
+            self._phase(comm, t1)
+            # block from source s = s's sub-block addressed to me
+            for s in range(n):
+                out[s * sz + off: s * sz + off + ln] = \
+                    data[s * self._chunk + r * ln:
+                         s * self._chunk + (r + 1) * ln]
+            self._phase(comm, t2)
+        spc.record_bytes("collsm_alltoall", packed.nbytes)
+        cv_unpack(out, robj, rcount, rdt)
+
     def __del__(self):  # pragma: no cover
         try:
             if self._seg is not None:
@@ -283,9 +411,16 @@ class SmCollComponent(Component):
     # reference runs xhc above tuned for all-local comms the same way
 
     def query(self, comm=None, **ctx: Any) -> Optional[SmColl]:
+        import platform
+
         from ompi_tpu.comm.communicator import ProcComm
 
         if not get_var("coll_sm", "enable"):
+            return None
+        if platform.machine() not in ("x86_64", "AMD64"):
+            # the flag protocol relies on total store order (see module
+            # docstring); on weak-memory hosts fall through to the pml
+            # path rather than risk a flag outrunning its payload
             return None
         if not isinstance(comm, ProcComm) or comm.size < 2:
             return None

@@ -298,8 +298,10 @@ class Win:
                     q.Wait()
                 ok = seg is not None
             else:
-                buf = np.empty(512, np.uint8)
-                req = comm.pml.irecv(buf, 512, BYTE, comm._world_rank(0),
+                # PATH_MAX-sized recv: a long TMPDIR path must not
+                # truncate the announcement (ADVICE r4)
+                buf = np.empty(4096, np.uint8)
+                req = comm.pml.irecv(buf, 4096, BYTE, comm._world_rank(0),
                                      _SHM_BOOT_TAG, ccid)
                 req.Wait()
                 raw = bytes(buf[: req.status._nbytes])

@@ -21,6 +21,7 @@ from __future__ import annotations
 import itertools
 import struct
 import threading
+import time as _time
 from typing import Dict, Optional
 
 import numpy as np
@@ -384,8 +385,6 @@ class Ob1Pml:
     _AHEAD_MAX_AGE = 30.0  # seconds a gap may stand before declaring loss
 
     def _incoming_match_plane(self, hdr: Header, payload) -> None:
-        import time as _time
-
         from ompi_tpu.runtime import spc
 
         deliveries = []

@@ -1,0 +1,85 @@
+"""MATCH-plane sequence enforcement (reference: ob1's per-proc
+send_sequence + recvfrag ordering guard): failover redelivery must
+collapse to exactly-once, legitimate ahead-of-sequence arrivals must
+reorder, and a true loss must raise instead of silently skipping.
+
+These drive Ob1Pml.handle_incoming directly with hand-packed frames —
+the deterministic version of the frame races transport failover
+produces (tests/procmode/check_failover.py exercises the live path).
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.datatype import INT64
+from ompi_tpu.core.errors import MPIError
+from ompi_tpu.pml.base import EAGER, pack_header
+from ompi_tpu.pml.ob1 import Ob1Pml
+from ompi_tpu.runtime import spc
+
+
+def frame(seq, val, src=5, tag=7, cid=0):
+    payload = np.array([val], np.int64).tobytes()
+    hdr = pack_header(EAGER, src, cid, tag, seq, len(payload), 0, 0)
+    return hdr, payload
+
+
+def recv(pml, src=5, tag=7, cid=0):
+    buf = np.zeros(1, np.int64)
+    return buf, pml.irecv(buf, 1, INT64, src, tag, cid)
+
+
+def test_duplicate_redelivery_dropped():
+    pml = Ob1Pml(my_rank=0)
+    b1, r1 = recv(pml)
+    pml.handle_incoming(*frame(1, 111))
+    assert r1.is_complete and b1[0] == 111
+    before = spc.snapshot().get("pml_dup_frame", 0)
+    b2, r2 = recv(pml)
+    pml.handle_incoming(*frame(1, 999))  # failover re-drive of seq 1
+    assert not r2.is_complete, "duplicate frame must not match a recv"
+    assert spc.snapshot().get("pml_dup_frame", 0) == before + 1
+    pml.handle_incoming(*frame(2, 222))
+    assert r2.is_complete and b2[0] == 222
+
+
+def test_ahead_of_sequence_reorders():
+    """Concurrent rails during failover can deliver seq 3 before 2; the
+    reorder buffer must park it and deliver both in order — and a recv
+    posted by TAG must see them in SEND order, which is exactly what an
+    unchecked stream would violate."""
+    pml = Ob1Pml(my_rank=0)
+    b1, r1 = recv(pml, tag=1)
+    b2, r2 = recv(pml, tag=2)
+    pml.handle_incoming(*frame(1, 100, tag=1))
+    pml.handle_incoming(*frame(3, 300, tag=2))   # ahead: parked
+    assert not r2.is_complete
+    assert spc.snapshot().get("pml_ooo_frame", 0) >= 1
+    pml.handle_incoming(*frame(2, 200, tag=1))   # fills the gap
+    assert r1.is_complete and b1[0] == 100
+    assert r2.is_complete and b2[0] == 300       # drained from the park
+    # ...but the tag-1 stream saw 100 then 200 in order
+    b3, r3 = recv(pml, tag=1)
+    assert r3.is_complete and b3[0] == 200
+
+
+def test_true_loss_raises_on_park_overflow():
+    """A frame lost with a dead transport (seq never arrives) must
+    surface as an error once enough traffic proves it missing — not as
+    a silent permanent skip (the pre-r5 stream had an unchecked seq)."""
+    pml = Ob1Pml(my_rank=0)
+    pml.handle_incoming(*frame(1, 1))
+    # seq 2 was lost; 64 successors park, the 65th declares the gap
+    for s in range(3, 3 + pml._AHEAD_LIMIT):
+        pml.handle_incoming(*frame(s, s))
+    with pytest.raises(MPIError):
+        pml.handle_incoming(*frame(3 + pml._AHEAD_LIMIT, 0))
+
+
+def test_aged_gap_raises():
+    pml = Ob1Pml(my_rank=0)
+    pml._AHEAD_MAX_AGE = 0.0  # every standing gap is instantly stale
+    pml.handle_incoming(*frame(1, 1))
+    pml.handle_incoming(*frame(3, 3))  # parks; gap at seq 2
+    with pytest.raises(MPIError):
+        pml.handle_incoming(*frame(4, 4))
