@@ -276,7 +276,17 @@ class Ob1Pml:
             try:
                 self._send_frame(dst, hdr, payload)
             except BaseException:
-                if self._seq_to.get(dst) == seq:
+                # the self btl delivers INLINE: an exception propagating
+                # out of its send came from the receive handler AFTER
+                # the receiver consumed this seq — rolling back would
+                # stamp the next message with a seq the gate already
+                # passed, and it would be dropped as a failover
+                # duplicate (observed: a singleton Recv hanging forever
+                # after an expected staging-copy error)
+                delivered_inline = getattr(self.endpoints.get(dst),
+                                           "NAME", "") == "self"
+                if not delivered_inline and \
+                        self._seq_to.get(dst) == seq:
                     self._seq_to[dst] = seq - 1
                 raise
 

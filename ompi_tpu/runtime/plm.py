@@ -154,6 +154,11 @@ def spawn_rank(host: Optional[str], agent: str, env: Dict[str, str],
     """Spawn one rank: direct fork for local hosts, launch agent for
     remote ones. The agent sees argv [*agent, host, command]."""
     if host is None or is_local(host):
+        env = dict(env)
+        # only meaningful for direct children: a rank checks this pid
+        # to detect a launcher that died before PR_SET_PDEATHSIG armed
+        # (remote ranks live in another pid namespace — never set it)
+        env["OMPI_TPU_LAUNCHER_PID"] = str(os.getpid())
         return subprocess.Popen([sys.executable, program, *args],
                                 env=env, cwd=cwd)
     cmd = remote_command(env, program, args, cwd)

@@ -31,6 +31,28 @@ def init_process_mode():
     rank = int(os.environ["OMPI_TPU_RANK"])
     size = int(os.environ["OMPI_TPU_SIZE"])
     modex_addr = os.environ["OMPI_TPU_MODEX"]
+    # die with the launcher (reference: prted kills its local ranks on
+    # DVM teardown): a SIGKILLed mpirun must not leave ranks spinning
+    # on a dead modex — PR_SET_PDEATHSIG covers the direct-spawn and
+    # exec-chain (fake_rsh) cases; real ssh relies on its own teardown
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(1, 15, 0, 0, 0)  # PR_SET_PDEATHSIG, SIGTERM
+        # close the set-after-death race: only exit if the REAL launcher
+        # pid is gone (ppid==1 alone false-positives when mpirun itself
+        # is pid 1, e.g. as a container entrypoint)
+        launcher = int(os.environ.get("OMPI_TPU_LAUNCHER_PID", "0"))
+        if launcher and os.getppid() != launcher:
+            try:
+                os.kill(launcher, 0)
+            except ProcessLookupError:
+                os._exit(143)  # launcher already gone
+            except OSError:
+                pass
+    except (OSError, AttributeError):
+        pass
     # dynamic-process support (reference: PMIx nspace + job-level rank):
     # spawned jobs live at a universe-rank offset so every transport
     # endpoint and modex key stays in one flat namespace

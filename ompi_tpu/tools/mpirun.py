@@ -101,6 +101,12 @@ def main(argv: List[str] | None = None) -> int:
     for var, value in opts.mca:
         env_base[f"OMPI_TPU_MCA_{var}"] = value
 
+    # a SIGTERM (shell timeout, operator ^C relayed by a wrapper) must
+    # run the finally block below — a default-handler death leaks every
+    # rank as an orphan spinning on a dead modex (observed: stale ranks
+    # from killed jobs loading the CI host for hours)
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
     procs: List[subprocess.Popen] = []
     try:
         for rank in range(opts.np):
