@@ -52,9 +52,11 @@ register_var("coll_tuned", "use_dynamic_rules", False,
              level=6)
 register_var("coll_tuned", "dynamic_rules_filename", "",
              help="Rules file: lines of '<coll> <comm_size_min> "
-                  "<msg_bytes_min> <algorithm>'; the most specific "
-                  "matching rule wins (reference: "
-                  "coll_tuned_dynamic_rules_filename)", level=6)
+                  "<msg_bytes_min> <algorithm> [key=value ...]'; the "
+                  "most specific matching rule wins, and params like "
+                  "segsize=N tune the chosen algorithm (reference: "
+                  "coll_tuned_dynamic_rules_filename's per-rule "
+                  "fanout/segsize columns)", level=6)
 
 TAG_TUNED = -30  # dedicated tag inside the collective CID plane
 
@@ -78,10 +80,19 @@ _KNOWN_ALGOS = {
 _rules_cache = {"path": None, "mtime": None, "rules": []}
 
 
+# per-rule tunables, scoped to the algorithms that consume them
+# (reference: the fanout/segsize columns of the tuned dynamic-file
+# format) — a param on an algorithm that ignores it is a silent
+# misconfiguration, so the parser rejects it loudly
+_ALGO_PARAMS = {
+    ("allreduce", "ring_segmented"): ("segsize",),
+}
+
+
 def _load_rules(path: str):
-    """[(coll, comm_size_min, msg_bytes_min, algo)] from the rules file
-    (parsed once per mtime; bad lines are skipped with a warning —
-    reference: ompi_coll_tuned_read_rules_config_file)."""
+    """[(coll, comm_size_min, msg_bytes_min, algo, params)] from the
+    rules file (parsed once per mtime; bad lines are skipped with a
+    warning — reference: ompi_coll_tuned_read_rules_config_file)."""
     import os
 
     from ompi_tpu.utils.output import get_logger
@@ -101,17 +112,38 @@ def _load_rules(path: str):
                 if not line:
                     continue
                 parts = line.split()
-                if len(parts) != 4:
-                    log.warning("rules %s:%d: want 4 fields, got %r",
+                if len(parts) < 4:
+                    log.warning("rules %s:%d: want >=4 fields, got %r",
                                 path, ln, line)
                     continue
-                coll, cs, ms, algo = parts
+                coll, cs, ms, algo = parts[:4]
                 if algo not in _KNOWN_ALGOS.get(coll, ()):
                     log.warning("rules %s:%d: unknown %s algorithm %r",
                                 path, ln, coll, algo)
                     continue
+                params = {}
+                ok = True
+                allowed = _ALGO_PARAMS.get((coll, algo), ())
+                for tok in parts[4:]:
+                    k, _, v = tok.partition("=")
+                    if k not in allowed:
+                        log.warning("rules %s:%d: param %r does not "
+                                    "apply to %s/%s (allowed: %s)",
+                                    path, ln, tok, coll, algo,
+                                    ", ".join(allowed) or "none")
+                        ok = False
+                        break
+                    try:
+                        params[k] = int(v)
+                    except ValueError:
+                        log.warning("rules %s:%d: non-integer param %r",
+                                    path, ln, tok)
+                        ok = False
+                        break
+                if not ok:
+                    continue
                 try:
-                    rules.append((coll, int(cs), int(ms), algo))
+                    rules.append((coll, int(cs), int(ms), algo, params))
                 except ValueError:
                     log.warning("rules %s:%d: non-integer bounds in %r",
                                 path, ln, line)
@@ -123,8 +155,8 @@ def _load_rules(path: str):
 
 
 def dynamic_choice(coll: str, comm_size: int, nbytes: int):
-    """The algorithm the dynamic rules select, or None (fall through to
-    the fixed heuristics). Most specific match wins: largest
+    """(algorithm, params) the dynamic rules select, or None (fall
+    through to the fixed heuristics). Most specific match wins: largest
     (comm_size_min, msg_bytes_min) pair that is <= the actual values."""
     if not get_var("coll_tuned", "use_dynamic_rules"):
         return None
@@ -133,10 +165,10 @@ def dynamic_choice(coll: str, comm_size: int, nbytes: int):
         return None
     best = None
     best_key = (-1, -1)
-    for c, cs, ms, algo in _load_rules(path):
+    for c, cs, ms, algo, params in _load_rules(path):
         if c == coll and cs <= comm_size and ms <= nbytes and \
                 (cs, ms) > best_key:
-            best, best_key = algo, (cs, ms)
+            best, best_key = (algo, params), (cs, ms)
     return best
 
 
@@ -148,10 +180,11 @@ class TunedColl(CollModule):
     def allreduce(self, comm, sendbuf, recvbuf, op: _op.Op) -> None:
         choice = get_var("coll_tuned", "allreduce_algorithm")
         nbytes = _msg_bytes(recvbuf)
+        params = {}
         if choice == "auto":
             dyn = dynamic_choice("allreduce", comm.size, nbytes)
-            if dyn is not None and (op.commutative or dyn == "linear"):
-                choice = dyn
+            if dyn is not None and (op.commutative or dyn[0] == "linear"):
+                choice, params = dyn
         if choice == "auto":
             if not op.commutative or comm.size == 1:
                 choice = "linear"
@@ -169,7 +202,10 @@ class TunedColl(CollModule):
         elif choice == "ring":
             _run(comm, alg.allreduce_ring(comm, sendbuf, recvbuf, op))
         else:
-            seg = max(1, get_var("coll_tuned", "allreduce_segsize"))
+            # per-rule segsize overrides the global var (reference: the
+            # dynamic file's per-entry segsize column)
+            seg = max(1, params.get(
+                "segsize", get_var("coll_tuned", "allreduce_segsize")))
             nseg = max(1, -(-nbytes // seg))
             _run(comm, alg.allreduce_ring(comm, sendbuf, recvbuf, op,
                                           nseg=nseg))
@@ -181,7 +217,7 @@ class TunedColl(CollModule):
             total = _msg_bytes(recvbuf)
             dyn = dynamic_choice("allgather", comm.size, total)
             if dyn is not None:
-                choice = dyn
+                choice = dyn[0]
         if choice == "auto":
             total = _msg_bytes(recvbuf)
             choice = ("bruck"
@@ -194,7 +230,19 @@ class TunedColl(CollModule):
 
     # --------------------------------------------------------------- reduce
     def reduce(self, comm, sendbuf, recvbuf, op: _op.Op, root: int) -> None:
-        if op.commutative and comm.size > 2:
+        choice = None
+        if get_var("coll_tuned", "use_dynamic_rules"):
+            # gate BEFORE sizing: _msg_bytes stages device buffers to
+            # host, a cost the default (rules-off) path must not pay
+            dyn = dynamic_choice("reduce", comm.size,
+                                 _msg_bytes(sendbuf if sendbuf is not None
+                                            else recvbuf))
+            if dyn is not None and (op.commutative or dyn[0] == "linear"):
+                choice = dyn[0]
+        if choice is None:
+            choice = ("binomial" if op.commutative and comm.size > 2
+                      else "linear")
+        if choice == "binomial":
             _run(comm, alg.reduce_binomial(comm, sendbuf, recvbuf, op, root))
         else:
             _run(comm, alg.reduce_linear(comm, sendbuf, recvbuf, op, root))

@@ -1,5 +1,6 @@
 """coll/tuned dynamic rule files (reference:
-coll_tuned_dynamic_rules_filename / use_dynamic_rules)."""
+coll_tuned_dynamic_rules_filename / use_dynamic_rules, incl. the
+per-rule tunable columns like segsize)."""
 
 from ompi_tpu.coll.tuned import dynamic_choice, _load_rules
 from ompi_tpu.mca.var import set_var
@@ -13,21 +14,34 @@ def _write(tmp_path, text):
 
 def test_most_specific_rule_wins(tmp_path):
     path = _write(tmp_path, """
-# coll  comm_min  msg_min  algo
+# coll  comm_min  msg_min  algo [params]
 allreduce 2 0       recursive_doubling
 allreduce 2 8192    ring
-allreduce 16 1048576 ring_segmented
+allreduce 16 1048576 ring_segmented segsize=262144
 allgather 2 0       bruck
 """)
     set_var("coll_tuned", "use_dynamic_rules", True)
     set_var("coll_tuned", "dynamic_rules_filename", path)
     try:
-        assert dynamic_choice("allreduce", 4, 100) == "recursive_doubling"
-        assert dynamic_choice("allreduce", 4, 10000) == "ring"
-        assert dynamic_choice("allreduce", 32, 2 << 20) == "ring_segmented"
-        assert dynamic_choice("allreduce", 4, 2 << 20) == "ring"
-        assert dynamic_choice("allgather", 4, 10) == "bruck"
+        assert dynamic_choice("allreduce", 4, 100) == \
+            ("recursive_doubling", {})
+        assert dynamic_choice("allreduce", 4, 10000) == ("ring", {})
+        assert dynamic_choice("allreduce", 32, 2 << 20) == \
+            ("ring_segmented", {"segsize": 262144})
+        assert dynamic_choice("allreduce", 4, 2 << 20) == ("ring", {})
+        assert dynamic_choice("allgather", 4, 10) == ("bruck", {})
         assert dynamic_choice("reduce", 4, 10) is None  # no rule
+    finally:
+        set_var("coll_tuned", "use_dynamic_rules", False)
+        set_var("coll_tuned", "dynamic_rules_filename", "")
+
+
+def test_reduce_rules(tmp_path):
+    path = _write(tmp_path, "reduce 2 0 linear\n")
+    set_var("coll_tuned", "use_dynamic_rules", True)
+    set_var("coll_tuned", "dynamic_rules_filename", path)
+    try:
+        assert dynamic_choice("reduce", 4, 10) == ("linear", {})
     finally:
         set_var("coll_tuned", "use_dynamic_rules", False)
         set_var("coll_tuned", "dynamic_rules_filename", "")
@@ -37,10 +51,82 @@ def test_bad_lines_and_unknown_algos_skipped(tmp_path):
     path = _write(tmp_path, """
 allreduce 2 0 warp_drive        # unknown algorithm
 allreduce not_a_number 0 ring
+allreduce 2 0 ring_segmented segsize=soon  # non-integer param
+allreduce 2 0 ring segsize=4096 # param doesn't apply to this algo
+allreduce 2 0 ring fanout=4     # unknown param
 allgather 2 0 ring
 """)
     rules = _load_rules(path)
-    assert rules == [("allgather", 2, 0, "ring")]
+    assert rules == [("allgather", 2, 0, "ring", {})]
+
+
+def test_params_reach_the_algorithm(tmp_path, monkeypatch):
+    """A rule's segsize must actually change the segment count handed to
+    the ring schedule, and a non-commutative op must refuse a dynamic
+    binomial reduce — the two behavior-bearing consumers."""
+    from ompi_tpu.coll import algorithms as alg
+    from ompi_tpu.coll import tuned as tuned_mod
+    from ompi_tpu.core import op as _op
+    import numpy as np
+
+    path = _write(tmp_path, """
+allreduce 2 0 ring_segmented segsize=1024
+reduce 2 0 binomial
+""")
+    set_var("coll_tuned", "use_dynamic_rules", True)
+    set_var("coll_tuned", "dynamic_rules_filename", path)
+
+    class Bail(Exception):
+        pass
+
+    class FakeComm:
+        size = 4
+        rank = 0
+
+    seen = {}
+
+    def fake_ring(comm, sendbuf, recvbuf, op, nseg=1):
+        seen["nseg"] = nseg
+        raise Bail
+
+    def fake_binomial(comm, sendbuf, recvbuf, op, root):
+        seen["algo"] = "binomial"
+        raise Bail
+
+    def fake_linear(comm, sendbuf, recvbuf, op, root):
+        seen["algo"] = "linear"
+        raise Bail
+
+    monkeypatch.setattr(alg, "allreduce_ring", fake_ring)
+    monkeypatch.setattr(alg, "reduce_binomial", fake_binomial)
+    monkeypatch.setattr(alg, "reduce_linear", fake_linear)
+    mod = tuned_mod.TunedColl()
+    buf = np.zeros(2048, np.uint8)  # 2048 bytes / segsize 1024 -> 2 segs
+    try:
+        try:
+            mod.allreduce(FakeComm(), buf, buf, _op.SUM)
+        except Bail:
+            pass
+        assert seen.get("nseg") == 2, seen
+
+        # commutative op: the binomial rule applies
+        try:
+            mod.reduce(FakeComm(), buf, buf, _op.SUM, 0)
+        except Bail:
+            pass
+        assert seen.get("algo") == "binomial", seen
+
+        # non-commutative op: the binomial rule must be refused
+        seen.clear()
+        nc = _op.Op.Create(lambda a, b: a, commute=False, name="nc")
+        try:
+            mod.reduce(FakeComm(), buf, buf, nc, 0)
+        except Bail:
+            pass
+        assert seen.get("algo") == "linear", seen
+    finally:
+        set_var("coll_tuned", "use_dynamic_rules", False)
+        set_var("coll_tuned", "dynamic_rules_filename", "")
 
 
 def test_disabled_returns_none(tmp_path):
