@@ -1,0 +1,55 @@
+/* ring_c.c — the classic token ring, in C against the framework's C
+ * binding (reference: examples/ring_c.c of the upstream tree).
+ *
+ *   python -m ompi_tpu.tools.mpicc examples/ring_c.c -o /tmp/ring_c
+ *   python -m ompi_tpu.tools.mpirun -np 4 /tmp/ring_c
+ */
+#include <stdio.h>
+
+#include <mpi.h>
+
+int main(int argc, char *argv[]) {
+    int rank, size, next, prev, message;
+
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+    next = (rank + 1) % size;
+    prev = (rank + size - 1) % size;
+
+    if (rank == 0) {
+        message = 10;
+        printf("Process 0 sending %d to %d, tag 201 (%d processes)\n",
+               message, next, size);
+        MPI_Send(&message, 1, MPI_INT, next, 201, MPI_COMM_WORLD);
+    }
+
+    while (1) {
+        MPI_Recv(&message, 1, MPI_INT, prev, 201, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE);
+        if (rank == 0) {
+            --message;
+            printf("Process 0 decremented value: %d\n", message);
+        }
+        MPI_Send(&message, 1, MPI_INT, next, 201, MPI_COMM_WORLD);
+        if (message == 0) {
+            printf("Process %d exiting\n", rank);
+            break;
+        }
+    }
+    if (rank == 0)
+        MPI_Recv(&message, 1, MPI_INT, prev, 201, MPI_COMM_WORLD,
+                 MPI_STATUS_IGNORE);
+
+    /* collective smoke: everyone agrees on the sum of ranks */
+    {
+        double mine = (double)rank, total = 0.0;
+        MPI_Allreduce(&mine, &total, 1, MPI_DOUBLE, MPI_SUM,
+                      MPI_COMM_WORLD);
+        if (rank == 0)
+            printf("Allreduce sum of ranks: %g\n", total);
+    }
+    MPI_Barrier(MPI_COMM_WORLD);
+    MPI_Finalize();
+    return 0;
+}

@@ -1,0 +1,345 @@
+/* capi.c — the C binding's implementation: classic MPI C calls backed
+ * by the TPU-native Python runtime through an embedded interpreter.
+ *
+ * Reference analog: ompi/mpi/c/ (the generated C binding layer over the
+ * internal ompi_* API). Redesign for this framework: the "internal API"
+ * IS the Python runtime, so the binding embeds CPython once at
+ * MPI_Init, resolves COMM_WORLD, and forwards each call while viewing
+ * the caller's C buffers zero-copy as numpy arrays (PyMemoryView over
+ * the raw pointer — no staging copies on the C side; the launch
+ * contract arrives via the OMPI_TPU_* environment like any rank).
+ *
+ * Threading: single GIL holder per call (PyGILState_Ensure), released
+ * between calls so MPI_THREAD_FUNNELED-style C programs work.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdio.h>
+#include <time.h>
+
+#include "mpi.h"
+
+static PyObject *g_mod;     /* ompi_tpu */
+static PyObject *g_world;   /* resolved ProcComm (proxy unwrapped) */
+static PyObject *g_np;      /* numpy */
+static PyThreadState *g_main;
+static int g_initialized;
+static int g_finalized;
+
+/* ------------------------------------------------------------ helpers */
+static const char *dt_np(MPI_Datatype dt) {
+    switch (dt) {
+    case MPI_CHAR:   return "int8";
+    case MPI_BYTE:   return "uint8";
+    case MPI_INT:    return "int32";
+    case MPI_LONG:   return "int64";
+    case MPI_FLOAT:  return "float32";
+    case MPI_DOUBLE: return "float64";
+    }
+    return NULL;
+}
+
+static Py_ssize_t dt_size(MPI_Datatype dt) {
+    switch (dt) {
+    case MPI_CHAR: case MPI_BYTE: return 1;
+    case MPI_INT: case MPI_FLOAT: return 4;
+    case MPI_LONG: case MPI_DOUBLE: return 8;
+    }
+    return 0;
+}
+
+static const char *op_name(MPI_Op op) {
+    switch (op) {
+    case MPI_SUM:  return "SUM";
+    case MPI_MAX:  return "MAX";
+    case MPI_MIN:  return "MIN";
+    case MPI_PROD: return "PROD";
+    }
+    return NULL;
+}
+
+static int err_out(const char *where) {
+    if (PyErr_Occurred()) {
+        fprintf(stderr, "[ompi_tpu capi] %s failed:\n", where);
+        PyErr_Print();
+    } else {
+        fprintf(stderr, "[ompi_tpu capi] %s failed\n", where);
+    }
+    return MPI_ERR_OTHER;
+}
+
+/* zero-copy numpy view over a C buffer */
+static PyObject *as_array(const void *buf, int count, MPI_Datatype dt,
+                          int writable) {
+    const char *npdt = dt_np(dt);
+    Py_ssize_t nbytes = (Py_ssize_t)count * dt_size(dt);
+    if (!npdt || count < 0) {
+        PyErr_SetString(PyExc_ValueError, "bad datatype/count");
+        return NULL;
+    }
+    PyObject *mv = PyMemoryView_FromMemory(
+        (char *)buf, nbytes, writable ? PyBUF_WRITE : PyBUF_READ);
+    if (!mv) return NULL;
+    PyObject *arr = PyObject_CallMethod(g_np, "frombuffer", "Os", mv,
+                                        npdt);
+    Py_DECREF(mv);
+    return arr;
+}
+
+static PyObject *comm_obj(MPI_Comm comm) {
+    if (comm == MPI_COMM_WORLD) return g_world;
+    PyErr_SetString(PyExc_ValueError,
+                    "the C binding currently exposes MPI_COMM_WORLD "
+                    "only (build sub-comms in Python)");
+    return NULL;
+}
+
+static PyObject *op_obj(MPI_Op op) {
+    const char *name = op_name(op);
+    if (!name) {
+        PyErr_SetString(PyExc_ValueError, "unknown MPI_Op");
+        return NULL;
+    }
+    PyObject *m = PyImport_ImportModule("ompi_tpu.core.op");
+    if (!m) return NULL;
+    PyObject *o = PyObject_GetAttrString(m, name);
+    Py_DECREF(m);
+    return o;
+}
+
+#define ENTER PyGILState_STATE gst_ = PyGILState_Ensure()
+#define LEAVE PyGILState_Release(gst_)
+
+/* ---------------------------------------------------------- lifecycle */
+int MPI_Init(int *argc, char ***argv) {
+    (void)argc; (void)argv;
+    if (g_initialized) return MPI_SUCCESS;
+    if (g_finalized) {
+        /* the standard forbids re-init, and the released-GIL state
+         * after finalize would make it a CPython fatal error anyway */
+        fprintf(stderr, "[ompi_tpu capi] MPI_Init after MPI_Finalize "
+                        "is not allowed\n");
+        return MPI_ERR_OTHER;
+    }
+    if (!Py_IsInitialized())
+        Py_InitializeEx(0);          /* keep the C program's signals */
+    g_mod = PyImport_ImportModule("ompi_tpu");
+    if (!g_mod) return err_out("import ompi_tpu");
+    g_np = PyImport_ImportModule("numpy");
+    if (!g_np) return err_out("import numpy");
+    /* unwrap the lazy COMM_WORLD proxy via its getter so every later
+     * call skips the proxy __getattr__ */
+    PyObject *proxy = PyObject_GetAttrString(g_mod, "COMM_WORLD");
+    if (!proxy) return err_out("COMM_WORLD");
+    PyObject *getter = PyObject_GetAttrString(proxy, "_getter");
+    if (getter) {
+        g_world = PyObject_CallNoArgs(getter);
+        Py_DECREF(getter);
+        Py_DECREF(proxy);
+        if (!g_world) return err_out("world init");
+    } else {
+        PyErr_Clear();
+        g_world = proxy;
+    }
+    g_initialized = 1;
+    g_main = PyEval_SaveThread();    /* release the GIL between calls */
+    return MPI_SUCCESS;
+}
+
+int MPI_Initialized(int *flag) {
+    /* stays true after finalize, per the standard */
+    if (flag) *flag = g_initialized || g_finalized;
+    return MPI_SUCCESS;
+}
+
+int MPI_Finalize(void) {
+    if (!g_initialized) return MPI_SUCCESS;
+    PyEval_RestoreThread(g_main);
+    PyObject *r = PyObject_CallMethod(g_mod, "Finalize", NULL);
+    int rc = r ? MPI_SUCCESS : err_out("Finalize");
+    Py_XDECREF(r);
+    Py_XDECREF(g_world);
+    Py_XDECREF(g_np);
+    Py_XDECREF(g_mod);
+    g_initialized = 0;
+    g_finalized = 1;
+    /* keep the interpreter alive: Py_Finalize with live daemon threads
+     * (progress engine) is UB; the process is exiting anyway */
+    g_main = PyEval_SaveThread();
+    return rc;
+}
+
+int MPI_Abort(MPI_Comm comm, int errorcode) {
+    (void)comm;
+    fprintf(stderr, "[ompi_tpu capi] MPI_Abort(%d)\n", errorcode);
+    _exit(errorcode ? errorcode : 1);
+}
+
+/* ------------------------------------------------------------ queries */
+static int int_query(MPI_Comm comm, const char *method, int *out) {
+    ENTER;
+    int rc = MPI_SUCCESS;
+    PyObject *c = comm_obj(comm);
+    PyObject *r = c ? PyObject_CallMethod(c, method, NULL) : NULL;
+    if (!r) rc = err_out(method);
+    else { *out = (int)PyLong_AsLong(r); Py_DECREF(r); }
+    LEAVE;
+    return rc;
+}
+
+int MPI_Comm_rank(MPI_Comm comm, int *rank) {
+    return int_query(comm, "Get_rank", rank);
+}
+
+int MPI_Comm_size(MPI_Comm comm, int *size) {
+    return int_query(comm, "Get_size", size);
+}
+
+double MPI_Wtime(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+/* -------------------------------------------------------------- pt2pt */
+int MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
+             int tag, MPI_Comm comm) {
+    ENTER;
+    int rc = MPI_SUCCESS;
+    PyObject *c = comm_obj(comm);
+    PyObject *arr = c ? as_array(buf, count, dt, 0) : NULL;
+    PyObject *r = arr ? PyObject_CallMethod(c, "Send", "Oii", arr, dest,
+                                            tag) : NULL;
+    if (!r) rc = err_out("MPI_Send");
+    Py_XDECREF(r);
+    Py_XDECREF(arr);
+    LEAVE;
+    return rc;
+}
+
+int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
+             MPI_Comm comm, MPI_Status *status) {
+    ENTER;
+    int rc = MPI_SUCCESS;
+    PyObject *c = comm_obj(comm);
+    PyObject *arr = c ? as_array(buf, count, dt, 1) : NULL;
+    PyObject *st = NULL, *r = NULL;
+    if (arr) {
+        st = PyObject_CallMethod(g_mod, "Status", NULL);
+        r = st ? PyObject_CallMethod(c, "Recv", "OiiO", arr, source,
+                                     tag, st) : NULL;
+    }
+    if (!r) rc = err_out("MPI_Recv");
+    else if (status) {
+        PyObject *src = PyObject_GetAttrString(st, "source");
+        PyObject *tg = PyObject_GetAttrString(st, "tag");
+        PyObject *nb = PyObject_GetAttrString(st, "_nbytes");
+        status->MPI_SOURCE = src ? (int)PyLong_AsLong(src) : -1;
+        status->MPI_TAG = tg ? (int)PyLong_AsLong(tg) : -1;
+        status->_nbytes = nb ? (int)PyLong_AsLong(nb) : 0;
+        status->MPI_ERROR = MPI_SUCCESS;
+        Py_XDECREF(src); Py_XDECREF(tg); Py_XDECREF(nb);
+        PyErr_Clear();
+    }
+    Py_XDECREF(r);
+    Py_XDECREF(st);
+    Py_XDECREF(arr);
+    LEAVE;
+    return rc;
+}
+
+int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt,
+                  int *count) {
+    Py_ssize_t sz = dt_size(dt);
+    if (!status || !sz) return MPI_ERR_ARG;
+    *count = (int)(status->_nbytes / sz);
+    return MPI_SUCCESS;
+}
+
+/* -------------------------------------------------------- collectives */
+int MPI_Barrier(MPI_Comm comm) {
+    ENTER;
+    int rc = MPI_SUCCESS;
+    PyObject *c = comm_obj(comm);
+    PyObject *r = c ? PyObject_CallMethod(c, "Barrier", NULL) : NULL;
+    if (!r) rc = err_out("MPI_Barrier");
+    Py_XDECREF(r);
+    LEAVE;
+    return rc;
+}
+
+int MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
+              MPI_Comm comm) {
+    ENTER;
+    int rc = MPI_SUCCESS;
+    PyObject *c = comm_obj(comm);
+    PyObject *arr = c ? as_array(buf, count, dt, 1) : NULL;
+    PyObject *r = arr ? PyObject_CallMethod(c, "Bcast", "Oi", arr, root)
+                      : NULL;
+    if (!r) rc = err_out("MPI_Bcast");
+    Py_XDECREF(r);
+    Py_XDECREF(arr);
+    LEAVE;
+    return rc;
+}
+
+int MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype dt, MPI_Op op, MPI_Comm comm) {
+    ENTER;
+    int rc = MPI_SUCCESS;
+    PyObject *c = comm_obj(comm);
+    PyObject *s = c ? as_array(sendbuf, count, dt, 0) : NULL;
+    PyObject *d = s ? as_array(recvbuf, count, dt, 1) : NULL;
+    PyObject *o = d ? op_obj(op) : NULL;
+    PyObject *r = o ? PyObject_CallMethod(c, "Allreduce", "OOO", s, d, o)
+                    : NULL;
+    if (!r) rc = err_out("MPI_Allreduce");
+    Py_XDECREF(r); Py_XDECREF(o); Py_XDECREF(d); Py_XDECREF(s);
+    LEAVE;
+    return rc;
+}
+
+int MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
+               MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm) {
+    ENTER;
+    int rc = MPI_SUCCESS;
+    PyObject *c = comm_obj(comm);
+    PyObject *s = c ? as_array(sendbuf, count, dt, 0) : NULL;
+    /* non-roots may legally pass recvbuf=NULL; the runtime wants an
+     * array object, so give it a scratch row there */
+    PyObject *d = NULL;
+    if (s) {
+        if (recvbuf)
+            d = as_array(recvbuf, count, dt, 1);
+        else
+            d = PyObject_CallMethod(g_np, "zeros", "is", count,
+                                    dt_np(dt));
+    }
+    PyObject *o = d ? op_obj(op) : NULL;
+    PyObject *r = o ? PyObject_CallMethod(c, "Reduce", "OOOi", s, d, o,
+                                          root) : NULL;
+    if (!r) rc = err_out("MPI_Reduce");
+    Py_XDECREF(r); Py_XDECREF(o); Py_XDECREF(d); Py_XDECREF(s);
+    LEAVE;
+    return rc;
+}
+
+int MPI_Allgather(const void *sendbuf, int sendcount,
+                  MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                  MPI_Datatype recvtype, MPI_Comm comm) {
+    ENTER;
+    int rc = MPI_SUCCESS;
+    int size = 0;
+    PyObject *c = comm_obj(comm);
+    PyObject *s = c ? as_array(sendbuf, sendcount, sendtype, 0) : NULL;
+    PyObject *d = NULL, *r = NULL;
+    if (s && int_query(comm, "Get_size", &size) == MPI_SUCCESS)
+        d = as_array(recvbuf, recvcount * size, recvtype, 1);
+    if (d)
+        r = PyObject_CallMethod(c, "Allgather", "OO", s, d);
+    if (!r) rc = err_out("MPI_Allgather");
+    Py_XDECREF(r); Py_XDECREF(d); Py_XDECREF(s);
+    LEAVE;
+    return rc;
+}

@@ -1,0 +1,88 @@
+/* mpi.h — C binding for the ompi_tpu framework.
+ *
+ * Reference analog: ompi/include/mpi.h.in — the reference's primary
+ * user-facing surface is the C API; this header exposes the same core
+ * subset over the TPU-native Python runtime via an embedded
+ * interpreter (ompi_tpu/native/capi.c). Build programs with the
+ * `python -m ompi_tpu.tools.mpicc` wrapper (the mpicc analog), run
+ * them with the usual launcher:
+ *
+ *     python -m ompi_tpu.tools.mpicc ring.c -o ring
+ *     python -m ompi_tpu.tools.mpirun -np 4 ./ring
+ */
+#ifndef OMPI_TPU_MPI_H
+#define OMPI_TPU_MPI_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int MPI_Comm;
+#define MPI_COMM_NULL  (-1)
+#define MPI_COMM_WORLD 0
+#define MPI_COMM_SELF  1
+
+typedef int MPI_Datatype;
+#define MPI_DATATYPE_NULL 0
+#define MPI_CHAR          1
+#define MPI_BYTE          2
+#define MPI_INT           3
+#define MPI_LONG          4
+#define MPI_FLOAT         5
+#define MPI_DOUBLE        6
+#define MPI_INT32_T       MPI_INT
+#define MPI_INT64_T       MPI_LONG
+#define MPI_UNSIGNED_CHAR MPI_BYTE
+
+typedef int MPI_Op;
+#define MPI_OP_NULL 0
+#define MPI_SUM     1
+#define MPI_MAX     2
+#define MPI_MIN     3
+#define MPI_PROD    4
+
+#define MPI_ANY_SOURCE (-1)
+#define MPI_ANY_TAG    (-1)
+#define MPI_PROC_NULL  (-2)
+
+#define MPI_SUCCESS     0
+#define MPI_ERR_OTHER   16
+#define MPI_ERR_ARG     13
+#define MPI_MAX_ERROR_STRING 256
+
+typedef struct {
+    int MPI_SOURCE;
+    int MPI_TAG;
+    int MPI_ERROR;
+    int _nbytes;   /* internal: received byte count for MPI_Get_count */
+} MPI_Status;
+#define MPI_STATUS_IGNORE ((MPI_Status *)0)
+
+int    MPI_Init(int *argc, char ***argv);
+int    MPI_Finalize(void);
+int    MPI_Initialized(int *flag);
+int    MPI_Comm_rank(MPI_Comm comm, int *rank);
+int    MPI_Comm_size(MPI_Comm comm, int *size);
+int    MPI_Send(const void *buf, int count, MPI_Datatype dt, int dest,
+                int tag, MPI_Comm comm);
+int    MPI_Recv(void *buf, int count, MPI_Datatype dt, int source,
+                int tag, MPI_Comm comm, MPI_Status *status);
+int    MPI_Get_count(const MPI_Status *status, MPI_Datatype dt,
+                     int *count);
+int    MPI_Barrier(MPI_Comm comm);
+int    MPI_Bcast(void *buf, int count, MPI_Datatype dt, int root,
+                 MPI_Comm comm);
+int    MPI_Allreduce(const void *sendbuf, void *recvbuf, int count,
+                     MPI_Datatype dt, MPI_Op op, MPI_Comm comm);
+int    MPI_Reduce(const void *sendbuf, void *recvbuf, int count,
+                  MPI_Datatype dt, MPI_Op op, int root, MPI_Comm comm);
+int    MPI_Allgather(const void *sendbuf, int sendcount,
+                     MPI_Datatype sendtype, void *recvbuf, int recvcount,
+                     MPI_Datatype recvtype, MPI_Comm comm);
+int    MPI_Abort(MPI_Comm comm, int errorcode);
+double MPI_Wtime(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* OMPI_TPU_MPI_H */

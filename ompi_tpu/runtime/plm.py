@@ -137,6 +137,17 @@ def _fwd_env(env: Dict[str, str]) -> List[Tuple[str, str]]:
     return out
 
 
+def _rank_argv(program: str, args: Sequence[str]) -> List[str]:
+    """Python scripts run under this interpreter; an EXECUTABLE program
+    (e.g. a C binary built against the mpicc wrapper) execs directly —
+    the embedded runtime reads the same OMPI_TPU_* launch contract.
+    Anything else (extensionless python script, no exec bit) falls back
+    to the interpreter, preserving the pre-binding behavior."""
+    if not program.endswith(".py") and os.access(program, os.X_OK):
+        return [program, *args]
+    return [sys.executable, program, *args]
+
+
 def remote_command(env: Dict[str, str], program: str,
                    args: Sequence[str], cwd: str) -> str:
     """One shell line carrying the whole launch contract. Assumes the
@@ -144,7 +155,7 @@ def remote_command(env: Dict[str, str], program: str,
     filesystem layout on every node (reference docs make the same
     assumption for non-shared-FS launches)."""
     envs = " ".join(f"{k}={shlex.quote(v)}" for k, v in _fwd_env(env))
-    argv = " ".join(shlex.quote(a) for a in (sys.executable, program, *args))
+    argv = " ".join(shlex.quote(a) for a in _rank_argv(program, args))
     return f"cd {shlex.quote(cwd)} && exec env {envs} {argv}"
 
 
@@ -159,7 +170,7 @@ def spawn_rank(host: Optional[str], agent: str, env: Dict[str, str],
         # to detect a launcher that died before PR_SET_PDEATHSIG armed
         # (remote ranks live in another pid namespace — never set it)
         env["OMPI_TPU_LAUNCHER_PID"] = str(os.getpid())
-        return subprocess.Popen([sys.executable, program, *args],
+        return subprocess.Popen(_rank_argv(program, args),
                                 env=env, cwd=cwd)
     cmd = remote_command(env, program, args, cwd)
     return subprocess.Popen([*agent_argv(agent), host, cmd])

@@ -1,0 +1,104 @@
+"""mpicc — compiler wrapper for the C binding.
+
+Reference: ompi/tools/wrappers (mpicc adds the include/lib flags so
+`mpicc ring.c -o ring` just works). Here the wrapper additionally
+builds the binding library itself on first use (the same on-demand
+pattern as ompi_tpu/native/__init__.py) and bakes an rpath so the
+produced binary runs without LD_LIBRARY_PATH:
+
+    python -m ompi_tpu.tools.mpicc ring.c -o ring
+    python -m ompi_tpu.tools.mpirun -np 4 ./ring
+
+Pass ``--showme`` to print the flags instead of compiling (the
+reference wrapper's introspection contract).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+import tempfile
+from typing import List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_NATIVE = os.path.join(os.path.dirname(_HERE), "native")
+_CAPI_SRC = os.path.join(_NATIVE, "capi.c")
+_CAPI_SO = os.path.join(_NATIVE, "libompi_tpu_c.so")
+
+
+def _python_embed_flags() -> List[str]:
+    """Include + link flags for embedding this interpreter (what
+    `python3-config --includes --embed --ldflags` reports, but read
+    from sysconfig so it matches THIS python even in venvs)."""
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    flags = [f"-I{inc}"]
+    if libdir:
+        flags += [f"-L{libdir}", f"-Wl,-rpath,{libdir}"]
+    flags += [f"-lpython{ver}", "-ldl", "-lm"]
+    return flags
+
+
+_CAPI_HDR = os.path.join(_NATIVE, "mpi.h")
+
+
+def build_capi(cc: str = "cc") -> Optional[str]:
+    """Compile libompi_tpu_c.so if stale (vs BOTH sources — a header
+    edit must rebuild or the lib's struct offsets go stale); returns
+    the path or None."""
+    srcs = [_CAPI_SRC, _CAPI_HDR]
+    missing = [s for s in srcs if not os.path.exists(s)]
+    if missing:
+        sys.stderr.write(
+            "mpicc: binding sources missing (%s) — reinstall with the "
+            "package data intact\n" % ", ".join(missing))
+        return None
+    if os.path.exists(_CAPI_SO) and os.path.getmtime(_CAPI_SO) >= \
+            max(os.path.getmtime(s) for s in srcs):
+        return _CAPI_SO
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_NATIVE)
+    os.close(fd)
+    cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{_NATIVE}", _CAPI_SRC,
+           "-o", tmp] + _python_embed_flags()
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True,
+                       timeout=180)
+        os.rename(tmp, _CAPI_SO)
+        return _CAPI_SO
+    except (subprocess.SubprocessError, OSError) as e:
+        sys.stderr.write("libompi_tpu_c build failed: %s\n%s\n"
+                         % (" ".join(cmd),
+                            getattr(e, "stderr", "") or str(e)))
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def wrapper_flags() -> List[str]:
+    """The flags mpicc injects around the user's arguments."""
+    return [f"-I{_NATIVE}", f"-L{_NATIVE}", f"-Wl,-rpath,{_NATIVE}",
+            "-lompi_tpu_c"] + _python_embed_flags()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    cc = os.environ.get("OMPI_TPU_CC", "cc")
+    if "--showme" in argv:
+        print(" ".join([cc] + wrapper_flags()))
+        return 0
+    if build_capi(cc) is None:
+        return 1
+    # user args first so their -o/-c land naturally; link flags last
+    # (the classic wrapper ordering: libraries after objects)
+    cmd = [cc] + argv + wrapper_flags()
+    return subprocess.run(cmd).returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""C binding: classic MPI C programs against libompi_tpu_c
+(reference: ompi/mpi/c bindings + the mpicc wrapper contract).
+
+Compiles examples/ring_c.c with the mpicc wrapper and runs it as real
+multi-rank jobs through the launcher — C binaries exec directly and
+their embedded runtime reads the same OMPI_TPU_* launch contract.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from tests.test_process_mode import REPO, subprocess_env
+
+pytestmark = pytest.mark.skipif(shutil.which("cc") is None,
+                                reason="no C compiler")
+
+
+@pytest.fixture(scope="module")
+def ring_bin(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("capi") / "ring_c")
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpicc",
+         "examples/ring_c.c", "-o", out],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env=subprocess_env())
+    assert r.returncode == 0, r.stdout + r.stderr
+    return out
+
+
+def test_mpicc_showme():
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpicc", "--showme"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+        env=subprocess_env())
+    assert r.returncode == 0, r.stderr
+    assert "-lompi_tpu_c" in r.stdout and "-I" in r.stdout
+
+
+def test_c_ring_4_ranks(ring_bin):
+    """BASELINE ladder #1 shape, but the ranks are C binaries."""
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "4",
+         ring_bin],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env=subprocess_env())
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Process 0 decremented value: 0" in r.stdout
+    assert r.stdout.count("exiting") == 4
+    assert "Allreduce sum of ranks: 6" in r.stdout
+
+
+def test_c_ring_2_ranks_tcp_only(ring_bin):
+    """The same binary over the tcp rail (no shared memory)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "2",
+         "--mca", "btl_btl", "^sm", ring_bin],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env=subprocess_env())
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Allreduce sum of ranks: 1" in r.stdout
