@@ -30,30 +30,43 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
 
 
-def _build() -> bool:
-    """Compile the native library. Multiple ranks may race here: each
-    compiles to a private temp file, then atomically renames into place
-    (last writer wins; identical content makes the race harmless)."""
-    log = get_logger("native")
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
-    os.close(fd)
+def compile_so(cmd_prefix, srcs, dest, timeout=180, on_error=None):
+    """Race-safe on-demand compile shared by every native lib: build to
+    a private temp file in dest's directory, atomically rename into
+    place (last writer wins; identical content makes the race
+    harmless). Returns dest or None; failures (including an unwritable
+    destination directory) go through ``on_error(message)``."""
+    report = on_error or (lambda m: get_logger("native").warning("%s", m))
     try:
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-             "-o", tmp] + _SRCS,
-            check=True, capture_output=True, text=True, timeout=120,
-        )
-        os.rename(tmp, _SO)
-        return True
+        fd, tmp = tempfile.mkstemp(suffix=".so",
+                                   dir=os.path.dirname(dest))
+        os.close(fd)
+    except OSError as e:
+        report(f"cannot write {os.path.dirname(dest)}: {e}")
+        return None
+    try:
+        subprocess.run(list(cmd_prefix) + list(srcs) + ["-o", tmp],
+                       check=True, capture_output=True, text=True,
+                       timeout=timeout)
+        os.rename(tmp, dest)
+        return dest
     except (subprocess.SubprocessError, OSError) as e:
         detail = getattr(e, "stderr", "") or str(e)
-        log.warning("native build failed (falling back to Python): %s",
-                    detail.strip()[:500])
+        report(f"native build failed: {detail.strip()[:500]}")
         try:
             os.unlink(tmp)
         except OSError:
             pass
-        return False
+        return None
+
+
+def _build() -> bool:
+    log = get_logger("native")
+    return compile_so(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17"], _SRCS, _SO,
+        timeout=120,
+        on_error=lambda m: log.warning(
+            "%s (falling back to Python)", m)) is not None
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

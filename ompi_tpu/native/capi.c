@@ -226,9 +226,12 @@ int MPI_Recv(void *buf, int count, MPI_Datatype dt, int source, int tag,
     PyObject *arr = c ? as_array(buf, count, dt, 1) : NULL;
     PyObject *st = NULL, *r = NULL;
     if (arr) {
-        st = PyObject_CallMethod(g_mod, "Status", NULL);
+        /* MPI_STATUS_IGNORE: skip the Status allocation entirely */
+        st = status ? PyObject_CallMethod(g_mod, "Status", NULL)
+                    : Py_None;
         r = st ? PyObject_CallMethod(c, "Recv", "OiiO", arr, source,
                                      tag, st) : NULL;
+        if (st == Py_None) st = NULL;
     }
     if (!r) rc = err_out("MPI_Recv");
     else if (status) {
@@ -253,7 +256,10 @@ int MPI_Get_count(const MPI_Status *status, MPI_Datatype dt,
                   int *count) {
     Py_ssize_t sz = dt_size(dt);
     if (!status || !sz) return MPI_ERR_ARG;
-    *count = (int)(status->_nbytes / sz);
+    /* a partial element means the count is undefined, per the
+     * standard (matches the Python Status.Get_count) */
+    *count = (status->_nbytes % sz) ? MPI_UNDEFINED
+                                    : (int)(status->_nbytes / sz);
     return MPI_SUCCESS;
 }
 

@@ -44,6 +44,7 @@ typedef int MPI_Op;
 #define MPI_ANY_SOURCE (-1)
 #define MPI_ANY_TAG    (-1)
 #define MPI_PROC_NULL  (-2)
+#define MPI_UNDEFINED  (-32766)
 
 #define MPI_SUCCESS     0
 #define MPI_ERR_OTHER   16

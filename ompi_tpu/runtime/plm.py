@@ -142,9 +142,18 @@ def _rank_argv(program: str, args: Sequence[str]) -> List[str]:
     (e.g. a C binary built against the mpicc wrapper) execs directly —
     the embedded runtime reads the same OMPI_TPU_* launch contract.
     Anything else (extensionless python script, no exec bit) falls back
-    to the interpreter, preserving the pre-binding behavior."""
-    if not program.endswith(".py") and os.access(program, os.X_OK):
-        return [program, *args]
+    to the interpreter. Bare names: exec resolves them via PATH, so a
+    cwd-local executable must be qualified with ./ or it would miss."""
+    import shutil
+
+    if not program.endswith(".py"):
+        if os.sep in program:
+            if os.access(program, os.X_OK):
+                return [program, *args]
+        elif shutil.which(program):
+            return [program, *args]
+        elif os.access(program, os.X_OK):
+            return [os.path.join(".", program), *args]
     return [sys.executable, program, *args]
 
 

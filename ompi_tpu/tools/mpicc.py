@@ -25,7 +25,6 @@ from typing import List, Optional
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _NATIVE = os.path.join(os.path.dirname(_HERE), "native")
 _CAPI_SRC = os.path.join(_NATIVE, "capi.c")
-_CAPI_SO = os.path.join(_NATIVE, "libompi_tpu_c.so")
 
 
 def _python_embed_flags() -> List[str]:
@@ -46,10 +45,26 @@ def _python_embed_flags() -> List[str]:
 _CAPI_HDR = os.path.join(_NATIVE, "mpi.h")
 
 
+def _lib_dirs() -> List[str]:
+    """Candidate homes for libompi_tpu_c.so: next to the sources, then
+    a per-user temp dir for read-only installs."""
+    import getpass
+
+    try:
+        user = getpass.getuser()
+    except Exception:  # pragma: no cover
+        user = "u"
+    return [_NATIVE,
+            os.path.join(tempfile.gettempdir(), f"ompi_tpu_c-{user}")]
+
+
 def build_capi(cc: str = "cc") -> Optional[str]:
     """Compile libompi_tpu_c.so if stale (vs BOTH sources — a header
     edit must rebuild or the lib's struct offsets go stale); returns
-    the path or None."""
+    the path or None. Falls back to a per-user temp dir when the
+    package directory is read-only."""
+    from ompi_tpu.native import compile_so
+
     srcs = [_CAPI_SRC, _CAPI_HDR]
     missing = [s for s in srcs if not os.path.exists(s)]
     if missing:
@@ -57,32 +72,30 @@ def build_capi(cc: str = "cc") -> Optional[str]:
             "mpicc: binding sources missing (%s) — reinstall with the "
             "package data intact\n" % ", ".join(missing))
         return None
-    if os.path.exists(_CAPI_SO) and os.path.getmtime(_CAPI_SO) >= \
-            max(os.path.getmtime(s) for s in srcs):
-        return _CAPI_SO
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_NATIVE)
-    os.close(fd)
-    cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{_NATIVE}", _CAPI_SRC,
-           "-o", tmp] + _python_embed_flags()
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True,
-                       timeout=180)
-        os.rename(tmp, _CAPI_SO)
-        return _CAPI_SO
-    except (subprocess.SubprocessError, OSError) as e:
-        sys.stderr.write("libompi_tpu_c build failed: %s\n%s\n"
-                         % (" ".join(cmd),
-                            getattr(e, "stderr", "") or str(e)))
+    src_mtime = max(os.path.getmtime(s) for s in srcs)
+    for d in _lib_dirs():
+        so = os.path.join(d, "libompi_tpu_c.so")
+        if os.path.exists(so) and os.path.getmtime(so) >= src_mtime:
+            return so
+    cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{_NATIVE}"] + \
+        _python_embed_flags()
+    for d in _lib_dirs():
         try:
-            os.unlink(tmp)
+            os.makedirs(d, exist_ok=True)
         except OSError:
-            pass
-        return None
+            continue
+        so = compile_so(cmd, [_CAPI_SRC],
+                        os.path.join(d, "libompi_tpu_c.so"),
+                        on_error=lambda m: sys.stderr.write(
+                            f"mpicc: {m}\n"))
+        if so:
+            return so
+    return None
 
 
-def wrapper_flags() -> List[str]:
+def wrapper_flags(libdir: str = _NATIVE) -> List[str]:
     """The flags mpicc injects around the user's arguments."""
-    return [f"-I{_NATIVE}", f"-L{_NATIVE}", f"-Wl,-rpath,{_NATIVE}",
+    return [f"-I{_NATIVE}", f"-L{libdir}", f"-Wl,-rpath,{libdir}",
             "-lompi_tpu_c"] + _python_embed_flags()
 
 
@@ -92,11 +105,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if "--showme" in argv:
         print(" ".join([cc] + wrapper_flags()))
         return 0
-    if build_capi(cc) is None:
+    so = build_capi(cc)
+    if so is None:
         return 1
     # user args first so their -o/-c land naturally; link flags last
     # (the classic wrapper ordering: libraries after objects)
-    cmd = [cc] + argv + wrapper_flags()
+    cmd = [cc] + argv + wrapper_flags(os.path.dirname(so))
     return subprocess.run(cmd).returncode
 
 
