@@ -45,26 +45,30 @@ def _python_embed_flags() -> List[str]:
 _CAPI_HDR = os.path.join(_NATIVE, "mpi.h")
 
 
+def _safe_dir(d: str) -> bool:
+    """Only trust/build in a dir we own that nobody else can write —
+    a world-writable fallback would let another local user plant a
+    libompi_tpu_c.so that gets rpath'd into the victim's binary."""
+    try:
+        st = os.stat(d)
+    except OSError:
+        return False
+    return st.st_uid == os.getuid() and not (st.st_mode & 0o022)
+
+
 def _lib_dirs() -> List[str]:
     """Candidate homes for libompi_tpu_c.so: next to the sources, then
-    a per-user temp dir for read-only installs."""
-    import getpass
-
-    try:
-        user = getpass.getuser()
-    except Exception:  # pragma: no cover
-        user = "u"
-    return [_NATIVE,
-            os.path.join(tempfile.gettempdir(), f"ompi_tpu_c-{user}")]
+    a per-user 0700 cache dir for read-only installs."""
+    cache = os.environ.get("XDG_CACHE_HOME") or \
+        os.path.join(os.path.expanduser("~"), ".cache")
+    return [_NATIVE, os.path.join(cache, "ompi_tpu_c")]
 
 
 def build_capi(cc: str = "cc") -> Optional[str]:
     """Compile libompi_tpu_c.so if stale (vs BOTH sources — a header
     edit must rebuild or the lib's struct offsets go stale); returns
-    the path or None. Falls back to a per-user temp dir when the
+    the path or None. Falls back to a per-user cache dir when the
     package directory is read-only."""
-    from ompi_tpu.native import compile_so
-
     srcs = [_CAPI_SRC, _CAPI_HDR]
     missing = [s for s in srcs if not os.path.exists(s)]
     if missing:
@@ -75,21 +79,28 @@ def build_capi(cc: str = "cc") -> Optional[str]:
     src_mtime = max(os.path.getmtime(s) for s in srcs)
     for d in _lib_dirs():
         so = os.path.join(d, "libompi_tpu_c.so")
-        if os.path.exists(so) and os.path.getmtime(so) >= src_mtime:
+        if _safe_dir(d) and os.path.exists(so) and \
+                os.path.getmtime(so) >= src_mtime:
             return so
+    from ompi_tpu.native import compile_so
+
     cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{_NATIVE}"] + \
         _python_embed_flags()
     for d in _lib_dirs():
         try:
-            os.makedirs(d, exist_ok=True)
+            os.makedirs(d, mode=0o700, exist_ok=True)
         except OSError:
             continue
-        so = compile_so(cmd, [_CAPI_SRC],
-                        os.path.join(d, "libompi_tpu_c.so"),
-                        on_error=lambda m: sys.stderr.write(
-                            f"mpicc: {m}\n"))
-        if so:
-            return so
+        # skip unwritable/untrusted dirs BEFORE compiling: a genuine
+        # compiler error must fail once, not be retried per dir
+        if not (_safe_dir(d) and os.access(d, os.W_OK)):
+            continue
+        return compile_so(cmd, [_CAPI_SRC],
+                          os.path.join(d, "libompi_tpu_c.so"),
+                          on_error=lambda m: sys.stderr.write(
+                              f"mpicc: {m}\n"))
+    sys.stderr.write("mpicc: no writable owner-only directory for "
+                     "libompi_tpu_c.so\n")
     return None
 
 
@@ -103,7 +114,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     cc = os.environ.get("OMPI_TPU_CC", "cc")
     if "--showme" in argv:
-        print(" ".join([cc] + wrapper_flags()))
+        # point -L/-rpath at wherever the lib actually lives (a
+        # read-only install builds into the cache dir, not _NATIVE)
+        libdir = _NATIVE
+        for d in _lib_dirs():
+            if os.path.exists(os.path.join(d, "libompi_tpu_c.so")):
+                libdir = d
+                break
+        print(" ".join([cc] + wrapper_flags(libdir)))
         return 0
     so = build_capi(cc)
     if so is None:
