@@ -1,0 +1,72 @@
+/* coll_c.c — collective + status coverage for the C binding
+ * (reference: the examples/ + test/datatype C programs of the
+ * upstream tree).
+ *
+ *   python -m ompi_tpu.tools.mpicc examples/coll_c.c -o /tmp/coll_c
+ *   python -m ompi_tpu.tools.mpirun -np 4 /tmp/coll_c
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include <mpi.h>
+
+#define CHECK(cond, msg)                                             \
+    do {                                                             \
+        if (!(cond)) {                                               \
+            fprintf(stderr, "FAIL rank %d: %s\n", rank, msg);        \
+            MPI_Abort(MPI_COMM_WORLD, 2);                            \
+        }                                                            \
+    } while (0)
+
+int main(int argc, char *argv[]) {
+    int rank, size, i;
+
+    MPI_Init(&argc, &argv);
+    MPI_Comm_rank(MPI_COMM_WORLD, &rank);
+    MPI_Comm_size(MPI_COMM_WORLD, &size);
+
+    /* bcast from a nonzero root */
+    double d[3] = {0, 0, 0};
+    if (rank == size - 1) { d[0] = 1.5; d[1] = 2.5; d[2] = -3.0; }
+    MPI_Bcast(d, 3, MPI_DOUBLE, size - 1, MPI_COMM_WORLD);
+    CHECK(d[0] == 1.5 && d[2] == -3.0, "bcast");
+
+    /* allgather */
+    long mine[2] = {rank, 10L * rank};
+    long *all = malloc(sizeof(long) * 2 * (size_t)size);
+    MPI_Allgather(mine, 2, MPI_LONG, all, 2, MPI_LONG, MPI_COMM_WORLD);
+    for (i = 0; i < size; i++)
+        CHECK(all[2 * i] == i && all[2 * i + 1] == 10L * i, "allgather");
+    free(all);
+
+    /* reduce MAX at root 0 (non-roots pass NULL recvbuf) */
+    float f = (float)(rank + 1), fmax = 0.0f;
+    MPI_Reduce(&f, rank == 0 ? &fmax : NULL, 1, MPI_FLOAT, MPI_MAX, 0,
+               MPI_COMM_WORLD);
+    if (rank == 0)
+        CHECK(fmax == (float)size, "reduce max");
+
+    /* status + MPI_Get_count, incl. the partial-element UNDEFINED */
+    if (size > 1) {
+        if (rank == 0) {
+            char six[6] = {1, 2, 3, 4, 5, 6};
+            MPI_Send(six, 6, MPI_CHAR, 1, 33, MPI_COMM_WORLD);
+        } else if (rank == 1) {
+            char buf[8];
+            MPI_Status st;
+            int n;
+            MPI_Recv(buf, 8, MPI_CHAR, MPI_ANY_SOURCE, MPI_ANY_TAG,
+                     MPI_COMM_WORLD, &st);
+            CHECK(st.MPI_SOURCE == 0 && st.MPI_TAG == 33, "status");
+            MPI_Get_count(&st, MPI_CHAR, &n);
+            CHECK(n == 6, "get_count char");
+            MPI_Get_count(&st, MPI_INT, &n);
+            CHECK(n == MPI_UNDEFINED, "get_count partial -> UNDEFINED");
+        }
+    }
+
+    MPI_Barrier(MPI_COMM_WORLD);
+    printf("rank %d: COLL-C-OK\n", rank);
+    MPI_Finalize();
+    return 0;
+}

@@ -28,6 +28,7 @@ def _load_everything() -> None:
     import ompi_tpu.runtime.topology  # topo binding vars
     import ompi_tpu.pml.ob1  # pml vars
     import ompi_tpu.pml.vprotocol  # pml_v message-logging vars
+    import ompi_tpu.runtime.smsc  # single-copy (cma) vars
     import ompi_tpu.io.file  # collective-IO aggregator vars
     import ompi_tpu.ft.era  # agreement vars
 

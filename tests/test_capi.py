@@ -53,6 +53,24 @@ def test_c_ring_4_ranks(ring_bin):
     assert "Allreduce sum of ranks: 6" in r.stdout
 
 
+def test_c_collectives_and_status(tmp_path):
+    """bcast/allgather/reduce/status/Get_count (incl. the
+    partial-element MPI_UNDEFINED contract) from C."""
+    out = str(tmp_path / "coll_c")
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpicc",
+         "examples/coll_c.c", "-o", out],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env=subprocess_env())
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "4", out],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env=subprocess_env())
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("COLL-C-OK") == 4
+
+
 def test_c_ring_2_ranks_tcp_only(ring_bin):
     """The same binary over the tcp rail (no shared memory)."""
     r = subprocess.run(
