@@ -65,6 +65,7 @@ import ompi_tpu.coll.nbc  # noqa: F401,E402
 import ompi_tpu.coll.neighbor  # noqa: F401,E402
 import ompi_tpu.coll.han  # noqa: F401,E402
 import ompi_tpu.coll.smcoll  # noqa: F401,E402
+import ompi_tpu.coll.adaptive  # noqa: F401,E402
 import ompi_tpu.hook.comm_method  # noqa: F401,E402
 
 

@@ -1,0 +1,50 @@
+"""coll/adapt: event-driven segment-pipelined tree collectives
+(reference: ompi/mca/coll/adapt — opt-in, like the reference)."""
+
+from ompi_tpu.coll.adaptive import _tree, _segments
+from tests.test_process_mode import run_mpi
+
+
+def test_binomial_tree_shape():
+    # 8 ranks rooted at 0: classic binomial
+    parent, children = _tree(0, 8, 0)
+    assert parent is None and children == [1, 2, 4]
+    parent, children = _tree(6, 8, 0)
+    assert parent == 4 and children == [7]
+    parent, children = _tree(5, 8, 0)
+    assert parent == 4 and children == []
+    # every non-root's parent lists it as a child (rotated root too)
+    for n in (2, 3, 5, 8, 13):
+        for root in (0, n - 1):
+            for r in range(n):
+                p, cs = _tree(r, n, root)
+                if r == root:
+                    assert p is None
+                else:
+                    assert p is not None
+                    _, pcs = _tree(p, n, root)
+                    assert r in pcs, (n, root, r, p, pcs)
+
+
+def test_segments_respect_tag_budget():
+    segs = _segments(1 << 20)
+    assert sum(ln for _, ln in segs) == 1 << 20
+    assert len(_segments(1 << 30)) <= 2048
+
+
+def test_adapt_procmode_4ranks():
+    r = run_mpi(4, "tests/procmode/check_adapt.py", timeout=180,
+                mca=(("coll_adapt_enable", "1"),
+                     ("coll_sm_enable", "0"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("ADAPT-OK") == 4
+
+
+def test_adapt_procmode_3ranks_no_progress_thread():
+    """Callbacks must fire from polled progress too."""
+    r = run_mpi(3, "tests/procmode/check_adapt.py", timeout=180,
+                mca=(("coll_adapt_enable", "1"),
+                     ("coll_sm_enable", "0"),
+                     ("runtime_progress_thread", "0"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("ADAPT-OK") == 3
