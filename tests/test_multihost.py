@@ -66,12 +66,15 @@ def test_remote_command_marshals_contract():
 
 
 # ----------------------------------------------------------- end to end
-def _run_multihost(script, np_=2, extra=(), script_args=(), timeout=150):
+def _run_multihost(script, np_=2, extra=(), mca=(), script_args=(),
+                   timeout=150):
     cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", str(np_),
            "--host", ",".join(f"fakenode{i}" for i in range(np_)),
            "--launch-agent", "fake",
-           "--mca", "btl_btl", "^sm",  # force the DCN (tcp) path
-           *extra, script, *script_args]
+           "--mca", "btl_btl", "^sm"]  # force the DCN (tcp) path
+    for k, v in mca:
+        cmd += ["--mca", k, str(v)]
+    cmd += [*extra, script, *script_args]
     return subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
                           timeout=timeout, env=subprocess_env())
 
@@ -92,9 +95,7 @@ def test_multihost_ulfm_member_dies():
     from tests.test_ft_agree import FT, _agree_values
 
     r = _run_multihost("tests/procmode/check_ft_agree.py", np_=3,
-                       extra=[x for k, v in FT
-                              for x in ("--mca", k, v)],
-                       script_args=("member_dies",))
+                       mca=FT, script_args=("member_dies",))
     assert r.returncode == 0, r.stdout + r.stderr
     vals = _agree_values(r.stdout)
     assert len(vals) == 2 and len(set(vals)) == 1, r.stdout
