@@ -209,6 +209,8 @@ def bench_dispatch_tax(world):
         "scatter": ("scatter", 0),
         "alltoall": ("alltoall",),
     }
+    from ompi_tpu.runtime import spc
+
     sweep = {}
     for name, (fn, arg) in verbs.items():
         direct = world._fast.get(fast_keys[name])
@@ -217,8 +219,17 @@ def bench_dispatch_tax(world):
             continue
         d = floor(fn, arg)
         d_direct = floor(direct, arg)
+        overhead_us = (d - d_direct) * 1e6
         sweep[name] = {"us": round(d * 1e6, 1),
-                       "layer_overhead_us": round((d - d_direct) * 1e6, 1)}
+                       "layer_overhead_us": round(overhead_us, 1)}
+        # surface the measured tax as an SPC counter so it reads back
+        # through all_pvars()/MPI_T/the info CLI, not only BENCH json
+        # (ns so the integer counter keeps sub-us resolution). Gauge
+        # semantics over an accumulating counter: record the delta so a
+        # re-run replaces the reading instead of summing with it.
+        cname = f"dispatch_{name}_layer_overhead_ns"
+        target = max(int(round(overhead_us * 1000)), 0)
+        spc.record(cname, target - spc.get(cname))
     # allreduce's floor was just measured by the sweep — reuse it
     d_ours = sweep["allreduce"]["us"] / 1e6 \
         if "us" in sweep.get("allreduce", {}) else floor(world.allreduce, x)

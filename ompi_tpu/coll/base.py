@@ -81,6 +81,16 @@ class CollTable:
 
 def select_coll(comm) -> CollTable:
     """Build the per-comm table: highest priority module wins each slot."""
+    from ompi_tpu.runtime import trace as _trace
+
+    if _trace.enabled():
+        with _trace.span("coll.select", cat="coll",
+                         comm=getattr(comm, "name", "")):
+            return _select_coll(comm)
+    return _select_coll(comm)
+
+
+def _select_coll(comm) -> CollTable:
     table = CollTable()
     modules = coll_framework.select_all(comm=comm)  # priority-descending
     for prio, name, module in modules:

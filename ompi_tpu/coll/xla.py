@@ -47,9 +47,37 @@ from ompi_tpu.coll.base import CollModule, coll_framework
 from ompi_tpu.core import op as _op
 from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_UNSUPPORTED_OPERATION
 from ompi_tpu.mca.component import Component
+from ompi_tpu.mca.var import register_pvar
+from ompi_tpu.runtime import trace as _trace
 
 
 from ompi_tpu.parallel.axes import shard_map_compat as _shard_map
+
+
+class _CacheStats:
+    """Compile-cache telemetry (the discipline SURVEY.md §7 lists as
+    hard part 6, made visible): hits count resolved-executable reuse —
+    both the slow path's _jit_cache probe and the communicator's _fast
+    table (parallel/mesh.py bumps hits there); misses and build time
+    come from _cached. Surfaced as coll_xla_* MPI_T pvars."""
+
+    __slots__ = ("hits", "misses", "compile_ns")
+
+    def __init__(self):
+        self.hits = 0
+        self.misses = 0
+        self.compile_ns = 0
+
+
+stats = _CacheStats()
+
+register_pvar("coll_xla", "cache_hits", lambda: stats.hits,
+              help="Collective dispatches served by a cached executable")
+register_pvar("coll_xla", "cache_misses", lambda: stats.misses,
+              help="Collective dispatches that had to trace+compile")
+register_pvar("coll_xla", "compile_time_us",
+              lambda: stats.compile_ns // 1000,
+              help="Cumulative trace+compile time across cache misses")
 
 
 def _is_bool(dtype) -> bool:
@@ -111,9 +139,47 @@ class XlaColl(CollModule):
     def _cached(self, comm, key, builder):
         fn = comm._jit_cache.get(key)
         if fn is None:
-            fn = builder()
-            comm._jit_cache[key] = fn
+            stats.misses += 1
+            raw = builder()
+
+            # jax.jit is lazy: the real XLA compile happens on the first
+            # invocation with concrete shapes, not in builder(). Cache a
+            # one-shot wrapper that times (and spans) that first call,
+            # then rebinds the cache entry to the raw executable so
+            # steady state pays nothing.
+            def first_call(*args, _raw=raw, _key=key, _comm=comm):
+                import time as _t
+
+                t0 = _t.perf_counter_ns()
+                if _trace.enabled():
+                    with _trace.span("coll.xla.compile", cat="coll",
+                                     verb=str(_key[0])):
+                        out = _raw(*args)
+                else:
+                    out = _raw(*args)
+                stats.compile_ns += _t.perf_counter_ns() - t0
+                _comm._jit_cache[_key] = _raw
+                return out
+
+            first_call._compile_pending = True
+            comm._jit_cache[key] = first_call
+            return first_call
+        if not getattr(fn, "_compile_pending", False):
+            # a still-pending wrapper (its first run raised before the
+            # rebind) is a retry of the compile, not a cache hit
+            stats.hits += 1
         return fn
+
+    def _dispatch(self, comm, key, builder, *args):
+        """Resolve (or build) the executable and run it under the
+        coll.xla.dispatch span — the component-dispatch hook the
+        BENCH_r05 'where does the layer time go' question needs."""
+        fn = self._cached(comm, key, builder)
+        if _trace.enabled():
+            with _trace.span("coll.xla.dispatch", cat="coll",
+                             verb=str(key[0])):
+                return fn(*args)
+        return fn(*args)
 
     def _wrap(self, comm, body, n_in: int = 1, rooted: bool = False):
         import jax
@@ -234,7 +300,7 @@ class XlaColl(CollModule):
                 body = self._grouped_allreduce_body(comm, op)
             return self._wrap(comm, body)
 
-        return self._cached(comm, key, build)(x)
+        return self._dispatch(comm, key, build, x)
 
     def reduce(self, comm, x, op: _op.Op = _op.SUM, root: int = 0):
         """MPI only defines the root row; we return the reduction on every
@@ -268,7 +334,7 @@ class XlaColl(CollModule):
 
             return self._wrap(comm, body, rooted=True)
 
-        return self._cached(comm, key, build)(x, jnp.int32(root))
+        return self._dispatch(comm, key, build, x, jnp.int32(root))
 
     def allgather(self, comm, x):
         """[W, ...] -> [W, G, ...]: each rank-row becomes its group's
@@ -307,7 +373,7 @@ class XlaColl(CollModule):
 
             return self._wrap(comm, body)
 
-        return self._cached(comm, key, build)(x)
+        return self._dispatch(comm, key, build, x)
 
     def alltoall(self, comm, x):
         """[W, G, ...] -> [W, G, ...]: chunk j of group-rank i goes to
@@ -357,7 +423,7 @@ class XlaColl(CollModule):
 
             return self._wrap(comm, body)
 
-        return self._cached(comm, key, build)(x)
+        return self._dispatch(comm, key, build, x)
 
     def reduce_scatter_block(self, comm, x, op: _op.Op = _op.SUM):
         """[W, G, ...] -> [W, ...]: reduce across the group elementwise,
@@ -407,7 +473,7 @@ class XlaColl(CollModule):
 
             return self._wrap(comm, body)
 
-        return self._cached(comm, key, build)(x)
+        return self._dispatch(comm, key, build, x)
 
     def scan(self, comm, x, op: _op.Op = _op.SUM, exclusive: bool = False):
         """Prefix reduction across group ranks via Hillis–Steele doubling
@@ -449,7 +515,7 @@ class XlaColl(CollModule):
 
             return self._wrap(comm, body)
 
-        return self._cached(comm, key, build)(x)
+        return self._dispatch(comm, key, build, x)
 
     def exscan(self, comm, x, op: _op.Op = _op.SUM):
         return self.scan(comm, x, op, exclusive=True)
@@ -468,7 +534,7 @@ class XlaColl(CollModule):
             return self._wrap(comm, body)
 
         x = comm.shard(jnp.ones((comm.world_size, 1), dtype=jnp.int32))
-        self._cached(comm, key, build)(x).block_until_ready()
+        self._dispatch(comm, key, build, x).block_until_ready()
 
     # --------------------------------------------- layout ("root") movers
     def gather(self, comm, x, root: int = 0):
@@ -518,7 +584,7 @@ class XlaColl(CollModule):
 
             return self._wrap(comm, body, rooted=True)
 
-        return self._cached(comm, key, build)(x, jnp.int32(root))
+        return self._dispatch(comm, key, build, x, jnp.int32(root))
 
     # ---------------------------------------------- neighborhood collectives
     # Reference: the coll.h neighbor_* slots. On a mesh, a cart topology's
@@ -562,7 +628,7 @@ class XlaColl(CollModule):
 
             return self._wrap(comm, body)
 
-        return self._cached(comm, key, build)(x)
+        return self._dispatch(comm, key, build, x)
 
     def neighbor_alltoall(self, comm, x):
         """[W, K, ...] -> [W, K, ...]: block k goes to neighbor k; recv
@@ -594,7 +660,7 @@ class XlaColl(CollModule):
 
             return self._wrap(comm, body)
 
-        return self._cached(comm, key, build)(x)
+        return self._dispatch(comm, key, build, x)
 
     # ------------------------------------------------------------- pt2pt
     def permute(self, comm, x, perm: Tuple[Tuple[int, int], ...]):
@@ -613,7 +679,7 @@ class XlaColl(CollModule):
 
             return self._wrap(comm, body)
 
-        return self._cached(comm, key, build)(x)
+        return self._dispatch(comm, key, build, x)
 
 
 class XlaCollComponent(Component):

@@ -41,6 +41,7 @@ from ompi_tpu.core.group import Group
 from ompi_tpu.core.request import Request
 from ompi_tpu.core.status import Status
 from ompi_tpu.runtime import peruse, spc
+from ompi_tpu.runtime import trace as _trace
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -406,7 +407,10 @@ class ProcComm(Intracomm):
         # allreduce.c.in:44); library-internal collectives are suppressed
         # at their call sites so counters reflect user activity
         spc.record(op)
-        return self.coll.get(op)
+        fn = self.coll.get(op)
+        if _trace.enabled():
+            return _trace.wrap_span(f"comm.{op}", "comm", fn)
+        return fn
 
     def Barrier(self) -> None:
         self._coll("barrier")(self)

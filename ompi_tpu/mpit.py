@@ -404,3 +404,8 @@ register_event_type("comm", "created", "A communicator was constructed")
 register_event_type("comm", "revoked", "A communicator was revoked")
 register_event_type("ft", "proc_failed",
                     "The detector declared a process failed")
+# span-stream mirror (runtime/trace.py fires these from its span hooks,
+# so an MPI_T-attached tool sees the same stream the Chrome-trace file
+# export records; flip the trace_enable cvar via a CvarHandle to start it)
+register_event_type("trace", "span_begin", "A trace span opened")
+register_event_type("trace", "span_end", "A trace span closed")

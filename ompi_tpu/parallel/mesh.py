@@ -30,6 +30,7 @@ from ompi_tpu.core.errors import (
 )
 from ompi_tpu.core.group import Group
 from ompi_tpu.runtime import spc
+from ompi_tpu.runtime import trace as _tr
 
 UNDEFINED = -32766
 
@@ -86,7 +87,12 @@ class XlaComm(Intracomm):
         # the first call through the slow path did it.
         self._fast = {}
         from ompi_tpu.coll.base import select_coll
+        from ompi_tpu.coll.xla import stats as _xla_stats
 
+        # compile-cache telemetry: fast-table dispatches count as cache
+        # hits (coll_xla_cache_hits pvar); misses/build time come from
+        # XlaColl._cached
+        self._cstats = _xla_stats
         self.coll = select_coll(self)
 
     # ------------------------------------------------------------- queries
@@ -146,7 +152,26 @@ class XlaComm(Intracomm):
     def _slot(self, name: str):
         self._check_usable()
         spc.record(name)  # allreduce records in its own fast path instead
-        return self.coll.get(name)
+        return self._verb_fn(name)
+
+    def _verb_fn(self, name: str):
+        """Slot lookup, wrapped in the comm.<verb> span when tracing
+        (the slow path; fast-table dispatches span through _hot)."""
+        fn = self.coll.get(name)
+        if _tr.enabled():
+            return _tr.wrap_span("comm." + name, "comm", fn)
+        return fn
+
+    def _hot(self, verb: str, fn, *args):
+        """Shared fast-path epilogue: SPC bump + compile-cache-hit
+        count + the comm.<verb> span (one branch when tracing is off —
+        the dispatch-tax budget of the resolved table)."""
+        spc.record(verb)
+        self._cstats.hits += 1
+        if _tr.enabled():
+            with _tr.span("comm." + verb, cat="comm"):
+                return fn(*args)
+        return fn(*args)
 
     def _promote(self, fast_key, exec_key, wrap=None):
         """After a slow call, resolve the compiled executable into the
@@ -163,12 +188,11 @@ class XlaComm(Intracomm):
         # module imports) lives on the miss path
         fn = self._fast.get(("allreduce", op.uid))
         if fn is not None and not self.revoked:
-            spc.record("allreduce")
             if op.is_pair:
                 from ompi_tpu.coll.xla import _check_device_op
 
                 _check_device_op(op, x)
-            return fn(x)
+            return self._hot("allreduce", fn, x)
         return self._allreduce_slow(x, op)
 
     def _allreduce_slow(self, x, op: _op.Op):
@@ -180,7 +204,7 @@ class XlaComm(Intracomm):
             # the cached executable retraces per shape, so the pair-layout
             # contract must hold on every call, not just the first
             _check_device_op(op, x)
-        out = self.coll.get("allreduce")(self, x, op)
+        out = self._verb_fn("allreduce")(self, x, op)
         self._promote(("allreduce", op.uid), cache_key("allreduce", op))
         return out
 
@@ -191,18 +215,17 @@ class XlaComm(Intracomm):
         # coll module may implement reduce differently)
         fn = self._fast.get(("reduce", op.uid, root))
         if fn is not None and not self.revoked:
-            spc.record("reduce")
             if op.is_pair:
                 from ompi_tpu.coll.xla import _check_device_op
 
                 _check_device_op(op, x)
-            return fn(x)
+            return self._hot("reduce", fn, x)
         self._check_usable()
         self._check_root(root)
         from ompi_tpu.coll.xla import cache_key
 
         spc.record("reduce")
-        out = self.coll.get("reduce")(self, x, op, root)
+        out = self._verb_fn("reduce")(self, x, op, root)
         self._promote(("reduce", op.uid, root),
                       cache_key("allreduce", op))
         return out
@@ -210,14 +233,13 @@ class XlaComm(Intracomm):
     def bcast(self, x, root: int = 0):
         fn = self._fast.get(("bcast", root))
         if fn is not None and not self.revoked:
-            spc.record("bcast")
-            return fn(x)
+            return self._hot("bcast", fn, x)
         self._check_usable()
         self._check_root(root)
         from ompi_tpu.coll.xla import cache_key
 
         spc.record("bcast")
-        out = self.coll.get("bcast")(self, x, root)
+        out = self._verb_fn("bcast")(self, x, root)
         import jax.numpy as jnp
 
         r = jnp.int32(root)
@@ -228,39 +250,36 @@ class XlaComm(Intracomm):
     def allgather(self, x):
         fn = self._fast.get(("allgather",))
         if fn is not None and not self.revoked:
-            spc.record("allgather")
-            return fn(x)
+            return self._hot("allgather", fn, x)
         self._check_usable()
         from ompi_tpu.coll.xla import cache_key
 
         spc.record("allgather")
-        out = self.coll.get("allgather")(self, x)
+        out = self._verb_fn("allgather")(self, x)
         self._promote(("allgather",), cache_key("allgather"))
         return out
 
     def alltoall(self, x):
         fn = self._fast.get(("alltoall",))
         if fn is not None and not self.revoked:
-            spc.record("alltoall")
-            return fn(x)
+            return self._hot("alltoall", fn, x)
         self._check_usable()
         from ompi_tpu.coll.xla import cache_key
 
         spc.record("alltoall")
-        out = self.coll.get("alltoall")(self, x)
+        out = self._verb_fn("alltoall")(self, x)
         self._promote(("alltoall",), cache_key("alltoall"))
         return out
 
     def reduce_scatter(self, x, op: _op.Op = _op.SUM):
         fn = self._fast.get(("reduce_scatter", op.uid))
         if fn is not None and not self.revoked:
-            spc.record("reduce_scatter_block")
-            return fn(x)
+            return self._hot("reduce_scatter_block", fn, x)
         self._check_usable()
         from ompi_tpu.coll.xla import cache_key
 
         spc.record("reduce_scatter_block")
-        out = self.coll.get("reduce_scatter_block")(self, x, op)
+        out = self._verb_fn("reduce_scatter_block")(self, x, op)
         self._promote(("reduce_scatter", op.uid),
                       cache_key("reduce_scatter_block", op))
         return out
@@ -268,12 +287,11 @@ class XlaComm(Intracomm):
     def scan(self, x, op: _op.Op = _op.SUM):
         fn = self._fast.get(("scan", op.uid))
         if fn is not None and not self.revoked:
-            spc.record("scan")
             if op.is_pair:
                 from ompi_tpu.coll.xla import _check_device_op
 
                 _check_device_op(op, x)
-            return fn(x)
+            return self._hot("scan", fn, x)
         from ompi_tpu.coll.xla import cache_key
 
         out = self._slot("scan")(self, x, op)
@@ -283,12 +301,11 @@ class XlaComm(Intracomm):
     def exscan(self, x, op: _op.Op = _op.SUM):
         fn = self._fast.get(("exscan", op.uid))
         if fn is not None and not self.revoked:
-            spc.record("exscan")
             if op.is_pair:
                 from ompi_tpu.coll.xla import _check_device_op
 
                 _check_device_op(op, x)
-            return fn(x)
+            return self._hot("exscan", fn, x)
         from ompi_tpu.coll.xla import cache_key
 
         out = self._slot("exscan")(self, x, op)
@@ -298,8 +315,7 @@ class XlaComm(Intracomm):
     def barrier(self) -> None:
         fn = self._fast.get(("barrier",))
         if fn is not None and not self.revoked:
-            spc.record("barrier")
-            fn()
+            self._hot("barrier", fn)
             return
         self._slot("barrier")(self)
         from ompi_tpu.coll.xla import cache_key
@@ -317,8 +333,7 @@ class XlaComm(Intracomm):
     def gather(self, x, root: int = 0):
         fn = self._fast.get(("gather", root))
         if fn is not None and not self.revoked:
-            spc.record("gather")
-            return fn(x)
+            return self._hot("gather", fn, x)
         self._check_root(root)
         from ompi_tpu.coll.xla import cache_key, XlaColl
 
@@ -336,8 +351,7 @@ class XlaComm(Intracomm):
     def scatter(self, x, root: int = 0):
         fn = self._fast.get(("scatter", root))
         if fn is not None and not self.revoked:
-            spc.record("scatter")
-            return fn(x)
+            return self._hot("scatter", fn, x)
         self._check_root(root)
         from ompi_tpu.coll.xla import cache_key
 
@@ -484,8 +498,15 @@ class XlaComm(Intracomm):
             )
         fn = self._fast.get(("permute", global_perm))
         if fn is not None and not self.revoked:
-            return fn(x)
-        out = self._slot_permute()(self, x, global_perm)
+            return self._hot("permute", fn, x)
+        # slow path mirrors _hot's accounting (spc + span) so the FIRST
+        # permute per schedule — the trace+compile one — isn't the only
+        # call missing from counters and the trace
+        spc.record("permute")
+        slow = self._slot_permute()
+        if _tr.enabled():
+            slow = _tr.wrap_span("comm.permute", "comm", slow)
+        out = slow(self, x, global_perm)
         from ompi_tpu.coll.xla import cache_key
 
         self._promote(("permute", global_perm),
@@ -575,8 +596,7 @@ class XlaComm(Intracomm):
         row (zeros off non-periodic edges)."""
         fn = self._fast.get(("neighbor_allgather",))
         if fn is not None and not self.revoked:
-            spc.record("neighbor_allgather")
-            return fn(x)
+            return self._hot("neighbor_allgather", fn, x)
         from ompi_tpu.coll.xla import cache_key
 
         out = self._slot("neighbor_allgather")(self, x)
@@ -588,8 +608,7 @@ class XlaComm(Intracomm):
         """[W, K, ...] -> [W, K, ...]: block k goes to neighbor k."""
         fn = self._fast.get(("neighbor_alltoall",))
         if fn is not None and not self.revoked:
-            spc.record("neighbor_alltoall")
-            return fn(x)
+            return self._hot("neighbor_alltoall", fn, x)
         from ompi_tpu.coll.xla import cache_key
 
         out = self._slot("neighbor_alltoall")(self, x)

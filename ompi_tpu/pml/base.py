@@ -27,6 +27,7 @@ from ompi_tpu.core.datatype import Datatype
 from ompi_tpu.core.errors import MPIError, ERR_TRUNCATE
 from ompi_tpu.core.request import Request
 from ompi_tpu.core.status import Status
+from ompi_tpu.runtime import trace as _trace
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -154,11 +155,18 @@ class MatchingEngine:
     def n_unexpected(self) -> int:
         return self._n_unexpected
 
+    def _depth(self, name: str, value: int) -> None:
+        """Perfetto counter track mirroring the queue-depth pvars —
+        recorded on BOTH edges so drains render, one site per name."""
+        if _trace.enabled():
+            _trace.counter(name, value, cat="pml")
+
     # Called with lock held -----------------------------------------------
     def post(self, req: RecvRequest) -> None:
         req._pseq = self._pseq
         self._pseq += 1
         self._n_posted += 1
+        self._depth("pml.posted_queue", self._n_posted)
         if req.src == ANY_SOURCE or req.tag == ANY_TAG:
             self._posted_wild.append(req)
         else:
@@ -183,6 +191,7 @@ class MatchingEngine:
             if not q:
                 del self._posted_exact[(req.cid, req.src, req.tag)]
         self._n_posted -= 1
+        self._depth("pml.posted_queue", self._n_posted)
         return True
 
     def match_posted(self, hdr: Header) -> Optional[RecvRequest]:
@@ -205,6 +214,7 @@ class MatchingEngine:
         if req is None:
             return None
         self._n_posted -= 1
+        self._depth("pml.posted_queue", self._n_posted)
         req.matched = True
         req.status.source = hdr.src
         req.status.tag = hdr.tag
@@ -214,6 +224,7 @@ class MatchingEngine:
         frag._aseq = self._aseq
         self._aseq += 1
         self._n_unexpected += 1
+        self._depth("pml.unexpected_queue", self._n_unexpected)
         h = frag.hdr
         self._unexpected.setdefault((h.cid, h.src, h.tag),
                                     deque()).append(frag)
@@ -232,6 +243,7 @@ class MatchingEngine:
                 if not q:
                     del self._unexpected[key]
                 self._n_unexpected -= 1
+                self._depth("pml.unexpected_queue", self._n_unexpected)
             return frag
         best_key = None
         best = None
@@ -248,6 +260,7 @@ class MatchingEngine:
             if not q:
                 del self._unexpected[best_key]
             self._n_unexpected -= 1
+            self._depth("pml.unexpected_queue", self._n_unexpected)
         return best
 
     def find_unexpected(self, src: int, tag: int, cid: int) -> Optional[UnexpectedFrag]:

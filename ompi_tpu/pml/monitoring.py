@@ -33,14 +33,23 @@ class MonitoringPml:
         # (peer, direction) -> [messages, bytes]
         self.counts: Dict[Tuple[int, str], list] = defaultdict(
             lambda: [0, 0])
-        register_pvar("pml_monitoring", "total_sent_bytes",
-                      lambda: sum(v[1] for (p, d), v in self.counts.items()
-                                  if d == "tx"),
-                      help="Bytes sent through the monitored pml")
-        register_pvar("pml_monitoring", "total_recv_bytes",
-                      lambda: sum(v[1] for (p, d), v in self.counts.items()
-                                  if d == "rx"),
-                      help="Bytes received through the monitored pml")
+        # register_pvar is idempotent-by-name: a SECOND MonitoringPml
+        # (restart in-process, tests) would get back the first instance's
+        # Pvar and its stale reader closures. Rebind the reader so the
+        # pvar always reports the LIVE wrapper.
+        for name, direction, help_ in (
+                ("total_sent_bytes", "tx",
+                 "Bytes sent through the monitored pml"),
+                ("total_recv_bytes", "rx",
+                 "Bytes received through the monitored pml")):
+            reader = (lambda d=direction, me=self: me._total_bytes(d))
+            register_pvar("pml_monitoring", name, reader,
+                          help=help_).reader = reader
+
+    def _total_bytes(self, direction: str) -> int:
+        with self._lock:
+            return sum(v[1] for (p, d), v in self.counts.items()
+                       if d == direction)
 
     def _bump(self, peer: int, direction: str, nbytes: int) -> None:
         with self._lock:

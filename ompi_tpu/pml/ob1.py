@@ -47,6 +47,7 @@ from ompi_tpu.pml.base import (
     UnexpectedFrag,
     pack_header,
 )
+from ompi_tpu.runtime import trace as _trace
 from ompi_tpu.utils.output import get_logger
 
 register_var("pml", "eager_limit", 65536,
@@ -232,6 +233,14 @@ class Ob1Pml:
 
     def isend(self, buf, count: int, datatype: Datatype, dst: int,
               tag: int, cid: int) -> SendRequest:
+        if _trace.enabled():
+            with _trace.span("pml.send", cat="pml", dst=dst, tag=tag,
+                             nbytes=count * datatype.size):
+                return self._isend(buf, count, datatype, dst, tag, cid)
+        return self._isend(buf, count, datatype, dst, tag, cid)
+
+    def _isend(self, buf, count: int, datatype: Datatype, dst: int,
+               tag: int, cid: int) -> SendRequest:
         btl = self._btl_for(dst)
         conv = Convertor(buf, count, datatype, for_send=True)
         req = SendRequest(dst, tag, cid, conv.packed_size)
@@ -292,6 +301,15 @@ class Ob1Pml:
 
     def irecv(self, buf, count: int, datatype: Datatype, src: int,
               tag: int, cid: int) -> RecvRequest:
+        # span covers post+match (completion is the request's own
+        # lifecycle — peruse events carry that)
+        if _trace.enabled():
+            with _trace.span("pml.recv", cat="pml", src=src, tag=tag):
+                return self._irecv(buf, count, datatype, src, tag, cid)
+        return self._irecv(buf, count, datatype, src, tag, cid)
+
+    def _irecv(self, buf, count: int, datatype: Datatype, src: int,
+               tag: int, cid: int) -> RecvRequest:
         req = RecvRequest(buf, count, datatype, src, tag, cid)
         with self.engine.lock:
             frag = self.engine.match_unexpected(req)
@@ -355,7 +373,14 @@ class Ob1Pml:
     def handle_incoming(self, raw_hdr: bytes, payload: bytes) -> None:
         """Single entry point for every BTL's received frames (reference:
         the btl recv callbacks registered per hdr type in ob1)."""
-        hdr = Header(raw_hdr)
+        if _trace.enabled():
+            hdr = Header(raw_hdr)
+            with _trace.span("pml.deliver", cat="pml", kind=hdr.kind,
+                             src=hdr.src, nbytes=hdr.nbytes):
+                return self._handle_incoming(hdr, payload)
+        return self._handle_incoming(Header(raw_hdr), payload)
+
+    def _handle_incoming(self, hdr: Header, payload: bytes) -> None:
         # MATCH-plane continuity gate (reference: the recvfrag ordering
         # guard over per-proc sequence numbers). Only EAGER/RTS consume
         # seqs — CTS/DATA/FIN/ACK order is protected by the msgid

@@ -19,6 +19,7 @@ from typing import Callable, List
 
 from ompi_tpu.core import request as _request
 from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.runtime import trace as _trace
 
 _callbacks: List[Callable[[], int]] = []
 _low_priority: List[Callable[[], int]] = []
@@ -46,15 +47,22 @@ def unregister_progress(fn: Callable[[], int]) -> None:
 
 def progress() -> int:
     """Poll all registered callbacks once; low-priority every 8th call
-    (the reference's event-library yield cadence)."""
+    (the reference's event-library yield cadence). Under tracing, only
+    iterations that actually handled events become spans (recorded
+    retroactively) — an idle spin loop would flood the ring with noise."""
     global _call_count
     _call_count += 1
+    tracing = _trace.enabled()
+    t0 = _trace.now() if tracing else 0
     n = 0
     for fn in list(_callbacks):
         n += fn()
     if _call_count % 8 == 0:
         for fn in list(_low_priority):
             n += fn()
+    if tracing and n:
+        _trace.record_span("runtime.progress", t0, _trace.now(),
+                           cat="runtime", events=n)
     return n
 
 

@@ -89,20 +89,25 @@ def record_max(name: str, value: int) -> None:
 
 class timer:
     """Context manager accumulating wall time in microseconds
-    (reference: the SPC_TIMER watermark counters)."""
+    (reference: the SPC_TIMER watermark counters). Reentrant: the same
+    instance may be nested (recursive call sites reuse one timer) — each
+    level keeps its own start on a stack and accumulates independently,
+    so an inner enter can't clobber the outer's baseline."""
 
-    __slots__ = ("name", "_t0")
+    __slots__ = ("name", "_starts")
 
     def __init__(self, name: str):
         self.name = name
+        self._starts = []
 
     def __enter__(self):
-        self._t0 = time.perf_counter_ns() if _enabled() else 0
+        self._starts.append(time.perf_counter_ns() if _enabled() else 0)
         return self
 
     def __exit__(self, *exc):
-        if self._t0:
-            us = (time.perf_counter_ns() - self._t0) // 1000
+        t0 = self._starts.pop()
+        if t0:
+            us = (time.perf_counter_ns() - t0) // 1000
             with _lock:
                 _counters[self.name + "_time_us"] += us
         return False

@@ -7,10 +7,13 @@ align per-rank trace timestamps). The classic midpoint estimator: rank
 CLOCK_MONOTONIC is machine-wide on Linux, so same-host offsets measure
 the method's own error bar; cross-host offsets measure real skew.
 
-Run:  mpirun -np N ompi_tpu/tools/mpisync.py [iters]
+Run:  mpirun -np N ompi_tpu/tools/mpisync.py [iters] [--out offsets.json]
 
 Output (rank 0): one line per rank — offset seconds + min RTT — the
 same table the reference tool feeds to its trace-alignment scripts.
+``--out`` additionally writes a ``{rank: offset_seconds}`` JSON map,
+the input ``tools/trace_merge.py --offsets`` consumes to align
+per-rank trace files onto rank 0's timeline.
 """
 
 from __future__ import annotations
@@ -57,7 +60,17 @@ def main() -> int:
     import ompi_tpu
     from ompi_tpu import COMM_WORLD
 
-    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 25
+    args = sys.argv[1:]
+    out_path = None
+    if "--out" in args:
+        i = args.index("--out")
+        if i + 1 >= len(args):
+            sys.stderr.write(
+                "usage: mpisync [iters] [--out offsets.json]\n")
+            return 2
+        out_path = args[i + 1]
+        del args[i:i + 2]
+    iters = int(args[0]) if args else 25
     table = measure_offsets(COMM_WORLD, iters)
     if table is not None:
         for rank in sorted(table):
@@ -66,6 +79,11 @@ def main() -> int:
                 f"mpisync rank {rank}: offset {off:+.6e} s  "
                 f"rtt {rtt:.6e} s\n")
         sys.stdout.flush()
+        if out_path:
+            import json
+
+            with open(out_path, "w") as f:
+                json.dump({str(r): table[r][0] for r in table}, f)
     COMM_WORLD.Barrier()
     ompi_tpu.Finalize()
     return 0

@@ -62,6 +62,44 @@ def test_spc_disable():
     assert spc.get("internal_only") == 0
 
 
+def test_spc_timer_reentrant():
+    """Nested use of ONE timer instance (recursive call sites) must
+    accumulate per level — the old single-slot _t0 let the inner enter
+    clobber the outer's baseline, losing the outer's elapsed time."""
+    import time
+
+    spc.reset()
+    t = spc.timer("nest")
+    with t:
+        with t:
+            time.sleep(0.002)
+    assert t._starts == []  # balanced
+    # inner >= 2ms and outer >= 2ms (it contains the inner), so the
+    # accumulated total must show BOTH levels, not just one
+    assert spc.get("nest_time_us") >= 3600
+
+
+def test_monitoring_pvar_rebinds_reader():
+    """register_pvar dedupes by name; a second MonitoringPml must rebind
+    the pvar readers to itself or the pvars silently keep reporting the
+    dead first instance's counters."""
+    from ompi_tpu.mca.var import all_pvars
+    from ompi_tpu.pml.monitoring import MonitoringPml
+
+    class _FakePml:
+        my_rank = 0
+
+    m1 = MonitoringPml(_FakePml())
+    m1._bump(1, "tx", 100)
+    assert all_pvars()["pml_monitoring_total_sent_bytes"].value == 100
+    m2 = MonitoringPml(_FakePml())  # re-registration
+    assert all_pvars()["pml_monitoring_total_sent_bytes"].value == 0
+    m2._bump(2, "tx", 7)
+    m2._bump(1, "rx", 3)
+    assert all_pvars()["pml_monitoring_total_sent_bytes"].value == 7
+    assert all_pvars()["pml_monitoring_total_recv_bytes"].value == 3
+
+
 def test_pvars_surface_spc_counters():
     from ompi_tpu.mca.var import all_pvars
 

@@ -1,0 +1,143 @@
+"""trace_lint — validate trace files against the Chrome-trace subset we emit.
+
+``ompi_tpu/runtime/trace.py`` (and ``tools/trace_merge.py``) emit the
+Chrome Trace Event Format "JSON Object Format": a top-level object with a
+``traceEvents`` list of duration (B/E), instant (i/I), counter (C), and
+metadata (M) events. This linter is the schema gate a test runs over any
+emitted file, so a future span site cannot silently start emitting events
+Perfetto will refuse or misrender.
+
+Checked subset:
+- top level: object with a ``traceEvents`` list (a bare list is also
+  accepted — Chrome's legacy "JSON Array Format"), optional metadata keys.
+- every event: a ``ph`` in {B, E, X, i, I, C, M} and a string ``name``;
+  non-metadata events need a numeric ``ts >= 0`` and an integer ``pid``;
+  B/E/X/C additionally need a ``tid``.
+- duration events: per (pid, tid), in file order, every E must close the
+  matching open B (same name, LIFO), and no B may stay open at EOF.
+- X (complete) events need a numeric ``dur >= 0``.
+- timestamps must be monotonic non-decreasing per (pid, tid) stream in
+  file order — our exporters emit sorted streams, and same-ts B/E
+  pairing depends on that emission order.
+
+Usage:  python tools/trace_lint.py trace-rank0.json [more.json ...]
+Exit status 0 = clean; 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List
+
+_PHASES = {"B", "E", "X", "i", "I", "C", "M"}
+_NEED_TID = {"B", "E", "X", "C"}
+
+
+def lint_events(events: List[Dict[str, Any]]) -> List[str]:
+    """Validate an event list; returns a list of violation strings."""
+    errors: List[str] = []
+    timed = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _PHASES:
+            errors.append(f"event {i}: bad/missing ph {ph!r}")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            errors.append(f"event {i}: missing name")
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+            errors.append(f"event {i}: missing numeric ts")
+            continue
+        if ts < 0:
+            errors.append(f"event {i}: negative ts {ts}")
+        if not isinstance(ev.get("pid"), int):
+            errors.append(f"event {i}: missing integer pid")
+        if ph in _NEED_TID and "tid" not in ev:
+            errors.append(f"event {i}: {ph} event without tid")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"event {i}: X event needs dur >= 0")
+        if ph in ("B", "E"):
+            timed.append(ev)
+
+    # B/E pairing per (pid, tid) in FILE order — our exporters emit each
+    # stream already sorted, and pairing of same-ts events depends on
+    # that emission order, so file order is the contract being linted
+    # (this is also what makes the monotonicity check below meaningful)
+    streams: Dict[tuple, List[Dict[str, Any]]] = {}
+    for ev in timed:
+        streams.setdefault((ev.get("pid"), ev.get("tid")), []).append(ev)
+    for (pid, tid), evs in streams.items():
+        stack: List[Dict[str, Any]] = []
+        last_ts = None
+        for ev in evs:
+            ts = ev["ts"]
+            if last_ts is not None and ts < last_ts:
+                errors.append(
+                    f"pid {pid} tid {tid}: ts went backwards "
+                    f"({ts} < {last_ts})")
+            last_ts = ts
+            if ev["ph"] == "B":
+                stack.append(ev)
+            else:
+                if not stack:
+                    errors.append(
+                        f"pid {pid} tid {tid}: E '{ev.get('name')}' "
+                        f"at ts {ts} with no open B")
+                elif stack[-1].get("name") != ev.get("name"):
+                    errors.append(
+                        f"pid {pid} tid {tid}: E '{ev.get('name')}' at "
+                        f"ts {ts} does not match open B "
+                        f"'{stack[-1].get('name')}'")
+                    stack.pop()
+                else:
+                    stack.pop()
+        for b in stack:
+            errors.append(
+                f"pid {pid} tid {tid}: B '{b.get('name')}' at "
+                f"ts {b['ts']} never closed")
+    return errors
+
+
+def lint_file(path: str) -> List[str]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/not JSON: {e}"]
+    if isinstance(doc, list):
+        events = doc
+    elif isinstance(doc, dict):
+        events = doc.get("traceEvents")
+        if not isinstance(events, list):
+            return [f"{path}: no traceEvents list"]
+    else:
+        return [f"{path}: top level must be an object or array"]
+    return [f"{path}: {e}" for e in lint_events(events)]
+
+
+def main(argv=None) -> int:
+    args = sys.argv[1:] if argv is None else argv
+    if not args:
+        print("usage: trace_lint.py TRACE.json [...]", file=sys.stderr)
+        return 2
+    bad = 0
+    for path in args:
+        errs = lint_file(path)
+        for e in errs:
+            print(e, file=sys.stderr)
+        bad += len(errs)
+        if not errs:
+            print(f"{path}: OK")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,135 @@
+"""trace_merge — merge per-rank Chrome-trace files onto one timeline.
+
+Reference workflow: ompi/tools/mpisync measures per-rank clock offsets
+(Hunold/Traeff midpoint estimator) and its companion scripts shift each
+rank's trace timestamps onto rank 0's clock before merging. Same deal
+here: ``ompi_tpu/runtime/trace.py`` stamps events with
+``time.monotonic_ns`` — the clock mpisync measures — so aligning rank r
+is ``ts0 = ts_r - offset_r`` (mpisync defines ``offset_r = t_r -
+midpoint(t0)``, i.e. rank r's clock minus rank 0's).
+
+Offsets come from ``ompi_tpu/tools/mpisync`` output, either
+
+- JSON: ``{"0": 0.0, "1": 3.2e-05, ...}`` (seconds, ``mpisync --out``), or
+- the human table: ``mpisync rank 1: offset +3.2e-05 s  rtt 1.1e-05 s``
+
+and default to zero (same-host ranks share CLOCK_MONOTONIC, where the
+offset measures only the method's error bar).
+
+Usage:
+    OMPI_TPU_MCA_trace_enable=1 mpirun -np 4 app.py
+    python -m ompi_tpu.tools.mpisync --out offsets.json   # (mpirun -np 4)
+    python tools/trace_merge.py trace-rank*.json --offsets offsets.json \
+        -o merged.json
+
+``merged.json`` loads in Perfetto with one process track per rank.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Any, Dict, List
+
+_MPISYNC_LINE = re.compile(
+    r"mpisync rank (\d+): offset ([+-]?[0-9.eE+-]+) s")
+
+
+def load_offsets(path: str) -> Dict[int, float]:
+    """Offsets file -> {rank: seconds}; accepts JSON or mpisync text."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        raw = json.loads(text)
+    except ValueError:
+        raw = None
+    if isinstance(raw, dict):
+        return {int(k): float(v) for k, v in raw.items()}
+    if isinstance(raw, list):  # array indexed by rank
+        return {i: float(v) for i, v in enumerate(raw)}
+    offsets = {}
+    for m in _MPISYNC_LINE.finditer(text):
+        offsets[int(m.group(1))] = float(m.group(2))
+    if not offsets:
+        raise ValueError(f"{path}: neither JSON nor mpisync output")
+    return offsets
+
+
+def _rank_of(doc: Any, path: str) -> int:
+    if isinstance(doc, dict):
+        other = doc.get("otherData", {})
+        if isinstance(other, dict) and "rank" in other:
+            return int(other["rank"])
+    m = re.search(r"rank(\d+)", path)
+    if m:
+        return int(m.group(1))
+    for ev in _events_of(doc):
+        if isinstance(ev.get("pid"), int):
+            return ev["pid"]
+    return 0
+
+
+def _events_of(doc: Any) -> List[Dict[str, Any]]:
+    if isinstance(doc, list):
+        return doc
+    if isinstance(doc, dict) and isinstance(doc.get("traceEvents"), list):
+        return doc["traceEvents"]
+    raise ValueError("no traceEvents")
+
+
+def merge(paths: List[str],
+          offsets: Dict[int, float]) -> Dict[str, Any]:
+    merged: List[Dict[str, Any]] = []
+    ranks = []
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        rank = _rank_of(doc, path)
+        ranks.append(rank)
+        shift_us = offsets.get(rank, 0.0) * 1e6
+        for ev in _events_of(doc):
+            ev = dict(ev)
+            ev["pid"] = rank  # one process track per rank
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] - shift_us
+            merged.append(ev)
+    # one shared timeline; Perfetto wants non-negative timestamps, so
+    # rebase everything onto the earliest event
+    tss = [ev["ts"] for ev in merged if "ts" in ev]
+    base = min(tss) if tss else 0.0
+    for ev in merged:
+        if "ts" in ev:
+            ev["ts"] -= base
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {"ranks": sorted(ranks),
+                      "aligned_with_offsets": bool(offsets)},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_merge",
+        description="Merge per-rank trace-rank<N>.json files onto one "
+                    "mpisync-aligned timeline")
+    ap.add_argument("traces", nargs="+", help="per-rank trace JSON files")
+    ap.add_argument("-o", "--output", default="merged.json")
+    ap.add_argument("--offsets", default=None,
+                    help="mpisync offsets (JSON map or mpisync stdout)")
+    opts = ap.parse_args(argv)
+    offsets = load_offsets(opts.offsets) if opts.offsets else {}
+    doc = merge(opts.traces, offsets)
+    with open(opts.output, "w") as f:
+        json.dump(doc, f)
+    n = len(doc["traceEvents"])
+    print(f"trace_merge: {len(opts.traces)} files, {n} events "
+          f"-> {opts.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
