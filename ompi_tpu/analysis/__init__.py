@@ -1,0 +1,20 @@
+"""Static correctness tooling shared by mpilint and trace_lint.
+
+The project enforces its MCA/runtime contracts (hot-path guard
+discipline, cvar/pvar registration, span pairing, request lifecycle) by
+convention — this package is the machine-checked arm of those
+conventions (reference inspiration: the MUST/Marmot MPI checkers and
+clang-tidy's project-contract plugins). Everything reports through one
+``Finding`` shape so every gate — ``tools/mpilint.py`` over the source
+tree, ``tools/trace_lint.py`` over emitted trace files — prints and
+exit-codes identically.
+"""
+
+from ompi_tpu.analysis.report import (  # noqa: F401
+    ERROR,
+    WARNING,
+    Finding,
+    exit_code,
+    format_finding,
+    report,
+)

@@ -1,0 +1,62 @@
+"""The one Finding/reporter format every analysis gate shares.
+
+A ``Finding`` is one violation: rule id, location, severity, message,
+and an optional fix hint. The text rendering is stable (tests and CI
+grep it) and mirrors compiler diagnostics::
+
+    path.py:123: error [hot-guard] span call outside an enabled() guard
+        hint: wrap the call in `if _trace.enabled():`
+
+Exit-code contract (both ``tools/mpilint.py`` and ``tools/trace_lint.py``):
+0 = clean, 1 = findings, 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List, Optional
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str               # stable rule id, e.g. "hot-guard"
+    path: str               # file (or trace file) the finding is in
+    line: int               # 1-based line; 0 = whole-file/no line
+    message: str
+    severity: str = ERROR   # ERROR | WARNING
+    hint: str = ""          # one-line suggested fix, may be empty
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}" if self.line else self.path
+
+
+def format_finding(f: Finding) -> str:
+    text = f"{f.location}: {f.severity} [{f.rule}] {f.message}"
+    if f.hint:
+        text += f"\n    hint: {f.hint}"
+    return text
+
+
+def report(findings: Iterable[Finding], file=None,
+           clean_paths: Optional[List[str]] = None) -> int:
+    """Print findings (errors and warnings to stderr, like a compiler),
+    an OK line per clean path, and return the process exit code."""
+    import sys
+
+    out = file or sys.stderr
+    n_err = 0
+    for f in findings:
+        if f.severity == ERROR:
+            n_err += 1
+        print(format_finding(f), file=out)
+    for path in clean_paths or ():
+        print(f"{path}: OK", file=sys.stdout if file is None else file)
+    return exit_code(n_err)
+
+
+def exit_code(n_errors: int) -> int:
+    return 1 if n_errors else 0

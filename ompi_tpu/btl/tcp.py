@@ -71,7 +71,7 @@ class TcpBtl(Btl):
         self.log = get_logger("btl.tcp")
         host = get_var("btl_tcp", "bind_host")
         if not host:
-            if os.environ.get("OMPI_TPU_MULTIHOST"):
+            if os.environ.get("OMPI_TPU_MULTIHOST"):  # mpilint: disable=raw-environ — launcher topology hint, not MCA config
                 host = "0.0.0.0"
             else:
                 host = "127.0.0.1"

@@ -71,7 +71,11 @@ def _issue(comm, rnd: Round, tag: int, cid: int):
 
 
 def run_blocking(comm, gen: Schedule, tag: int, cid: int) -> None:
-    """Drive a schedule to completion, waiting out each round."""
+    """Drive a schedule to completion, waiting out each round. A failing
+    request must not abandon the round's remaining requests mid-loop
+    (the Waitsome lesson): outstanding sends left unwaited would
+    cross-match the NEXT schedule on this communicator — wait them all,
+    then surface the first error."""
     bufs: Optional[List[np.ndarray]] = None
     while True:
         try:
@@ -79,8 +83,15 @@ def run_blocking(comm, gen: Schedule, tag: int, cid: int) -> None:
         except StopIteration:
             return
         reqs, bufs = _issue(comm, rnd, tag, cid)
+        first_error: Optional[MPIError] = None
         for r in reqs:
-            r.Wait()
+            try:
+                r.Wait()
+            except MPIError as e:
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
 
 
 def alloc_nbc_tag(comm) -> int:

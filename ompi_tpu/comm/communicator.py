@@ -41,6 +41,7 @@ from ompi_tpu.core.group import Group
 from ompi_tpu.core.request import Request
 from ompi_tpu.core.status import Status
 from ompi_tpu.runtime import peruse, spc
+from ompi_tpu.runtime import sanitizer as _san
 from ompi_tpu.runtime import trace as _trace
 
 ANY_SOURCE = -1
@@ -408,6 +409,11 @@ class ProcComm(Intracomm):
         # at their call sites so counters reflect user activity
         spc.record(op)
         fn = self.coll.get(op)
+        if _san._enable_var._value:
+            # call-order matching sees the buffers, so the interposition
+            # happens here on the resolved slot, before any schedule or
+            # transport work runs
+            fn = _san.wrap_coll(self, op, fn)
         if _trace.enabled():
             return _trace.wrap_span(f"comm.{op}", "comm", fn)
         return fn
@@ -543,6 +549,9 @@ class ProcComm(Intracomm):
         def start_issue():
             self._check_usable()  # a revoked comm must fail at Start too
             spc.record(slot)      # each Start is one collective invocation
+            if _san._enable_var._value:  # every Start is one ordered call
+                _san.on_collective(self, slot,
+                                   _san._signature(slot, args))
             return issue(self, *args)
 
         return PersistentCollRequest(start_issue)

@@ -58,6 +58,9 @@ ERR_SESSION = 72
 ERR_PROC_FAILED = 75
 ERR_PROC_FAILED_PENDING = 76
 ERR_REVOKED = 77
+# implementation-specific class: the runtime sanitizer detected an MPI
+# semantics violation (deadlock cycle, signature mismatch) at level >= 2
+ERR_SANITIZER = 78
 
 _ERROR_STRINGS = {
     SUCCESS: "MPI_SUCCESS: no error",
@@ -85,6 +88,8 @@ _ERROR_STRINGS = {
     ERR_PROC_FAILED: "MPIX_ERR_PROC_FAILED: process failure",
     ERR_REVOKED: "MPIX_ERR_REVOKED: communicator revoked",
     ERR_UNSUPPORTED_OPERATION: "MPI_ERR_UNSUPPORTED_OPERATION",
+    ERR_SANITIZER: "MPIX_ERR_SANITIZER: MPI semantics violation "
+                   "detected by the runtime sanitizer",
 }
 
 

@@ -69,14 +69,22 @@ class Request:
         self.status = Status()
         self._complete = threading.Event()
         self._error: int = 0
+        self._error_reported = False
         self._on_complete: List[Callable[["Request"], None]] = []
         self._cb_lock = threading.Lock()
         self.persistent = False
+        if _san_new is not None:  # sanitizer request-leak tracking
+            _san_new(self)
 
     # ------------------------------------------------------------ completion
     def _set_complete(self, error: int = 0) -> None:
         self._error = error
+        # each completion is a fresh activation (persistent requests
+        # cycle): the error, if any, is raisable exactly once again
+        self._error_reported = False
         self.status.error = error
+        if _san_done is not None:
+            _san_done(self)
         # Flip the flag and snapshot callbacks under the registration lock:
         # a registration racing on another thread either lands in the
         # snapshot or observes the flag and self-fires — never lost
@@ -113,23 +121,39 @@ class Request:
         hot loop over opal_progress)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         backoff = IdleBackoff()
-        while not self._complete.is_set():
-            made_progress = _progress_once()
-            if self._complete.is_set():
-                break
-            if deadline is not None and time.monotonic() > deadline:
-                raise MPIError(ERR_PENDING, "Wait timed out")
-            backoff.step(made_progress, _completion_cond_wait)
+        # sanitizer wait-for-graph edge: register this blocked wait so
+        # the deadlock detector can chase probes through it (one global
+        # load + branch when the sanitizer is off)
+        watch = _san_wait(self) if _san_wait is not None else None
+        try:
+            while not self._complete.is_set():
+                made_progress = _progress_once()
+                if self._complete.is_set():
+                    break
+                if deadline is not None and time.monotonic() > deadline:
+                    raise MPIError(ERR_PENDING, "Wait timed out")
+                if watch is not None:
+                    watch.poll()
+                backoff.step(made_progress, _completion_cond_wait)
+        finally:
+            if watch is not None:
+                watch.close()
         self._finish(status)
 
     def _finish(self, status: Optional[Status]) -> None:
+        """Deliver completion to the caller. Idempotent per completion:
+        a stored error is raised exactly ONCE per activation — multi-wait
+        verbs (Waitsome/Waitany then Waitall) legitimately finish the
+        same request twice, and a double raise abandoned the remaining
+        done requests mid-loop (the Waitsome bug)."""
         if status is not None:
             status.source = self.status.source
             status.tag = self.status.tag
             status.error = self.status.error
             status._nbytes = self.status._nbytes
             status.cancelled = self.status.cancelled
-        if self._error:
+        if self._error and not self._error_reported:
+            self._error_reported = True
             raise MPIError(self._error)
 
     def Cancel(self) -> None:
@@ -162,12 +186,26 @@ class Request:
 
     @staticmethod
     def Waitsome(requests: Sequence["Request"]) -> List[int]:
-        first = Request.Waitany(requests)
-        if first < 0:
+        """Wait until at least one request completes; finish and return
+        the indices of ALL completed entries. Errors are collected and
+        the first one raised only after every done entry is finished
+        (MPI_Waitsome's ERR_IN_STATUS shape: one failure must not
+        abandon the other completions)."""
+        if not requests:
             return []
+        backoff = IdleBackoff()
+        while not any(r.is_complete for r in requests):
+            backoff.step(_progress_once(), _completion_cond_wait)
         done = [i for i, r in enumerate(requests) if r.is_complete]
+        first_error: Optional[MPIError] = None
         for i in done:
-            requests[i]._finish(None)
+            try:
+                requests[i]._finish(None)
+            except MPIError as e:
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
         return done
 
     @staticmethod
@@ -250,6 +288,20 @@ class Prequest(Request):
 # Wired to the runtime progress engine lazily so core stays import-light.
 _progress_fn: Optional[Callable[[], int]] = None
 _completion_cond = threading.Condition()
+
+# Sanitizer hooks, bound lazily by runtime/sanitizer.py install() (same
+# pattern as _bind_progress — core must not import the runtime). All
+# three default to None so the disabled path costs one global load and
+# a branch; _san_new fires per Request construction, _san_done per
+# completion, _san_wait wraps blocked Waits for the deadlock detector.
+_san_new: Optional[Callable[["Request"], None]] = None
+_san_done: Optional[Callable[["Request"], None]] = None
+_san_wait = None  # Request -> watch object with poll()/close(), or None
+
+
+def _bind_sanitizer(new, done, wait) -> None:
+    global _san_new, _san_done, _san_wait
+    _san_new, _san_done, _san_wait = new, done, wait
 
 
 def _bind_progress(fn: Callable[[], int]) -> None:

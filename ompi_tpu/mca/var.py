@@ -134,15 +134,27 @@ def register_var(
 ) -> Var:
     """Register a typed variable and resolve its value from all sources.
 
-    Idempotent on re-registration with identical defaults (components may be
-    re-imported); returns the existing Var in that case.
+    Idempotent on re-registration with identical default/type (components
+    may be re-imported); returns the existing Var in that case. A
+    CONFLICTING re-registration (different default or type) raises — it
+    means two subsystems each believe they own the name, and whichever
+    imported second would silently inherit the other's default (the
+    runtime arm of mpilint's cvar-once contract).
     """
     if typ is None:
         typ = type(default)
     with _lock:
         key = f"{framework}_{name}"
         if key in _registry:
-            return _registry[key]
+            existing = _registry[key]
+            if existing.default != default or existing.typ is not typ:
+                raise ValueError(
+                    f"cvar {key} re-registered with conflicting "
+                    f"default/type: {existing.default!r} "
+                    f"({existing.typ.__name__}) vs {default!r} "
+                    f"({typ.__name__}) — cvar names must be registered "
+                    "exactly once")
+            return existing
         var = Var(
             framework=framework,
             name=name,

@@ -34,7 +34,16 @@ import numpy as np
 
 from ompi_tpu.core import op as _op
 from ompi_tpu.core.errors import MPIError, ERR_ARG
+from ompi_tpu.core.request import Request
 from ompi_tpu.parallel.mesh import XlaComm
+
+
+class _FutureRequest(Request):
+    """Worker-thread-completed request for the DCN-staged nonblocking
+    verbs (was defined per _ireq call, minting a throwaway class per
+    invocation); ``result`` carries the verb's output."""
+
+    result = None
 
 
 class MultiSliceComm:
@@ -196,8 +205,6 @@ class MultiSliceComm:
     def _ireq(self, fn, *args, **kw):
         from concurrent.futures import ThreadPoolExecutor
 
-        from ompi_tpu.core.request import Request
-
         if not hasattr(self, "_pool"):
             self._pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="multislice-nbc")
@@ -207,9 +214,6 @@ class MultiSliceComm:
 
             register_hook("finalize_top",
                           lambda: self._pool.shutdown(wait=False))
-
-        class _FutureRequest(Request):
-            pass
 
         req = _FutureRequest()
 

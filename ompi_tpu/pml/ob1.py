@@ -47,6 +47,7 @@ from ompi_tpu.pml.base import (
     UnexpectedFrag,
     pack_header,
 )
+from ompi_tpu.runtime import sanitizer as _san
 from ompi_tpu.runtime import trace as _trace
 from ompi_tpu.utils.output import get_logger
 
@@ -508,6 +509,11 @@ class Ob1Pml:
 
     def _deliver_matched(self, req: RecvRequest, hdr: Header,
                          payload: Optional[bytes]) -> None:
+        # sanitizer: datatype/count mismatch check at the match point
+        # (one attribute load when disabled — ob1 hot-path discipline);
+        # at level >= 2 the check fails the request and stops delivery
+        if _san._enable_var._value and not _san.check_p2p(req, hdr, self):
+            return
         req.status.source = hdr.src
         req.status.tag = hdr.tag
         if hdr.kind == EAGER:

@@ -67,6 +67,7 @@ import ompi_tpu.coll.han  # noqa: F401,E402
 import ompi_tpu.coll.smcoll  # noqa: F401,E402
 import ompi_tpu.coll.adaptive  # noqa: F401,E402
 import ompi_tpu.hook.comm_method  # noqa: F401,E402
+import ompi_tpu.runtime.sanitizer  # noqa: F401,E402  (cvars + hooks)
 
 
 def _instance_up() -> None:
@@ -76,7 +77,7 @@ def _instance_up() -> None:
     global _world, _self_comm
     if _world is not None:
         return
-    if os.environ.get("OMPI_TPU_RANK") is not None:
+    if os.environ.get("OMPI_TPU_RANK") is not None:  # mpilint: disable=raw-environ — launch-shape detection (rank identity, not config)
         if _torn_down:
             # the job's other ranks fenced out of the modex during the
             # previous teardown; a fresh wireup would wait on a fence

@@ -189,7 +189,7 @@ def _emit_mpit(kind: str, name: str, cat: str) -> None:
 # ----------------------------------------------------------------- export
 def _rank() -> int:
     try:
-        return int(os.environ.get("OMPI_TPU_RANK", "0"))
+        return int(os.environ.get("OMPI_TPU_RANK", "0"))  # mpilint: disable=raw-environ — rank identity for the export filename
     except ValueError:
         return 0
 

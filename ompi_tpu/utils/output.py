@@ -19,7 +19,7 @@ _configured = False
 
 
 def _rank_prefix() -> str:
-    rank = os.environ.get("OMPI_TPU_RANK")
+    rank = os.environ.get("OMPI_TPU_RANK")  # mpilint: disable=raw-environ — rank identity for log prefixes
     return f"[rank {rank}] " if rank is not None else ""
 
 
@@ -49,9 +49,9 @@ def get_logger(name: str) -> logging.Logger:
     log = _loggers.get(full)
     if log is None:
         log = logging.getLogger(full)
-        env = os.environ.get(
-            f"OMPI_TPU_MCA_{name.replace('.', '_')}_verbose",
-            os.environ.get("OMPI_TPU_VERBOSE"),
+        env = os.environ.get(  # mpilint: disable=raw-environ — see below
+            f"OMPI_TPU_MCA_{name.replace('.', '_')}_verbose",  # mpilint: disable=cvar-once — logger names are dynamic; their verbose knobs cannot be pre-registered
+            os.environ.get("OMPI_TPU_VERBOSE"),  # mpilint: disable=raw-environ — dynamic per-logger verbosity
         )
         if env is not None:
             try:

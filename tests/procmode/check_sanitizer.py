@@ -1,0 +1,87 @@
+"""Real 2-rank sanitizer scenarios, selected by argv[1].
+
+``deadlock`` (default) — both ranks Send a rendezvous-sized message to
+each other with no receive posted: each blocks in Wait for a CTS that
+can never come, the classic unsafe-send deadlock. With the sanitizer at
+level 2 the wait-for-graph probe (Chandy–Misra–Haas over the system
+plane) finds the 0 -> 1 -> 0 cycle, show_help renders it, and the
+blocked requests fail with MPIX_ERR_SANITIZER instead of hanging the
+job until the harness timeout.
+
+``rndv-mismatch`` — rank 0 Sends a rendezvous-sized byte count that
+does not divide into rank 1's posted float32 receive. The receiver
+fails at the match point, and — because stopping there would skip the
+CTS the sender is blocked on — the sanitizer NACKs the sender over the
+system plane so BOTH sides raise MPIX_ERR_SANITIZER instead of the
+sender hanging one-sided.
+
+Run: mpirun -np 2 --mca sanitizer_enable 1 --mca sanitizer_level 2
+            [--mca sanitizer_deadlock_timeout 1.0]
+            check_sanitizer.py [deadlock|rndv-mismatch]
+"""
+
+import sys
+
+import numpy as np
+
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core.errors import MPIError, ERR_SANITIZER
+
+
+def deadlock(rank: int) -> int:
+    peer = 1 - rank
+    # > pml_eager_limit so the send runs RTS/CTS and blocks in Wait
+    big = np.zeros(128 * 1024, np.uint8)
+    try:
+        COMM_WORLD.Send(big, dest=peer, tag=7)
+    except MPIError as e:
+        if e.code == ERR_SANITIZER:
+            print(f"rank {rank}: SANITIZER-DEADLOCK-OK", flush=True)
+            return 0
+        raise
+    print(f"rank {rank}: deadlocked send unexpectedly completed",
+          flush=True)
+    return 1
+
+
+def rndv_mismatch(rank: int) -> int:
+    if rank == 0:
+        big = np.zeros(128 * 1024 + 3, np.uint8)  # rendezvous, not /4
+        try:
+            COMM_WORLD.Send(big, dest=1, tag=5)
+        except MPIError as e:
+            if e.code == ERR_SANITIZER:
+                print(f"rank {rank}: SANITIZER-NACK-OK", flush=True)
+                return 0
+            raise
+        print(f"rank {rank}: mismatched send unexpectedly completed",
+              flush=True)
+        return 1
+    recv = np.zeros(64 * 1024, np.float32)
+    try:
+        COMM_WORLD.Recv(recv, source=0, tag=5)
+    except MPIError as e:
+        if e.code == ERR_SANITIZER:
+            print(f"rank {rank}: SANITIZER-NACK-OK", flush=True)
+            return 0
+        raise
+    print(f"rank {rank}: mismatched recv unexpectedly completed",
+          flush=True)
+    return 1
+
+
+def main() -> int:
+    rank = COMM_WORLD.Get_rank()
+    size = COMM_WORLD.Get_size()
+    assert size == 2, f"this check wants exactly 2 ranks, got {size}"
+    mode = sys.argv[1] if len(sys.argv) > 1 else "deadlock"
+    if mode == "deadlock":
+        return deadlock(rank)
+    if mode == "rndv-mismatch":
+        return rndv_mismatch(rank)
+    print(f"unknown mode {mode}", flush=True)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

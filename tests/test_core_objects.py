@@ -108,6 +108,56 @@ def test_waitall_waitany():
     assert Request.Testall(rs)
 
 
+def test_waitsome_returns_all_done_entries():
+    import threading
+
+    rs = [Request() for _ in range(4)]
+    rs[0]._set_complete(0)
+    rs[2]._set_complete(0)
+    assert Request.Waitsome(rs) == [0, 2]
+    # blocks until at least one completes
+    r = Request()
+    threading.Timer(0.02, lambda: r._set_complete(0)).start()
+    assert Request.Waitsome([r, Request()]) == [0]
+    assert Request.Waitsome([]) == []
+
+
+def test_waitsome_error_completes_all_done_before_raising():
+    """Regression: Waitsome used to double-finish the index Waitany had
+    already finished, and a stored error re-raised MID-LOOP, leaving the
+    remaining done requests unfinished."""
+    import pytest
+
+    from ompi_tpu.core.errors import MPIError, ERR_INTERN
+
+    rs = [Request() for _ in range(3)]
+    rs[0]._set_complete(ERR_INTERN)  # failing entry FIRST in the list
+    rs[1]._set_complete(0)
+    rs[2]._set_complete(0)
+    with pytest.raises(MPIError):
+        Request.Waitsome(rs)
+    # every done entry was finished despite the early error: a second
+    # multi-wait over the same list must not re-raise (raise-once per
+    # completion) and must still report them all done
+    assert Request.Waitsome(rs) == [0, 1, 2]
+
+
+def test_finish_raises_error_exactly_once_per_completion():
+    import pytest
+
+    from ompi_tpu.core.errors import MPIError, ERR_INTERN
+
+    r = Request()
+    r._set_complete(ERR_INTERN)
+    with pytest.raises(MPIError):
+        r.Wait()
+    r.Wait()  # idempotent: already-reported error does not re-raise
+    # a NEW completion (persistent-request restart) re-arms the raise
+    r._set_complete(ERR_INTERN)
+    with pytest.raises(MPIError):
+        r.Wait()
+
+
 def test_grequest():
     r = Grequest()
     assert not r.is_complete

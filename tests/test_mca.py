@@ -54,9 +54,15 @@ def test_var_enum_validation():
 
 def test_var_reregistration_idempotent():
     v1 = mca_var.register_var("testfw", "idem", 3)
-    v2 = mca_var.register_var("testfw", "idem", 99)
+    v2 = mca_var.register_var("testfw", "idem", 3)  # re-import: same spec
     assert v1 is v2
     assert v2.value == 3
+    # a CONFLICTING re-registration (different default) is a contract
+    # violation, not a silent merge (the cvar-once runtime check)
+    with pytest.raises(ValueError):
+        mca_var.register_var("testfw", "idem", 99)
+    with pytest.raises(ValueError):
+        mca_var.register_var("testfw", "idem", 3, typ=float)
 
 
 class _Comp(Component):

@@ -1,0 +1,75 @@
+"""mpilint CLI — the project-contract linter gate.
+
+Thin wrapper over ``ompi_tpu.analysis.lint`` (rules, suppression syntax,
+and the Finding format are documented there). Usage::
+
+    python -m tools.mpilint [PATH ...]      # default: ompi_tpu/
+    python -m tools.mpilint --self-test     # every rule vs a bad snippet
+    python -m tools.mpilint --list-rules
+
+Exit status: 0 = clean, 1 = findings (including the expected seeded
+violations under --self-test), 2 = usage error or a rule that failed to
+fire in --self-test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ompi_tpu.analysis.report import format_finding, report  # noqa: E402
+from ompi_tpu.analysis import lint as _lint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mpilint",
+        description="AST linter for ompi_tpu project contracts")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the ompi_tpu "
+                         "package next to this tool)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="lint the embedded bad snippet for every rule; "
+                         "exits 1 when all rules correctly fire on the "
+                         "seeded violations, 2 when any rule is silent")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule ids and contracts")
+    opts = ap.parse_args(argv)
+
+    if opts.list_rules:
+        width = max(len(r) for r in _lint.RULES)
+        for rule, desc in _lint.RULES.items():
+            print(f"{rule:<{width}}  {desc}")
+        return 0
+
+    if opts.self_test:
+        findings, missed = _lint.self_test()
+        for f in findings:
+            print(format_finding(f), file=sys.stderr)
+        for rule in missed:
+            print(f"SELF-TEST FAIL: rule '{rule}' did not fire on its "
+                  "seeded violation", file=sys.stderr)
+        if missed:
+            return 2
+        print(f"self-test: all {len(_lint.SELF_TEST_SNIPPETS)} rules "
+              f"fired ({len(findings)} seeded findings)")
+        return 1 if findings else 2
+
+    paths = opts.paths or [os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ompi_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"mpilint: no such path: {p}", file=sys.stderr)
+            return 2
+    findings = _lint.lint_paths(paths)
+    rc = report(findings,
+                        clean_paths=None if findings else paths)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,10 @@ metadata (M) events. This linter is the schema gate a test runs over any
 emitted file, so a future span site cannot silently start emitting events
 Perfetto will refuse or misrender.
 
+Findings report through the shared ``ompi_tpu.analysis`` Finding/reporter
+format (rule id ``trace-schema``), so trace-schema findings and mpilint
+findings print and exit-code identically.
+
 Checked subset:
 - top level: object with a ``traceEvents`` list (a bare list is also
   accepted — Chrome's legacy "JSON Array Format"), optional metadata keys.
@@ -21,49 +25,78 @@ Checked subset:
   pairing depends on that emission order.
 
 Usage:  python tools/trace_lint.py trace-rank0.json [more.json ...]
-Exit status 0 = clean; 1 = violations (printed one per line).
+Exit status 0 = clean; 1 = violations (printed one per line); 2 = usage.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import os
 import sys
 from typing import Any, Dict, List
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Share the exact Finding class with mpilint when the package is already
+# loaded (tests); standalone, load report.py directly — it is stdlib-only,
+# and `import ompi_tpu` would drag the whole runtime (numpy, component
+# registration, ~1s) into a milliseconds file linter and couple it to any
+# runtime import-time breakage.
+if "ompi_tpu" in sys.modules:
+    from ompi_tpu.analysis.report import Finding, report
+else:
+    import importlib.util
+
+    _spec = importlib.util.spec_from_file_location(
+        "_ompi_tpu_analysis_report",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "ompi_tpu", "analysis",
+            "report.py"))
+    _mod = importlib.util.module_from_spec(_spec)
+    sys.modules[_spec.name] = _mod  # dataclasses resolves cls.__module__
+    _spec.loader.exec_module(_mod)
+    Finding, report = _mod.Finding, _mod.report
+
+RULE = "trace-schema"
 _PHASES = {"B", "E", "X", "i", "I", "C", "M"}
 _NEED_TID = {"B", "E", "X", "C"}
 
 
-def lint_events(events: List[Dict[str, Any]]) -> List[str]:
-    """Validate an event list; returns a list of violation strings."""
-    errors: List[str] = []
+def _f(message: str, hint: str = "") -> Finding:
+    return Finding(RULE, "<events>", 0, message, hint=hint)
+
+
+def lint_events(events: List[Dict[str, Any]]) -> List[Finding]:
+    """Validate an event list; returns the violations as Findings."""
+    errors: List[Finding] = []
     timed = []
     for i, ev in enumerate(events):
         if not isinstance(ev, dict):
-            errors.append(f"event {i}: not an object")
+            errors.append(_f(f"event {i}: not an object"))
             continue
         ph = ev.get("ph")
         if ph not in _PHASES:
-            errors.append(f"event {i}: bad/missing ph {ph!r}")
+            errors.append(_f(f"event {i}: bad/missing ph {ph!r}"))
             continue
         if not isinstance(ev.get("name"), str) or not ev["name"]:
-            errors.append(f"event {i}: missing name")
+            errors.append(_f(f"event {i}: missing name"))
         if ph == "M":
             continue
         ts = ev.get("ts")
         if not isinstance(ts, (int, float)) or isinstance(ts, bool):
-            errors.append(f"event {i}: missing numeric ts")
+            errors.append(_f(f"event {i}: missing numeric ts"))
             continue
         if ts < 0:
-            errors.append(f"event {i}: negative ts {ts}")
+            errors.append(_f(f"event {i}: negative ts {ts}"))
         if not isinstance(ev.get("pid"), int):
-            errors.append(f"event {i}: missing integer pid")
+            errors.append(_f(f"event {i}: missing integer pid"))
         if ph in _NEED_TID and "tid" not in ev:
-            errors.append(f"event {i}: {ph} event without tid")
+            errors.append(_f(f"event {i}: {ph} event without tid"))
         if ph == "X":
             dur = ev.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:
-                errors.append(f"event {i}: X event needs dur >= 0")
+                errors.append(_f(f"event {i}: X event needs dur >= 0"))
         if ph in ("B", "E"):
             timed.append(ev)
 
@@ -80,47 +113,48 @@ def lint_events(events: List[Dict[str, Any]]) -> List[str]:
         for ev in evs:
             ts = ev["ts"]
             if last_ts is not None and ts < last_ts:
-                errors.append(
+                errors.append(_f(
                     f"pid {pid} tid {tid}: ts went backwards "
-                    f"({ts} < {last_ts})")
+                    f"({ts} < {last_ts})"))
             last_ts = ts
             if ev["ph"] == "B":
                 stack.append(ev)
             else:
                 if not stack:
-                    errors.append(
+                    errors.append(_f(
                         f"pid {pid} tid {tid}: E '{ev.get('name')}' "
-                        f"at ts {ts} with no open B")
+                        f"at ts {ts} with no open B"))
                 elif stack[-1].get("name") != ev.get("name"):
-                    errors.append(
+                    errors.append(_f(
                         f"pid {pid} tid {tid}: E '{ev.get('name')}' at "
                         f"ts {ts} does not match open B "
-                        f"'{stack[-1].get('name')}'")
+                        f"'{stack[-1].get('name')}'"))
                     stack.pop()
                 else:
                     stack.pop()
         for b in stack:
-            errors.append(
+            errors.append(_f(
                 f"pid {pid} tid {tid}: B '{b.get('name')}' at "
-                f"ts {b['ts']} never closed")
+                f"ts {b['ts']} never closed"))
     return errors
 
 
-def lint_file(path: str) -> List[str]:
+def lint_file(path: str) -> List[Finding]:
     try:
         with open(path) as f:
             doc = json.load(f)
     except (OSError, ValueError) as e:
-        return [f"{path}: unreadable/not JSON: {e}"]
+        return [Finding(RULE, path, 0, f"unreadable/not JSON: {e}")]
     if isinstance(doc, list):
         events = doc
     elif isinstance(doc, dict):
         events = doc.get("traceEvents")
         if not isinstance(events, list):
-            return [f"{path}: no traceEvents list"]
+            return [Finding(RULE, path, 0, "no traceEvents list")]
     else:
-        return [f"{path}: top level must be an object or array"]
-    return [f"{path}: {e}" for e in lint_events(events)]
+        return [Finding(RULE, path, 0,
+                        "top level must be an object or array")]
+    return [dataclasses.replace(e, path=path) for e in lint_events(events)]
 
 
 def main(argv=None) -> int:
@@ -128,15 +162,14 @@ def main(argv=None) -> int:
     if not args:
         print("usage: trace_lint.py TRACE.json [...]", file=sys.stderr)
         return 2
-    bad = 0
+    findings: List[Finding] = []
+    clean: List[str] = []
     for path in args:
         errs = lint_file(path)
-        for e in errs:
-            print(e, file=sys.stderr)
-        bad += len(errs)
+        findings.extend(errs)
         if not errs:
-            print(f"{path}: OK")
-    return 1 if bad else 0
+            clean.append(path)
+    return report(findings, clean_paths=clean)
 
 
 if __name__ == "__main__":
