@@ -11,7 +11,9 @@ rule id                   contract
 ========================  =====================================================
 hot-guard                 in hot modules (parallel/mesh.py, pml/ob1.py,
                           coll/xla.py, runtime/progress.py) every trace/
-                          sanitizer instrumentation call sits behind a live-Var
+                          sanitizer instrumentation call — and every
+                          ft/inject.py chaos hook (framework code allowed on
+                          the wire path) — sits behind a live-Var
                           guard: ``X.enabled()`` / ``X._enable_var._value`` (or
                           a local name assigned from one) — context-manager
                           construction on the disabled path is too expensive
@@ -88,14 +90,19 @@ HOT_MODULES = {
 VERB_LAYER_DIRS = ("comm/", "parallel/")
 ENVIRON_EXEMPT = ("mca/var.py", "tools/")
 # the instrumentation implementations themselves (they define the guards)
-INSTR_IMPL = ("runtime/trace.py", "runtime/sanitizer.py", "runtime/spc.py")
+INSTR_IMPL = ("runtime/trace.py", "runtime/sanitizer.py", "runtime/spc.py",
+              "ft/inject.py")
 
 TRACE_ALIASES = {"trace", "_trace", "_tr"}
 SAN_ALIASES = {"sanitizer", "_san", "_sanitizer"}
+# ft/inject.py chaos hooks are framework code ALLOWED on the wire path —
+# but only behind the same live-Var guard discipline as trace/sanitizer
+INJECT_ALIASES = {"inject", "_inject"}
 INSTR_TRACE_ATTRS = {"span", "record_span", "instant", "counter",
                      "wrap_span"}
 INSTR_SAN_ATTRS = {"wrap_coll", "on_collective", "check_p2p",
                    "wait_watch", "track_request"}
+INSTR_INJECT_ATTRS = {"on_op", "wire_send", "wrap_deliver"}
 
 _SUPPRESS_RE = re.compile(r"#\s*mpilint:\s*disable=([A-Za-z0-9_,\- ]+)")
 
@@ -183,7 +190,8 @@ def _is_guard_expr(node: ast.AST, guard_names: Set[str]) -> bool:
 
 
 def _instr_call(node: ast.AST) -> Optional[str]:
-    """'trace' / 'sanitizer' when node is an instrumentation call."""
+    """'trace' / 'sanitizer' / 'inject' when node is an
+    instrumentation (or fault-injection hook) call."""
     if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
         v = node.func.value
         if isinstance(v, ast.Name):
@@ -192,6 +200,9 @@ def _instr_call(node: ast.AST) -> Optional[str]:
                 return "trace"
             if v.id in SAN_ALIASES and node.func.attr in INSTR_SAN_ATTRS:
                 return "sanitizer"
+            if v.id in INJECT_ALIASES and \
+                    node.func.attr in INSTR_INJECT_ATTRS:
+                return "inject"
     return None
 
 
@@ -587,9 +598,11 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
 # --self-test` lints each and verifies its rule fires.
 SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
     "hot-guard": ("ompi_tpu/pml/ob1.py", """
+from ompi_tpu.ft import inject as _inject
 from ompi_tpu.runtime import trace as _trace
 
 def isend(self, dst):
+    _inject.on_op(self.my_rank, 0)
     with _trace.span("pml.send", cat="pml"):
         return self._isend(dst)
 """),

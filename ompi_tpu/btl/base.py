@@ -29,6 +29,18 @@ class Btl:
 
     def __init__(self, deliver: Callable[[bytes, bytes], None]):
         # deliver(header_bytes, payload) — the PML's handle_incoming.
+        # Chaos harness receive-side choke point: with a plan armed,
+        # every transport's inbound funnel is filtered (side=recv rules:
+        # drop/delay/dup by frame source). The wrapper is installed at
+        # CONSTRUCTION whenever ANY plan is armed — so the disabled path
+        # never pays a wrapper frame, while the rule list itself stays
+        # live (install()/uninstall() after btls exist re-point it).
+        # Limitation: arming injection from scratch AFTER transports are
+        # built only reaches the send-side and op-counter hooks.
+        from ompi_tpu.ft import inject as _inject
+
+        if _inject._enable_var._value:
+            deliver = _inject.wrap_deliver(deliver)
         self.deliver = deliver
 
     def send(self, peer: int, header: bytes, payload) -> None:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import errno
 import os
+import random
 import selectors
 import socket
 import struct
@@ -25,6 +26,7 @@ import time
 from typing import Callable, Dict, Optional, Tuple
 
 from ompi_tpu.btl.base import Btl, btl_framework
+from ompi_tpu.ft import inject as _inject
 from ompi_tpu.mca.component import Component
 from ompi_tpu.mca.var import register_var, get_var
 from ompi_tpu.pml.base import HDR_SIZE
@@ -32,6 +34,20 @@ from ompi_tpu.utils.output import get_logger
 
 register_var("btl_tcp", "eager_limit", 1 << 20,
              help="TCP eager/rendezvous threshold in bytes", level=4)
+register_var("btl_tcp", "retries", 18,
+             help="Bounded connection-establishment retries before the "
+                  "connect fails up to the pml failover path "
+                  "(reference: btl_tcp_retries_on_connect... the "
+                  "endpoint complete-connect retry loop). The default "
+                  "schedule (with btl_tcp_backoff_ms doubling to its "
+                  "2s cap) spans the 30s total deadline, so a peer "
+                  "that takes the whole pre-retry 30s window to come "
+                  "up still connects", level=5)
+register_var("btl_tcp", "backoff_ms", 25.0,
+             help="Base delay between connect retries; doubles per "
+                  "attempt (capped at 2s) with +-50% jitter so a "
+                  "restarted peer isn't reconnect-stormed by every "
+                  "rank at once", level=5)
 # empty = auto: loopback for single-host jobs, all-interfaces bound +
 # best non-loopback address advertised when the launcher flags a
 # multi-host job (OMPI_TPU_MULTIHOST) — reference: btl_tcp_if_include
@@ -111,7 +127,6 @@ class TcpBtl(Btl):
     def _connect(self, peer: int) -> _Conn:
         addr = self.peers[peer]
         host, port = addr.rsplit(":", 1)
-        deadline = time.monotonic() + 30.0
         # multi-homed hosts: dial from the best-weighted local interface
         # for this peer (reference: opal/mca/reachable weighted scoring)
         from ompi_tpu.runtime.ifaces import pick_source
@@ -120,16 +135,43 @@ class TcpBtl(Btl):
             src = pick_source(socket.gethostbyname(host))
         except OSError:
             src = None
+        # Bounded establishment retry with exponential backoff + jitter
+        # (reference: the endpoint connect retry of btl/tcp): a peer
+        # mid-restart or briefly overloaded must not fail the link on
+        # the first ECONNREFUSED, and a herd of ranks redialing must
+        # not synchronize. BOTH bounds apply — attempt count AND a 30s
+        # total deadline (the pre-retry behavior): a SYN-blackholed
+        # peer burning full per-attempt timeouts must not stretch the
+        # failure to attempts * timeout. Exhaustion raises to the pml
+        # failover path.
+        retries = int(get_var("btl_tcp", "retries"))
+        backoff = float(get_var("btl_tcp", "backoff_ms")) / 1000.0
+        deadline = time.monotonic() + 30.0
+        attempt = 0
         while True:
+            left = deadline - time.monotonic()
             try:
                 s = socket.create_connection(
-                    (host, int(port)), timeout=30.0,
+                    (host, int(port)), timeout=max(min(10.0, left), 1.0),
                     source_address=(src, 0) if src else None)
                 break
-            except OSError:
-                if time.monotonic() > deadline:
+            except OSError as e:
+                left = deadline - time.monotonic()
+                if attempt >= retries or left <= 0:
+                    self.log.error(
+                        "connect to rank %s (%s) failed after %d "
+                        "attempts: %s", peer, addr, attempt + 1, e)
                     raise
-                time.sleep(0.02)
+                from ompi_tpu.runtime import spc
+
+                spc.record("btl_tcp_connect_retries")
+                delay = min(backoff * (1 << attempt), 2.0) \
+                    * (0.5 + random.random())
+                attempt += 1
+                # clamp the sleep to the remaining budget: backing off
+                # past the deadline would stretch total failure latency
+                # beyond the 30s bound the deadline exists to keep
+                time.sleep(min(delay, left))
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # identify ourselves so the acceptor can map conn -> rank
         s.sendall(_LEN.pack(self.my_rank))
@@ -153,6 +195,18 @@ class TcpBtl(Btl):
         opportunistically, otherwise from progress()). Never blocks the
         caller on a full socket — the head-to-head large-send deadlock the
         reference's pending-frag design exists to avoid."""
+        dup = False
+        if _inject._enable_var._value:  # chaos wire hook (ft/inject.py)
+            verdict = _inject.wire_send(self.my_rank, peer)
+            if verdict:
+                if verdict & _inject.SEVER:
+                    conn = self._get_conn(peer)
+                    self._conn_failed(conn, ConnectionResetError(
+                        "link severed by ft_inject_plan"))
+                    # fall through: the dead-check below raises
+                elif verdict & _inject.DROP:
+                    return
+                dup = bool(verdict & _inject.DUP)
         conn = self._get_conn(peer)
         if not isinstance(payload, (bytes, bytearray)):
             payload = bytes(memoryview(payload))
@@ -162,12 +216,23 @@ class TcpBtl(Btl):
             # under the same lock, so a frame can't slip past the check
             # into a cleared buffer
             if conn.dead is not None:
-                from ompi_tpu.core.errors import MPIError, ERR_OTHER
-
-                raise MPIError(
+                from ompi_tpu.core.errors import (
+                    MPIError,
                     ERR_OTHER,
+                    ERR_PROC_FAILED,
+                )
+                from ompi_tpu.ft.detector import known_failed
+
+                # ULFM class when the failure detector confirmed the
+                # peer's death — user recovery code keys off this code
+                code = ERR_PROC_FAILED if peer in known_failed() \
+                    else ERR_OTHER
+                raise MPIError(
+                    code,
                     f"connection to rank {peer} is dead: {conn.dead}")
             conn.wbuf += frame
+            if dup:
+                conn.wbuf += frame
             self._flush_locked(conn)
 
     def _flush_locked(self, conn: _Conn) -> None:
@@ -202,6 +267,12 @@ class TcpBtl(Btl):
         # The dead conn stays in self.conns: bytes already queued (and
         # eagerly completed) were lost, so silently reconnecting would hide
         # a hole in the message stream — subsequent sends raise instead.
+        # mark_failed stays UNCONDITIONAL here (unlike the EOF path): the
+        # exit-fence abandon predicate and the failure flood both key off
+        # known_failed() even in non-FT jobs. The pml's request-failing
+        # sweep is what gates on ft_enable — without the detector armed a
+        # single-rail write error must not fail requests a healthy
+        # fallback rail can still re-drive.
         if conn.peer is not None:
             from ompi_tpu.ft.detector import mark_failed
 
@@ -278,12 +349,21 @@ class TcpBtl(Btl):
             self._conn_failed(conn, e)
             return 0
         if not data:
-            # EOF: could be a peer crash OR a clean peer Finalize — mark the
-            # conn dead so later sends raise instead of vanishing, but leave
-            # failure *detection* to the heartbeat detector (a clean
-            # shutdown must not raise ULFM failure events).
+            # EOF: could be a peer crash OR a clean peer Finalize — mark
+            # the conn dead so later sends raise instead of vanishing.
+            # With the ULFM detector armed (ft_enable) the EOF is also
+            # reported as a failure vantage point — in an FT job a peer
+            # that stops talking IS failed (its heartbeats stop too, so
+            # the flood only arrives sooner); without ft_enable a clean
+            # shutdown must not raise failure events, so detection stays
+            # local.
             if conn.dead is None:
                 conn.dead = ConnectionResetError("closed by peer")
+            if conn.peer is not None:
+                from ompi_tpu.ft.detector import mark_failed
+
+                if get_var("ft", "enable"):
+                    mark_failed(conn.peer)
             self._unregister(conn)
             return 0
         conn.rbuf += data

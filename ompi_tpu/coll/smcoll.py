@@ -371,7 +371,14 @@ class SmColl(CollModule):
         packed = np.ascontiguousarray(cv_pack(sobj, scount, sdt)
                                       ).view(np.uint8).reshape(-1)
         n, r = self._n, comm.rank
-        sz = packed.nbytes // n         # per-destination block
+        sz, rem = divmod(packed.nbytes, n)  # per-destination block
+        if rem:
+            # indivisible packed size: the sub-block layout below would
+            # floor the remainder away and deliver uninitialized tail
+            # bytes — delegate whole, like the chunk-too-small fallback
+            # above (ADVICE r5; symmetric: alltoall counts match across
+            # ranks, so every rank takes this branch together)
+            return self._flat.alltoall(comm, sendbuf, recvbuf)
         if sz == 0:
             return
         data = self._data

@@ -1,0 +1,398 @@
+"""Deterministic, seedable fault injection (the chaos harness).
+
+Reference: the reference validates ompi/communicator/ft with dedicated
+failure-propagator tests and the ftagree mpiext fault hooks; MTT-style
+soak rigs additionally use wire-level drop/delay shims. Here the same
+discipline is a first-class framework: a *chaos plan* parsed from the
+``ft_inject_plan`` cvar (which rides the normal MCA env channel, so
+``mpirun --mca ft_inject_plan ...`` reaches every procmode child)
+drives two choke points:
+
+- a **btl wire hook** — ``wire_send`` consulted by ``btl/tcp.py`` before
+  a frame is queued (drop / delay / dup / sever on the DCN path), and a
+  ``wrap_deliver`` receive-side filter installed by ``btl/base.py`` on
+  every transport for rules marked ``side=recv``;
+- a **pml op-counter hook** — ``on_op`` in ``pml/ob1``'s isend/irecv,
+  counting MATCH-plane operations so ``kill(rank, after=N)`` terminates
+  the victim at a deterministic point mid-protocol.
+
+Plan grammar (``;``-separated actions; ranks are universe ranks, ``*``
+is a wildcard)::
+
+    kill(rank, after=N)            die (exit 0) after N pml ops
+    drop(src, dst, frac=F)         drop outbound frames with prob. F
+    drop(src, dst, nth=N)          drop every Nth frame
+    delay(src, dst, ms=M)          sleep M ms before queuing a frame
+    sever(src, dst)                break the link: conn marked dead,
+                                   peer marked failed (the pml's
+                                   request-failing sweep on that mark
+                                   arms only with ft_enable)
+    dup(src, dst, nth=N)           queue every Nth frame twice
+
+Wire rules take an optional ``side=recv`` to apply at the receiver's
+deliver funnel instead of the sender's tcp enqueue. ``frac`` draws from
+a ``ft_inject_seed``-keyed PRNG (stable per rule across runs and ranks).
+
+Hot-guard discipline: the disabled path is ONE live attribute load —
+``_enable_var._value`` — the same slot shape as spc/trace/sanitizer
+gates (mpilint enforces this for injection calls in hot modules).
+Every injected fault counts into the ``ft_injected_faults`` pvar, an
+``spc`` counter per action, and (when tracing) a trace instant.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import time
+import zlib
+from typing import Dict, List, Optional
+
+from ompi_tpu.mca.var import register_var, register_pvar
+from ompi_tpu.runtime import trace as _trace
+from ompi_tpu.utils.output import get_logger
+from ompi_tpu.utils.show_help import register_topic, show_help
+
+register_topic(
+    "ft", "bad-inject-plan",
+    "The ft_inject_plan cvar could not be parsed:\n  {error}\n"
+    "Grammar: ';'-separated kill(rank,after=N) / drop(src,dst,frac=F"
+    "|nth=N) / delay(src,dst,ms=M) / sever(src,dst) / dup(src,dst,"
+    "nth=N), optional side=recv on wire rules ('*' = any rank).\n"
+    "Fix the plan or unset the cvar; injection refuses to start with "
+    "a plan it cannot honor.")
+
+_plan_var = register_var(
+    "ft", "inject_plan", "", typ=str,
+    help="Chaos plan: ';'-separated kill(rank,after=N) / "
+         "drop(src,dst,frac=F|nth=N) / delay(src,dst,ms=M) / "
+         "sever(src,dst) / dup(src,dst,nth=N) actions applied at the "
+         "btl wire and pml op-counter hooks (empty = injection off; "
+         "wire rules take side=recv to apply at the receiver)",
+    level=9)
+_seed_var = register_var(
+    "ft", "inject_seed", 0,
+    help="Seed for probabilistic (frac=) injection decisions — the "
+         "same plan+seed replays the same fault schedule", level=9)
+
+log = get_logger("ft.inject")
+
+# wire_send verdict bits
+DROP = 1
+DUP = 2
+SEVER = 4
+
+_WIRE_ACTIONS = ("drop", "delay", "sever", "dup")
+
+
+class _LiveFlag:
+    """One-slot live gate: hot call sites load ``_enable_var._value``
+    exactly like the spc/trace/sanitizer guards (a registered bool cvar
+    would be wrong here — enablement is derived from the parsed plan,
+    not a user knob of its own)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        self._value = False
+
+
+_enable_var = _LiveFlag()
+
+
+class _Rule:
+    __slots__ = ("action", "src", "dst", "frac", "nth", "ms", "after",
+                 "side", "count", "rng", "fired_edges")
+
+    def __init__(self, action: str, src: Optional[int], dst: Optional[int],
+                 frac: Optional[float], nth: Optional[int],
+                 ms: float, after: int, side: str, seed: int):
+        self.action = action
+        self.src = src        # None = wildcard ('*'); kill: the victim
+        self.dst = dst
+        self.frac = frac
+        self.nth = nth
+        self.ms = ms
+        self.after = after
+        self.side = side
+        self.count = 0
+        self.fired_edges = set()  # sever one-shot latch, per (src,dst)
+        # stable per-rule stream: identical across ranks and runs for a
+        # given (plan position irrelevant) rule shape + seed
+        key = zlib.crc32(f"{action}:{src}:{dst}:{frac}:{nth}".encode())
+        self.rng = random.Random(seed ^ key)
+
+    def __repr__(self) -> str:  # plan echo in logs/errors
+        extra = []
+        if self.frac is not None:
+            extra.append(f"frac={self.frac}")
+        if self.nth is not None:
+            extra.append(f"nth={self.nth}")
+        if self.action == "delay":
+            extra.append(f"ms={self.ms}")
+        if self.action == "kill":
+            return f"kill({self.src},after={self.after})"
+        if self.side == "recv":
+            extra.append("side=recv")
+        args = ",".join([str("*" if self.src is None else self.src),
+                         str("*" if self.dst is None else self.dst)]
+                        + extra)
+        return f"{self.action}({args})"
+
+
+_kill_rules: List[_Rule] = []
+_send_rules: List[_Rule] = []
+_recv_rules: List[_Rule] = []
+_my_rank: Optional[int] = None
+_faults: Dict[str, int] = {}
+
+register_pvar("ft", "injected_faults",
+              lambda: sum(_faults.values()),
+              help="Faults injected by the ft_inject_plan chaos harness "
+                   "(all actions; per-action detail in the "
+                   "spc_ft_inject_* counters)")
+
+
+_ACTION_RE = re.compile(r"^\s*([a-z]+)\s*\(([^)]*)\)\s*$")
+
+
+def _parse_action(text: str, seed: int) -> _Rule:
+    m = _ACTION_RE.match(text)
+    if m is None:
+        raise ValueError(f"ft_inject_plan: cannot parse action {text!r}")
+    action, raw = m.group(1), m.group(2)
+    if action not in _WIRE_ACTIONS and action != "kill":
+        raise ValueError(f"ft_inject_plan: unknown action {action!r}")
+    pos: List[str] = []
+    kv: Dict[str, str] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            k, v = part.split("=", 1)
+            kv[k.strip()] = v.strip()
+        else:
+            if kv:
+                raise ValueError(
+                    f"ft_inject_plan: positional arg after keyword "
+                    f"in {text!r}")
+            pos.append(part)
+
+    def rank(s: str) -> Optional[int]:
+        return None if s == "*" else int(s)
+
+    if action == "kill":
+        if len(pos) != 1 or pos[0] == "*":
+            raise ValueError(
+                f"ft_inject_plan: kill needs kill(rank, after=N), "
+                f"got {text!r}")
+        after = int(kv.pop("after", "0"))
+        if kv:
+            raise ValueError(
+                f"ft_inject_plan: unknown kill() args {sorted(kv)}")
+        return _Rule("kill", int(pos[0]), None, None, None, 0.0,
+                     max(after, 1), "send", seed)
+
+    if len(pos) != 2:
+        raise ValueError(
+            f"ft_inject_plan: {action} needs (src, dst), got {text!r}")
+    src, dst = rank(pos[0]), rank(pos[1])
+    side = kv.pop("side", "send")
+    if side not in ("send", "recv"):
+        raise ValueError(f"ft_inject_plan: side must be send|recv "
+                         f"in {text!r}")
+    frac = float(kv.pop("frac")) if "frac" in kv else None
+    nth = int(kv.pop("nth")) if "nth" in kv else None
+    ms = float(kv.pop("ms", "0"))
+    if kv:
+        raise ValueError(
+            f"ft_inject_plan: unknown {action}() args {sorted(kv)}")
+    if action == "drop" and frac is None and nth is None:
+        frac = 1.0  # drop(src,dst) = drop everything on the edge
+    if action == "dup" and nth is None:
+        nth = 1
+    if action == "delay" and ms <= 0:
+        raise ValueError(f"ft_inject_plan: delay needs ms=M in {text!r}")
+    if action == "sever" and side == "recv":
+        raise ValueError("ft_inject_plan: sever is send-side only "
+                         "(it kills the sender's connection)")
+    return _Rule(action, src, dst, frac, nth, ms, 0, side, seed)
+
+
+def parse_plan(text: str, seed: int = 0) -> List[_Rule]:
+    return [_parse_action(a, seed) for a in text.split(";") if a.strip()]
+
+
+def install(plan: Optional[str] = None, seed: Optional[int] = None) -> None:
+    """(Re)parse the plan and arm the hooks. Called at import with the
+    cvar value; tests call it directly after set_var. Send-side and
+    op-counter hooks are live-guarded at every call site; side=recv
+    rules additionally need a deliver wrapper that btl/base installs at
+    transport construction — they take effect immediately when SOME
+    plan was already armed at that point (the rule list is live), but
+    arming injection from scratch after transports exist reaches only
+    the send/op hooks."""
+    global _kill_rules, _send_rules, _recv_rules
+    if plan is None:
+        plan = str(_plan_var._value or "")
+    if seed is None:
+        seed = int(_seed_var._value or 0)
+    rules = parse_plan(plan, seed)
+    _kill_rules = [r for r in rules if r.action == "kill"]
+    _send_rules = [r for r in rules
+                   if r.action != "kill" and r.side == "send"]
+    _recv_rules = [r for r in rules if r.side == "recv"]
+    _enable_var._value = bool(rules)
+    if rules:
+        log.warning("chaos plan armed: %s",
+                    "; ".join(repr(r) for r in rules))
+
+
+def uninstall() -> None:
+    global _kill_rules, _send_rules, _recv_rules
+    _kill_rules, _send_rules, _recv_rules = [], [], []
+    _faults.clear()
+    _enable_var._value = False
+
+
+def note_rank(rank: int) -> None:
+    """Identity for the receive-side filter (set by ob1 when a plan is
+    armed — the pml knows the universe rank; btls are built after it)."""
+    global _my_rank
+    _my_rank = rank
+
+
+def fault_counts() -> Dict[str, int]:
+    return dict(_faults)
+
+
+def has_recv_rules() -> bool:
+    return bool(_recv_rules)
+
+
+def _fire(rule: _Rule, src, dst) -> None:
+    from ompi_tpu.runtime import spc
+
+    _faults[rule.action] = _faults.get(rule.action, 0) + 1
+    spc.record(f"ft_inject_{rule.action}")
+    if _trace.enabled():
+        _trace.instant(f"ft.inject.{rule.action}", cat="ft",
+                       src=src, dst=dst)
+
+
+def _hits(rule: _Rule) -> bool:
+    if rule.frac is not None:
+        return rule.rng.random() < rule.frac
+    if rule.nth is not None:
+        return rule.count % rule.nth == 0
+    return True
+
+
+def _edge(rule: _Rule, src: int, dst: int) -> bool:
+    return (rule.src is None or rule.src == src) and \
+           (rule.dst is None or rule.dst == dst)
+
+
+# ------------------------------------------------------------------ hooks
+def on_op(rank: int, tag: int) -> None:
+    """pml op counter (call sites guard on ``_enable_var._value``).
+    System-plane traffic (heartbeats, era, revoke floods — tag <=
+    SYSTEM_TAG_BASE) is excluded so op counts stay deterministic under
+    background detector chatter."""
+    from ompi_tpu.pml.base import SYSTEM_TAG_BASE
+
+    if tag <= SYSTEM_TAG_BASE:
+        return
+    for rule in _kill_rules:
+        if rule.src != rank:
+            continue
+        rule.count += 1
+        if rule.count >= rule.after:
+            import os
+
+            _fire(rule, rank, None)
+            log.warning("chaos kill: rank %d dying after %d pml ops",
+                        rank, rule.count)
+            # exit 0: the launcher treats nonzero as a job abort and
+            # would tear down the survivors this plan exists to test
+            os._exit(0)
+
+
+def wire_send(my_rank: int, peer: int) -> int:
+    """Send-side wire verdict for one frame: OR of DROP/DUP/SEVER bits;
+    delay sleeps inline. Call sites guard on ``_enable_var._value``."""
+    flags = 0
+    for rule in _send_rules:
+        if not _edge(rule, my_rank, peer):
+            continue
+        rule.count += 1
+        if rule.action == "sever":
+            # one-shot PER EDGE (a wildcard rule severs every matching
+            # link once): the first matching frame kills that
+            # connection; after that the dead-conn check raises on its
+            # own, and re-firing would inflate ft_injected_faults (one
+            # severed link = one fault) and re-run the btl's failure
+            # path per frame
+            if (my_rank, peer) not in rule.fired_edges:
+                rule.fired_edges.add((my_rank, peer))
+                _fire(rule, my_rank, peer)
+                flags |= SEVER
+        elif rule.action == "delay":
+            _fire(rule, my_rank, peer)
+            time.sleep(rule.ms / 1000.0)
+        elif rule.action == "drop":
+            if _hits(rule):
+                _fire(rule, my_rank, peer)
+                flags |= DROP
+        elif rule.action == "dup":
+            if _hits(rule):
+                _fire(rule, my_rank, peer)
+                flags |= DUP
+    return flags
+
+
+def wrap_deliver(deliver):
+    """Receive-side filter over a btl's deliver funnel — installed by
+    btl/base.py at construction whenever a plan is armed (the rule list
+    stays live across install()/uninstall()). With no recv-side rules
+    the wrapper costs one truthiness check per frame — no Header parse
+    — and the no-plan path never pays even the wrapper frame."""
+    from ompi_tpu.pml.base import Header
+
+    def injected_deliver(hdr_bytes, payload):
+        if not _recv_rules:
+            return deliver(hdr_bytes, payload)
+        h = Header(hdr_bytes)
+        me = _my_rank
+        drop = dup = False
+        for rule in _recv_rules:
+            if me is None or not _edge(rule, h.src, me):
+                continue
+            rule.count += 1
+            if rule.action == "delay":
+                _fire(rule, h.src, me)
+                time.sleep(rule.ms / 1000.0)
+            elif rule.action == "drop" and _hits(rule):
+                _fire(rule, h.src, me)
+                drop = True
+            elif rule.action == "dup" and _hits(rule):
+                _fire(rule, h.src, me)
+                dup = True
+        if drop:
+            return
+        deliver(hdr_bytes, payload)
+        if dup:
+            deliver(hdr_bytes, payload)
+
+    return injected_deliver
+
+
+try:
+    install()  # arm from the cvar (env-sourced in procmode children)
+except ValueError as _e:
+    # an operator typo must fail LOUDLY with an MCA-style banner before
+    # the import error cascade — silently disabling injection would let
+    # a chaos test run with no chaos and report false confidence
+    show_help("ft", "bad-inject-plan", error=str(_e))
+    raise

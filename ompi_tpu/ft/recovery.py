@@ -1,0 +1,164 @@
+"""Shrink-and-continue recovery — the canonical ULFM idiom, reusable.
+
+Reference: the ULFM specification's fault-tolerant loop (and OMPI's
+ompi/mpiext/ftmpi examples): on MPIX_ERR_PROC_FAILED the survivors
+revoke the communicator, agree on the failure knowledge, shrink to a
+new communicator over the live membership, restore state, and retry.
+This module packages that sequence over the pieces this tree already
+has — ``ft/revoke.py`` (revoke flood + shrink), ``ft/era.py``
+(early-returning agreement), ``ft/detector.py`` (the failure oracle),
+and ``runtime/checkpoint.py`` (ranked two-phase-commit checkpoints):
+
+- :func:`recover` runs revoke -> era agreement on the survivor set ->
+  shrink -> optional restore from the newest committed checkpoint.
+- :func:`resilient` wraps user code in the retry-on-the-shrunk-comm
+  loop so an application writes its step function once and the ULFM
+  choreography stays here.
+
+Counters: ``ft_failovers`` / ``ft_retries`` pvars (mirrored as
+``spc_ft_failover`` / ``spc_ft_retry``) join the watchdog's
+``pml_watchdog_trips`` and the chaos harness's ``ft_injected_faults``
+in ``ompi_tpu_info --pvars`` output.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+from ompi_tpu.core.errors import (
+    MPIError,
+    ERR_PROC_FAILED,
+    ERR_PROC_FAILED_PENDING,
+    ERR_REVOKED,
+)
+from ompi_tpu.mca.var import register_pvar
+from ompi_tpu.runtime import trace as _trace
+from ompi_tpu.utils.output import get_logger
+
+log = get_logger("ft.recovery")
+
+#: error classes the recovery loop treats as a survivable peer failure
+FAILURE_CODES = (ERR_PROC_FAILED, ERR_PROC_FAILED_PENDING, ERR_REVOKED)
+
+_counts: Dict[str, int] = {"failovers": 0, "retries": 0}
+
+register_pvar("ft", "failovers", lambda: _counts["failovers"],
+              help="Completed revoke->agree->shrink recoveries")
+register_pvar("ft", "retries", lambda: _counts["retries"],
+              help="User operations retried on a shrunk communicator "
+                   "by the ft.recovery.resilient wrapper")
+
+
+def _agree_survivors(comm) -> None:
+    """Align every survivor's failure knowledge BEFORE shrink: each
+    contributes a bitmask of the members it believes alive; the era
+    AND is exactly the intersection, uniform on all survivors (the
+    agreement itself excludes members that die mid-call). Without this
+    step two survivors whose detectors fired at different times could
+    shrink to DIFFERENT groups and the new comm would be torn.
+
+    Masks ride the era int64 payload, so comms beyond 62 ranks fall
+    back to a plain Agree(1) sync (their detectors have the flood to
+    converge on; documented limit)."""
+    from ompi_tpu.ft.detector import known_failed, mark_failed
+
+    members = comm.group.ranks
+    if len(members) > 62:
+        comm.Agree(1)
+        return
+    failed = known_failed()
+    mask = 0
+    for i, r in enumerate(members):
+        if r not in failed:
+            mask |= 1 << i
+    agreed = comm.Agree(mask)
+    for i, r in enumerate(members):
+        if not (agreed >> i) & 1 and r not in known_failed():
+            # a peer's detector saw a death mine hasn't yet: adopt it
+            mark_failed(r)
+
+
+def recover(comm, checkpoint_dir: Optional[str] = None,
+            step: Optional[int] = None) -> Tuple[Any, Optional[dict]]:
+    """One full ULFM recovery: revoke ``comm``, agree on the survivor
+    set, shrink, and (with ``checkpoint_dir``) restore this rank's
+    partition of the newest committed ranked checkpoint — by the rank
+    it held in ``comm``, which is the rank that wrote the partition.
+
+    Returns ``(shrunk_comm, state_or_None)``. Collective over the
+    survivors; the caller retries its work on the returned comm."""
+    from ompi_tpu.runtime import spc
+
+    if _trace.enabled():
+        with _trace.span("ft.recover", cat="ft", cid=comm.cid):
+            return _recover(comm, checkpoint_dir, step, spc)
+    return _recover(comm, checkpoint_dir, step, spc)
+
+
+def _recover(comm, checkpoint_dir, step, spc):
+    old_rank = comm.Get_rank()
+    comm.Revoke()
+    _agree_survivors(comm)
+    shrunk = comm.Shrink()
+    _counts["failovers"] += 1
+    spc.record("ft_failover")
+    log.warning("recovered: %s (%d ranks) -> %s (%d ranks)",
+                comm.name, comm.size, shrunk.name, shrunk.size)
+    state = None
+    if checkpoint_dir is not None:
+        from ompi_tpu.runtime.checkpoint import (
+            latest_ranked_step,
+            restore_ranked,
+        )
+
+        use = latest_ranked_step(checkpoint_dir) if step is None else step
+        if use is not None:
+            state = restore_ranked(shrunk, checkpoint_dir, use,
+                                   rank=old_rank)
+    return shrunk, state
+
+
+def resilient(checkpoint_dir: Optional[str] = None,
+              max_failovers: int = 2,
+              codes: Tuple[int, ...] = FAILURE_CODES):
+    """Decorator running ``fn(comm, state, *args, **kwargs)`` with the
+    retry-the-work-on-the-shrunk-comm loop::
+
+        @resilient(checkpoint_dir="/ckpt")
+        def train(comm, state):
+            ...collectives on comm, save_ranked checkpoints...
+            return state
+
+        result = train(COMM_WORLD, initial_state)
+
+    On an MPIError in ``codes`` the wrapper runs :func:`recover` and
+    re-invokes ``fn`` with the shrunk comm (and the restored checkpoint
+    state when a directory is configured), up to ``max_failovers``
+    failures; anything else — or one failure too many — re-raises."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def run(comm, state=None, *args, **kwargs):
+            failures = 0
+            while True:
+                try:
+                    return fn(comm, state, *args, **kwargs)
+                except MPIError as e:
+                    if e.code not in codes or failures >= max_failovers:
+                        raise
+                    failures += 1
+                    log.warning("%s failed (%s); recovering "
+                                "(failover %d/%d)", fn.__name__, e,
+                                failures, max_failovers)
+                    comm, restored = recover(comm, checkpoint_dir)
+                    if restored is not None:
+                        state = restored
+                    from ompi_tpu.runtime import spc
+
+                    _counts["retries"] += 1
+                    spc.record("ft_retry")
+
+        return run
+
+    return deco

@@ -181,7 +181,8 @@ class Win:
     """
 
     def __init__(self, buffer: Optional[np.ndarray], comm, win_id=None,
-                 alloc_bytes: Optional[int] = None):
+                 alloc_bytes: Optional[int] = None,
+                 dynamic: bool = False):
         self.comm = comm
         # zero-copy intra-node path (reference: osc/rdma directly on btl
         # put/get, osc_rdma_comm.c:838 + opal/mca/smsc): when the
@@ -204,9 +205,14 @@ class Win:
         # osc's two-copy active-message fallback for on-node windows)
         self._cma_peers = None    # rank -> (pid, addr, nbytes)
         # the gate must be rank-symmetric (buffer CONTENT may differ per
-        # rank — a size-0 contribution is legal Win_create): eligibility
-        # of this rank's buffer is decided INSIDE the collective
-        if buffer is not None and alloc_bytes is None and win_id is None:
+        # rank — a size-0 or even None contribution is legal Win_create):
+        # eligibility of this rank's buffer is decided INSIDE the
+        # collective, so every Win_create rank runs the same collective
+        # sequence; `buffer is not None` here was per-rank and a single
+        # None rank desynced the win_id agreement (ADVICE r5). Dynamic
+        # windows skip symmetrically — every rank passes dynamic=True
+        # and none can ever be cma-eligible at creation.
+        if alloc_bytes is None and win_id is None and not dynamic:
             self._try_cma_map()
         self.lock = threading.RLock()
         self._outstanding: Dict[int, tuple] = {}  # rid -> (pending, target)
@@ -400,7 +406,7 @@ class Win:
         """MPI_Win_create_dynamic: no initial memory; ranks Attach/Detach
         regions later (reference: osc/rdma dynamic windows,
         osc_rdma_dynamic.c)."""
-        win = Win(None, comm)
+        win = Win(None, comm, dynamic=True)
         win.dynamic = True
         return win
 

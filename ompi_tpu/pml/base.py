@@ -263,6 +263,29 @@ class MatchingEngine:
             self._depth("pml.unexpected_queue", self._n_unexpected)
         return best
 
+    def drain_posted_for_src(self, src: int) -> List[RecvRequest]:
+        """Remove every posted receive NAMING ``src`` (the ULFM
+        peer-death drain: the pml completes them with ERR_PROC_FAILED) —
+        both the fully-specified bucket entries and named-source ANY_TAG
+        receives parked on the wildcard list. Only ANY_SOURCE receives
+        stay posted — a live sender may still match them, which is
+        exactly the MPI_ERR_PROC_FAILED_PENDING nuance. Call with the
+        engine lock held (it is an RLock; the pml's failure callback
+        holds it)."""
+        out: List[RecvRequest] = []
+        for key in [k for k in self._posted_exact if k[1] == src]:
+            out.extend(self._posted_exact.pop(key))
+        named_wild = [req for req in self._posted_wild if req.src == src]
+        for req in named_wild:
+            self._posted_wild.remove(req)
+        out.extend(named_wild)
+        for req in out:
+            req.matched = True  # a late cancel_posted must no-op
+            self._n_posted -= 1
+        if out:
+            self._depth("pml.posted_queue", self._n_posted)
+        return out
+
     def find_unexpected(self, src: int, tag: int, cid: int) -> Optional[UnexpectedFrag]:
         probe = RecvRequest(None, 0, None, src, tag, cid)  # matcher only
         return self.match_unexpected(probe, remove=False)

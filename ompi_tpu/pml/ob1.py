@@ -28,9 +28,16 @@ import numpy as np
 
 from ompi_tpu.core.convertor import Convertor
 from ompi_tpu.core.datatype import Datatype
-from ompi_tpu.core.errors import MPIError, ERR_TRUNCATE, ERR_RANK, ERR_INTERN
+from ompi_tpu.core.errors import (
+    MPIError,
+    ERR_TRUNCATE,
+    ERR_RANK,
+    ERR_INTERN,
+    ERR_PROC_FAILED,
+)
 from ompi_tpu.core.status import Status
-from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.ft import inject as _inject
+from ompi_tpu.mca.var import register_var, register_pvar, get_var
 from ompi_tpu.pml.base import (
     ANY_SOURCE,
     ANY_TAG,
@@ -77,8 +84,26 @@ register_var("pml", "cma", True,
                   "analog (process_vm_writev straight into the posted "
                   "receive buffer) when both sides are contiguous "
                   "(reference: opal/mca/smsc/cma)", level=5)
+register_var("pml", "peer_timeout", 0.0,
+             help="Seconds a mid-protocol rendezvous may stall — an "
+                  "unanswered RTS, a silent DATA stream, or a missing "
+                  "flow-control ACK — before the peer-death watchdog "
+                  "fails the request with MPIX_ERR_PROC_FAILED instead "
+                  "of hanging the Wait. 0 (default) disables the "
+                  "timeout arm; peer death is then surfaced only by "
+                  "the ft heartbeat detector. Nonzero values are a "
+                  "deployment policy: a receiver that legitimately "
+                  "posts its match later than the timeout will be "
+                  "declared failed", level=6)
 # cma-offer blob a receiver appends to its CTS: target pid + buffer addr
 _CMA_OFFER = struct.Struct("<qQ")
+
+# watchdog-failed requests, all pml instances (pvar + spc mirror)
+_wd_trips = [0]
+register_pvar("pml", "watchdog_trips", lambda: _wd_trips[0],
+              help="Requests failed with MPIX_ERR_PROC_FAILED by the "
+                   "peer-death watchdog (detector callbacks + "
+                   "pml_peer_timeout trips)")
 
 
 class Ob1Pml:
@@ -127,6 +152,50 @@ class Ob1Pml:
         register_pvar("pml", "posted_recv_queue_length",
                       lambda: self.engine.n_posted,
                       help="Posted-receive queue depth")
+        # Peer-death watchdog, detector arm: a confirmed failure fails
+        # every request mid-protocol with that rank so blocked Wait*
+        # calls raise ERR_PROC_FAILED instead of hanging (reference:
+        # ULFM's error propagation into pending requests). Zero cost on
+        # the hot path — this is a callback registration. WEAKLY bound:
+        # the detector/progress registries are process-global with no
+        # unregister lifecycle, and a strong self would pin every pml
+        # instance (tests build several per process) forever, with
+        # stale instances still reacting to failures.
+        import weakref
+
+        from ompi_tpu.ft import detector as _ftd
+
+        ref = weakref.ref(self)
+
+        def _peer_failed_cb(rank, _ref=ref):
+            pml = _ref()
+            if pml is not None:
+                pml._on_peer_failed(rank)
+
+        _ftd.on_failure(_peer_failed_cb)
+        # Timeout arm (opt-in cvar): a low-priority progress callback
+        # converts *undetected* rendezvous/ACK stalls into the same
+        # failure. Not registered at all when disabled; self-unregisters
+        # once the pml is collected.
+        self._peer_timeout = float(get_var("pml", "peer_timeout"))
+        if self._peer_timeout > 0:
+            from ompi_tpu.runtime.progress import (
+                register_progress,
+                unregister_progress,
+            )
+
+            self._wd_next = 0.0
+
+            def _watchdog_cb(_ref=ref):
+                pml = _ref()
+                if pml is None:
+                    unregister_progress(_watchdog_cb)
+                    return 0
+                return pml._watchdog_poll()
+
+            register_progress(_watchdog_cb, low_priority=True)
+        if _inject._enable_var._value:
+            _inject.note_rank(my_rank)  # chaos recv-side rank identity
 
     # ------------------------------------------------------------- wiring
     def add_endpoint(self, rank: int, btl) -> None:
@@ -138,6 +207,108 @@ class Ob1Pml:
         transport fails (reference: bml_r2's btl_send array — the next
         eligible BTL takes over when one is ejected)."""
         self.fallbacks[rank] = list(btls)
+
+    # ------------------------------------------------ peer-death watchdog
+    def _fail_requests(self, victims, why: str) -> None:
+        """Complete each victim with ERR_PROC_FAILED. MUST be called
+        WITHOUT engine.lock held: flowing sends are completed under
+        their _pump_lock to serialize against a concurrent _pump (whose
+        success completion would otherwise race last-writer-wins with
+        the failure), and _pump's self-btl inline delivery acquires
+        engine.lock — taking _pump_lock under engine.lock would invert
+        that order and deadlock."""
+        from ompi_tpu.runtime import spc
+
+        def fail(req) -> None:
+            # counters/log BEFORE the completion flips: the victim's
+            # blocked Wait wakes (and its error handler may read the
+            # pvar/spc surface) the moment _set_complete runs
+            _wd_trips[0] += 1
+            spc.record("pml_watchdog_trip")
+            self.log.error("failing %s with ERR_PROC_FAILED: %s",
+                           type(req).__name__, why)
+            req._set_complete(ERR_PROC_FAILED)
+
+        for req in victims:
+            lock = getattr(req, "_pump_lock", None)
+            if lock is not None:
+                with lock:
+                    if req._complete.is_set():
+                        continue  # _pump finished first: its verdict holds
+                    fail(req)
+            else:
+                if not req._complete.is_set():
+                    fail(req)
+
+    def _on_peer_failed(self, rank: int) -> None:
+        """ft detector callback: every request mid-protocol with the
+        failed rank — unanswered RTS, matched-but-unfinished receive,
+        flow-controlled DATA stream, or a still-posted exact receive —
+        completes with ERR_PROC_FAILED so blocked waits return.
+        Wildcard (ANY_SOURCE) receives stay posted: a live sender may
+        still match them (MPI_ERR_PROC_FAILED_PENDING semantics)."""
+        if not get_var("ft", "enable"):
+            # without the ULFM detector armed, mark_failed is only a
+            # log/flood/exit-fence signal — a tcp rail error reaches it
+            # too, and failing requests then would defeat the bml
+            # failover re-drive on a healthy fallback rail (non-FT jobs
+            # keep their pre-watchdog semantics; the opt-in
+            # pml_peer_timeout arm fails its victims directly)
+            return
+        victims = []
+        with self.engine.lock:
+            # victim only when WE popped it: a concurrent _incoming_cts /
+            # _incoming_data that won the pop owns the request's
+            # completion — appending it anyway would race their success
+            # verdict last-writer-wins
+            for msgid, sreq in list(self._pending_sends.items()):
+                if sreq.dst == rank and \
+                        self._pending_sends.pop(msgid, None) is not None:
+                    victims.append(sreq)
+            for msgid, sreq in list(self._flowing.items()):
+                if getattr(sreq, "_peer", None) == rank and \
+                        self._flowing.pop(msgid, None) is not None:
+                    victims.append(sreq)
+            for msgid, rreq in list(self._active_recvs.items()):
+                if rreq.status.source == rank and \
+                        self._active_recvs.pop(msgid, None) is not None:
+                    victims.append(rreq)
+            victims.extend(self.engine.drain_posted_for_src(rank))
+        self._fail_requests(victims, f"rank {rank} is failed")
+
+    def _watchdog_poll(self) -> int:
+        """Low-priority progress callback (armed only when
+        pml_peer_timeout > 0): requests whose peer has been silent
+        mid-protocol longer than the timeout fail with ERR_PROC_FAILED,
+        and the peer is reported to the detector — the sanitizer's
+        fail-deadlocked-requests discipline applied to peer death."""
+        now = _time.monotonic()
+        if now < self._wd_next:
+            return 0
+        self._wd_next = now + min(self._peer_timeout / 4.0, 1.0)
+        cutoff = now - self._peer_timeout
+        stale = []  # (req, peer)
+        with self.engine.lock:
+            for store, peer_of in (
+                    (self._pending_sends, lambda r: r.dst),
+                    (self._flowing, lambda r: getattr(r, "_peer", None)),
+                    (self._active_recvs, lambda r: r.status.source)):
+                for msgid, req in list(store.items()):
+                    t0 = getattr(req, "_wd_last", None)
+                    if t0 is not None and t0 < cutoff and \
+                            store.pop(msgid, None) is not None:
+                        # stale only if WE popped it (see _on_peer_failed)
+                        stale.append((req, peer_of(req)))
+        if not stale:
+            return 0
+        self._fail_requests(
+            [r for r, _ in stale],
+            f"peer silent > pml_peer_timeout={self._peer_timeout}s")
+        from ompi_tpu.ft.detector import mark_failed
+
+        for peer in {p for _, p in stale if p is not None and p >= 0}:
+            mark_failed(peer)
+        return len(stale)
 
     def _send_frame(self, dst: int, hdr: bytes, payload) -> None:
         """Every outbound frame funnels here: on transport failure the
@@ -242,6 +413,8 @@ class Ob1Pml:
 
     def _isend(self, buf, count: int, datatype: Datatype, dst: int,
                tag: int, cid: int) -> SendRequest:
+        if _inject._enable_var._value:  # chaos op counter (ft/inject.py)
+            _inject.on_op(self.my_rank, tag)
         btl = self._btl_for(dst)
         conv = Convertor(buf, count, datatype, for_send=True)
         req = SendRequest(dst, tag, cid, conv.packed_size)
@@ -264,6 +437,15 @@ class Ob1Pml:
             req._set_complete(0)
         else:
             req.msgid = next(self._msgid)
+            # the pump lock exists from the moment the request is
+            # watchdog-visible: _fail_requests serializes its failure
+            # completion through it, and a pre-CTS request without one
+            # would race an _incoming_cts->_pump success verdict
+            # (eager sends never enter the pending dicts, so the eager
+            # path doesn't pay the allocation)
+            req._pump_lock = threading.RLock()
+            if self._peer_timeout:
+                req._wd_last = _time.monotonic()  # RTS->CTS stall clock
             self._pending_sends[req.msgid] = req
             self._send_match_frame(dst, RNDV_RTS, cid, tag,
                                    conv.packed_size, req.msgid, b"")
@@ -311,6 +493,8 @@ class Ob1Pml:
 
     def _irecv(self, buf, count: int, datatype: Datatype, src: int,
                tag: int, cid: int) -> RecvRequest:
+        if _inject._enable_var._value:  # chaos op counter (ft/inject.py)
+            _inject.on_op(self.my_rank, tag)
         req = RecvRequest(buf, count, datatype, src, tag, cid)
         with self.engine.lock:
             frag = self.engine.match_unexpected(req)
@@ -534,6 +718,8 @@ class Ob1Pml:
             req.convertor = conv
             req.status._nbytes = hdr.nbytes
             req._sender_msgid = hdr.msgid  # for flow-control ACKs
+            if self._peer_timeout:
+                req._wd_last = _time.monotonic()  # DATA stall clock
             recv_id = next(self._msgid)
             self._active_recvs[recv_id] = req
             cts = pack_header(RNDV_CTS, self.my_rank, hdr.cid, hdr.tag, 0,
@@ -559,9 +745,22 @@ class Ob1Pml:
             except MPIError as e:
                 # dead transport: fail the receive instead of leaving it
                 # matched-but-incomplete (Wait would spin forever)
-                del self._active_recvs[recv_id]
+                self._active_recvs.pop(recv_id, None)
                 req.status._nbytes = 0
                 req._set_complete(e.code)
+                return
+            # symmetric TOCTOU close (see _incoming_cts): a detector
+            # sweep between matching and the _active_recvs insert above
+            # misses this receive, and an sm-transport CTS to a dead
+            # peer "succeeds" silently — re-check now that we are
+            # registered
+            if get_var("ft", "enable"):
+                from ompi_tpu.ft.detector import known_failed
+
+                if hdr.src in known_failed() and \
+                        self._active_recvs.pop(recv_id, None) is not None:
+                    self._fail_requests(
+                        [req], f"rank {hdr.src} is failed (match race)")
 
     def _incoming_rts(self, hdr: Header) -> None:
         with self.engine.lock:
@@ -650,17 +849,34 @@ class Ob1Pml:
         sreq._rmsgid = hdr.msgid
         sreq._offset = 0
         sreq._acked = 0
+        if self._peer_timeout:
+            sreq._wd_last = _time.monotonic()  # ACK stall clock
         depth = int(get_var("pml", "pipeline_depth"))
         frag_size = get_var("pml", "frag_size")
         if depth:
             depth = max(depth, 2 * frag_size)  # window >= ack cadence
         sreq._depth = depth
         sreq._frag_size = frag_size
+        # Close the pop->insert TOCTOU against _on_peer_failed: a
+        # detector callback landing after the lock-free _pending_sends
+        # pop above but before the _flowing insert below finds the
+        # request in NEITHER dict and never fails it — and a flow-
+        # controlled pump to a dead (sm) peer then stalls window-full
+        # forever. Gated like the sweep itself: without ft_enable a
+        # marked rank may still be reachable over a fallback rail.
+        if get_var("ft", "enable"):
+            from ompi_tpu.ft.detector import known_failed
+
+            if hdr.src in known_failed():
+                self._fail_requests(
+                    [sreq], f"rank {hdr.src} is failed (CTS race)")
+                return
         sreq._btls = self._stripe_btls(hdr.src, sreq.nbytes)
         sreq._weights = [max(int(getattr(b, "bandwidth", 1)), 1)
                          for b in sreq._btls]
         sreq._credits = [0] * len(sreq._btls)
-        sreq._pump_lock = threading.RLock()
+        # _pump_lock was created in _isend, before the request became
+        # watchdog-visible
         if depth and sreq.nbytes > depth:
             self._flowing[sreq.msgid] = sreq
         self._pump(sreq)
@@ -729,8 +945,15 @@ class Ob1Pml:
         sreq = self._flowing.get(hdr.msgid)
         if sreq is None:
             return
-        if hdr.nbytes > sreq._acked:
-            sreq._acked = hdr.nbytes
+        if self._peer_timeout:
+            sreq._wd_last = _time.monotonic()
+        # monotonic update under the pump lock: two ACKs landing on
+        # different progress threads could otherwise interleave
+        # check-then-assign so a stale smaller credit overwrites a newer
+        # one and permanently shrinks the window (ADVICE r5)
+        with sreq._pump_lock:
+            if hdr.nbytes > sreq._acked:
+                sreq._acked = hdr.nbytes
         self._pump(sreq)
 
     def _incoming_fin(self, hdr: Header) -> None:
@@ -749,6 +972,8 @@ class Ob1Pml:
         req = self._active_recvs.get(hdr.msgid)
         if req is None:
             return
+        if self._peer_timeout:
+            req._wd_last = _time.monotonic()
         # striped rendezvous interleaves frags across transports (and
         # their progress contexts): serialize per-message delivery and
         # complete on BYTE COUNT of DISTINCT offsets — failover re-drives
@@ -756,6 +981,12 @@ class Ob1Pml:
         # and must not double-count (ADVICE r4); a re-driven frag carries
         # identical bytes, so re-unpacking it is idempotent.
         with self.engine.lock:
+            # re-check ownership under the lock: the peer-death watchdog
+            # pops-and-fails active recvs under engine.lock, and a frag
+            # that raced that removal must not unpack into (or complete
+            # with success) a request already failed with ERR_PROC_FAILED
+            if self._active_recvs.get(hdr.msgid) is not req:
+                return
             nbytes = (payload.nbytes if hasattr(payload, "nbytes")
                       else len(payload))
             seen = getattr(req, "_recv_offsets", None)
@@ -769,7 +1000,9 @@ class Ob1Pml:
                 req._recv_bytes = getattr(req, "_recv_bytes", 0) + nbytes
             done = req._recv_bytes >= hdr.nbytes
             if done:
-                del self._active_recvs[hdr.msgid]
+                # pop, not del: the peer-death watchdog may have already
+                # reclaimed the entry from another thread
+                self._active_recvs.pop(hdr.msgid, None)
                 req._recv_offsets = None  # free the dedup set
         if done:
             req._set_complete(0)
@@ -779,7 +1012,16 @@ class Ob1Pml:
         # own MCA config, and no registry lookups on the hot path)
         depth = hdr.seq
         if depth:
-            interval = max(depth // 2, 1 << 16)
+            # ACK every half window (ADVICE r5). The old 64KB floor only
+            # ever BOUND when the window itself was under 128KB — where
+            # it deadlocked the rendezvous (the receiver waited for byte
+            # 64K+1 while the sender stalled at `depth` unacked waiting
+            # for the first credit). Half-window cadence is already
+            # chatter-bounded: the sender enforces depth >= 2*frag_size,
+            # so this is at most one ACK per received DATA frag, and it
+            # keeps sender/receiver overlapped on small windows instead
+            # of stop-and-go full-window bubbles.
+            interval = max(depth // 2, 1)
             last = getattr(req, "_last_ack", 0)
             if req._recv_bytes - last >= interval:
                 req._last_ack = req._recv_bytes

@@ -168,9 +168,16 @@ def latest_ranked_step(directory: str) -> Optional[int]:
     return best
 
 
-def restore_ranked(comm, directory: str,
-                   step: Optional[int] = None) -> Dict[str, np.ndarray]:
-    """Load this rank's partition of the committed checkpoint."""
+def restore_ranked(comm, directory: str, step: Optional[int] = None,
+                   rank: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Load this rank's partition of the committed checkpoint.
+
+    ``rank`` overrides the partition index for shrink-and-continue
+    recovery (ft/recovery.py): a checkpoint taken by the pre-failure
+    communicator is restored by each survivor under the rank it HELD
+    when the partition was written — the committed geometry legitimately
+    differs from the shrunk comm's size, so the geometry guard is
+    skipped; full repartitioning remains the application's job."""
     if step is None:
         step = latest_ranked_step(directory)
         if step is None:
@@ -179,17 +186,18 @@ def restore_ranked(comm, directory: str,
     manifest = _read_manifest(d)
     if manifest is None:
         raise MPIError(ERR_FILE, f"step {step} has no committed manifest")
-    if manifest["size"] != comm.Get_size():
+    if rank is None and manifest["size"] != comm.Get_size():
         raise MPIError(
             ERR_OTHER,
             f"checkpoint was taken by {manifest['size']} ranks, "
             f"restoring with {comm.Get_size()} (repartitioning is the "
             "application's job)")
+    use_rank = comm.Get_rank() if rank is None else int(rank)
     if "attempt" in manifest:
         path = os.path.join(
-            d, f"rank_{comm.Get_rank()}.a{manifest['attempt']}.npz")
+            d, f"rank_{use_rank}.a{manifest['attempt']}.npz")
     else:  # legacy pre-attempt format: unversioned rank files
-        path = os.path.join(d, f"rank_{comm.Get_rank()}.npz")
+        path = os.path.join(d, f"rank_{use_rank}.npz")
     if not os.path.exists(path):
         raise MPIError(ERR_FILE, f"missing rank file {path}")
     with np.load(path) as z:

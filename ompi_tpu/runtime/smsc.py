@@ -36,7 +36,13 @@ log = get_logger("runtime.smsc")
 register_var("smsc", "enable", True,
              help="Allow single-copy user-memory transfers via "
                   "process_vm_readv/writev (reference: the smsc "
-                  "framework's component gate)", level=4)
+                  "framework's component gate). NOTE the ptrace "
+                  "surface: on Yama-restricted hosts enabling this "
+                  "opts the process in to being ptrace-attached — by "
+                  "its one known same-node job peer when there is "
+                  "exactly one (PR_SET_PTRACER <pid>), else by any "
+                  "same-uid process (PR_SET_PTRACER_ANY; the kernel "
+                  "holds only a single ptracer grant)", level=4)
 
 _PR_SET_PTRACER = 0x59616d61  # "Yama"
 _PR_SET_PTRACER_ANY = ctypes.c_ulong(-1).value
@@ -97,12 +103,31 @@ def copy_to(pid: int, remote_addr: int, src: np.ndarray) -> None:
           src.nbytes)
 
 
-def enable_peer_access() -> None:
-    """Target-side opt-in for Yama-restricted hosts: allow any process
-    (our same-uid peers) to attach. No-op where prctl is absent or the
-    policy already allows it (reference: smsc_cma's Yama handling)."""
+_granted: Optional[str] = None  # None | "pid" | "any"
+
+
+def enable_peer_access(peer_pids=None) -> None:
+    """Target-side opt-in for Yama-restricted hosts (reference:
+    smsc_cma's Yama handling), scoped as narrowly as the kernel allows:
+    PR_SET_PTRACER holds exactly ONE grant, so with a single known peer
+    pid (the modex-learned same-node job peer, see wireup) the grant is
+    that pid only; with several peers — or when the per-pid grant fails
+    — fall back to PR_SET_PTRACER_ANY as before. No-op where prctl is
+    absent or the policy already allows attaching."""
+    global _granted
+    if peer_pids and len(peer_pids) == 1:
+        try:
+            if _lib().prctl(_PR_SET_PTRACER, int(peer_pids[0]),
+                            0, 0, 0) == 0:
+                _granted = "pid"
+                log.debug("ptracer grant scoped to peer pid %s",
+                          peer_pids[0])
+                return
+        except (OSError, AttributeError, ValueError):
+            pass
     try:
         _lib().prctl(_PR_SET_PTRACER, _PR_SET_PTRACER_ANY, 0, 0, 0)
+        _granted = "any"
     except (OSError, AttributeError):
         pass
 
@@ -129,7 +154,11 @@ def available() -> bool:
         except (OSError, AttributeError, ValueError):
             _cached = False
         if _cached:
-            enable_peer_access()
+            if _granted is None:
+                # contexts outside wireup's scoped per-pid grant
+                # (mesh-mode scripts, tests) still need the opt-in;
+                # wireup's earlier grant, when present, is not widened
+                enable_peer_access()
         else:
             log.debug("cma unavailable: falling back to two-copy paths")
     return _cached

@@ -102,6 +102,10 @@ def init_process_mode():
         my_node = node_id()
         modex.put("btl.sm.seg", sm.seg_path)
         modex.put("btl.sm.node", my_node)
+        # pid card for the smsc/cma ptracer grant: peers that may
+        # single-copy into this process are exactly the same-node job
+        # peers, and scoping PR_SET_PTRACER needs their pids (ADVICE r5)
+        modex.put("smsc.pid", str(os.getpid()))
     modex.fence()  # reference: PMIx_Fence_nb at instance.c:575-625
 
     job_peers = [base + i for i in range(size)]  # universe ranks of my job
@@ -127,6 +131,22 @@ def init_process_mode():
             except Exception:
                 pass  # peer has no sm card (e.g. excluded via --mca btl)
         sm.set_peers(sm_peers)
+        if sm_peers:
+            from ompi_tpu.runtime import smsc  # registers smsc_enable
+
+            if get_var("smsc", "enable"):
+                # scope the ptracer opt-in to the known same-node peer
+                # pids (one-pid kernel grant when possible, ANY
+                # otherwise — see smsc.enable_peer_access)
+                pids = []
+                for r in sm_peers:
+                    try:
+                        pids.append(int(modex.get(r, "smsc.pid",
+                                                  timeout=0.0)))
+                    except Exception:
+                        pass
+                if pids:
+                    smsc.enable_peer_access(pids)
 
     # add_procs: bind the best endpoint per peer, ordered by component
     # priority + locality — the bml/r2 endpoint ordering (instance.c:730):

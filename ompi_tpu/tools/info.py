@@ -33,6 +33,9 @@ def _load_everything() -> None:
     import ompi_tpu.runtime.smsc  # single-copy (cma) vars
     import ompi_tpu.io.file  # collective-IO aggregator vars
     import ompi_tpu.ft.era  # agreement vars
+    import ompi_tpu.ft.detector  # heartbeat detector vars
+    import ompi_tpu.ft.inject  # chaos-plan vars + injected-faults pvar
+    import ompi_tpu.ft.recovery  # failover/retry pvars
 
 
 def print_header(out) -> None:

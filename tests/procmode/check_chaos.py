@@ -1,0 +1,176 @@
+"""Chaos scenarios: the self-healing transport + ULFM recovery path
+exercised by deterministic fault injection (ft_inject_plan), selected
+by argv[1].
+
+``kill`` — argv: kill <ckdir>. A 3-rank job iterates
+    allreduce-accumulate steps, checkpointing each with the ranked
+    two-phase-commit writer. The injection plan kills rank 1 after a
+    fixed number of pml ops (mid-protocol); the heartbeat detector
+    declares it failed, blocked collectives on the survivors raise
+    MPIX_ERR_PROC_FAILED instead of hanging, and ft.recovery runs
+    revoke -> survivor agreement -> shrink -> restore. The survivors
+    finish the remaining steps on the shrunk comm and verify the
+    arithmetic against the restored step — correct results, clean exit.
+
+``drop`` — 2 ranks, plan drops EVERY frame rank1 -> rank0, so rank 0's
+    rendezvous send stalls awaiting CTS and rank 1's matched receive
+    stalls awaiting DATA. The pml_peer_timeout watchdog converts both
+    hangs into MPIX_ERR_PROC_FAILED within the timeout.
+
+``jitter`` — 2 ranks, delay + dup injection on the 0 -> 1 edge: a
+    ping-pong stream stays correct (the MATCH-plane seq gate drops the
+    duplicates) and injected-fault counters read back.
+
+Reference analogs: the failure-propagator tests of
+ompi/communicator/ft and the ftagree fault-injection hooks.
+"""
+
+import faulthandler
+import signal as _signal
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core.errors import (
+    MPIError,
+    ERR_INTERN,
+    ERR_OTHER,
+    ERR_PROC_FAILED,
+    ERR_PROC_FAILED_PENDING,
+    ERR_REVOKED,
+)
+
+ITERS = 6
+
+
+def kill_mode(ckdir: str) -> int:
+    """Kill-mid-allreduce: shrink-and-continue with checkpoint restore."""
+    from ompi_tpu.ft.recovery import FAILURE_CODES, recover
+    from ompi_tpu.runtime.checkpoint import save_ranked
+
+    comm = COMM_WORLD
+    me = comm.Get_rank()  # original world rank, stable across shrink
+    n0 = comm.Get_size()
+    assert n0 == 3, f"choreography assumes 3 ranks, got {n0}"
+    state = {"x": np.full(4, 100.0 * (me + 1)),
+             "step": np.array([0], np.int64)}
+    step = 0
+    failovers = 0
+    restored_at = -1
+    contrib = np.full(4, float(me + 1))
+    while step < ITERS:
+        try:
+            total = np.zeros_like(contrib)
+            comm.Allreduce(contrib, total)
+            state["x"] = state["x"] + total
+            step += 1
+            state["step"][0] = step
+            save_ranked(comm, ckdir, step, state)
+        except MPIError as e:
+            # dead-transport (ERR_OTHER) and lost-frame (ERR_INTERN)
+            # errors can surface before the detector confirms the
+            # death; all route into the same recovery
+            if e.code not in FAILURE_CODES + (ERR_OTHER, ERR_INTERN):
+                raise
+            failovers += 1
+            assert failovers <= 2, "recovery did not converge"
+            comm, restored = recover(comm, ckdir)
+            assert restored is not None, "no committed checkpoint found"
+            state = restored
+            step = int(state["step"][0])
+            restored_at = step
+    assert failovers >= 1, "rank 1 was never killed — plan inert?"
+    assert comm.Get_size() == 2, comm.Get_size()
+    # arithmetic witness: iterations 1..restored_at summed all three
+    # contributions (1+2+3), the re-run restored_at+1..ITERS only the
+    # survivors' (1+3) — any torn checkpoint, lost revoke, or divergent
+    # shrink breaks this exactness
+    expect = 100.0 * (me + 1) + 6.0 * restored_at \
+        + 4.0 * (ITERS - restored_at)
+    assert np.allclose(state["x"], expect), (state["x"], expect)
+    # the shrunk comm stays fully usable
+    comm.Barrier()
+    from ompi_tpu.mca.var import all_pvars
+
+    assert all_pvars()["ft_failovers"].value >= 1
+    print(f"rank {me}: CHAOS-KILL-OK step={restored_at} "
+          f"size={comm.Get_size()} x={float(state['x'][0])}", flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+def drop_mode() -> int:
+    """Total 1->0 frame loss: the peer-timeout watchdog must fail both
+    sides of the stalled rendezvous instead of hanging the job."""
+    r = COMM_WORLD.Get_rank()
+    big = np.arange(300_000, dtype=np.float64)  # > tcp eager limit
+    try:
+        if r == 0:
+            COMM_WORLD.Send(big, dest=1, tag=11)  # RTS out, CTS dropped
+        else:
+            out = np.zeros_like(big)
+            COMM_WORLD.Recv(out, source=0, tag=11)  # CTS out, then silence
+    except MPIError as e:
+        if e.code in (ERR_PROC_FAILED, ERR_PROC_FAILED_PENDING,
+                      ERR_REVOKED):
+            from ompi_tpu.runtime import spc
+
+            assert spc.get("pml_watchdog_trip") >= 1
+            print(f"rank {r}: CHAOS-WATCHDOG-OK", flush=True)
+            return 0
+        raise
+    print(f"rank {r}: stalled rendezvous unexpectedly completed",
+          flush=True)
+    return 1
+
+
+def jitter_mode() -> int:
+    """Latency + duplication on 0->1: traffic stays correct, duplicate
+    frames are swallowed by the sequence gate, counters read back."""
+    r = COMM_WORLD.Get_rank()
+    buf = np.zeros(8, np.int64)
+    for i in range(12):
+        if r == 0:
+            COMM_WORLD.Send(np.full(8, 1000 + i, np.int64), dest=1, tag=i)
+            COMM_WORLD.Recv(buf, source=1, tag=i)
+            assert buf[0] == 2000 + i, (i, buf)
+        else:
+            COMM_WORLD.Recv(buf, source=0, tag=i)
+            assert buf[0] == 1000 + i, (i, buf)
+            COMM_WORLD.Send(np.full(8, 2000 + i, np.int64), dest=0, tag=i)
+    COMM_WORLD.Barrier()
+    from ompi_tpu.ft import inject
+    from ompi_tpu.mca.var import all_pvars
+    from ompi_tpu.runtime import spc
+
+    if r == 0:
+        counts = inject.fault_counts()
+        assert counts.get("delay", 0) >= 12, counts
+        assert counts.get("dup", 0) >= 1, counts
+        assert all_pvars()["ft_injected_faults"].value >= 13
+    else:
+        # rank 1 received each duplicated MATCH frame twice; the seq
+        # gate must have dropped the redeliveries
+        assert spc.get("pml_dup_frame") >= 1
+    print(f"rank {r}: CHAOS-JITTER-OK", flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+def main() -> int:
+    faulthandler.register(_signal.SIGUSR1)  # hang diagnosis: kill -USR1
+    mode = sys.argv[1]
+    if mode == "kill":
+        return kill_mode(sys.argv[2])
+    if mode == "drop":
+        return drop_mode()
+    if mode == "jitter":
+        return jitter_mode()
+    print(f"unknown mode {mode}", flush=True)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,21 @@ def main() -> int:
     dwin.Detach(disp)
     dwin.Free()
 
+    # ---- Win_create with buffer=None on ONE rank (ADVICE r5): a legal
+    # zero-size contribution — the cma-map gate must stay rank-symmetric
+    # so the win_id agreement doesn't desync (this used to corrupt or
+    # hang window creation when the other rank ran the cma collectives)
+    nbase = np.zeros(4, np.float64) if r == 0 else None
+    nwin = Win.Create(nbase, COMM_WORLD)
+    nwin.Fence()
+    if r == 1:
+        nwin.Put(np.full(2, 8.25), target=0, target_disp=0)
+        nwin.Flush(0)
+    nwin.Fence()
+    if r == 0:
+        np.testing.assert_array_equal(nbase[:2], [8.25] * 2)
+    nwin.Free()
+
     print(f"RMA-OK rank {r}")
     return 0
 

@@ -1,0 +1,350 @@
+"""Chaos harness + self-healing transport.
+
+Covers the ft_inject_plan grammar and hooks, the tcp connect
+retry/backoff path, the peer-death watchdog, and the end-to-end ULFM
+shrink-and-continue recovery (kill-mid-allreduce under mpirun).
+Reference analogs: ompi/communicator/ft failure-propagator tests and
+the ftagree fault-injection hooks.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+import ompi_tpu.btl.tcp  # registers the btl_tcp retry/backoff cvars
+import ompi_tpu.pml.ob1  # registers pml_peer_timeout + watchdog pvar
+from ompi_tpu.core.errors import MPIError, ERR_PROC_FAILED
+from ompi_tpu.ft import inject
+from ompi_tpu.mca.var import all_pvars, all_vars, set_var
+from ompi_tpu.pml.base import HDR_SIZE
+
+from tests.test_process_mode import run_mpi
+
+# generous heartbeat margins (test_ft_agree discipline: a starved
+# heartbeat thread on the oversubscribed CI host must not read as a
+# death) + coll/sm off so collectives ride the pml and blocked requests
+# are reachable by the watchdog/detector
+FT = (("ft_enable", "1"),
+      ("ft_heartbeat_period", "0.25"),
+      ("ft_heartbeat_timeout", "4.0"),
+      ("ft_era_timeout", "60"),
+      ("coll_sm_enable", "0"))
+
+
+@pytest.fixture
+def clean_inject():
+    yield inject
+    inject.uninstall()
+
+
+# ------------------------------------------------------------ plan grammar
+def test_plan_grammar(clean_inject):
+    rules = inject.parse_plan(
+        "kill(1,after=40); drop(0,1,frac=0.5); drop(2,*,nth=3,side=recv);"
+        "delay(0,1,ms=15); sever(0,1); dup(0,1,nth=2)")
+    assert [r.action for r in rules] == \
+        ["kill", "drop", "drop", "delay", "sever", "dup"]
+    assert rules[0].src == 1 and rules[0].after == 40
+    assert rules[2].dst is None and rules[2].side == "recv"
+    assert rules[3].ms == 15.0
+
+
+@pytest.mark.parametrize("bad", [
+    "explode(1)",              # unknown action
+    "kill(*)",                 # kill needs a concrete rank
+    "drop(1)",                 # missing dst
+    "delay(0,1)",              # delay needs ms
+    "sever(0,1,side=recv)",    # sever is send-side only
+    "drop(0,1,bogus=1)",       # unknown kv
+    "kill 1 after 2",          # unparseable
+])
+def test_plan_grammar_rejects(bad, clean_inject):
+    with pytest.raises(ValueError):
+        inject.parse_plan(bad)
+
+
+def test_install_arms_and_uninstall_disarms(clean_inject):
+    assert inject._enable_var._value is False  # plan cvar empty in-process
+    inject.install("drop(0,1,nth=2)")
+    assert inject._enable_var._value is True
+    inject.uninstall()
+    assert inject._enable_var._value is False
+
+
+def test_wire_send_verdicts_and_counters(clean_inject):
+    inject.install("drop(0,1,nth=1);dup(0,2,nth=1)")
+    assert inject.wire_send(0, 1) & inject.DROP
+    assert inject.wire_send(0, 2) & inject.DUP
+    assert inject.wire_send(1, 0) == 0  # edge filter
+    counts = inject.fault_counts()
+    assert counts["drop"] == 1 and counts["dup"] == 1
+    assert all_pvars()["ft_injected_faults"].value >= 2
+
+
+def test_sever_fires_exactly_once(clean_inject):
+    """One severed link = one injected fault: after the first frame the
+    dead connection raises on its own, and re-firing would inflate the
+    counter and re-run the btl failure path per frame."""
+    inject.install("sever(0,1)")
+    assert inject.wire_send(0, 1) & inject.SEVER
+    assert inject.wire_send(0, 1) == 0
+    assert inject.wire_send(0, 1) == 0
+    assert inject.fault_counts()["sever"] == 1
+
+
+def test_sever_wildcard_latches_per_edge(clean_inject):
+    """sever(0,*) must sever EVERY matching link once, not just the
+    first-dialed one."""
+    inject.install("sever(0,*)")
+    assert inject.wire_send(0, 1) & inject.SEVER
+    assert inject.wire_send(0, 2) & inject.SEVER
+    assert inject.wire_send(0, 1) == 0
+    assert inject.wire_send(0, 2) == 0
+    assert inject.fault_counts()["sever"] == 2
+
+
+def test_frac_drops_are_seed_deterministic(clean_inject):
+    def schedule(seed):
+        inject.install("drop(0,1,frac=0.5)", seed=seed)
+        return [bool(inject.wire_send(0, 1) & inject.DROP)
+                for _ in range(64)]
+
+    a, b, c = schedule(7), schedule(7), schedule(8)
+    assert a == b          # same seed -> same fault schedule
+    assert a != c          # seed actually keys the stream
+    assert any(a) and not all(a)
+
+
+def test_recv_side_wrap_filters_by_source(clean_inject):
+    from ompi_tpu.pml.base import pack_header
+
+    inject.install("drop(5,0,nth=1,side=recv)")
+    inject.note_rank(0)
+    got = []
+    deliver = inject.wrap_deliver(lambda h, p: got.append(p))
+    assert inject.has_recv_rules()
+    deliver(pack_header(1, 5, 0, 3, 1, 4, 0, 0), b"dead")  # src 5: dropped
+    deliver(pack_header(1, 4, 0, 3, 1, 4, 0, 0), b"live")  # src 4: passes
+    assert got == [b"live"]
+
+
+# ------------------------------------------------------- tcp retry/backoff
+def _free_port() -> int:
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def tcp_knobs():
+    prev = {name: all_vars()[f"btl_tcp_{name}"].value
+            for name in ("retries", "backoff_ms")}
+    yield
+    for name, value in prev.items():
+        set_var("btl_tcp", name, value)
+
+
+def test_tcp_connect_retry_rides_out_late_listener(tcp_knobs):
+    """The self-healing connect: ECONNREFUSED (peer restarting) is
+    retried with backoff until the listener appears, and the queued
+    frame is delivered."""
+    from ompi_tpu.btl.tcp import TcpBtl
+    from ompi_tpu.runtime import spc
+
+    set_var("btl_tcp", "retries", 12)
+    set_var("btl_tcp", "backoff_ms", 20.0)
+    port = _free_port()
+    btl = TcpBtl(lambda h, p: None, my_rank=0)
+    btl.set_peers({1: f"127.0.0.1:{port}"})
+    received = []
+
+    def late_listener():
+        time.sleep(0.25)
+        ls = socket.socket()
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind(("127.0.0.1", port))
+        ls.listen(1)
+        conn, _ = ls.accept()
+        conn.settimeout(5.0)
+        while len(b"".join(received)) < 4 + 4 + HDR_SIZE + 5:
+            chunk = conn.recv(4096)
+            if not chunk:
+                break
+            received.append(chunk)
+        conn.close()
+        ls.close()
+
+    t = threading.Thread(target=late_listener, daemon=True)
+    t.start()
+    before = spc.get("btl_tcp_connect_retries")
+    try:
+        btl.send(1, b"\0" * HDR_SIZE, b"hello")  # connects lazily
+        for _ in range(200):  # drain any backpressured bytes
+            btl.progress()
+            if len(b"".join(received)) >= 4 + 4 + HDR_SIZE + 5:
+                break
+            time.sleep(0.01)
+    finally:
+        t.join(timeout=10)
+        btl.finalize()
+    assert spc.get("btl_tcp_connect_retries") > before
+    blob = b"".join(received)
+    assert blob.endswith(b"hello"), blob[-16:]
+
+
+def test_tcp_connect_retry_exhausts_and_raises(tcp_knobs):
+    from ompi_tpu.btl.tcp import TcpBtl
+
+    set_var("btl_tcp", "retries", 2)
+    set_var("btl_tcp", "backoff_ms", 2.0)
+    btl = TcpBtl(lambda h, p: None, my_rank=0)
+    btl.set_peers({1: f"127.0.0.1:{_free_port()}"})
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            btl.send(1, b"\0" * HDR_SIZE, b"x")
+        assert time.monotonic() - t0 < 10.0  # bounded, not a 30s stall
+    finally:
+        btl.finalize()
+
+
+def test_drain_posted_sweeps_named_source_any_tag():
+    """The peer-death drain must fail named-source receives wherever
+    they are queued: ANY_TAG routes to the wildcard list, but a request
+    naming the dead source must not survive there (only ANY_SOURCE has
+    the PROC_FAILED_PENDING exemption)."""
+    from ompi_tpu.pml.base import (
+        ANY_SOURCE,
+        ANY_TAG,
+        MatchingEngine,
+        RecvRequest,
+    )
+
+    eng = MatchingEngine()
+    named = RecvRequest(None, 0, None, 5, ANY_TAG, 0)
+    anysrc = RecvRequest(None, 0, None, ANY_SOURCE, 3, 0)
+    exact = RecvRequest(None, 0, None, 5, 7, 0)
+    with eng.lock:
+        for req in (named, anysrc, exact):
+            eng.post(req)
+        out = eng.drain_posted_for_src(5)
+    assert {id(r) for r in out} == {id(named), id(exact)}
+    assert eng.n_posted == 1  # the ANY_SOURCE receive survives
+
+
+# ----------------------------------------------------- recovery decorator
+def test_resilient_decorator_retries_on_shrunk_comm(monkeypatch):
+    from ompi_tpu.ft import recovery
+
+    shrunk = object()
+    calls = []
+    monkeypatch.setattr(recovery, "recover",
+                        lambda comm, ckdir=None, step=None:
+                        (shrunk, {"x": 42}))
+
+    @recovery.resilient(checkpoint_dir="/nonexistent")
+    def work(comm, state):
+        calls.append((comm, state))
+        if len(calls) == 1:
+            raise MPIError(ERR_PROC_FAILED)
+        return comm, state
+
+    first = object()
+    comm, state = work(first, {"x": 0})
+    assert comm is shrunk and state == {"x": 42}
+    assert calls[0] == (first, {"x": 0})
+    assert all_pvars()["ft_retries"].value >= 1
+
+
+def test_resilient_decorator_reraises_other_codes():
+    from ompi_tpu.ft.recovery import resilient
+
+    @resilient()
+    def work(comm, state):
+        raise MPIError(13)  # ERR_ARG: not a failure class
+
+    with pytest.raises(MPIError):
+        work(None)
+
+
+# ------------------------------------------------------- registered surface
+def test_cvars_and_pvars_registered():
+    vars_ = all_vars()
+    for name in ("ft_inject_plan", "ft_inject_seed", "btl_tcp_retries",
+                 "btl_tcp_backoff_ms", "pml_peer_timeout"):
+        assert name in vars_, name
+    assert vars_["ft_inject_plan"].default == ""
+    assert vars_["pml_peer_timeout"].default == 0.0
+    pvars = all_pvars()
+    for name in ("ft_injected_faults", "ft_failovers", "ft_retries",
+                 "pml_watchdog_trips"):
+        assert name in pvars, name
+
+
+def test_info_cli_lists_ft_surface(capsys):
+    from ompi_tpu.tools.info import main as info_main
+
+    info_main(["--level", "9", "--param", "ft", "--pvars"])
+    out = capsys.readouterr().out
+    assert "ft_inject_plan" in out
+    assert "ft_injected_faults" in out
+    assert "ft_failovers" in out
+
+
+def test_mpilint_enforces_guard_on_inject_hooks():
+    """Satellite: injection hooks are linted framework code — allowed on
+    the wire path, but only behind the live-Var guard discipline."""
+    from ompi_tpu.analysis.lint import lint_source
+
+    bad = (
+        "from ompi_tpu.ft import inject as _inject\n"
+        "def isend(self, dst, tag):\n"
+        "    _inject.on_op(self.my_rank, tag)\n")
+    got = lint_source(bad, "ompi_tpu/pml/ob1.py")
+    assert any(f.rule == "hot-guard" for f in got), got
+    good = (
+        "from ompi_tpu.ft import inject as _inject\n"
+        "def isend(self, dst, tag):\n"
+        "    if _inject._enable_var._value:\n"
+        "        _inject.on_op(self.my_rank, tag)\n")
+    assert not lint_source(good, "ompi_tpu/pml/ob1.py")
+
+
+# ---------------------------------------------------------- procmode proof
+def test_chaos_kill_mid_allreduce(tmp_path):
+    """The headline: a rank dies mid-allreduce (injected), survivors
+    detect, revoke, agree, shrink, restore the ranked checkpoint, and
+    finish with exact results and a clean exit."""
+    r = run_mpi(3, "tests/procmode/check_chaos.py", "kill",
+                str(tmp_path / "ck"), timeout=150,
+                mca=FT + (("ft_inject_plan", "kill(1,after=60)"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("CHAOS-KILL-OK") == 2, r.stdout + r.stderr
+
+
+def test_chaos_drop_trips_watchdog():
+    """Total frame loss on one edge: the pml_peer_timeout watchdog
+    converts both stalled rendezvous sides into ERR_PROC_FAILED within
+    the timeout — no hang, no orphans."""
+    r = run_mpi(2, "tests/procmode/check_chaos.py", "drop", timeout=90,
+                mca=(("btl_btl", "^sm"),
+                     ("pml_peer_timeout", "2.0"),
+                     ("ft_inject_plan", "drop(1,0,frac=1.0)")))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("CHAOS-WATCHDOG-OK") == 2, r.stdout + r.stderr
+
+
+def test_chaos_delay_dup_stream_stays_correct():
+    """Latency + duplication injection: the MATCH-plane seq gate
+    swallows duplicates, traffic stays correct, counters read back."""
+    r = run_mpi(2, "tests/procmode/check_chaos.py", "jitter", timeout=90,
+                mca=(("btl_btl", "^sm"),
+                     ("ft_inject_plan",
+                      "delay(0,1,ms=25);dup(0,1,nth=3)")))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("CHAOS-JITTER-OK") == 2, r.stdout + r.stderr

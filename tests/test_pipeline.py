@@ -24,6 +24,20 @@ def test_pipeline_bounded_inflight():
     assert "inflight_hwm=16MB" in r.stdout, r.stdout
 
 
+def test_pipeline_small_depth_acks_within_window():
+    """Regression (ADVICE r5): an effective depth below the 64KB ACK
+    threshold deadlocked the rendezvous — the sender stalled at `depth`
+    unacked bytes while the receiver waited for 64KB before its first
+    credit. The cadence is now half the window at any depth."""
+    r = run_mpi(2, "tests/procmode/check_pipeline.py", "2",
+                timeout=120,
+                mca=(("btl_btl", "^sm"),
+                     ("pml_pipeline_depth", "32768"),
+                     ("pml_frag_size", "8192")))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("PIPELINE-OK") == 2, r.stdout + r.stderr
+
+
 def test_pipeline_window_is_real():
     """Counter-factual: with an effectively unbounded depth the sender
     high-water mark reaches the whole message — proving the bounded

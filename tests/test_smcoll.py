@@ -30,3 +30,32 @@ def test_smcoll_procmode_4ranks():
         # serialized single-core host that loses to the pml's blocking
         # recvs (measured ~0.7x here) — the bench artifact carries the
         # number with the untestable_here caveat instead
+
+
+def test_alltoall_remainder_delegates_to_flat():
+    """Regression (ADVICE r5): an indivisible packed size must not
+    floor the remainder away and deliver uninitialized tail bytes —
+    the segment alltoall delegates whole to the flat fallback, like
+    the chunk-too-small path."""
+    import numpy as np
+
+    from ompi_tpu.coll.smcoll import SmColl
+
+    calls = []
+
+    class _FlatProbe:
+        def alltoall(self, comm, sendbuf, recvbuf):
+            calls.append((sendbuf, recvbuf))
+
+    class _Comm:
+        size, rank = 3, 0
+
+    probe = SmColl.__new__(SmColl)
+    probe._flat = _FlatProbe()
+    probe._segment = lambda comm: None
+    probe._chunk = 1 << 20
+    probe._n = 3
+    send = np.arange(10, dtype=np.float64)  # 80 bytes % 3 != 0
+    recv = np.zeros(10, dtype=np.float64)
+    probe.alltoall(_Comm(), send, recv)
+    assert len(calls) == 1, "remainder did not delegate to the fallback"
