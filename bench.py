@@ -257,11 +257,23 @@ def bench_dispatch_tax(world):
     finally:
         world._fast.clear()
         world._fast.update(saved)
-    return {"ours_us": round(d_ours * 1e6, 1),
-            "raw_us": round(d_raw * 1e6, 1),
-            "overhead_us": round((d_ours - d_raw) * 1e6, 1),
-            "prologue_us": round((t_verb - t_stub) * 1e6, 2),
-            "verb_sweep": sweep}
+    out = {"ours_us": round(d_ours * 1e6, 1),
+           "raw_us": round(d_raw * 1e6, 1),
+           "overhead_us": round((d_ours - d_raw) * 1e6, 1),
+           "prologue_us": round((t_verb - t_stub) * 1e6, 2),
+           "verb_sweep": sweep}
+    # mirror the dispatch-tax results into the metrics registry so the
+    # BENCH json and the Prometheus/snapshot exports report the SAME
+    # numbers (gauges, not counters: a re-run replaces the reading)
+    from ompi_tpu.runtime import metrics
+
+    metrics.gauge_set("bench_prologue_us", out["prologue_us"])
+    metrics.gauge_set("bench_dispatch_overhead_us", out["overhead_us"])
+    for vname, d in sweep.items():
+        if "layer_overhead_us" in d:
+            metrics.gauge_set("bench_layer_overhead_us",
+                              d["layer_overhead_us"], verb=vname)
+    return out
 
 
 def bench_verbs(world, n):

@@ -11,7 +11,7 @@ rule id                   contract
 ========================  =====================================================
 hot-guard                 in hot modules (parallel/mesh.py, pml/ob1.py,
                           coll/xla.py, runtime/progress.py) every trace/
-                          sanitizer instrumentation call — and every
+                          sanitizer/metrics instrumentation call — and every
                           ft/inject.py chaos hook (framework code allowed on
                           the wire path) — sits behind a live-Var
                           guard: ``X.enabled()`` / ``X._enable_var._value`` (or
@@ -91,18 +91,23 @@ VERB_LAYER_DIRS = ("comm/", "parallel/")
 ENVIRON_EXEMPT = ("mca/var.py", "tools/")
 # the instrumentation implementations themselves (they define the guards)
 INSTR_IMPL = ("runtime/trace.py", "runtime/sanitizer.py", "runtime/spc.py",
-              "ft/inject.py")
+              "runtime/metrics.py", "ft/inject.py")
 
 TRACE_ALIASES = {"trace", "_trace", "_tr"}
 SAN_ALIASES = {"sanitizer", "_san", "_sanitizer"}
 # ft/inject.py chaos hooks are framework code ALLOWED on the wire path —
 # but only behind the same live-Var guard discipline as trace/sanitizer
 INJECT_ALIASES = {"inject", "_inject"}
+# runtime/metrics.py live-metrics hooks ride the same contract: entry
+# stamps and latency observations in hot modules must be guarded
+METRICS_ALIASES = {"metrics", "_metrics", "_mx"}
 INSTR_TRACE_ATTRS = {"span", "record_span", "instant", "counter",
                      "wrap_span"}
 INSTR_SAN_ATTRS = {"wrap_coll", "on_collective", "check_p2p",
                    "wait_watch", "track_request"}
 INSTR_INJECT_ATTRS = {"on_op", "wire_send", "wrap_deliver"}
+INSTR_METRICS_ATTRS = {"on_coll_entry", "observe", "ewma_update",
+                       "gauge_set"}
 
 _SUPPRESS_RE = re.compile(r"#\s*mpilint:\s*disable=([A-Za-z0-9_,\- ]+)")
 
@@ -203,6 +208,9 @@ def _instr_call(node: ast.AST) -> Optional[str]:
             if v.id in INJECT_ALIASES and \
                     node.func.attr in INSTR_INJECT_ATTRS:
                 return "inject"
+            if v.id in METRICS_ALIASES and \
+                    node.func.attr in INSTR_METRICS_ATTRS:
+                return "metrics"
     return None
 
 
@@ -599,10 +607,12 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
 SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
     "hot-guard": ("ompi_tpu/pml/ob1.py", """
 from ompi_tpu.ft import inject as _inject
+from ompi_tpu.runtime import metrics as _metrics
 from ompi_tpu.runtime import trace as _trace
 
 def isend(self, dst):
     _inject.on_op(self.my_rank, 0)
+    _metrics.observe("pml_send_latency_us", 1.0, peer=dst)
     with _trace.span("pml.send", cat="pml"):
         return self._isend(dst)
 """),

@@ -41,6 +41,7 @@ from ompi_tpu.core.group import Group
 from ompi_tpu.core.request import Request
 from ompi_tpu.core.status import Status
 from ompi_tpu.runtime import peruse, spc
+from ompi_tpu.runtime import metrics as _metrics
 from ompi_tpu.runtime import sanitizer as _san
 from ompi_tpu.runtime import trace as _trace
 
@@ -409,6 +410,11 @@ class ProcComm(Intracomm):
         # at their call sites so counters reflect user activity
         spc.record(op)
         fn = self.coll.get(op)
+        if _metrics._enable_var._value:
+            # straggler plane: stamp collective entry at dispatch and
+            # ship it to the comm root (runtime/metrics.py); one live
+            # attribute load when the metrics plane is off
+            _metrics.on_coll_entry(self, op)
         if _san._enable_var._value:
             # call-order matching sees the buffers, so the interposition
             # happens here on the resolved slot, before any schedule or
@@ -549,6 +555,8 @@ class ProcComm(Intracomm):
         def start_issue():
             self._check_usable()  # a revoked comm must fail at Start too
             spc.record(slot)      # each Start is one collective invocation
+            if _metrics._enable_var._value:  # each Start enters the comm
+                _metrics.on_coll_entry(self, slot)
             if _san._enable_var._value:  # every Start is one ordered call
                 _san.on_collective(self, slot,
                                    _san._signature(slot, args))
@@ -649,6 +657,11 @@ class ProcComm(Intracomm):
 
     def Free(self) -> None:
         self._delete_all_attrs()
+        # reclaim the straggler plane's per-comm state (call index,
+        # tracker rows/latches, skew EWMAs) — unconditionally: a tool
+        # may have enabled metrics for a window and flipped it back off,
+        # and state recorded during the window must not outlive the comm
+        _metrics._forget_cid(self.cid)
         self.coll = None
         self._freed = True
 

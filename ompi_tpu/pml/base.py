@@ -44,6 +44,62 @@ SYSTEM_TAG_BASE = -4000
 def user_traffic(tag: int, cid: int) -> bool:
     return (cid & _PLANE_MASK) == 0 and tag > SYSTEM_TAG_BASE
 
+
+def send_system(pml, dst: int, obj: dict, tag: int) -> None:
+    """Fire-and-forget diagnostic frame on the system plane (bypasses
+    matching; suppressed from SPC so counters stay user-only). Shared
+    by every diagnostic subsystem with its own tag (sanitizer -4400,
+    metrics -4500) — the diagnostic plane must never take the
+    application down."""
+    import json
+
+    from ompi_tpu.core.datatype import BYTE
+    from ompi_tpu.runtime import spc
+
+    payload = json.dumps(obj).encode()
+    try:
+        with spc.suppressed():
+            pml.isend(payload, len(payload), BYTE, dst, tag, 0)
+    except Exception:
+        pass
+
+
+def world_pml():
+    """The world communicator's pml, or None before Init/after teardown
+    (shared by the diagnostic planes' handler binding)."""
+    from ompi_tpu.runtime import state
+
+    w = state._world
+    return None if w is None else w.pml
+
+
+class SystemPlane:
+    """One diagnostic system-plane binding: a tag plus its handler,
+    (re)bound onto whatever pml is live. Identity is a weakref, not
+    id(): a finalize/re-Init cycle can allocate the new pml at the
+    freed old pml's address, and a stale id match would silently skip
+    registration for the whole second epoch."""
+
+    __slots__ = ("tag", "handler", "_pml_ref")
+
+    def __init__(self, tag: int, handler):
+        self.tag = tag
+        self.handler = handler
+        self._pml_ref = None
+
+    def ensure(self, pml) -> None:
+        import weakref
+
+        if self._pml_ref is None or self._pml_ref() is not pml:
+            pml.register_system_handler(self.tag, self.handler)
+            self._pml_ref = weakref.ref(pml)
+
+    def reset(self) -> None:
+        self._pml_ref = None
+
+    def send(self, pml, dst: int, obj: dict) -> None:
+        send_system(pml, dst, obj, self.tag)
+
 # Header kinds (reference: pml_ob1_hdr.h type enum — FIN and ACK are the
 # analogs of MCA_PML_OB1_HDR_TYPE_FIN / _ACK)
 EAGER = 1

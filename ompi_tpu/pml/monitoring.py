@@ -4,19 +4,27 @@ Reference: ompi/mca/pml/monitoring + ompi/mca/common/monitoring (the
 interposition PML that counts messages/bytes per peer then forwards to
 the real PML; matrix output via profile2mat.pl). Redesign: a delegating
 wrapper around the selected PML, enabled with
-``--mca pml_monitoring_enable 1``; per-peer counters surface as pvars
-and the finalize hook prints the communication matrix (one row per
-rank: ``peer:msgs/bytes``), the profile2mat analog.
+``--mca pml_monitoring_enable 1`` (or implicitly by
+``--mca metrics_enable 1`` — the live metrics plane rides the same
+interposition); per-peer counters surface as pvars, the finalize hook
+prints the communication matrix (one row per rank: ``peer:msgs/bytes``,
+the profile2mat analog) when monitoring proper is enabled, and with the
+metrics plane on every user send/recv also lands in per-peer latency
+histograms (``pml_send_latency_us`` / ``pml_recv_latency_us``) plus a
+src→dst bytes/messages matrix sampler merged into the metrics snapshot
+(runtime/metrics.py, tools/promexport.py).
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
-from typing import Dict, Tuple
+from typing import Dict, List, Tuple
 
 from ompi_tpu.mca.var import register_var, get_var, register_pvar
 from ompi_tpu.pml.base import user_traffic
+from ompi_tpu.runtime import metrics as _metrics
 
 register_var("pml_monitoring", "enable", False,
              help="Interpose the pml and count per-peer messages/bytes "
@@ -45,6 +53,10 @@ class MonitoringPml:
             reader = (lambda d=direction, me=self: me._total_bytes(d))
             register_pvar("pml_monitoring", name, reader,
                           help=help_).reader = reader
+        # metrics sampler rides the same rebind discipline: the snapshot
+        # always reflects the live wrapper's matrix
+        _metrics.register_sampler(
+            "pml_comm_matrix", lambda me=self: me.matrix())
 
     def _total_bytes(self, direction: str) -> int:
         with self._lock:
@@ -61,14 +73,32 @@ class MonitoringPml:
     def isend(self, buf, count, datatype, dst, tag, cid):
         if user_traffic(tag, cid):
             self._bump(dst, "tx", count * datatype.size)
+            if _metrics._enable_var._value:
+                # post→completion latency into the per-peer histogram
+                # (one attribute load when the metrics plane is off)
+                t0 = time.monotonic_ns()
+                req = self._inner.isend(buf, count, datatype, dst, tag,
+                                        cid)
+                req.add_completion_callback(
+                    lambda r, t0=t0, dst=dst: _metrics.observe(
+                        "pml_send_latency_us",
+                        (time.monotonic_ns() - t0) / 1000.0, peer=dst))
+                return req
         return self._inner.isend(buf, count, datatype, dst, tag, cid)
 
     def irecv(self, buf, count, datatype, src, tag, cid):
         req = self._inner.irecv(buf, count, datatype, src, tag, cid)
         if user_traffic(tag, cid):
+            t0 = time.monotonic_ns()
+
             def done(r):
                 if r.status.source >= 0:
                     self._bump(r.status.source, "rx", r.status._nbytes)
+                    if _metrics._enable_var._value:
+                        _metrics.observe(
+                            "pml_recv_latency_us",
+                            (time.monotonic_ns() - t0) / 1000.0,
+                            peer=r.status.source)
 
             req.add_completion_callback(done)
         return req
@@ -89,6 +119,32 @@ class MonitoringPml:
             setattr(self._inner, name, value)
 
     # ------------------------------------------------------ matrix dump
+    def matrix(self) -> List[Dict[str, int]]:
+        """src→dst messages/bytes rows from THIS rank's vantage (tx rows
+        originate here, rx rows terminate here) — the metrics-snapshot /
+        Prometheus shape of the communication matrix."""
+        me = self._inner.my_rank
+        with self._lock:
+            # materialize the [msgs, bytes] pairs under the lock — the
+            # lists are the live objects _bump mutates, and reading
+            # them after release could tear a row mid-bump
+            items = sorted((k, tuple(v)) for k, v in self.counts.items())
+        merged: Dict[Tuple[int, int], List[int]] = {}
+        for (p, d), v in items:
+            key = (me, p) if d == "tx" else (p, me)
+            cur = merged.get(key)
+            if cur is None:
+                merged[key] = [v[0], v[1]]
+            else:
+                # self-traffic: the tx and rx counters are two views of
+                # the SAME (me, me) edge — emitting both would render
+                # duplicate Prometheus samples; max (not sum: that
+                # double-counts) tolerates an in-flight delta
+                cur[0] = max(cur[0], v[0])
+                cur[1] = max(cur[1], v[1])
+        return [{"src": s, "dst": t, "msgs": m, "bytes": b}
+                for (s, t), (m, b) in sorted(merged.items())]
+
     def dump_matrix(self, file=None) -> None:
         """The comm-matrix report (reference: common/monitoring's
         output consumed by profile2mat.pl)."""
@@ -108,11 +164,17 @@ class MonitoringPml:
 
 def maybe_wrap(pml):
     """Interpose if enabled (called by wireup at PML selection — the
-    reference's monitoring component wins selection then forwards)."""
-    if not get_var("pml_monitoring", "enable"):
+    reference's monitoring component wins selection then forwards).
+    The live metrics plane implies interposition too (latency
+    histograms + matrix sampler need the wrapper in place at init);
+    the finalize stderr matrix stays exclusive to pml_monitoring_enable
+    so metrics-only jobs don't get the text dump."""
+    monitoring = get_var("pml_monitoring", "enable")
+    if not (monitoring or _metrics._enable_var._value):
         return pml
     wrapped = MonitoringPml(pml)
-    from ompi_tpu.hook import register_hook
+    if monitoring:
+        from ompi_tpu.hook import register_hook
 
-    register_hook("finalize_top", wrapped.dump_matrix)
+        register_hook("finalize_top", wrapped.dump_matrix)
     return wrapped

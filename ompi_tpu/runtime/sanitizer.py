@@ -255,26 +255,6 @@ class _WaitWatch:
 
 _blocked: Dict[int, _WaitWatch] = {}
 _reported_cycles: Dict[tuple, float] = {}  # cycle key -> report time
-_handler_pml_ref = None  # weakref to the pml the handler is bound to
-
-
-def _world_pml():
-    from ompi_tpu.runtime import state
-
-    w = state._world
-    return None if w is None else w.pml
-
-
-def _ensure_handler(pml) -> None:
-    # weakref identity, not id(): a finalize/re-Init cycle can allocate
-    # the new pml at the freed old pml's address, and a stale id match
-    # would silently skip registration for the whole second epoch
-    global _handler_pml_ref
-    import weakref
-
-    if _handler_pml_ref is None or _handler_pml_ref() is not pml:
-        pml.register_system_handler(SAN_TAG, _on_system)
-        _handler_pml_ref = weakref.ref(pml)
 
 
 def _bind_world_handler() -> None:
@@ -282,26 +262,19 @@ def _bind_world_handler() -> None:
     runs — a peer's first shipped coll entry or probe arriving before
     lazy registration would be silently dropped, skewing every
     subsequent call index by one (observed as phantom divergence)."""
+    from ompi_tpu.pml.base import world_pml
+
     if not _enable_var._value:
         return
-    pml = _world_pml()
+    pml = world_pml()
     if pml is not None:
-        _ensure_handler(pml)
+        _plane.ensure(pml)
 
 
 def _send_system(pml, dst: int, obj: dict) -> None:
-    """Fire-and-forget diagnostic frame on the system plane (bypasses
-    matching; suppressed from SPC so counters stay user-only). The
-    diagnostic plane must never take the application down."""
-    from ompi_tpu.core.datatype import BYTE
-    from ompi_tpu.runtime import spc
-
-    payload = json.dumps(obj).encode()
-    try:
-        with spc.suppressed():
-            pml.isend(payload, len(payload), BYTE, dst, SAN_TAG, 0)
-    except Exception:
-        pass
+    """Probe/verdict frame on the sanitizer plane (the shared
+    fire-and-forget helper in pml/base, tagged -4400)."""
+    _plane.send(pml, dst, obj)
 
 
 def wait_watch(req):
@@ -314,10 +287,12 @@ def wait_watch(req):
         peer = getattr(req, "src", None)
     if peer is None or peer < 0:
         return None
-    pml = _world_pml()
+    from ompi_tpu.pml.base import world_pml
+
+    pml = world_pml()
     if pml is None or peer == pml.my_rank:
         return None
-    _ensure_handler(pml)
+    _plane.ensure(pml)
     w = _WaitWatch(req, int(peer), pml,
                    max(float(_timeout_var._value), 0.05))
     with _lock:
@@ -332,8 +307,10 @@ def _on_system(hdr, payload) -> None:
         msg = json.loads(bytes(payload))
     except ValueError:
         return
+    from ompi_tpu.pml.base import world_pml
+
     kind = msg.get("k")
-    pml = _world_pml()
+    pml = world_pml()
     if pml is None:
         return
     me = pml.my_rank
@@ -388,6 +365,13 @@ def _on_system(hdr, payload) -> None:
             int(msg["msgid"]), None)
         if sreq is not None and not sreq._complete.is_set():
             sreq._set_complete(ERR_SANITIZER)
+
+
+from ompi_tpu.pml.base import SystemPlane as _SystemPlane  # noqa: E402
+
+# the sanitizer probe/verdict plane: tag -4400, handler above (the
+# shared weakref rebind discipline lives in pml/base.SystemPlane)
+_plane = _SystemPlane(SAN_TAG, _on_system)
 
 
 def _deadlock_detected(pml, cycle: List[int]) -> None:
@@ -556,7 +540,7 @@ def on_collective(comm, verb: str, sig: str) -> None:
     pml = getattr(comm, "pml", None)
     if pml is None or comm.size <= 1:
         return
-    _ensure_handler(pml)  # the root must listen too (normally bound at
+    _plane.ensure(pml)    # the root must listen too (normally bound at
     root_world = comm.group.world_rank(0)  # init_bottom; this is the
     if root_world == pml.my_rank:          # late-enable fallback)
         return  # the root's own entries were recorded locally above
@@ -625,11 +609,11 @@ def install() -> None:
 
 
 def uninstall() -> None:
-    global _installed, _handler_pml_ref
+    global _installed
     if not _installed:
         return
     _installed = False
-    _handler_pml_ref = None
+    _plane.reset()
     from ompi_tpu.core import request as _request
 
     _request._bind_sanitizer(None, None, None)

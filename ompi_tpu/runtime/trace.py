@@ -42,6 +42,16 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ompi_tpu.mca.var import register_var, register_pvar
+from ompi_tpu.utils.show_help import register_topic, show_help
+
+register_topic(
+    "trace", "ring-overflow",
+    "The trace ring buffers wrapped: {dropped} events were overwritten\n"
+    "before export (oldest first) — the exported timeline is TRUNCATED\n"
+    "at its old end. Raise --mca trace_buffer_events (currently {cap}\n"
+    "events per thread) or trace a shorter window. The exact count is\n"
+    "also in the export's otherData.dropped_events field and the\n"
+    "trace_dropped_events pvar.")
 
 _enable_var = register_var(
     "trace", "enable", False,
@@ -288,6 +298,16 @@ def dropped_events() -> int:
         return sum(r.dropped for r in _rings)
 
 
+def _warn_overflow() -> int:
+    """show_help the ring-overflow banner when events were lost; returns
+    the dropped count (the export's otherData.dropped_events mirror)."""
+    d = dropped_events()
+    if d:
+        show_help("trace", "ring-overflow", dropped=d,
+                  cap=int(_cap_var._value))
+    return d
+
+
 def buffered_events() -> int:
     with _reg_lock:
         return sum(r.cap if r.full else r.pos for r in _rings)
@@ -323,6 +343,12 @@ def _maybe_export() -> None:
     if _exported or not buffered_events():
         return
     _exported = True
+    try:
+        # silent truncation must be visible — but a broken stderr
+        # (atexit with the pipe reader gone) must not cost the export
+        _warn_overflow()
+    except Exception:
+        pass
     try:
         export()
     except Exception:

@@ -26,7 +26,9 @@ def _load_everything() -> None:
     import ompi_tpu.coll.neighbor  # topology collectives
     import ompi_tpu.runtime.spc  # spc vars
     import ompi_tpu.runtime.trace  # trace cvars + pvars
+    import ompi_tpu.runtime.metrics  # metrics cvars + straggler pvar
     import ompi_tpu.runtime.sanitizer  # sanitizer cvars + pvar
+    import ompi_tpu.pml.monitoring  # pml_monitoring enable cvar
     import ompi_tpu.runtime.topology  # topo binding vars
     import ompi_tpu.pml.ob1  # pml vars
     import ompi_tpu.pml.vprotocol  # pml_v message-logging vars

@@ -1,0 +1,69 @@
+"""3-rank straggler detection under deterministic injected delay.
+
+Rank 1 is made the laggard with the PR 3 chaos harness: a
+``delay(0,1,ms=60,side=recv)`` plan sleeps 60ms in rank 1's deliver
+funnel for every frame arriving from rank 0. Each round runs a ring
+``Sendrecv`` (rank 1's receive comes from rank 0, so only rank 1
+stalls — the per-rank "imbalanced work" shape) and then an
+``Allreduce``: rank 1 enters the collective 60ms+ after ranks 0/2,
+which track each other within a millisecond. The comm root (rank 0)
+aggregates the entry stamps, rank 1's skew-vs-median EWMA crosses
+``metrics_straggler_threshold_us`` within the rolling window, and the
+trip fires ON RANK 1 ONLY: its ``metrics_straggler_trips`` pvar bumps
+and its stderr carries the show_help banner, while ranks 0/2 stay at
+zero.
+
+Run: mpirun -np 3 --mca metrics_enable 1
+            --mca metrics_straggler_threshold_us 20000
+            --mca ft_inject_plan "delay(0,1,ms=60,side=recv)"
+            --mca coll_sm_enable 0
+            check_metrics.py [rounds]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.mca.var import all_pvars
+
+LAGGARD = 1
+
+
+def main() -> int:
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+    rank = COMM_WORLD.Get_rank()
+    size = COMM_WORLD.Get_size()
+    assert size == 3, f"this check wants exactly 3 ranks, got {size}"
+    x = np.ones(256, np.float32)
+    out = np.zeros(256, np.float32)
+    ping = np.ones(256, np.float32)
+    pong = np.zeros(256, np.float32)
+    for _ in range(rounds):
+        # the "unbalanced work" phase: ring exchange whose 0 -> 1 edge
+        # is chaos-delayed, so only rank 1 enters the collective late
+        COMM_WORLD.Sendrecv(ping, (rank + 1) % size, 7,
+                            pong, (rank - 1) % size, 7)
+        COMM_WORLD.Allreduce(x, out)
+    assert out[0] == size, f"allreduce arithmetic broke: {out[0]}"
+
+    def trips() -> int:
+        return int(all_pvars()["metrics_straggler_trips"].value)
+
+    # the straggler verdict rides the async system plane root -> laggard
+    # (and the laggard's deliver funnel is the delayed one): give
+    # in-flight frames time to land before reading the pvar
+    if rank == LAGGARD:
+        deadline = time.monotonic() + 8.0
+        while trips() == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+    else:
+        # non-laggards absorb any (wrong) late verdicts before asserting
+        time.sleep(0.5)
+    print(f"rank {rank}: METRICS-TRIPS={trips()}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

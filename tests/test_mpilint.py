@@ -102,6 +102,33 @@ def test_hot_guard_flags_unguarded_span_only_in_hot_modules():
     assert not any(f.rule == "hot-guard" for f in cold)
 
 
+def test_hot_guard_covers_metrics_hooks():
+    """The live-metrics hooks (runtime/metrics.py) ride the same
+    hot-guard contract as trace/sanitizer/inject: unguarded calls in a
+    hot module fire, one-live-Var-load guarded calls pass."""
+    bare = (
+        "from ompi_tpu.runtime import metrics as _metrics\n"
+        "def _coll(self, op):\n"
+        "    _metrics.on_coll_entry(self, op)\n"
+        "    _metrics.observe('lat', 1.0, peer=0)\n"
+    )
+    hot = lint.lint_source(bare, "ompi_tpu/pml/ob1.py")
+    assert sum(f.rule == "hot-guard" for f in hot) == 2
+    assert not any(f.rule == "hot-guard" for f in
+                   lint.lint_source(bare, "ompi_tpu/osc/window.py"))
+    guarded = (
+        "from ompi_tpu.runtime import metrics as _metrics\n"
+        "def _coll(self, op):\n"
+        "    if _metrics._enable_var._value:\n"
+        "        _metrics.on_coll_entry(self, op)\n"
+    )
+    assert lint.lint_source(guarded, "ompi_tpu/pml/ob1.py") == []
+
+
+def test_metrics_module_is_in_the_instrumented_impl_set():
+    assert "runtime/metrics.py" in lint.INSTR_IMPL
+
+
 def test_request_override_accepts_delegation():
     src = (
         "from ompi_tpu.core.request import Request\n"

@@ -135,6 +135,30 @@ def test_ring_overflow_drops_oldest_and_stays_wellformed(tracing,
         trace.reset()
 
 
+def test_ring_overflow_banner_and_metadata(tracing, tmp_path, capfd):
+    """Silent truncation must be visible: the finalize path show_helps
+    a ring-overflow banner and the export carries the dropped count in
+    its metadata (otherData.dropped_events)."""
+    set_var("trace", "buffer_events", 16)
+    trace.reset()
+    try:
+        for i in range(64):
+            trace.instant(f"e{i}", cat="test")
+        dropped = trace.dropped_events()
+        assert dropped > 0
+        assert trace._warn_overflow() == dropped
+        err = capfd.readouterr().err
+        assert "ring buffers wrapped" in err
+        assert str(dropped) in err
+        path = trace.export(str(tmp_path / "overflow-meta.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["otherData"]["dropped_events"] == dropped
+    finally:
+        set_var("trace", "buffer_events", 65536)
+        trace.reset()
+
+
 def test_trace_spans_mirror_onto_mpit_events(tracing):
     """The MPI_T surface sees the same stream the file export records
     (MPI-4 §14.3.8: typed event sources with immutable instances)."""

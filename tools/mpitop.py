@@ -1,0 +1,193 @@
+"""mpitop — top-like live view over per-rank metrics snapshots.
+
+Each rank rewrites ``metrics-rank<N>.json`` every
+``metrics_snapshot_period`` seconds (``ompi_tpu/runtime/metrics.py``;
+at finalize always). mpitop tails the directory, merges the per-rank
+views — optionally aligning snapshot ages with the mpisync clock
+offsets that ``tools/trace_merge.py`` already parses — and renders one
+row per rank: collective counts and rates, traffic totals, the
+straggler skew EWMA the comm root computed for that rank, trip counts,
+and the p50/p99 of the pml send-latency histogram.
+
+Usage::
+
+    OMPI_TPU_MCA_metrics_enable=1 \\
+    OMPI_TPU_MCA_metrics_snapshot_period=1.0 \\
+        python -m ompi_tpu.tools.mpirun -np 4 app.py &
+    python tools/mpitop.py --dir . --interval 1
+    python tools/mpitop.py --once            # one frame (scripts/tests)
+
+The skew column reads the ``coll_entry_skew_us`` EWMAs out of the comm
+roots' snapshots (the root computes every member's skew), so it is
+populated for all ranks even though each rank only exports its own
+registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _TOOLS)
+sys.path.insert(0, os.path.dirname(_TOOLS))
+
+from trace_merge import load_offsets  # noqa: E402  (mpisync offsets)
+from ompi_tpu.coll.base import COLL_OPS  # noqa: E402
+
+
+def read_snapshots(directory: str) -> Dict[int, dict]:
+    """rank -> snapshot for every readable metrics-rank*.json."""
+    out: Dict[int, dict] = {}
+    for path in sorted(glob.glob(
+            os.path.join(directory, "metrics-rank*.json"))):
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue  # mid-rewrite or gone; next refresh catches it
+        out[int(snap.get("rank", 0))] = snap
+    return out
+
+
+def coll_total(snap: dict) -> int:
+    counters = snap.get("counters", {})
+    return sum(int(counters.get(op, 0)) for op in COLL_OPS)
+
+
+def _hist_quantile(snap: dict, name: str, q: float) -> Optional[float]:
+    """q-quantile (upper-edge estimate) over ALL labelsets of one
+    histogram family in a snapshot. Edges come from the snapshot's own
+    ``le`` list (written by metrics.snapshot()) — re-deriving them here
+    would silently desynchronize from the exporter's bucket scheme."""
+    merged: Dict[int, int] = {}
+    total = 0
+    edges: List[Any] = []
+    for h in snap.get("histograms", []):
+        if h.get("name") != name:
+            continue
+        if len(h.get("le", [])) > len(edges):
+            edges = h["le"]
+        for i, c in enumerate(h.get("buckets", [])):
+            merged[i] = merged.get(i, 0) + int(c)
+            total += int(c)
+    if not total:
+        return None
+    target = q * total
+    seen = 0
+    for i in sorted(merged):
+        seen += merged[i]
+        if seen >= target:
+            edge = edges[i] if i < len(edges) else "+Inf"
+            # "+Inf" is the overflow bucket: no finite edge to report
+            # (rendered as "inf" rather than a made-up number)
+            return math.inf if edge == "+Inf" else float(edge)
+    return math.inf
+
+
+def skew_by_rank(snaps: Dict[int, dict]) -> Dict[int, float]:
+    """Worst coll_entry_skew_us EWMA per rank, pulled from every
+    snapshot (comm roots hold the values for their members)."""
+    out: Dict[int, float] = {}
+    for snap in snaps.values():
+        for e in snap.get("ewmas", []):
+            if e.get("name") != "coll_entry_skew_us":
+                continue
+            try:
+                rank = int(e.get("labels", {}).get("rank"))
+                v = float(e.get("value"))
+            except (TypeError, ValueError):
+                continue
+            if v > out.get(rank, -math.inf):
+                out[rank] = v
+    return out
+
+
+def render(snaps: Dict[int, dict], prev: Dict[int, dict],
+           dt: float, offsets: Dict[int, float]) -> str:
+    now_ns = time.monotonic_ns()
+    skews = skew_by_rank(snaps)
+    lines = [f"{'RANK':>4} {'AGE-S':>6} {'COLLS':>8} {'COLL/S':>7} "
+             f"{'TX-MB':>9} {'RX-MB':>9} {'SKEW-US':>8} {'TRIPS':>5} "
+             f"{'P50-US':>7} {'P99-US':>8}"]
+    for rank in sorted(snaps):
+        snap = snaps[rank]
+        pv = snap.get("pvars", {})
+        colls = coll_total(snap)
+        rate = ""
+        if rank in prev and dt > 0:
+            rate = f"{(colls - coll_total(prev[rank])) / dt:.1f}"
+        # snapshot age on rank 0's clock: same-host ranks share
+        # CLOCK_MONOTONIC; cross-host offsets come from mpisync
+        age_ns = now_ns - int(snap.get("ts_ns", now_ns)) \
+            + int(offsets.get(rank, 0.0) * 1e9)
+        tx = pv.get("pml_monitoring_total_sent_bytes", 0) / 1e6
+        rx = pv.get("pml_monitoring_total_recv_bytes", 0) / 1e6
+        skew = skews.get(rank)
+        p50 = _hist_quantile(snap, "pml_send_latency_us", 0.50)
+        p99 = _hist_quantile(snap, "pml_send_latency_us", 0.99)
+        lines.append(
+            f"{rank:>4} {age_ns / 1e9:>6.1f} {colls:>8} {rate:>7} "
+            f"{tx:>9.2f} {rx:>9.2f} "
+            f"{'' if skew is None else format(skew, '.0f'):>8} "
+            f"{pv.get('metrics_straggler_trips', 0):>5} "
+            f"{'' if p50 is None else format(p50, '.0f'):>7} "
+            f"{'' if p99 is None else format(p99, '.0f'):>8}")
+    trips = sum(int(s.get("pvars", {}).get("metrics_straggler_trips", 0))
+                for s in snaps.values())
+    lines.append(f"-- {len(snaps)} rank(s), {trips} straggler trip(s), "
+                 f"refreshed {time.strftime('%H:%M:%S')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mpitop",
+        description="top-like live viewer over per-rank "
+                    "metrics-rank<N>.json snapshots")
+    ap.add_argument("--dir", default=".",
+                    help="snapshot directory (default .)")
+    ap.add_argument("--offsets", default=None,
+                    help="mpisync offsets (JSON map or mpisync stdout) "
+                         "for cross-host snapshot-age alignment")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clear)")
+    opts = ap.parse_args(argv)
+    offsets = load_offsets(opts.offsets) if opts.offsets else {}
+
+    prev: Dict[int, dict] = {}
+    t_prev = time.monotonic()
+    while True:
+        snaps = read_snapshots(opts.dir)
+        if not snaps:
+            print(f"mpitop: no metrics-rank*.json under {opts.dir} "
+                  "(enable with --mca metrics_enable 1; live refresh "
+                  "needs --mca metrics_snapshot_period N)",
+                  file=sys.stderr)
+            if opts.once:
+                return 1
+        else:
+            now = time.monotonic()
+            frame = render(snaps, prev, now - t_prev, offsets)
+            if opts.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            prev, t_prev = snaps, now
+        try:
+            time.sleep(opts.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

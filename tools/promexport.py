@@ -1,0 +1,319 @@
+"""promexport — Prometheus/OpenMetrics text export of metrics snapshots.
+
+The runtime side (``ompi_tpu/runtime/metrics.py``) writes one
+``metrics-rank<N>.json`` per rank (at finalize, and periodically when
+``metrics_snapshot_period`` > 0) and can serve its own live ``/metrics``
+endpoint (``metrics_http_port``). This CLI is the file-based companion:
+merge the per-rank snapshots into ONE exposition (every sample carries a
+``rank`` label), validate it against the Prometheus text-format grammar,
+or serve the merged view for a scraper when the ranks themselves don't
+listen.
+
+Usage::
+
+    python tools/promexport.py metrics-rank*.json            # stdout
+    python tools/promexport.py metrics-rank*.json -o out.prom
+    python tools/promexport.py metrics-rank*.json --check    # grammar gate
+    python tools/promexport.py --serve 9464 --dir .          # scrape proxy
+
+Exit status: 0 = clean, 1 = validation findings (--check), 2 = usage
+error (the mpilint/trace_lint contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ompi_tpu.runtime.metrics import render_prometheus  # noqa: E402
+
+# ------------------------------------------------------------- validator
+# The text-format grammar rules promtool enforces, encoded here so the
+# unit tests (tests/test_metrics.py) can gate every rendering change:
+#   - metric names [a-zA-Z_:][a-zA-Z0-9_:]*, label names without ':'
+#   - '# TYPE <name> <counter|gauge|histogram|summary|untyped>' at most
+#     once per family, BEFORE any of its samples
+#   - all samples of a family form one contiguous group
+#   - sample values are floats / NaN / +-Inf; optional ms timestamp
+#   - no duplicate (name, labelset) samples
+#   - histograms: cumulative non-decreasing buckets, an le="+Inf"
+#     bucket present and equal to <name>_count
+_METRIC_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+    r"(?:\s+(?P<ts>-?[0-9]+))?$")
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def _parse_labels(raw: str, line_no: int,
+                  errors: List[str]) -> Optional[Tuple[Tuple[str, str], ...]]:
+    """Parse the inside of {...}; returns a canonical labelset or None
+    on error. Handles the three escapes (\\\\, \\", \\n)."""
+    labels: List[Tuple[str, str]] = []
+    i, n = 0, len(raw)
+    while i < n:
+        m = re.match(r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"', raw[i:])
+        if m is None:
+            errors.append(f"line {line_no}: bad label syntax at {raw[i:]!r}")
+            return None
+        name = m.group(1)
+        i += m.end()
+        val = []
+        while i < n and raw[i] != '"':
+            if raw[i] == "\\":
+                if i + 1 >= n or raw[i + 1] not in ('\\', '"', 'n'):
+                    errors.append(f"line {line_no}: bad escape in label "
+                                  f"value of {name}")
+                    return None
+                val.append({'\\': '\\', '"': '"', 'n': '\n'}[raw[i + 1]])
+                i += 2
+            else:
+                val.append(raw[i])
+                i += 1
+        if i >= n:
+            errors.append(f"line {line_no}: unterminated label value")
+            return None
+        i += 1  # closing quote
+        if any(k == name for k, _ in labels):
+            errors.append(f"line {line_no}: duplicate label name "
+                          f"{name!r} in one labelset")
+            return None
+        labels.append((name, "".join(val)))
+        rest = raw[i:].lstrip()
+        if rest.startswith(","):
+            i = n - len(rest) + 1
+        elif rest:
+            errors.append(f"line {line_no}: junk after label: {rest!r}")
+            return None
+        else:
+            break
+    return tuple(sorted(labels))
+
+
+def _parse_value(raw: str) -> Optional[float]:
+    if raw == "NaN":
+        return math.nan
+    if raw in ("+Inf", "Inf"):
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def _family_of(name: str, types: Dict[str, str]) -> str:
+    """Histogram/summary samples belong to their base family."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        base = name[:-len(suffix)] if name.endswith(suffix) else None
+        if base and types.get(base) in ("histogram", "summary"):
+            return base
+    return name
+
+
+def validate(text: str) -> List[str]:
+    """Returns a list of grammar violations (empty = parses clean)."""
+    errors: List[str] = []
+    types: Dict[str, str] = {}
+    helps: Dict[str, int] = {}
+    seen_samples: set = set()
+    sampled_families: set = set()
+    family_closed: Dict[str, bool] = {}
+    current_family: Optional[str] = None
+    # histogram accounting: family -> labelset-sans-le -> [(le, value)]
+    buckets: Dict[str, Dict[tuple, List[Tuple[float, float]]]] = {}
+    counts: Dict[str, Dict[tuple, float]] = {}
+
+    for line_no, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                continue  # arbitrary comments are legal
+            name = parts[2]
+            if not _METRIC_RE.match(name):
+                errors.append(f"line {line_no}: bad metric name {name!r}")
+                continue
+            if parts[1] == "TYPE":
+                typ = parts[3].strip() if len(parts) > 3 else ""
+                if typ not in _TYPES:
+                    errors.append(f"line {line_no}: unknown TYPE {typ!r}")
+                if name in types:
+                    errors.append(f"line {line_no}: duplicate TYPE for "
+                                  f"{name}")
+                if name in sampled_families:
+                    errors.append(f"line {line_no}: TYPE for {name} after "
+                                  "its samples")
+                types[name] = typ
+            else:
+                if name in helps:
+                    errors.append(f"line {line_no}: duplicate HELP for "
+                                  f"{name}")
+                helps[name] = line_no
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            errors.append(f"line {line_no}: unparseable sample: {line!r}")
+            continue
+        name = m.group("name")
+        labels = _parse_labels(m.group("labels") or "", line_no, errors) \
+            if m.group("labels") is not None else ()
+        if labels is None:
+            continue
+        value = _parse_value(m.group("value"))
+        if value is None:
+            errors.append(f"line {line_no}: bad sample value "
+                          f"{m.group('value')!r}")
+            continue
+        fam = _family_of(name, types)
+        if (name, labels) in seen_samples:
+            errors.append(f"line {line_no}: duplicate sample "
+                          f"{name}{dict(labels)}")
+        seen_samples.add((name, labels))
+        if current_family is not None and fam != current_family:
+            family_closed[current_family] = True
+        if family_closed.get(fam):
+            errors.append(f"line {line_no}: samples of {fam} are not "
+                          "one contiguous group")
+        current_family = fam
+        sampled_families.add(fam)
+        if types.get(fam) == "histogram":
+            sans_le = tuple(kv for kv in labels if kv[0] != "le")
+            if name.endswith("_bucket"):
+                le = dict(labels).get("le")
+                if le is None:
+                    errors.append(f"line {line_no}: histogram bucket "
+                                  "without an le label")
+                    continue
+                lev = _parse_value(le)
+                if lev is None:
+                    errors.append(f"line {line_no}: bad le value {le!r}")
+                    continue
+                buckets.setdefault(fam, {}).setdefault(
+                    sans_le, []).append((lev, value))
+            elif name.endswith("_count"):
+                counts.setdefault(fam, {})[sans_le] = value
+
+    for fam, per_labels in buckets.items():
+        for sans_le, series in per_labels.items():
+            series.sort(key=lambda p: p[0])
+            if not series or series[-1][0] != math.inf:
+                errors.append(f"{fam}{dict(sans_le)}: histogram is "
+                              'missing the le="+Inf" bucket')
+                continue
+            prev = -math.inf
+            for le, v in series:
+                if v < prev:
+                    errors.append(f"{fam}{dict(sans_le)}: bucket "
+                                  f"le={le} count {v} decreases — "
+                                  "buckets must be cumulative")
+                prev = v
+            total = counts.get(fam, {}).get(sans_le)
+            if total is not None and series[-1][1] != total:
+                errors.append(f"{fam}{dict(sans_le)}: le=\"+Inf\" bucket "
+                              f"{series[-1][1]} != _count {total}")
+    return errors
+
+
+# ------------------------------------------------------------------ merge
+def load_snapshots(paths: List[str]) -> List[dict]:
+    snaps = []
+    for path in paths:
+        with open(path) as f:
+            snaps.append(json.load(f))
+    snaps.sort(key=lambda s: s.get("rank", 0))
+    return snaps
+
+
+def _serve(port: int, directory: str) -> int:
+    """Scrape proxy: re-read metrics-rank*.json on every GET /metrics
+    and serve the merged exposition (localhost only)."""
+    import http.server
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            paths = sorted(glob.glob(
+                os.path.join(directory, "metrics-rank*.json")))
+            try:
+                body = render_prometheus(load_snapshots(paths)).encode()
+            except (OSError, ValueError) as e:
+                self.send_response(500)
+                self.end_headers()
+                self.wfile.write(str(e).encode())
+                return
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    print(f"promexport: serving {directory}/metrics-rank*.json on "
+          f"127.0.0.1:{srv.server_address[1]}/metrics", file=sys.stderr)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="promexport",
+        description="Merge per-rank metrics-rank<N>.json snapshots into "
+                    "one Prometheus text exposition")
+    ap.add_argument("snapshots", nargs="*",
+                    help="metrics-rank<N>.json files")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the exposition here (default stdout)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the rendered text against the "
+                         "Prometheus text-format grammar; exit 1 on "
+                         "findings")
+    ap.add_argument("--serve", type=int, default=None, metavar="PORT",
+                    help="serve the merged exposition on 127.0.0.1:PORT "
+                         "(re-reads the files per scrape)")
+    ap.add_argument("--dir", default=".",
+                    help="snapshot directory for --serve (default .)")
+    opts = ap.parse_args(argv)
+
+    if opts.serve is not None:
+        return _serve(opts.serve, opts.dir)
+    if not opts.snapshots:
+        ap.error("no snapshot files given (or use --serve)")
+    text = render_prometheus(load_snapshots(opts.snapshots))
+    if opts.check:
+        errors = validate(text)
+        for e in errors:
+            print(f"promexport: {e}", file=sys.stderr)
+        if errors:
+            return 1
+        print(f"promexport: {len(opts.snapshots)} snapshot(s) render "
+              f"clean ({len(text.splitlines())} lines)")
+    if opts.output:
+        with open(opts.output, "w") as f:
+            f.write(text)
+    elif not opts.check:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
