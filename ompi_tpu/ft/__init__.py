@@ -1,4 +1,8 @@
 """ULFM-style fault tolerance (reference: ompi/communicator/ft + coll/ftagree
 + ompi/mpiext/ftmpi — MPIX_Comm_revoke/shrink/agree and the heartbeat
 failure detector). The detector lives in ompi_tpu.ft.detector; revoke/shrink
-in ompi_tpu.ft.revoke; agreement in ompi_tpu.ft.agreement."""
+in ompi_tpu.ft.revoke; agreement in ompi_tpu.ft.agreement; diskless
+in-memory checkpoint replication in ompi_tpu.ft.diskless; the
+shrink/respawn recovery policies in ompi_tpu.ft.recovery; deterministic
+fault injection (incl. the preemption-notice model) in
+ompi_tpu.ft.inject."""

@@ -1,0 +1,674 @@
+"""Diskless in-memory checkpoint replication.
+
+Reference: the classic diskless-checkpointing pair the vprotocol /
+rollback-recovery literature assumes (SURVEY §5; Plank's diskless
+checkpointing and the ftmpi examples keep survivor state in peer
+memory): every rank serializes its application state each *epoch* and
+ships it to peers, so recovery needs NO shared filesystem — exactly the
+preemptible-TPU deployment the ROADMAP targets, where local disk
+vanishes with the VM. Two redundancy schemes, both over a dedicated
+system-plane tag (``FT_CKPT_TAG`` = -4600, the sanitizer/metrics idiom):
+
+- **buddy** (default): each rank ships its blob to the next
+  ``ft_ckpt_buddies`` ranks in comm order. Memory cost 1+k blobs per
+  rank; any failure whose owner has one live buddy is recoverable.
+- **parity**: ranks form groups of ``ft_ckpt_group``; each member XORs
+  every group peer's blob into a running accumulator (transient — peer
+  blobs are NOT retained) and keeps only the group parity ``P`` = XOR
+  over all g members plus a per-owner length map. Memory cost 2 blobs
+  per rank regardless of g; any SINGLE failure per group is rebuilt as
+  ``P ⊕ (⊕ survivors' own blobs)``. A double failure inside one group
+  falls back to the disk checkpoint (ft/recovery.py) when one exists.
+
+Epoch semantics are prepare/commit: blobs stage under their epoch
+number until EVERY rank reports its expected replicas arrived, ratified
+by a :func:`ft.agreement.agree` (ERA) round — the uniform-consensus
+property means a crash mid-epoch aborts the epoch on every survivor and
+the previous complete epoch stays restorable (the two-phase-commit
+discipline of ``runtime/checkpoint.save_ranked``, minus the
+filesystem). The blob encoding IS ``save_ranked``'s: an in-memory npz
+of the rank's ``{name: ndarray}`` state.
+
+Preemption: ``ft/inject.py``'s ``preempt(rank, after=N, grace_ms=M)``
+action (the TPU preemption-notice model from runtime/checkpoint.py's
+design note) invokes :func:`flush_final` on the doomed rank, which
+ships one FINAL single-owner blob (from the registered state provider)
+to its buddies inside the grace window. When every dead rank left a
+final blob, ``recover(policy="respawn")`` skips the rollback entirely:
+survivors keep their live state and only the replacement restores.
+
+Hot-path discipline: everything is gated on the ``ft_ckpt_enable``
+live Var — the disabled path of every hook is one attribute load
+(``_enable_var._value``; mpilint's hot-guard rule covers the
+``diskless.save`` / ``diskless.flush_final`` hooks in hot modules).
+Observability: ``ft_ckpt_epochs`` / ``ft_ckpt_bytes_replicated`` /
+``ft_ckpt_restores_mem`` / ``ft_ckpt_restores_parity`` pvars,
+``ft_ckpt_ship_us`` / ``ft_ckpt_restore_us`` latency histograms +
+``ft_ckpt_epoch`` / ``ft_ckpt_store_bytes`` gauges in the metrics
+plane, trace spans, and ``ft`` MPI_T events.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import threading
+import time
+import weakref
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIError, ERR_OTHER
+from ompi_tpu.mca.var import register_var, register_pvar
+from ompi_tpu.mpit import emit as _emit, register_event_type
+from ompi_tpu.runtime import metrics as _metrics
+from ompi_tpu.runtime import trace as _trace
+from ompi_tpu.utils.output import get_logger
+from ompi_tpu.utils.show_help import register_topic
+
+log = get_logger("ft.diskless")
+
+#: diskless replication plane (sanitizer -4400, metrics -4500)
+FT_CKPT_TAG = -4600
+
+#: epoch-commit votes ride their own era cid plane (payload-only — era
+#: frames carry the cid in their int64 body, not the wire header) so a
+#: commit racing a recovery agreement on the same comm can never join
+#: the wrong sequence
+CKPT_CID_BIT = 1 << 31
+
+_enable_var = register_var(
+    "ft", "ckpt_enable", False,
+    help="Replicate in-memory checkpoint epochs to peer ranks "
+         "(diskless checkpointing) so ft/recovery can restore from "
+         "survivor memory with no shared filesystem; disabled path is "
+         "one attribute load per hook", level=3)
+_mode_var = register_var(
+    "ft", "ckpt_mode", "buddy", typ=str,
+    help="Redundancy scheme: 'buddy' ships each rank's blob to the "
+         "next ft_ckpt_buddies ranks; 'parity' keeps one XOR parity "
+         "block per ft_ckpt_group ranks (2x memory at any group size, "
+         "one recoverable failure per group)", level=4)
+_buddies_var = register_var(
+    "ft", "ckpt_buddies", 1,
+    help="Replica count k in buddy mode: rank r ships to ranks "
+         "r+1..r+k (mod size)", level=4)
+_group_var = register_var(
+    "ft", "ckpt_group", 3,
+    help="XOR parity group size g in parity mode (consecutive comm "
+         "ranks; a trailing remainder group smaller than 2 has no "
+         "redundancy)", level=4)
+_timeout_var = register_var(
+    "ft", "ckpt_timeout", 30.0, float,
+    help="Seconds a rank waits for its expected incoming replicas "
+         "before voting to abort the epoch (the commit agreement turns "
+         "any rank's timeout into a uniform abort)", level=6)
+_keep_var = register_var(
+    "ft", "ckpt_keep", 2,
+    help="Committed epochs retained in memory (own blob + replicas "
+         "+ parity); older epochs are garbage-collected at commit",
+    level=7)
+
+register_event_type("ft", "ckpt_commit",
+                    "A diskless checkpoint epoch committed (ratified "
+                    "by ERA agreement)")
+register_event_type("ft", "ckpt_restore",
+                    "Rank state restored from the in-memory epoch "
+                    "store (own blob, buddy replica, or XOR parity)")
+register_event_type("ft", "ckpt_preempt_flush",
+                    "A preemption-doomed rank flushed one final blob "
+                    "to its buddies inside the grace window")
+register_topic(
+    "ft", "ckpt-unrecoverable",
+    "Diskless recovery cannot rebuild the state of dead rank(s) "
+    "{ranks}:\n  {reason}\nNo buddy replica survived, the XOR parity "
+    "group lost more than one member, and no committed disk "
+    "checkpoint exists to fall back to. Increase ft_ckpt_buddies, "
+    "shrink ft_ckpt_group, or configure a checkpoint_dir; escalating "
+    "MPIX_ERR_PROC_FAILED to the application.")
+
+_counts: Dict[str, int] = {"epochs": 0, "bytes": 0,
+                           "restores_mem": 0, "restores_parity": 0}
+
+register_pvar("ft", "ckpt_epochs", lambda: _counts["epochs"],
+              help="Diskless checkpoint epochs committed (agreement-"
+                   "ratified) on this rank")
+register_pvar("ft", "ckpt_bytes_replicated", lambda: _counts["bytes"],
+              help="Serialized state bytes shipped to buddy/parity "
+                   "peers by the diskless checkpoint plane")
+register_pvar("ft", "ckpt_restores_mem", lambda: _counts["restores_mem"],
+              help="States restored from in-memory blobs (own epoch "
+                   "copy or a buddy replica)")
+register_pvar("ft", "ckpt_restores_parity",
+              lambda: _counts["restores_parity"],
+              help="States reconstructed from an XOR parity group")
+
+
+def enabled() -> bool:
+    """One attribute load off the live Var (spc/trace discipline)."""
+    return _enable_var._value
+
+
+# ----------------------------------------------------------- blob encoding
+def encode_state(state: Dict[str, np.ndarray]) -> bytes:
+    """The ``save_ranked`` npz encoding, in memory."""
+    buf = io.BytesIO()
+    np.savez(buf, **state)
+    return buf.getvalue()
+
+
+def decode_state(blob: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(bytes(blob))) as z:
+        return {k: z[k].copy() for k in z.files}
+
+
+def _xor_into(acc: bytearray, blob: bytes) -> None:
+    """acc ^= blob, growing acc to cover blob (zero padding is the XOR
+    identity, so differing blob lengths compose correctly)."""
+    if len(acc) < len(blob):
+        acc.extend(b"\0" * (len(blob) - len(acc)))
+    a = np.frombuffer(acc, np.uint8)
+    a[: len(blob)] ^= np.frombuffer(blob, np.uint8)
+
+
+def xor_reconstruct(parity: bytes, lengths: Dict[int, int], dead: int,
+                    blobs: List[bytes]) -> bytes:
+    """Rebuild the dead group member's blob: parity ⊕ every surviving
+    member's blob, truncated to the dead member's recorded length.
+    ``blobs`` must hold the g-1 surviving members' blobs (any order)."""
+    acc = bytearray(parity)
+    for b in blobs:
+        _xor_into(acc, b)
+    n = int(lengths[dead])
+    if n > len(acc):
+        raise MPIError(ERR_OTHER,
+                       f"parity reconstruction underflow: need {n} "
+                       f"bytes, accumulator holds {len(acc)}")
+    _counts["restores_parity"] += 1
+    return bytes(acc[:n])
+
+
+# ------------------------------------------------------------- geometry
+def buddies(rank: int, size: int, k: Optional[int] = None) -> List[int]:
+    """The k successor ranks holding this rank's replica (comm order)."""
+    if k is None:
+        k = int(_buddies_var._value)
+    k = max(0, min(int(k), size - 1))
+    return [(rank + j) % size for j in range(1, k + 1)]
+
+
+def group_members(rank: int, size: int,
+                  g: Optional[int] = None) -> List[int]:
+    """This rank's XOR parity group (consecutive comm ranks)."""
+    if g is None:
+        g = int(_group_var._value)
+    g = max(2, int(g))
+    lo = (rank // g) * g
+    return list(range(lo, min(lo + g, size)))
+
+
+def _expected_owners(rank: int, size: int, mode: str) -> List[int]:
+    """Ranks whose epoch blob must land HERE for the epoch to commit.
+    Buddy mode is the closed form — I replicate FOR my k predecessors
+    (the inverse of buddies()) — not an O(size) membership scan."""
+    if mode == "parity":
+        return [m for m in group_members(rank, size) if m != rank]
+    k = max(0, min(int(_buddies_var._value), size - 1))
+    return sorted({(rank - j) % size for j in range(1, k + 1)})
+
+
+# ----------------------------------------------------------------- store
+class _Store:
+    """Epoch-keyed blob store. ``staged_*`` holds the in-flight epoch;
+    commit promotes it and garbage-collects beyond ft_ckpt_keep."""
+
+    def __init__(self):
+        self.own: Dict[int, bytes] = {}
+        self.replicas: Dict[Tuple[int, int], bytes] = {}  # (epoch, owner)
+        self.parity: Dict[int, Tuple[bytes, Dict[int, int]]] = {}
+        self.staged_own: Dict[int, bytes] = {}
+        self.staged_replicas: Dict[Tuple[int, int], bytes] = {}
+        self.staged_parity: Dict[int, list] = {}  # epoch -> [acc, lens]
+        self.final: Dict[int, Tuple[bytes, dict]] = {}  # owner -> blob
+        self.committed = -1
+        self.next_epoch = 0
+
+
+_lock = threading.Lock()
+_store = _Store()
+_provider: Optional[Callable[[], Dict[str, np.ndarray]]] = None
+_comm_ref = None  # weakref to the last attached communicator
+
+
+# ----------------------------------------------------------- system plane
+def _ship(pml, dst_urank: int, kind: str, epoch: int, owner: int,
+          blob: bytes) -> None:
+    """One framed blob on the replication plane: u32 meta length + JSON
+    meta + raw npz bytes, a single system-plane frame (system tags skip
+    the eager limit). Fire-and-forget: a dead destination surfaces as a
+    missing receipt and the commit agreement aborts the epoch."""
+    from ompi_tpu.core.datatype import BYTE
+    from ompi_tpu.runtime import spc
+
+    meta = json.dumps({"kind": kind, "epoch": int(epoch),
+                       "owner": int(owner), "len": len(blob)}).encode()
+    frame = struct.pack("<I", len(meta)) + meta + bytes(blob)
+    arr = np.frombuffer(frame, np.uint8)
+    try:
+        with spc.suppressed():
+            pml.isend(arr, arr.size, BYTE, dst_urank, FT_CKPT_TAG, 0)
+    except Exception:
+        log.debug("ship to universe rank %d failed (dead peer?)",
+                  dst_urank, exc_info=True)
+
+
+def _on_system(hdr, payload) -> None:
+    """Replication-plane dispatch (runs on the transport's delivery
+    thread — store and return, never raise)."""
+    try:
+        data = bytes(payload)
+        (mlen,) = struct.unpack_from("<I", data, 0)
+        meta = json.loads(data[4:4 + mlen].decode())
+        blob = data[4 + mlen:]
+        kind = meta["kind"]
+        epoch = int(meta["epoch"])
+        owner = int(meta["owner"])
+    except Exception:
+        log.warning("dropping malformed ft_ckpt frame from %d", hdr.src)
+        return
+    with _lock:
+        if kind in ("replica", "parity") and \
+                epoch < _store.next_epoch - 1:
+            # straggler for an epoch whose save already finished
+            # (committed or aborted): staging it would pin the blob
+            # forever — nothing ever promotes or purges a past-epoch
+            # staged entry
+            return
+        if kind == "replica":
+            _store.staged_replicas[(epoch, owner)] = blob
+        elif kind == "parity":
+            acc = _store.staged_parity.get(epoch)
+            if acc is None:
+                acc = _store.staged_parity[epoch] = [bytearray(), {}]
+            if owner in acc[1]:
+                # XOR is NOT idempotent: a duplicated frame (transport
+                # re-drive, chaos dup rule — the wire hooks don't
+                # exempt system tags) would cancel the owner's
+                # contribution out of the parity while still counting
+                # it present, committing a silently corrupt block
+                return
+            _xor_into(acc[0], blob)
+            acc[1][owner] = len(blob)
+        elif kind == "final":
+            _store.final[owner] = (blob, meta)
+    if _trace.enabled():
+        _trace.instant("ft.ckpt.recv", cat="ft", kind=kind,
+                       epoch=epoch, owner=owner, nbytes=len(blob))
+
+
+from ompi_tpu.pml.base import SystemPlane as _SystemPlane  # noqa: E402
+
+_plane = _SystemPlane(FT_CKPT_TAG, _on_system)
+
+
+def _bind_world_handler() -> None:
+    """init_bottom hook: bind the replication handler before user code
+    runs, so a fast peer's first epoch blob can't be dropped by lazy
+    registration (the metrics-plane discipline)."""
+    from ompi_tpu.pml.base import world_pml
+
+    if not _enable_var._value:
+        return
+    pml = world_pml()
+    if pml is not None:
+        _plane.ensure(pml)
+
+
+# ------------------------------------------------------------------ save
+def attach(comm) -> None:
+    """Remember the communicator the replication geometry runs over —
+    save() does this implicitly; the preemption flush needs it when the
+    notice arrives outside any save call."""
+    global _comm_ref
+    _comm_ref = weakref.ref(comm)
+    pml = getattr(comm, "pml", None)
+    if pml is not None:
+        _plane.ensure(pml)
+
+
+def set_state_provider(comm, fn: Callable[[], Dict[str, np.ndarray]]) -> None:
+    """Register the zero-arg callable the preemption-notice flush
+    serializes (return a self-consistent {name: ndarray} snapshot —
+    update it only at step boundaries)."""
+    global _provider
+    _provider = fn
+    attach(comm)
+
+
+class _CommitChannel:
+    """The comm facets era reads (cid, group, pml, revoked), with the
+    cid shifted onto the commit plane."""
+
+    __slots__ = ("_comm", "cid", "group", "pml")
+
+    def __init__(self, comm):
+        self._comm = comm
+        self.cid = comm.cid | CKPT_CID_BIT
+        self.group = comm.group
+        self.pml = comm.pml
+
+    @property
+    def revoked(self) -> bool:
+        return self._comm.revoked
+
+
+def _have_all(epoch: int, owners: List[int], mode: str) -> bool:
+    with _lock:
+        if mode == "parity":
+            acc = _store.staged_parity.get(epoch)
+            got = set(acc[1]) if acc is not None else set()
+            return all(o in got for o in owners)
+        return all((epoch, o) in _store.staged_replicas for o in owners)
+
+
+def save(comm, state: Dict[str, np.ndarray],
+         timeout: Optional[float] = None) -> bool:
+    """Replicate one epoch of ``state`` (collective over ``comm``).
+    Returns True when the epoch committed on every rank, False when it
+    aborted (a peer died or timed out mid-epoch — the previous
+    committed epoch remains restorable either way). No-op returning
+    False when ``ft_ckpt_enable`` is unset (one attribute load)."""
+    if not _enable_var._value:
+        return False
+    if _trace.enabled():
+        with _trace.span("ft.ckpt.save", cat="ft", cid=comm.cid):
+            return _save(comm, state, timeout)
+    return _save(comm, state, timeout)
+
+
+def _save(comm, state, timeout) -> bool:
+    from ompi_tpu.runtime import spc
+    from ompi_tpu.runtime.progress import progress_until
+
+    pml = getattr(comm, "pml", None)
+    if pml is None:
+        raise MPIError(ERR_OTHER,
+                       "diskless checkpoints require process mode "
+                       "(mesh mode has a single controller — use "
+                       "MeshCheckpointer)")
+    attach(comm)
+    me, n = comm.Get_rank(), comm.Get_size()
+    mode = str(_mode_var._value)
+    with _lock:
+        epoch = _store.next_epoch
+        _store.next_epoch = epoch + 1
+        # shed staging left behind by older epochs (a frame that raced
+        # past the handler's past-epoch gate, or an abort whose
+        # straggler landed later) — staging is only ever live for the
+        # current epoch ± a one-epoch peer skew
+        for key in [k for k in _store.staged_replicas if k[0] < epoch]:
+            del _store.staged_replicas[key]
+        for e in [e for e in _store.staged_parity if e < epoch]:
+            del _store.staged_parity[e]
+        for e in [e for e in _store.staged_own if e < epoch]:
+            del _store.staged_own[e]
+    t0 = time.monotonic()
+    blob = encode_state(state)
+    if mode == "parity" and n > 1:
+        peers = [m for m in group_members(me, n) if m != me]
+        kind = "parity"
+    else:
+        peers = buddies(me, n)
+        kind = "replica"
+    with _lock:
+        _store.staged_own[epoch] = blob
+        if kind == "parity":
+            acc = _store.staged_parity.setdefault(epoch, [bytearray(), {}])
+            _xor_into(acc[0], blob)
+            acc[1][me] = len(blob)
+    for p in peers:
+        _ship(pml, comm.group.world_rank(p), kind, epoch, me, blob)
+    if peers:
+        _counts["bytes"] += len(blob) * len(peers)
+        spc.record_bytes("ft_ckpt_ship_bytes", len(blob) * len(peers))
+    owners = _expected_owners(me, n, mode)
+    owner_uranks = {comm.group.world_rank(o) for o in owners}
+    tmo = float(_timeout_var._value) if timeout is None else timeout
+
+    def _settled() -> bool:
+        # complete, or provably never completing: a dead owner can't
+        # ship its blob (vote to abort now, don't burn the timeout),
+        # and a revocation means a peer already failed into recovery
+        from ompi_tpu.ft.detector import known_failed
+
+        return (_have_all(epoch, owners, mode) or comm.revoked
+                or bool(owner_uranks & known_failed()))
+
+    progress_until(_settled, timeout=tmo)
+    if comm.revoked:
+        from ompi_tpu.core.errors import ERR_REVOKED
+
+        raise MPIError(ERR_REVOKED,
+                       "epoch save aborted: communicator revoked "
+                       "(a peer is already in recovery)")
+    ok = _have_all(epoch, owners, mode)
+    if _metrics._enable_var._value:
+        _metrics.observe("ft_ckpt_ship_us",
+                         (time.monotonic() - t0) * 1e6, mode=mode)
+    # The commit vote: AND over every member's "my replicas arrived" —
+    # uniform even under mid-call death (the ERA property), so a torn
+    # epoch aborts everywhere and the previous epoch stays whole. Runs
+    # on a dedicated era cid channel with abort_on_revoke: a peer that
+    # already failed into recovery revokes the comm, and this vote must
+    # yield to that recovery (ERR_REVOKED reaches the caller's
+    # failure-handling loop) instead of colliding with its agreement.
+    from ompi_tpu.ft.era import engine_for
+
+    decided = engine_for(pml).agree(_CommitChannel(comm), 1 if ok else 0,
+                                    abort_on_revoke=True)
+    if decided:
+        _commit(epoch)
+        return True
+    with _lock:
+        _store.staged_own.pop(epoch, None)
+        _store.staged_parity.pop(epoch, None)
+        for key in [k for k in _store.staged_replicas if k[0] == epoch]:
+            del _store.staged_replicas[key]
+    log.warning("diskless epoch %d aborted (ok=%d)", epoch, ok)
+    return False
+
+
+def _commit(epoch: int) -> None:
+    with _lock:
+        _store.own[epoch] = _store.staged_own.pop(epoch)
+        for key in [k for k in _store.staged_replicas if k[0] == epoch]:
+            _store.replicas[key] = _store.staged_replicas.pop(key)
+        acc = _store.staged_parity.pop(epoch, None)
+        if acc is not None:
+            _store.parity[epoch] = (bytes(acc[0]), dict(acc[1]))
+        _store.committed = epoch
+        floor = epoch - max(int(_keep_var._value), 1) + 1
+        for d in (_store.own, _store.parity):
+            for e in [e for e in d if e < floor]:
+                del d[e]
+        for key in [k for k in _store.replicas if k[0] < floor]:
+            del _store.replicas[key]
+        resident = (sum(map(len, _store.own.values()))
+                    + sum(map(len, _store.replicas.values()))
+                    + sum(len(p) for p, _ in _store.parity.values()))
+    _counts["epochs"] += 1
+    _emit("ft", "ckpt_commit", epoch=epoch)
+    if _metrics._enable_var._value:
+        _metrics.gauge_set("ft_ckpt_epoch", epoch)
+        _metrics.gauge_set("ft_ckpt_store_bytes", resident)
+    if _trace.enabled():
+        _trace.instant("ft.ckpt.commit", cat="ft", epoch=epoch,
+                       resident=resident)
+
+
+# --------------------------------------------------------------- restore
+def committed_epoch() -> int:
+    return _store.committed
+
+
+def next_epoch() -> int:
+    return _store.next_epoch
+
+
+def my_state(epoch: Optional[int] = None) -> Optional[Dict[str, np.ndarray]]:
+    """This rank's own committed blob, decoded (the survivor-side
+    rollback in recover); None when nothing is committed."""
+    with _lock:
+        e = _store.committed if epoch is None else int(epoch)
+        blob = _store.own.get(e)
+    if blob is None:
+        return None
+    t0 = time.monotonic()
+    state = decode_state(blob)
+    _counts["restores_mem"] += 1
+    _emit("ft", "ckpt_restore", epoch=e, source="own")
+    if _metrics._enable_var._value:
+        _metrics.observe("ft_ckpt_restore_us",
+                         (time.monotonic() - t0) * 1e6, source="own")
+    return state
+
+
+def replica_blob(owner: int, epoch: int) -> Optional[bytes]:
+    with _lock:
+        return _store.replicas.get((int(epoch), int(owner)))
+
+
+def replica_epochs(owner: int) -> List[int]:
+    """Every committed epoch this rank holds ``owner``'s replica for —
+    the recovery planner keys on min(survivor committed epochs), which
+    can trail MY committed epoch by one when a commit vote was torn by
+    a concurrent revocation, so capabilities must cover the whole keep
+    window, not just the newest epoch."""
+    with _lock:
+        return sorted(e for (e, o) in _store.replicas
+                      if o == int(owner))
+
+
+def parity_epochs() -> List[int]:
+    """Committed epochs with a retained parity block (same keep-window
+    rationale as replica_epochs)."""
+    with _lock:
+        return sorted(_store.parity)
+
+
+def own_epochs() -> List[int]:
+    """Committed epochs whose OWN blob is still held — a parity rebuild
+    needs every surviving group member's own blob at the plan epoch, so
+    the planner must see each helper's retention, not just the
+    coordinator's parity block."""
+    with _lock:
+        return sorted(_store.own)
+
+
+def note_replica_restore() -> None:
+    """Count a buddy-replica restore (the recovery driver decodes the
+    blob itself after shipping it to the newcomer)."""
+    _counts["restores_mem"] += 1
+    _emit("ft", "ckpt_restore", source="replica")
+
+
+def parity_info(epoch: int) -> Optional[Tuple[bytes, Dict[int, int]]]:
+    with _lock:
+        return _store.parity.get(int(epoch))
+
+
+def own_blob(epoch: int) -> Optional[bytes]:
+    with _lock:
+        return _store.own.get(int(epoch))
+
+
+def final_blob(owner: int) -> Optional[Tuple[bytes, dict]]:
+    with _lock:
+        return _store.final.get(int(owner))
+
+
+def rollback_to(epoch: int) -> None:
+    """Re-align the epoch clock after a recovery: the next save() on
+    every member (survivor or respawned newcomer) must stamp the same
+    epoch number or receipts would never match their waits."""
+    with _lock:
+        _store.next_epoch = int(epoch) + 1
+        _store.committed = min(_store.committed, int(epoch))
+        _store.staged_own.clear()
+        _store.staged_replicas.clear()
+        _store.staged_parity.clear()
+        _store.final.clear()
+
+
+def seed_own(epoch: int, blob: bytes) -> None:
+    """Install a restored blob as the newcomer's own committed copy so
+    it can serve a future recovery as a survivor."""
+    with _lock:
+        _store.own[int(epoch)] = bytes(blob)
+        _store.committed = max(_store.committed, int(epoch))
+
+
+# ------------------------------------------------------- preemption flush
+def flush_final(grace_s: float) -> int:
+    """Preemption-notice hook (registered with ft/inject.on_preempt):
+    serialize the provider's state and ship ONE final single-owner blob
+    to this rank's buddies, then drive progress for the remainder of
+    the grace window so the frames reach the wire before death.
+    Returns the number of blobs shipped (0 = disabled/no provider)."""
+    if not _enable_var._value:
+        return 0
+    from ompi_tpu.runtime.progress import progress_until
+
+    prov = _provider
+    comm = _comm_ref() if _comm_ref is not None else None
+    if prov is None or comm is None:
+        return 0
+    try:
+        blob = encode_state(prov())
+    except Exception:
+        log.warning("preempt flush: state provider failed", exc_info=True)
+        return 0
+    me, n = comm.Get_rank(), comm.Get_size()
+    targets = buddies(me, n)
+    # parity mode can't recompute a group XOR inside the grace window —
+    # the final flush always buddy-ships (documented asymmetry)
+    if not targets and n > 1:
+        targets = buddies(me, n, k=1)
+    with _lock:
+        epoch = _store.next_epoch
+    for p in targets:
+        _ship(comm.pml, comm.group.world_rank(p), "final", epoch, me, blob)
+    if targets:
+        _counts["bytes"] += len(blob) * len(targets)
+    _emit("ft", "ckpt_preempt_flush", epoch=epoch, nbytes=len(blob),
+          targets=len(targets))
+    if _trace.enabled():
+        _trace.instant("ft.ckpt.preempt_flush", cat="ft", epoch=epoch,
+                       nbytes=len(blob))
+    log.warning("preemption flush: %d bytes to buddies %s (grace %.0fms)",
+                len(blob), targets, grace_s * 1000)
+    # drain: the frames are queued on the transport; progress pushes
+    # them out. The rank dies right after, so burning the window is fine.
+    progress_until(lambda: False, timeout=max(float(grace_s), 0.05))
+    return len(targets)
+
+
+def reset_for_testing() -> None:
+    global _store, _provider, _comm_ref
+    with _lock:
+        _store = _Store()
+    _provider = None
+    _comm_ref = None
+    for k in _counts:
+        _counts[k] = 0
+    _plane.reset()
+
+
+# preemption notice + early handler binding
+from ompi_tpu.ft import inject as _inject  # noqa: E402
+from ompi_tpu.hook import register_hook  # noqa: E402
+
+_inject.on_preempt(flush_final)
+register_hook("init_bottom", _bind_world_handler)

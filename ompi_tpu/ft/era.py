@@ -155,8 +155,18 @@ class EraEngine:
                 self._send(src, K_DECIDE, cid, seq, dec)
 
     # ----------------------------------------------------------- the driver
-    def agree(self, comm, flag: int) -> int:
-        from ompi_tpu.core.errors import MPIError, ERR_PENDING
+    def agree(self, comm, flag: int, abort_on_revoke: bool = False) -> int:
+        """Uniform AND-consensus over ``comm``'s live members.
+
+        ``abort_on_revoke=True`` is for agreements subordinate to the
+        recovery choreography (the diskless epoch-commit vote): a
+        revocation landing mid-call means a peer has already entered
+        recovery on this comm, so waiting out the era timeout would
+        stall the failover — raise ERR_REVOKED promptly instead. The
+        DEFAULT stays False: MPIX_Comm_agree and the recovery's own
+        survivor agreement must complete on revoked comms (that is the
+        ULFM contract and the entire point of ERA)."""
+        from ompi_tpu.core.errors import MPIError, ERR_PENDING, ERR_REVOKED
         from ompi_tpu.ft.detector import known_failed
         from ompi_tpu.runtime.progress import progress_until
         import time
@@ -185,9 +195,13 @@ class EraEngine:
             if not live:
                 raise MPIError(ERR_PENDING, "agreement: no live members")
             coord = live[0]
+            if abort_on_revoke and comm.revoked and st.decision is None:
+                raise MPIError(ERR_REVOKED,
+                               "agreement aborted: communicator revoked "
+                               "(a peer is already in recovery)")
             if coord == me:
                 return self._coordinate(comm, st, cid, seq, members,
-                                        deadline)
+                                        deadline, abort_on_revoke)
             # member: wait for a decision or the coordinator's death.
             # In recovery the new coordinator may have ALREADY returned
             # (it got the dead coordinator's decision) and will never
@@ -196,7 +210,9 @@ class EraEngine:
             # (the early-returning property).
             if recovering:
                 self._send(coord, K_PULL, cid, seq, 0)
-            slice_s = 0.25 if recovering else None
+            # short wait slices whenever a prompt wake matters: a
+            # recovery pull retry, or noticing a mid-call revocation
+            slice_s = 0.25 if (recovering or abort_on_revoke) else None
             left = max(0.0, deadline - time.monotonic())
             done = progress_until(
                 lambda: st.decision is not None
@@ -214,8 +230,8 @@ class EraEngine:
             # state through the query phase — nothing to resend.
 
     def _coordinate(self, comm, st: _AgreeState, cid: int, seq: int,
-                    members, deadline) -> int:
-        from ompi_tpu.core.errors import MPIError, ERR_PENDING
+                    members, deadline, abort_on_revoke: bool = False) -> int:
+        from ompi_tpu.core.errors import MPIError, ERR_PENDING, ERR_REVOKED
         from ompi_tpu.ft.detector import known_failed
         from ompi_tpu.runtime.progress import progress_until
         import time
@@ -225,8 +241,13 @@ class EraEngine:
         def remaining() -> float:
             return max(0.0, deadline - time.monotonic())
 
+        def aborted() -> bool:
+            return abort_on_revoke and comm.revoked
+
         # phase 1: a contribution-or-death for every member
         def contribs_complete() -> bool:
+            if aborted():
+                return True
             failed = known_failed()
             return all(m in st.contribs or m in failed for m in members)
 
@@ -235,6 +256,10 @@ class EraEngine:
                        and m not in known_failed()]
             raise MPIError(ERR_PENDING,
                            f"agreement: no contribution from {missing}")
+        if aborted():
+            raise MPIError(ERR_REVOKED,
+                           "agreement aborted: communicator revoked "
+                           "(a peer is already in recovery)")
 
         # phase 2: query every live member for a surviving decision (the
         # early-returning recovery path). min_decider fences out any
@@ -250,6 +275,8 @@ class EraEngine:
                 self._send(m, K_QUERY, cid, seq, 0)
 
             def queries_complete() -> bool:
+                if aborted():
+                    return True
                 failed = known_failed()
                 return all(m in st.qans or m in failed for m in queried)
 
@@ -258,6 +285,10 @@ class EraEngine:
                            and m not in known_failed()]
                 raise MPIError(ERR_PENDING,
                                f"agreement: no query answer from {missing}")
+            if aborted():
+                raise MPIError(ERR_REVOKED,
+                               "agreement aborted: communicator revoked "
+                               "(a peer is already in recovery)")
 
         # decide: adopt any surviving decision, else AND over every
         # collected contribution (contributions from members that died

@@ -20,6 +20,10 @@ Plan grammar (``;``-separated actions; ranks are universe ranks, ``*``
 is a wildcard)::
 
     kill(rank, after=N)            die (exit 0) after N pml ops
+    preempt(rank, after=N,         preemption notice: run the registered
+            grace_ms=M)            preemption hooks (ft/diskless's final
+                                   flush) with an M-ms grace window,
+                                   then die — the TPU preemption model
     drop(src, dst, frac=F)         drop outbound frames with prob. F
     drop(src, dst, nth=N)          drop every Nth frame
     delay(src, dst, ms=M)          sleep M ms before queuing a frame
@@ -56,15 +60,17 @@ from ompi_tpu.utils.show_help import register_topic, show_help
 register_topic(
     "ft", "bad-inject-plan",
     "The ft_inject_plan cvar could not be parsed:\n  {error}\n"
-    "Grammar: ';'-separated kill(rank,after=N) / drop(src,dst,frac=F"
-    "|nth=N) / delay(src,dst,ms=M) / sever(src,dst) / dup(src,dst,"
-    "nth=N), optional side=recv on wire rules ('*' = any rank).\n"
+    "Grammar: ';'-separated kill(rank,after=N) / preempt(rank,after=N,"
+    "grace_ms=M) / drop(src,dst,frac=F|nth=N) / delay(src,dst,ms=M) / "
+    "sever(src,dst) / dup(src,dst,nth=N), optional side=recv on wire "
+    "rules ('*' = any rank).\n"
     "Fix the plan or unset the cvar; injection refuses to start with "
     "a plan it cannot honor.")
 
 _plan_var = register_var(
     "ft", "inject_plan", "", typ=str,
     help="Chaos plan: ';'-separated kill(rank,after=N) / "
+         "preempt(rank,after=N,grace_ms=M) / "
          "drop(src,dst,frac=F|nth=N) / delay(src,dst,ms=M) / "
          "sever(src,dst) / dup(src,dst,nth=N) actions applied at the "
          "btl wire and pml op-counter hooks (empty = injection off; "
@@ -83,6 +89,7 @@ DUP = 2
 SEVER = 4
 
 _WIRE_ACTIONS = ("drop", "delay", "sever", "dup")
+_DIE_ACTIONS = ("kill", "preempt")  # victim-terminating op-counter rules
 
 
 class _LiveFlag:
@@ -132,6 +139,9 @@ class _Rule:
             extra.append(f"ms={self.ms}")
         if self.action == "kill":
             return f"kill({self.src},after={self.after})"
+        if self.action == "preempt":
+            return (f"preempt({self.src},after={self.after},"
+                    f"grace_ms={self.ms:g})")
         if self.side == "recv":
             extra.append("side=recv")
         args = ",".join([str("*" if self.src is None else self.src),
@@ -161,7 +171,7 @@ def _parse_action(text: str, seed: int) -> _Rule:
     if m is None:
         raise ValueError(f"ft_inject_plan: cannot parse action {text!r}")
     action, raw = m.group(1), m.group(2)
-    if action not in _WIRE_ACTIONS and action != "kill":
+    if action not in _WIRE_ACTIONS and action not in _DIE_ACTIONS:
         raise ValueError(f"ft_inject_plan: unknown action {action!r}")
     pos: List[str] = []
     kv: Dict[str, str] = {}
@@ -182,16 +192,20 @@ def _parse_action(text: str, seed: int) -> _Rule:
     def rank(s: str) -> Optional[int]:
         return None if s == "*" else int(s)
 
-    if action == "kill":
+    if action in _DIE_ACTIONS:
         if len(pos) != 1 or pos[0] == "*":
             raise ValueError(
-                f"ft_inject_plan: kill needs kill(rank, after=N), "
-                f"got {text!r}")
+                f"ft_inject_plan: {action} needs {action}(rank, "
+                f"after=N), got {text!r}")
         after = int(kv.pop("after", "0"))
+        # preempt carries its grace window in the ms slot (the notice
+        # hooks get grace_ms/1000 seconds to flush before death)
+        grace = float(kv.pop("grace_ms", "500")) if action == "preempt" \
+            else 0.0
         if kv:
             raise ValueError(
-                f"ft_inject_plan: unknown kill() args {sorted(kv)}")
-        return _Rule("kill", int(pos[0]), None, None, None, 0.0,
+                f"ft_inject_plan: unknown {action}() args {sorted(kv)}")
+        return _Rule(action, int(pos[0]), None, None, None, grace,
                      max(after, 1), "send", seed)
 
     if len(pos) != 2:
@@ -239,9 +253,9 @@ def install(plan: Optional[str] = None, seed: Optional[int] = None) -> None:
     if seed is None:
         seed = int(_seed_var._value or 0)
     rules = parse_plan(plan, seed)
-    _kill_rules = [r for r in rules if r.action == "kill"]
+    _kill_rules = [r for r in rules if r.action in _DIE_ACTIONS]
     _send_rules = [r for r in rules
-                   if r.action != "kill" and r.side == "send"]
+                   if r.action not in _DIE_ACTIONS and r.side == "send"]
     _recv_rules = [r for r in rules if r.side == "recv"]
     _enable_var._value = bool(rules)
     if rules:
@@ -269,6 +283,20 @@ def fault_counts() -> Dict[str, int]:
 
 def has_recv_rules() -> bool:
     return bool(_recv_rules)
+
+
+# Preemption-notice hooks: run on the doomed rank between the notice
+# and death, with the rule's grace window (seconds) — the registration
+# channel for ft/diskless.flush_final (the TPU preemption model where a
+# doomed worker gets a short warning to flush state).
+_preempt_hooks: List = []
+
+
+def on_preempt(cb) -> None:
+    """Register ``cb(grace_s: float)`` to run when a preempt() rule
+    fires on this rank, before the process exits."""
+    if cb not in _preempt_hooks:
+        _preempt_hooks.append(cb)
 
 
 def _fire(rule: _Rule, src, dst) -> None:
@@ -312,8 +340,23 @@ def on_op(rank: int, tag: int) -> None:
             import os
 
             _fire(rule, rank, None)
-            log.warning("chaos kill: rank %d dying after %d pml ops",
-                        rank, rule.count)
+            if rule.action == "preempt":
+                # latch BEFORE the hooks: a flush that sends user-tag
+                # traffic would re-enter this counter and recurse
+                fired = rule.count
+                rule.after = 1 << 62
+                log.warning("chaos preempt: rank %d notified after %d "
+                            "pml ops (grace %.0fms)", rank, fired,
+                            rule.ms)
+                for cb in list(_preempt_hooks):
+                    try:
+                        cb(rule.ms / 1000.0)
+                    except Exception:
+                        log.warning("preempt hook failed",
+                                    exc_info=True)
+            else:
+                log.warning("chaos kill: rank %d dying after %d pml "
+                            "ops", rank, rule.count)
             # exit 0: the launcher treats nonzero as a job abort and
             # would tear down the survivors this plan exists to test
             os._exit(0)

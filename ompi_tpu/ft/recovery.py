@@ -1,4 +1,4 @@
-"""Shrink-and-continue recovery — the canonical ULFM idiom, reusable.
+"""Recovery policies: shrink-and-continue and respawn-and-rejoin.
 
 Reference: the ULFM specification's fault-tolerant loop (and OMPI's
 ompi/mpiext/ftmpi examples): on MPIX_ERR_PROC_FAILED the survivors
@@ -7,27 +7,51 @@ new communicator over the live membership, restore state, and retry.
 This module packages that sequence over the pieces this tree already
 has — ``ft/revoke.py`` (revoke flood + shrink), ``ft/era.py``
 (early-returning agreement), ``ft/detector.py`` (the failure oracle),
-and ``runtime/checkpoint.py`` (ranked two-phase-commit checkpoints):
+``runtime/checkpoint.py`` (ranked two-phase-commit disk checkpoints)
+and ``ft/diskless.py`` (in-memory replicated epochs):
 
 - :func:`recover` runs revoke -> era agreement on the survivor set ->
-  shrink -> optional restore from the newest committed checkpoint.
-- :func:`resilient` wraps user code in the retry-on-the-shrunk-comm
-  loop so an application writes its step function once and the ULFM
-  choreography stays here.
+  shrink, then applies a recovery *policy*:
 
-Counters: ``ft_failovers`` / ``ft_retries`` pvars (mirrored as
-``spc_ft_failover`` / ``spc_ft_retry``) join the watchdog's
-``pml_watchdog_trips`` and the chaos harness's ``ft_injected_faults``
-in ``ompi_tpu_info --pvars`` output.
+  * ``policy="shrink"`` — continue degraded at N-1 ranks, optionally
+    restoring this rank's partition of the newest committed DISK
+    checkpoint (the PR 3 behavior, unchanged).
+  * ``policy="respawn"`` — restore the ORIGINAL world size: the
+    survivors spawn replacements through ``runtime/dpm.spawn``, merge
+    the child job in and re-rank everyone back to their original
+    ranks, rebuild each dead rank's state from survivor memory (a
+    buddy replica, an XOR parity group, or a preemption final-flush
+    blob — ft/diskless.py), and deliver it to the newcomer. No
+    filesystem is touched unless every in-memory source is gone, in
+    which case the disk checkpoint (when configured) is the fallback;
+    with nothing left the failure show_helps and escalates
+    ERR_PROC_FAILED.
+
+- :func:`rejoin` is the replacement process's side of the respawn
+  choreography (detect with :func:`is_respawned`): merge with the
+  survivors, take the dead rank's original rank, receive the rebuilt
+  state.
+- :func:`resilient` wraps user code in the retry loop so an
+  application writes its step function once.
+
+Counters: ``ft_failovers`` / ``ft_retries`` / ``ft_respawns`` pvars
+(mirrored as spc counters) join the watchdog's ``pml_watchdog_trips``
+and the chaos harness's ``ft_injected_faults`` in ``ompi_tpu_info``.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional, Tuple
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from ompi_tpu.core.errors import (
     MPIError,
+    ERR_ARG,
     ERR_PROC_FAILED,
     ERR_PROC_FAILED_PENDING,
     ERR_REVOKED,
@@ -35,19 +59,29 @@ from ompi_tpu.core.errors import (
 from ompi_tpu.mca.var import register_pvar
 from ompi_tpu.runtime import trace as _trace
 from ompi_tpu.utils.output import get_logger
+from ompi_tpu.utils.show_help import show_help
 
 log = get_logger("ft.recovery")
 
 #: error classes the recovery loop treats as a survivable peer failure
 FAILURE_CODES = (ERR_PROC_FAILED, ERR_PROC_FAILED_PENDING, ERR_REVOKED)
 
-_counts: Dict[str, int] = {"failovers": 0, "retries": 0}
+#: user-plane tag reserved on the re-ranked comm for state delivery to
+#: newcomers (the comm is fresh — no application traffic precedes it)
+RESPAWN_STATE_TAG = 4242
+#: shrunk-comm tag for parity-reconstruction blob exchange
+_PARITY_XCHG_TAG = 4243
+
+_counts: Dict[str, int] = {"failovers": 0, "retries": 0, "respawns": 0}
 
 register_pvar("ft", "failovers", lambda: _counts["failovers"],
               help="Completed revoke->agree->shrink recoveries")
 register_pvar("ft", "retries", lambda: _counts["retries"],
-              help="User operations retried on a shrunk communicator "
+              help="User operations retried on a recovered communicator "
                    "by the ft.recovery.resilient wrapper")
+register_pvar("ft", "respawns", lambda: _counts["respawns"],
+              help="Respawn-and-rejoin recoveries completed (original "
+                   "world size restored)")
 
 
 def _agree_survivors(comm) -> None:
@@ -80,23 +114,44 @@ def _agree_survivors(comm) -> None:
 
 
 def recover(comm, checkpoint_dir: Optional[str] = None,
-            step: Optional[int] = None) -> Tuple[Any, Optional[dict]]:
+            step: Optional[int] = None, policy: str = "shrink",
+            command: Optional[str] = None,
+            args: Optional[Tuple[str, ...]] = None
+            ) -> Tuple[Any, Optional[dict]]:
     """One full ULFM recovery: revoke ``comm``, agree on the survivor
-    set, shrink, and (with ``checkpoint_dir``) restore this rank's
-    partition of the newest committed ranked checkpoint — by the rank
-    it held in ``comm``, which is the rank that wrote the partition.
+    set, shrink, then apply ``policy`` (see the module docstring).
 
-    Returns ``(shrunk_comm, state_or_None)``. Collective over the
-    survivors; the caller retries its work on the returned comm."""
+    Returns ``(new_comm, state_or_None)``; ``state=None`` means
+    "continue with your live state" (the preemption final-flush path,
+    or no checkpoint source configured).
+
+    Final-flush consistency contract: when every dead rank left a
+    grace-window flush, survivors are NOT rolled back — this assumes
+    the application advances its state only after a collective
+    completes, and that the torn collective completed on no survivor.
+    A symmetric collective CAN complete on a strict subset of
+    survivors (the dying rank's last frames may reach only some
+    peers), leaving survivors one step apart; applications that cannot
+    tolerate that skew should reconcile after recovery (e.g. agree on
+    the minimum step) or rely on the epoch path, which rolls every
+    member to the same committed epoch. For ``policy="respawn"``, ``command``/``args`` name
+    the replacement's program (default: this process's own argv) and
+    the returned comm has the ORIGINAL size with every survivor at its
+    original rank. Collective over the survivors."""
+    if policy not in ("shrink", "respawn"):
+        raise MPIError(ERR_ARG, f"unknown recovery policy {policy!r}")
     from ompi_tpu.runtime import spc
 
     if _trace.enabled():
-        with _trace.span("ft.recover", cat="ft", cid=comm.cid):
-            return _recover(comm, checkpoint_dir, step, spc)
-    return _recover(comm, checkpoint_dir, step, spc)
+        with _trace.span("ft.recover", cat="ft", cid=comm.cid,
+                         policy=policy):
+            return _recover(comm, checkpoint_dir, step, policy,
+                            command, args, spc)
+    return _recover(comm, checkpoint_dir, step, policy, command, args,
+                    spc)
 
 
-def _recover(comm, checkpoint_dir, step, spc):
+def _recover(comm, checkpoint_dir, step, policy, command, args, spc):
     old_rank = comm.Get_rank()
     comm.Revoke()
     _agree_survivors(comm)
@@ -105,37 +160,370 @@ def _recover(comm, checkpoint_dir, step, spc):
     spc.record("ft_failover")
     log.warning("recovered: %s (%d ranks) -> %s (%d ranks)",
                 comm.name, comm.size, shrunk.name, shrunk.size)
+    if policy == "respawn":
+        return _respawn(comm, shrunk, old_rank, checkpoint_dir,
+                        command, args)
     state = None
     if checkpoint_dir is not None:
-        from ompi_tpu.runtime.checkpoint import (
-            latest_ranked_step,
-            restore_ranked,
-        )
-
-        use = latest_ranked_step(checkpoint_dir) if step is None else step
-        if use is not None:
-            state = restore_ranked(shrunk, checkpoint_dir, use,
-                                   rank=old_rank)
+        state = _disk_restore(shrunk, checkpoint_dir, step, old_rank)
     return shrunk, state
+
+
+def _disk_restore(comm, checkpoint_dir, step, old_rank):
+    from ompi_tpu.runtime.checkpoint import (
+        latest_ranked_step,
+        restore_ranked,
+    )
+
+    use = latest_ranked_step(checkpoint_dir) if step is None else step
+    if use is None:
+        return None
+    return restore_ranked(comm, checkpoint_dir, use, rank=old_rank)
+
+
+# ------------------------------------------------------ respawn machinery
+def _allgather_obj(comm, obj) -> List[dict]:
+    """JSON allgather over ``comm`` (suppressed from user counters)."""
+    from ompi_tpu.runtime import spc
+
+    data = json.dumps(obj).encode()
+    n = comm.Get_size()
+    lens = np.zeros(n, np.int64)
+    with spc.suppressed():
+        comm.Allgather(np.array([len(data)], np.int64), lens)
+        buf = np.zeros(max(int(lens.sum()), 1), np.uint8)
+        comm.Allgatherv(np.frombuffer(data, np.uint8), buf,
+                        counts=lens.tolist())
+    out, pos = [], 0
+    for ln in lens.tolist():
+        out.append(json.loads(bytes(buf[pos:pos + ln]).decode()))
+        pos += ln
+    return out
+
+
+def _survivor_caps(old_rank: int, dead: List[int], checkpoint_dir) -> dict:
+    """What THIS survivor can serve for each dead original rank."""
+    from ompi_tpu.ft import diskless
+    from ompi_tpu.runtime.checkpoint import latest_ranked_step
+
+    committed = diskless.committed_epoch()
+    # capabilities cover the WHOLE keep window: min() over survivor
+    # committed epochs can trail this rank's newest epoch by one when a
+    # commit vote was torn by a concurrent revocation
+    caps = {
+        "rank": old_rank,
+        "epoch": committed,
+        "next": diskless.next_epoch(),
+        "replicas": {str(d): diskless.replica_epochs(d) for d in dead},
+        "final": [d for d in dead
+                  if diskless.final_blob(d) is not None],
+        "parity": diskless.parity_epochs(),
+        "own": diskless.own_epochs(),
+        "disk": (latest_ranked_step(checkpoint_dir)
+                 if checkpoint_dir is not None else None),
+    }
+    return caps
+
+
+def _plan_sources(dead: List[int], caps: List[dict], size: int,
+                  mode: str, groups: Dict[int, List[int]]) -> dict:
+    """Deterministic recovery plan, computed identically on every
+    survivor from the allgathered capabilities. ``caps[i]`` belongs to
+    the survivor at shrunk rank i; ``caps[i]['rank']`` is its original
+    rank. Returns ``{"mode": "final"|"epoch", "epoch": E,
+    "next": N, "sources": {dead: (kind, shrunk_rank)}}`` where kind is
+    final|mem|parity|disk; raises ERR_PROC_FAILED (after a show_help)
+    when some dead rank has no source at all."""
+    old_of = [c["rank"] for c in caps]
+    alive = set(old_of)
+    epochs = [c["epoch"] for c in caps if c["epoch"] >= 0]
+    E = min(epochs) if epochs else -1
+    nxt = max(c["next"] for c in caps)
+    # preemption fast path: every dead rank flushed a final blob —
+    # survivors keep their live state, nobody rolls back
+    finals = {}
+    for d in dead:
+        for i, c in enumerate(caps):
+            if d in c["final"]:
+                finals[d] = ("final", i)
+                break
+    if len(finals) == len(dead):
+        return {"mode": "final", "epoch": E, "next": nxt,
+                "sources": finals}
+    sources: Dict[int, Tuple[str, int]] = {}
+    unrecoverable = []
+    for d in dead:
+        src = None
+        if E >= 0:
+            for i, c in enumerate(caps):  # buddy replica at E
+                if E in c["replicas"].get(str(d), ()):
+                    src = ("mem", i)
+                    break
+            if src is None and mode == "parity":
+                others = [m for m in groups[d] if m != d]
+                if others and all(m in alive for m in others):
+                    # single failure in the group: the lowest surviving
+                    # member coordinates the XOR rebuild — which needs
+                    # the coordinator's parity block AND every helper's
+                    # own blob retained at E (a keep-window divergence
+                    # can purge either; falling through to disk beats
+                    # crashing mid-choreography)
+                    coord = min(others)
+                    if E in caps[old_of.index(coord)]["parity"] and \
+                            all(E in caps[old_of.index(m)].get("own", ())
+                                for m in others):
+                        src = ("parity", old_of.index(coord))
+        if src is None:
+            for i, c in enumerate(caps):  # disk fallback
+                if c["disk"] is not None:
+                    src = ("disk", i)
+                    break
+        if src is None:
+            unrecoverable.append(d)
+        else:
+            sources[d] = src
+    if unrecoverable:
+        show_help("ft", "ckpt-unrecoverable", once=False,
+                  ranks=unrecoverable,
+                  reason=f"mode={mode}, committed epoch {E}, "
+                         f"survivors {sorted(alive)}")
+        raise MPIError(
+            ERR_PROC_FAILED,
+            f"diskless recovery: no state source for dead ranks "
+            f"{unrecoverable}")
+    return {"mode": "epoch", "epoch": E, "next": nxt,
+            "sources": sources}
+
+
+def _rebuild_blob(shrunk, plan, d: int, caps: List[dict],
+                  groups: Dict[int, List[int]], checkpoint_dir,
+                  my_shrunk: int) -> Optional[Tuple[bytes, dict]]:
+    """Produce dead rank ``d``'s state blob on its designated sender
+    (returns None on every other rank). Parity reconstruction is
+    collective among the group's survivors; everything else is local."""
+    from ompi_tpu.ft import diskless
+    from ompi_tpu.runtime import spc
+
+    kind, sender = plan["sources"][d]
+    E = plan["epoch"]
+    meta = {"kind": kind, "epoch": E, "next": plan["next"],
+            "mode": plan["mode"]}
+    if kind == "final":
+        if my_shrunk != sender:
+            return None
+        blob, fmeta = diskless.final_blob(d)
+        meta["flush_epoch"] = fmeta.get("epoch")
+        return blob, meta
+    if kind == "mem":
+        if my_shrunk != sender:
+            return None
+        diskless.note_replica_restore()
+        return diskless.replica_blob(d, E), meta
+    if kind == "parity":
+        others = [m for m in groups[d] if m != d]
+        old_of = [c["rank"] for c in caps]
+        if old_of[my_shrunk] not in others:
+            return None
+        if my_shrunk == sender:
+            pinfo = diskless.parity_info(E)
+            parity, lengths = pinfo
+            lengths = {int(k): int(v) for k, v in lengths.items()}
+            blobs = [diskless.own_blob(E)]
+            for m in others:
+                if m == old_of[my_shrunk]:
+                    continue
+                buf = np.zeros(lengths[m], np.uint8)
+                with spc.suppressed():
+                    shrunk.Recv(buf, source=old_of.index(m),
+                                tag=_PARITY_XCHG_TAG)
+                blobs.append(bytes(buf))
+            return diskless.xor_reconstruct(parity, lengths, d,
+                                            blobs), meta
+        # helper: ship my own epoch blob to the coordinator
+        blob = diskless.own_blob(E)
+        with spc.suppressed():
+            shrunk.Send(np.frombuffer(blob, np.uint8), dest=sender,
+                        tag=_PARITY_XCHG_TAG)
+        return None
+    # disk: the sender reads the dead rank's partition and re-encodes
+    if my_shrunk != sender:
+        return None
+    state = _disk_restore(shrunk, checkpoint_dir, None, d)
+    if state is None:
+        raise MPIError(ERR_PROC_FAILED,
+                       f"disk fallback vanished for rank {d}")
+    meta["kind"] = "disk"
+    return diskless.encode_state(state), meta
+
+
+def _send_state(comm, dst: int, meta: dict, blob: bytes) -> None:
+    from ompi_tpu.runtime import spc
+
+    mb = json.dumps(meta).encode()
+    hdr = np.array([len(mb), len(blob)], np.int64)
+    with spc.suppressed():
+        comm.Send(hdr, dest=dst, tag=RESPAWN_STATE_TAG)
+        comm.Send(np.frombuffer(mb + bytes(blob), np.uint8), dest=dst,
+                  tag=RESPAWN_STATE_TAG)
+
+
+def _recv_state(comm) -> Tuple[dict, bytes]:
+    from ompi_tpu.comm.communicator import ANY_SOURCE
+    from ompi_tpu.core.status import Status
+    from ompi_tpu.runtime import spc
+
+    st = Status()
+    hdr = np.zeros(2, np.int64)
+    with spc.suppressed():
+        comm.Recv(hdr, source=ANY_SOURCE, tag=RESPAWN_STATE_TAG,
+                  status=st)
+        buf = np.zeros(int(hdr[0] + hdr[1]), np.uint8)
+        comm.Recv(buf, source=st.source, tag=RESPAWN_STATE_TAG)
+    meta = json.loads(bytes(buf[:int(hdr[0])]).decode())
+    return meta, bytes(buf[int(hdr[0]):])
+
+
+def _respawn(comm, shrunk, old_rank: int, checkpoint_dir,
+             command, args):
+    """Survivor side of respawn-and-rejoin (see recover)."""
+    from ompi_tpu.ft import diskless
+    from ompi_tpu.ft.detector import known_failed
+    from ompi_tpu.mca.var import get_var
+    from ompi_tpu.runtime import spc
+    from ompi_tpu.runtime.dpm import spawn
+
+    members = comm.group.ranks
+    n = len(members)
+    failed = known_failed()
+    dead = [i for i, r in enumerate(members) if r in failed]
+    if not dead:
+        raise MPIError(ERR_PROC_FAILED,
+                       "respawn requested but no member of this "
+                       "communicator is known failed")
+    mode = str(get_var("ft", "ckpt_mode"))
+    groups = {d: diskless.group_members(d, n) for d in dead}
+    caps = _allgather_obj(
+        shrunk, _survivor_caps(old_rank, dead, checkpoint_dir))
+    plan = _plan_sources(dead, caps, n, mode, groups)
+    log.warning("respawn plan: dead=%s mode=%s epoch=%d sources=%s",
+                dead, plan["mode"], plan["epoch"],
+                {d: k for d, (k, _s) in plan["sources"].items()})
+    # rebuild the dead ranks' blobs BEFORE spawning (parity exchange
+    # runs on the shrunk comm; the spawn handshake must not interleave)
+    rebuilt: Dict[int, Tuple[bytes, dict]] = {}
+    for d in dead:
+        out = _rebuild_blob(shrunk, plan, d, caps, groups,
+                            checkpoint_dir, shrunk.Get_rank())
+        if out is not None:
+            rebuilt[d] = out
+    # launch the replacements and bridge them in; the argv defaults are
+    # INDEPENDENT — command=X with args unset still inherits this
+    # process's argv tail (a replacement launched with no arguments
+    # would crash at startup and fail the whole recovery)
+    if command is None:
+        command = os.path.abspath(sys.argv[0])
+    if args is None:
+        args = tuple(sys.argv[1:])
+    info = {"env_OMPI_TPU_RESPAWN": "1",
+            "env_OMPI_TPU_RESPAWN_TARGETS":
+                ",".join(str(d) for d in dead),
+            "env_OMPI_TPU_RESPAWN_SIZE": str(n)}
+    inter = spawn(shrunk, command, tuple(args or ()), maxprocs=len(dead),
+                  root=0, info=info)
+    merged = inter.Merge(high=False)
+    newcomm = merged.Split(0, key=old_rank)
+    newcomm.name = f"{comm.name}-respawned"
+    # deliver each rebuilt state to its newcomer (now at rank d)
+    for d, (blob, meta) in rebuilt.items():
+        _send_state(newcomm, d, meta, blob)
+    # epoch alignment + survivor-side restore
+    if plan["mode"] == "final":
+        diskless.rollback_to(plan["next"] - 1)
+        state = None  # survivors keep their live state (no rollback)
+    else:
+        state = diskless.my_state(plan["epoch"]) \
+            if plan["epoch"] >= 0 else None
+        diskless.rollback_to(plan["epoch"]
+                             if plan["epoch"] >= 0
+                             else plan["next"] - 1)
+        if state is None and checkpoint_dir is not None:
+            state = _disk_restore(newcomm, checkpoint_dir, None,
+                                  old_rank)
+    _counts["respawns"] += 1
+    spc.record("ft_respawn")
+    log.warning("respawn complete: %s is back to %d ranks (me=%d)",
+                newcomm.name, newcomm.Get_size(), newcomm.Get_rank())
+    return newcomm, state
+
+
+def is_respawned() -> bool:
+    """Is this process a replacement launched by a respawn recovery?"""
+    return os.environ.get("OMPI_TPU_RESPAWN") == "1"  # mpilint: disable=raw-environ — respawn identity rides the dpm launch channel, like rank identity
+
+
+def rejoin() -> Tuple[Any, Optional[dict], dict]:
+    """Replacement-process side of respawn-and-rejoin: merge with the
+    survivors, take the dead rank's original rank, receive the rebuilt
+    state. Returns ``(comm, state_or_None, meta)`` — the comm has the
+    original world size, this process sits at the dead rank's rank,
+    and ``meta['kind']`` says where the state came from
+    (final|mem|parity|disk)."""
+    from ompi_tpu.ft import diskless
+    from ompi_tpu.runtime import state as _state
+    from ompi_tpu.runtime.dpm import Comm_get_parent
+
+    targets = [int(x) for x in
+               os.environ["OMPI_TPU_RESPAWN_TARGETS"].split(",")]  # mpilint: disable=raw-environ — respawn identity rides the dpm launch channel, like rank identity
+    world = _state.get_world()
+    parent = Comm_get_parent()
+    if parent is None:
+        raise MPIError(ERR_ARG, "rejoin() outside a respawned process")
+    target = targets[world.Get_rank()]
+    merged = parent.Merge(high=True)
+    want = int(os.environ["OMPI_TPU_RESPAWN_SIZE"])  # mpilint: disable=raw-environ — respawn identity rides the dpm launch channel, like rank identity
+    if merged.Get_size() != want:
+        raise MPIError(
+            ERR_ARG,
+            f"respawn merge produced {merged.Get_size()} ranks, the "
+            f"original world had {want} — survivor set and spawn count "
+            "disagree")
+    newcomm = merged.Split(0, key=target)
+    meta, blob = _recv_state(newcomm)
+    state = diskless.decode_state(blob) if blob else None
+    # align the epoch clock with the survivors (SAME rule they apply in
+    # _respawn — a skewed clock would stamp future epochs differently
+    # and no receipt would ever match its wait); seed our own committed
+    # copy so we can serve the NEXT recovery as a survivor
+    if meta.get("mode") == "epoch" and int(meta["epoch"]) >= 0:
+        diskless.rollback_to(int(meta["epoch"]))
+        if blob:
+            diskless.seed_own(int(meta["epoch"]), blob)
+    else:
+        diskless.rollback_to(int(meta["next"]) - 1)
+    log.warning("rejoined as rank %d of %s (state source: %s)",
+                newcomm.Get_rank(), newcomm.name, meta.get("kind"))
+    return newcomm, state, meta
 
 
 def resilient(checkpoint_dir: Optional[str] = None,
               max_failovers: int = 2,
-              codes: Tuple[int, ...] = FAILURE_CODES):
+              codes: Tuple[int, ...] = FAILURE_CODES,
+              policy: str = "shrink"):
     """Decorator running ``fn(comm, state, *args, **kwargs)`` with the
-    retry-the-work-on-the-shrunk-comm loop::
+    retry-on-the-recovered-comm loop::
 
         @resilient(checkpoint_dir="/ckpt")
         def train(comm, state):
-            ...collectives on comm, save_ranked checkpoints...
+            ...collectives on comm, save_ranked/diskless checkpoints...
             return state
 
         result = train(COMM_WORLD, initial_state)
 
-    On an MPIError in ``codes`` the wrapper runs :func:`recover` and
-    re-invokes ``fn`` with the shrunk comm (and the restored checkpoint
-    state when a directory is configured), up to ``max_failovers``
-    failures; anything else — or one failure too many — re-raises."""
+    On an MPIError in ``codes`` the wrapper runs :func:`recover` with
+    the configured ``policy`` and re-invokes ``fn`` with the recovered
+    comm (and the restored state when a source exists), up to
+    ``max_failovers`` failures; anything else — or one failure too
+    many — re-raises."""
 
     def deco(fn):
         @functools.wraps(fn)
@@ -151,7 +539,8 @@ def resilient(checkpoint_dir: Optional[str] = None,
                     log.warning("%s failed (%s); recovering "
                                 "(failover %d/%d)", fn.__name__, e,
                                 failures, max_failovers)
-                    comm, restored = recover(comm, checkpoint_dir)
+                    comm, restored = recover(comm, checkpoint_dir,
+                                             policy=policy)
                     if restored is not None:
                         state = restored
                     from ompi_tpu.runtime import spc

@@ -49,8 +49,8 @@ def send_system(pml, dst: int, obj: dict, tag: int) -> None:
     """Fire-and-forget diagnostic frame on the system plane (bypasses
     matching; suppressed from SPC so counters stay user-only). Shared
     by every diagnostic subsystem with its own tag (sanitizer -4400,
-    metrics -4500) — the diagnostic plane must never take the
-    application down."""
+    metrics -4500, diskless checkpoint replication -4600) — the
+    diagnostic plane must never take the application down."""
     import json
 
     from ompi_tpu.core.datatype import BYTE

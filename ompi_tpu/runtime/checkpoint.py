@@ -193,6 +193,15 @@ def restore_ranked(comm, directory: str, step: Optional[int] = None,
             f"restoring with {comm.Get_size()} (repartitioning is the "
             "application's job)")
     use_rank = comm.Get_rank() if rank is None else int(rank)
+    if rank is not None and not 0 <= use_rank < int(manifest["size"]):
+        # an out-of-range override would otherwise surface as a missing
+        # rank file (or silently read a stale foreign one) — validate
+        # against the COMMITTED geometry, which is the authority on
+        # which partitions exist
+        raise MPIError(
+            ERR_FILE,
+            f"rank override {use_rank} out of range for checkpoint "
+            f"step {step} taken by {manifest['size']} ranks")
     if "attempt" in manifest:
         path = os.path.join(
             d, f"rank_{use_rank}.a{manifest['attempt']}.npz")

@@ -15,11 +15,21 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_SPAWN
+from ompi_tpu.mca.var import register_var, get_var
+
+register_var(
+    "dpm", "spawn_timeout", 30.0, float,
+    help="Seconds the spawn root waits for the child job to finish "
+         "wireup (the child leader's dpm.ready modex card) before "
+         "failing the spawn with MPI_ERR_SPAWN on every rank — a child "
+         "that dies pre-handshake must not hang the parent job's "
+         "intercomm exchange forever", level=6)
 
 _parent_intercomm = None
 
@@ -49,6 +59,14 @@ def connect_parent_if_spawned(world) -> None:
     from ompi_tpu.comm.intercomm import intercomm_create
 
     tag = int(os.environ.get("OMPI_TPU_SPAWN_TAG", "0"))  # mpilint: disable=raw-environ — launcher wire-up plumbing (env IS the launch channel)
+    if world.Get_rank() == 0:
+        # readiness card: the whole child job is wired (our own init
+        # fence proved every sibling alive) — the spawn root's bounded
+        # wait keys off this instead of blocking in the leader
+        # exchange against a job that never came up
+        from ompi_tpu.runtime import wireup
+
+        wireup._ctx["modex"].put("dpm.ready", 1)
     _parent_intercomm = intercomm_create(
         world, 0, int(parent_root), tag=tag)
     _parent_intercomm.name = "parent-intercomm"
@@ -65,6 +83,12 @@ def spawn(comm, command: str, args: Sequence[str] = (), maxprocs: int = 1,
     ctx = wireup._ctx
     if ctx is None:
         raise MPIError(ERR_SPAWN, "spawn requires process mode (mpirun)")
+    if maxprocs < 1:
+        # uniform argument error (every rank holds maxprocs): raising
+        # here beats shipping an unsatisfiable request to the launcher
+        raise MPIError(ERR_SPAWN,
+                       f"Comm_spawn maxprocs={maxprocs} is not "
+                       "satisfiable (need >= 1)")
     modex = ctx["modex"]
 
     # The root launches; every rank learns the outcome from the Bcast —
@@ -79,6 +103,7 @@ def spawn(comm, command: str, args: Sequence[str] = (), maxprocs: int = 1,
             _launch_children(command, list(args), maxprocs, job, base,
                              parent_root=comm.pml.my_rank,
                              spawn_tag=job, info=info or {}, ctx=ctx)
+            _await_child_wireup(modex, base, ctx["spawned"][-maxprocs:])
         except Exception as e:
             job, base = -1, -1
             err = str(e)
@@ -208,6 +233,38 @@ def Comm_connect(port: str, comm, root: int = 0):
     return intercomm_create(comm, root, acceptor_rank, tag=int(tag_arr[0]))
 
 
+def _await_child_wireup(modex, base: int, procs) -> None:
+    """Bounded wait (dpm_spawn_timeout) for the child job's readiness
+    card, failing fast when a child process already exited — without
+    this, a child that dies before wireup (bad interpreter, crashed
+    import, unsatisfiable command) strands every parent rank in the
+    leader exchange forever. Runs on the spawn root; the Bcast in
+    spawn() propagates the failure to the other ranks."""
+    deadline = time.monotonic() + float(get_var("dpm", "spawn_timeout"))
+    while True:
+        try:
+            modex.get(base, "dpm.ready", timeout=0.25)
+            return
+        except TimeoutError:
+            pass
+        dead = [p for p in procs if p.poll() is not None]
+        if dead:
+            for p in procs:  # reap the siblings of the dead child
+                if p.poll() is None:
+                    p.kill()
+            raise MPIError(
+                ERR_SPAWN,
+                f"spawned child exited with rc={dead[0].returncode} "
+                "before completing wireup")
+        if time.monotonic() > deadline:
+            for p in procs:
+                p.kill()
+            raise MPIError(
+                ERR_SPAWN,
+                "spawned job failed to wire up within "
+                f"dpm_spawn_timeout={get_var('dpm', 'spawn_timeout')}s")
+
+
 def _launch_children(command: str, args: List[str], n: int, job: int,
                      base: int, parent_root: int, spawn_tag: int,
                      info: dict, ctx) -> None:
@@ -218,6 +275,14 @@ def _launch_children(command: str, args: List[str], n: int, job: int,
         argv_base = [command]
     for i in range(n):
         env = dict(os.environ)  # mpilint: disable=raw-environ — launcher wire-up plumbing (env IS the launch channel)
+        # respawn identity is NOT inherited: a replacement process that
+        # later performs an ordinary Comm_spawn must not brand ITS
+        # children as respawned (they would run rejoin() and hang
+        # waiting for a state delivery no survivor sends) — a real
+        # respawn re-adds these explicitly through `info`
+        for key in ("OMPI_TPU_RESPAWN", "OMPI_TPU_RESPAWN_TARGETS",
+                    "OMPI_TPU_RESPAWN_SIZE"):
+            env.pop(key, None)
         env.update({
             "OMPI_TPU_RANK": str(i),
             "OMPI_TPU_SIZE": str(n),
@@ -234,5 +299,9 @@ def _launch_children(command: str, args: List[str], n: int, job: int,
         try:
             p = subprocess.Popen(argv_base + args, env=env)
         except OSError as e:
+            # reap the part of the job already launched: a half-spawned
+            # child set would block in its init fence forever
+            for q in ctx["spawned"][-i:] if i else ():
+                q.kill()
             raise MPIError(ERR_SPAWN, f"cannot exec {command}: {e}")
         ctx["spawned"].append(p)

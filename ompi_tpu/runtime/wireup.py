@@ -235,6 +235,16 @@ def init_process_mode():
 
     engine_for(pml)
 
+    # diskless checkpoint replication plane: bound BEFORE the exit
+    # fence below, so a fast peer's first epoch blob can never beat
+    # this rank's handler registration (system frames have no
+    # unexpected queue — an unbound tag drops the frame); the
+    # init_bottom hook only covers the singleton path
+    from ompi_tpu.ft import diskless as ft_diskless
+
+    if ft_diskless.enabled():
+        ft_diskless._plane.ensure(pml)
+
     hb = None
     if get_var("ft", "enable") and job == 0:
         # the heartbeat ring runs over job-0 world ranks; spawned jobs

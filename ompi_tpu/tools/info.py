@@ -37,7 +37,9 @@ def _load_everything() -> None:
     import ompi_tpu.ft.era  # agreement vars
     import ompi_tpu.ft.detector  # heartbeat detector vars
     import ompi_tpu.ft.inject  # chaos-plan vars + injected-faults pvar
-    import ompi_tpu.ft.recovery  # failover/retry pvars
+    import ompi_tpu.ft.recovery  # failover/retry/respawn pvars
+    import ompi_tpu.ft.diskless  # diskless ckpt cvars + ft_ckpt_* pvars
+    import ompi_tpu.runtime.dpm  # dynamic-process spawn vars
 
 
 def print_header(out) -> None:

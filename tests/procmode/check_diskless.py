@@ -1,0 +1,194 @@
+"""Diskless respawn-and-rejoin proofs, selected by argv[1]. NO
+checkpoint directory exists on disk in any mode — every restore is
+served from survivor memory.
+
+``respawn`` — 3 ranks, buddy replication (ft_ckpt_buddies=1). Each
+    step: allreduce-accumulate, then a diskless epoch save (commit
+    ratified by era agreement). The plan kills rank 1 mid-allreduce;
+    survivors run ``recover(policy="respawn")``: revoke -> survivor
+    agreement -> shrink -> dpm.spawn a replacement -> merge + re-rank
+    back to the ORIGINAL ranks -> rank 1's state rebuilt from its
+    buddy's in-memory replica and delivered to the newcomer, survivors
+    roll back to their own in-memory copy of the committed epoch. The
+    finish is arithmetically EXACT: every completed step summed all
+    three contributions, so the final value is identical to a
+    failure-free run — any torn epoch, mis-ranked newcomer, or
+    divergent rollback breaks the equality.
+
+``parity`` — same choreography with ft_ckpt_mode=parity (group=3):
+    the dead rank's blob is XOR-reconstructed from the group parity
+    plus the survivors' own blobs (ft_ckpt_restores_parity proves the
+    path taken).
+
+``preempt`` — the TPU preemption model: preempt(1,after=N,grace_ms=M)
+    delivers a notice; the doomed rank flushes ONE final blob to its
+    buddy inside the grace window, then dies. Recovery sees a final
+    blob for every dead rank and skips the rollback entirely:
+    survivors keep their live state, only the newcomer restores (from
+    the flush). Exactness witnesses both the flush content and the
+    no-rollback mode.
+
+``spawnfail`` — satellite: Comm_spawn of a command that dies before
+    wireup fails with a clean MPI_ERR_SPAWN within dpm_spawn_timeout
+    on EVERY rank (no hang), and maxprocs=0 raises uniformly.
+"""
+
+import faulthandler
+import signal as _signal
+import sys
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu.core.errors import (
+    MPIError,
+    ERR_INTERN,
+    ERR_OTHER,
+    ERR_SPAWN,
+)
+from ompi_tpu.ft import diskless
+from ompi_tpu.ft.recovery import (
+    FAILURE_CODES,
+    is_respawned,
+    recover,
+    rejoin,
+)
+from ompi_tpu.mca.var import all_pvars
+from ompi_tpu.runtime.state import get_world
+
+ITERS = 6
+SELF = __file__
+
+
+def _step_loop(variant: str) -> int:
+    """The shared proof body: accumulate ITERS allreduce steps with a
+    mid-run death + respawn recovery; verify exactness."""
+    save_every_step = variant != "preempt"
+    meta = {}
+    if is_respawned():
+        comm, state, meta = rejoin()
+        me = comm.Get_rank()
+        assert me == 1, f"newcomer must take the dead rank's rank, got {me}"
+        assert state is not None, "newcomer received no state"
+        step = int(state["step"][0])
+    else:
+        comm = get_world()
+        me = comm.Get_rank()
+        assert comm.Get_size() == 3, comm.Get_size()
+        state = {"x": np.full(4, 100.0 * (me + 1)),
+                 "step": np.array([0], np.int64)}
+        step = 0
+        # baseline epoch: even the preempt variant has a committed
+        # epoch 0 underneath the final-flush fast path
+        assert diskless.save(comm, state), "baseline epoch did not commit"
+    holder = {"state": state}
+    if not save_every_step:
+        diskless.set_state_provider(comm, lambda: holder["state"])
+    contrib = np.full(4, float(me + 1))
+    failovers = 0
+    while step < ITERS:
+        try:
+            total = np.zeros_like(contrib)
+            comm.Allreduce(contrib, total)
+            holder["state"] = {"x": holder["state"]["x"] + total,
+                               "step": np.array([step + 1], np.int64)}
+            step += 1
+            if save_every_step:
+                diskless.save(comm, holder["state"])
+        except MPIError as e:
+            # dead-transport (ERR_OTHER) and lost-frame (ERR_INTERN)
+            # errors can surface before the detector confirms; all
+            # route into the same recovery
+            if e.code not in FAILURE_CODES + (ERR_OTHER, ERR_INTERN):
+                raise
+            failovers += 1
+            assert failovers <= 2, "recovery did not converge"
+            comm, restored = recover(comm, policy="respawn",
+                                     command=SELF, args=(variant,))
+            me = comm.Get_rank()
+            if restored is not None:
+                holder["state"] = restored
+            elif variant != "preempt":
+                raise AssertionError(
+                    "epoch-mode survivor got no rollback state")
+            step = int(holder["state"]["step"][0])
+            contrib = np.full(4, float(me + 1))
+            if not save_every_step:
+                diskless.set_state_provider(comm,
+                                            lambda: holder["state"])
+    assert comm.Get_size() == 3, comm.Get_size()
+    # exactness: EVERY completed step summed all three contributions
+    # (1+2+3), whether it ran before the failure, was rolled back and
+    # re-run, or ran on the respawned world — so the result equals the
+    # failure-free run bit-for-bit
+    expect = 100.0 * (me + 1) + 6.0 * ITERS
+    assert np.allclose(holder["state"]["x"], expect), \
+        (holder["state"]["x"], expect)
+    if not is_respawned():
+        assert failovers >= 1, "rank 1 never died — plan inert?"
+        assert all_pvars()["ft_respawns"].value >= 1
+    pv = all_pvars()
+    if variant == "parity" and not is_respawned() and me == 0:
+        # rank 0 is the lowest surviving group member = the XOR
+        # coordinator
+        assert pv["ft_ckpt_restores_parity"].value >= 1, \
+            pv["ft_ckpt_restores_parity"].value
+    src = meta.get("kind", "-")
+    if is_respawned():
+        want = {"respawn": "mem", "parity": "parity",
+                "preempt": "final"}[variant]
+        assert src == want, (src, want)
+    comm.Barrier()
+    print(f"rank {me}: DISKLESS-{variant.upper()}-OK "
+          f"x={float(holder['state']['x'][0])} src={src} "
+          f"epochs={pv['ft_ckpt_epochs'].value}", flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+def spawnfail_mode() -> int:
+    comm = get_world()
+    r = comm.Get_rank()
+    # a command that exits before wireup: bounded clean failure
+    t0 = time.monotonic()
+    try:
+        comm.Spawn("/bin/false", maxprocs=1, root=0)
+    except MPIError as e:
+        assert e.code == ERR_SPAWN, e
+        took = time.monotonic() - t0
+        assert took < 25.0, f"spawn failure took {took:.1f}s"
+    else:
+        print(f"rank {r}: spawn of /bin/false unexpectedly succeeded",
+              flush=True)
+        return 1
+    # unsatisfiable maxprocs: uniform argument error, no RPC
+    try:
+        comm.Spawn(sys.executable, maxprocs=0, root=0)
+    except MPIError as e:
+        assert e.code == ERR_SPAWN, e
+    else:
+        print(f"rank {r}: maxprocs=0 unexpectedly succeeded", flush=True)
+        return 1
+    # the job is still fully usable after both failures
+    total = np.zeros(1, np.float64)
+    comm.Allreduce(np.full(1, float(r + 1)), total)
+    assert total[0] == comm.Get_size() * (comm.Get_size() + 1) / 2
+    print(f"rank {r}: DISKLESS-SPAWNFAIL-OK", flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+def main() -> int:
+    faulthandler.register(_signal.SIGUSR1)  # hang diagnosis: kill -USR1
+    mode = sys.argv[1]
+    if mode in ("respawn", "parity", "preempt"):
+        return _step_loop(mode)
+    if mode == "spawnfail":
+        return spawnfail_mode()
+    print(f"unknown mode {mode}", flush=True)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -244,7 +244,7 @@ def test_resilient_decorator_retries_on_shrunk_comm(monkeypatch):
     shrunk = object()
     calls = []
     monkeypatch.setattr(recovery, "recover",
-                        lambda comm, ckdir=None, step=None:
+                        lambda comm, ckdir=None, step=None, **kw:
                         (shrunk, {"x": 42}))
 
     @recovery.resilient(checkpoint_dir="/nonexistent")
@@ -348,3 +348,62 @@ def test_chaos_delay_dup_stream_stays_correct():
                       "delay(0,1,ms=25);dup(0,1,nth=3)")))
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("CHAOS-JITTER-OK") == 2, r.stdout + r.stderr
+
+
+# ------------------------------------------------------- randomized soak
+# Nightly invocation (excluded from tier-1 by -m 'not slow'; see the
+# README "Fault tolerance" section):
+#
+#     JAX_PLATFORMS=cpu pytest tests/test_chaos.py -m slow -q
+#
+# Sweeps ft_inject_seed over kill/preempt/drop/delay faults crossed
+# with the shrink and respawn recovery policies. Every scenario is
+# deterministic per seed, so a nightly failure replays exactly.
+_SOAK_CKPT = FT + (("ft_ckpt_enable", "1"), ("ft_ckpt_timeout", "10"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(20))
+def test_chaos_soak_randomized(seed, tmp_path):
+    if seed % 3 == 0:
+        # respawn policy (diskless, no disk): kill or preemption at a
+        # seed-varied op count, with receiver-side delay jitter riding
+        # along on the 0->2 edge
+        after = 6 + seed % 12
+        if seed % 6 == 0:
+            action = f"preempt(1,after={after},grace_ms=500)"
+            variant = "preempt"
+        else:
+            action = f"kill(1,after={after})"
+            variant = "respawn"
+        plan = f"{action};delay(0,2,ms={1 + seed % 7},side=recv)"
+        r = run_mpi(3, "tests/procmode/check_diskless.py", variant,
+                    timeout=150,
+                    mca=_SOAK_CKPT + (("ft_inject_plan", plan),
+                                      ("ft_inject_seed", str(seed))))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count(f"DISKLESS-{variant.upper()}-OK") == 3, \
+            r.stdout + r.stderr
+    elif seed % 3 == 1:
+        # shrink policy with the ranked disk checkpoint, kill point and
+        # jitter varied by seed
+        plan = (f"kill(1,after={30 + 4 * (seed % 8)});"
+                f"delay(0,2,ms={1 + seed % 5},side=recv)")
+        r = run_mpi(3, "tests/procmode/check_chaos.py", "kill",
+                    str(tmp_path / "ck"), timeout=150,
+                    mca=FT + (("ft_inject_plan", plan),
+                              ("ft_inject_seed", str(seed))))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count("CHAOS-KILL-OK") == 2, r.stdout + r.stderr
+    else:
+        # total frame loss on one edge: the watchdog must convert both
+        # stalled rendezvous sides, whatever the seed keys
+        r = run_mpi(2, "tests/procmode/check_chaos.py", "drop",
+                    timeout=90,
+                    mca=(("btl_btl", "^sm"),
+                         ("pml_peer_timeout", "2.0"),
+                         ("ft_inject_plan", "drop(1,0,frac=1.0)"),
+                         ("ft_inject_seed", str(seed))))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count("CHAOS-WATCHDOG-OK") == 2, \
+            r.stdout + r.stderr

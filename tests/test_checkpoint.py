@@ -106,6 +106,27 @@ def test_procmode_checkpoint_size_mismatch(tmp_path):
     assert "repartitioning" in (r2.stdout + r2.stderr)
 
 
+def test_restore_rank_override_validated_against_geometry(tmp_path):
+    """Satellite (PR 5): the shrink-recovery ``rank=`` override must be
+    range-checked against the COMMITTED manifest geometry — an
+    out-of-range override raises a clean MPIError(ERR_FILE) instead of
+    a confusing missing-file error or a silent foreign read."""
+    from ompi_tpu.core.errors import MPIError, ERR_FILE
+    from ompi_tpu.runtime.checkpoint import restore_ranked, save_ranked
+    from ompi_tpu.runtime.state import get_world
+
+    w = get_world()  # singleton: manifest geometry is 1 rank
+    ckdir = str(tmp_path / "ranked3")
+    save_ranked(w, ckdir, 4, {"x": np.arange(3.0)})
+    got = restore_ranked(w, ckdir, 4, rank=0)  # valid override
+    np.testing.assert_array_equal(got["x"], np.arange(3.0))
+    for bad in (1, -1, 99):
+        with pytest.raises(MPIError) as ei:
+            restore_ranked(w, ckdir, 4, rank=bad)
+        assert ei.value.code == ERR_FILE
+        assert "out of range" in str(ei.value)
+
+
 def test_torn_attempt_is_invisible(tmp_path):
     """A step dir without a committed manifest is never restored."""
     import os

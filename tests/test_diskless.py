@@ -1,0 +1,391 @@
+"""Diskless in-memory checkpoint replication + respawn recovery.
+
+Covers the blob encoding, the buddy/parity geometry, XOR
+reconstruction, epoch commit/abort semantics, the recovery-source
+planner (incl. the double-failure disk fallback and the unrecoverable
+escalation), the preempt() grammar, the registered cvar/pvar surface,
+the Prometheus export of the ft_ckpt metrics, and the procmode proofs:
+kill-mid-step with NO disk checkpoint recovering via
+policy="respawn" from a buddy replica (deterministic over 5 runs),
+from XOR parity, and via the preemption grace flush; plus the bounded
+spawn-failure satellite.
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu.core.errors import MPIError, ERR_FILE, ERR_PROC_FAILED
+from ompi_tpu.ft import diskless, inject
+from ompi_tpu.ft.recovery import _plan_sources
+from ompi_tpu.mca.var import all_pvars, all_vars, set_var
+
+from tests.test_process_mode import run_mpi
+
+# the chaos-test heartbeat margins (PR 3 discipline: a starved thread
+# on an oversubscribed CI host must not read as a death) + the diskless
+# plane armed
+FT_CKPT = (("ft_enable", "1"),
+           ("ft_heartbeat_period", "0.25"),
+           ("ft_heartbeat_timeout", "4.0"),
+           ("ft_era_timeout", "60"),
+           ("coll_sm_enable", "0"),
+           ("ft_ckpt_enable", "1"),
+           ("ft_ckpt_timeout", "10"))
+
+
+@pytest.fixture
+def clean_diskless():
+    set_var("ft", "ckpt_enable", True)
+    yield diskless
+    set_var("ft", "ckpt_enable", False)
+    diskless.reset_for_testing()
+
+
+# ------------------------------------------------------------- encoding
+def test_blob_roundtrip_preserves_dtypes():
+    st = {"x": np.arange(6.0).reshape(2, 3),
+          "step": np.array([7], np.int64),
+          "b": np.array([1, 0, 1], np.uint8)}
+    back = diskless.decode_state(diskless.encode_state(st))
+    assert set(back) == set(st)
+    for k in st:
+        assert np.array_equal(back[k], st[k])
+        assert back[k].dtype == st[k].dtype
+
+
+def test_xor_reconstruct_any_member():
+    blobs = [b"alpha-blob", b"bb", b"the-longest-of-the-three"]
+    acc = bytearray()
+    for b in blobs:
+        diskless._xor_into(acc, b)
+    lengths = {i: len(b) for i, b in enumerate(blobs)}
+    for dead in range(3):
+        survivors = [blobs[i] for i in range(3) if i != dead]
+        got = diskless.xor_reconstruct(bytes(acc), lengths, dead,
+                                       survivors)
+        assert got == blobs[dead]
+
+
+# ------------------------------------------------------------- geometry
+def test_buddy_and_group_geometry():
+    assert diskless.buddies(0, 3, k=1) == [1]
+    assert diskless.buddies(2, 3, k=2) == [0, 1]
+    assert diskless.buddies(0, 1, k=3) == []  # capped at size-1
+    assert diskless.group_members(4, 9, g=3) == [3, 4, 5]
+    assert diskless.group_members(8, 9, g=3) == [6, 7, 8]
+    assert diskless.group_members(6, 7, g=3) == [6]  # remainder group
+    # every rank's replica lands somewhere: expected-owner sets cover
+    for n in (2, 3, 5):
+        covered = set()
+        for r in range(n):
+            covered.update(
+                o for o in diskless._expected_owners(r, n, "buddy"))
+        assert covered == set(range(n))
+
+
+# ------------------------------------------------- epoch commit semantics
+def test_singleton_save_commit_restore(clean_diskless):
+    from ompi_tpu.runtime.state import get_world
+
+    diskless.reset_for_testing()
+    w = get_world()
+    st = {"x": np.arange(4.0), "step": np.array([3], np.int64)}
+    before = all_pvars()["ft_ckpt_epochs"].value
+    assert diskless.save(w, st) is True
+    assert diskless.committed_epoch() == 0
+    assert all_pvars()["ft_ckpt_epochs"].value == before + 1
+    back = diskless.my_state()
+    assert all(np.array_equal(st[k], back[k]) for k in st)
+    assert all_pvars()["ft_ckpt_restores_mem"].value >= 1
+    # second epoch supersedes; keep-window retains both
+    st2 = {"x": st["x"] + 1, "step": np.array([4], np.int64)}
+    assert diskless.save(w, st2) is True
+    assert diskless.committed_epoch() == 1
+    assert np.array_equal(diskless.my_state()["x"], st2["x"])
+    assert diskless.own_blob(0) is not None  # ft_ckpt_keep=2
+
+
+def test_disabled_save_is_a_noop():
+    from ompi_tpu.runtime.state import get_world
+
+    set_var("ft", "ckpt_enable", False)
+    before = all_pvars()["ft_ckpt_epochs"].value
+    assert diskless.save(get_world(), {"x": np.zeros(1)}) is False
+    assert all_pvars()["ft_ckpt_epochs"].value == before
+
+
+def test_rollback_realigns_epoch_clock(clean_diskless):
+    from ompi_tpu.runtime.state import get_world
+
+    diskless.reset_for_testing()
+    w = get_world()
+    for i in range(3):
+        assert diskless.save(w, {"x": np.full(2, float(i))})
+    assert diskless.next_epoch() == 3
+    diskless.rollback_to(1)
+    assert diskless.next_epoch() == 2
+    assert diskless.committed_epoch() == 1
+    assert np.array_equal(diskless.my_state()["x"], np.full(2, 1.0))
+
+
+# ------------------------------------------------------- recovery planner
+def _caps(rank, epoch=2, nxt=3, replicas=(), final=(), parity=False,
+          disk=None, dead=(1,)):
+    return {"rank": rank, "epoch": epoch, "next": nxt,
+            "replicas": {str(d): ([epoch] if d in replicas else [])
+                         for d in dead},
+            "final": list(final),
+            "parity": [epoch] if parity else [],
+            "own": [epoch], "disk": disk}
+
+
+def test_plan_prefers_final_flush_for_all_dead():
+    caps = [_caps(0), _caps(2, final=(1,), replicas=(1,))]
+    plan = _plan_sources([1], caps, 3, "buddy", {1: [0, 1, 2]})
+    assert plan["mode"] == "final"
+    assert plan["sources"][1] == ("final", 1)
+
+
+def test_plan_buddy_replica_then_parity_then_disk():
+    # buddy replica wins
+    caps = [_caps(0), _caps(2, replicas=(1,))]
+    plan = _plan_sources([1], caps, 3, "buddy", {1: [0, 1, 2]})
+    assert plan["sources"][1] == ("mem", 1)
+    # parity: full surviving group, coordinator = lowest survivor
+    caps = [_caps(0, parity=True), _caps(2, parity=True)]
+    plan = _plan_sources([1], caps, 3, "parity", {1: [0, 1, 2]})
+    assert plan["sources"][1] == ("parity", 0)
+    # double failure in the group: falls back to disk when present
+    caps = [_caps(0, parity=True, disk=5, dead=(1, 2))]
+    plan = _plan_sources([1, 2], caps, 3, "parity",
+                         {1: [0, 1, 2], 2: [0, 1, 2]})
+    assert plan["sources"][1] == ("disk", 0)
+    assert plan["sources"][2] == ("disk", 0)
+
+
+def test_plan_survives_one_epoch_commit_divergence():
+    """A commit vote torn by a concurrent revocation can leave one
+    survivor committed at E+1 while another stayed at E; the planner
+    keys on min(E) and capabilities cover the whole keep window, so a
+    replica held at E (ft_ckpt_keep=2) is still found."""
+    caps = [_caps(0, epoch=2, nxt=4),
+            {"rank": 2, "epoch": 3, "next": 4,
+             "replicas": {"1": [2, 3]}, "final": [],
+             "parity": [2, 3], "own": [2, 3], "disk": None}]
+    plan = _plan_sources([1], caps, 3, "buddy", {1: [0, 1, 2]})
+    assert plan["epoch"] == 2
+    assert plan["sources"][1] == ("mem", 1)
+    pcaps = [dict(c, replicas={"1": []}, parity=[2, 3]) for c in caps]
+    plan = _plan_sources([1], pcaps, 3, "parity", {1: [0, 1, 2]})
+    assert plan["epoch"] == 2
+    assert plan["sources"][1] == ("parity", 0)
+    # a helper whose keep window purged own[E] disqualifies the parity
+    # rebuild (disk/unrecoverable beats crashing mid-choreography)
+    degraded = [dict(pcaps[0], own=[3]), pcaps[1]]
+    with pytest.raises(MPIError):
+        _plan_sources([1], degraded, 3, "parity", {1: [0, 1, 2]})
+
+
+def test_straggler_frame_for_finished_epoch_not_staged(clean_diskless):
+    """A replica landing after its epoch's save finished (committed or
+    aborted) must be dropped, not pinned forever in staging."""
+    import json
+    import struct
+
+    from ompi_tpu.runtime.state import get_world
+
+    diskless.reset_for_testing()
+    w = get_world()
+    diskless.save(w, {"x": np.zeros(2)})
+    diskless.save(w, {"x": np.ones(2)})  # next_epoch is now 2
+
+    class _Hdr:
+        src = 0
+
+    def frame(epoch):
+        meta = json.dumps({"kind": "replica", "epoch": epoch,
+                           "owner": 5, "len": 3}).encode()
+        return struct.pack("<I", len(meta)) + meta + b"xyz"
+
+    diskless._on_system(_Hdr(), frame(0))  # straggler: dropped
+    assert diskless.replica_blob(5, 0) is None
+    with diskless._lock:
+        assert (0, 5) not in diskless._store.staged_replicas
+    diskless._on_system(_Hdr(), frame(2))  # current-ish: staged
+    with diskless._lock:
+        assert (2, 5) in diskless._store.staged_replicas
+
+
+def test_plan_unrecoverable_escalates_proc_failed(capsys):
+    caps = [_caps(0, parity=True, dead=(1, 2))]
+    with pytest.raises(MPIError) as ei:
+        _plan_sources([1, 2], caps, 3, "parity",
+                      {1: [0, 1, 2], 2: [0, 1, 2]})
+    assert ei.value.code == ERR_PROC_FAILED
+    assert "ckpt" in capsys.readouterr().err.lower()
+
+
+# ------------------------------------------------------- preempt grammar
+def test_preempt_plan_grammar():
+    rules = inject.parse_plan("preempt(1,after=5,grace_ms=250)")
+    assert rules[0].action == "preempt"
+    assert rules[0].src == 1 and rules[0].after == 5
+    assert rules[0].ms == 250.0
+    assert "preempt(1,after=5,grace_ms=250)" in repr(rules[0])
+    # default grace; kill still rejects grace_ms
+    assert inject.parse_plan("preempt(2,after=1)")[0].ms == 500.0
+    with pytest.raises(ValueError):
+        inject.parse_plan("kill(1,after=2,grace_ms=9)")
+    with pytest.raises(ValueError):
+        inject.parse_plan("preempt(*)")
+    inject.uninstall()
+
+
+def test_preempt_hook_registry_dedups():
+    calls = []
+
+    def cb(grace):
+        calls.append(grace)
+
+    inject.on_preempt(cb)
+    inject.on_preempt(cb)
+    assert inject._preempt_hooks.count(cb) == 1
+    inject._preempt_hooks.remove(cb)
+
+
+def test_flush_final_disabled_is_one_load():
+    set_var("ft", "ckpt_enable", False)
+    assert diskless.flush_final(0.1) == 0
+
+
+# --------------------------------------------------- registered surface
+def test_ckpt_cvars_and_pvars_registered():
+    vars_ = all_vars()
+    for name in ("ft_ckpt_enable", "ft_ckpt_mode", "ft_ckpt_buddies",
+                 "ft_ckpt_group", "ft_ckpt_timeout", "ft_ckpt_keep",
+                 "dpm_spawn_timeout"):
+        assert name in vars_, name
+    assert vars_["ft_ckpt_mode"].default == "buddy"
+    pvars = all_pvars()
+    for name in ("ft_ckpt_epochs", "ft_ckpt_bytes_replicated",
+                 "ft_ckpt_restores_mem", "ft_ckpt_restores_parity",
+                 "ft_respawns"):
+        assert name in pvars, name
+
+
+def test_info_cli_lists_ckpt_surface(capsys):
+    from ompi_tpu.tools.info import main as info_main
+
+    info_main(["--level", "9", "--param", "ft", "--pvars"])
+    out = capsys.readouterr().out
+    for name in ("ft_ckpt_enable", "ft_ckpt_mode", "ft_ckpt_epochs",
+                 "ft_ckpt_bytes_replicated", "ft_ckpt_restores_mem",
+                 "ft_ckpt_restores_parity"):
+        assert name in out, name
+
+
+def test_mpilint_guards_diskless_hooks():
+    """Satellite: the replication hooks are linted framework code —
+    allowed on hot paths only behind the live-Var guard discipline."""
+    from ompi_tpu.analysis.lint import lint_source
+
+    bad = (
+        "from ompi_tpu.ft import diskless as _diskless\n"
+        "def isend(self, dst):\n"
+        "    _diskless.flush_final(0.1)\n")
+    got = lint_source(bad, "ompi_tpu/pml/ob1.py")
+    assert any(f.rule == "hot-guard" for f in got), got
+    good = (
+        "from ompi_tpu.ft import diskless as _diskless\n"
+        "def isend(self, dst):\n"
+        "    if _diskless._enable_var._value:\n"
+        "        _diskless.flush_final(0.1)\n")
+    assert not lint_source(good, "ompi_tpu/pml/ob1.py")
+
+
+# ----------------------------------------------------- prometheus export
+def test_ckpt_metrics_in_prometheus_export(clean_diskless):
+    from ompi_tpu.runtime import metrics
+    from ompi_tpu.runtime.state import get_world
+    from tools.promexport import validate
+
+    diskless.reset_for_testing()
+    metrics.reset_for_testing()
+    set_var("metrics", "enable", True)
+    try:
+        assert diskless.save(get_world(), {"x": np.arange(8.0)})
+        diskless.my_state()
+        text = metrics.render_prometheus()
+    finally:
+        set_var("metrics", "enable", False)
+        metrics.reset_for_testing()
+    assert validate(text) == [], validate(text)
+    assert "ompi_metrics_ft_ckpt_ship_us_bucket" in text
+    assert "ompi_metrics_ft_ckpt_restore_us_bucket" in text
+    assert "ompi_metrics_ft_ckpt_epoch" in text
+    assert "ompi_metrics_ft_ckpt_store_bytes" in text
+    assert "ompi_pvar_ft_ckpt_epochs" in text
+
+
+# ---------------------------------------------------------- procmode proofs
+@pytest.mark.parametrize("run", range(5))
+def test_respawn_from_buddy_replica_deterministic(run):
+    """The headline: kill-mid-step with NO checkpoint_dir on disk —
+    recovery spawns a replacement, re-ranks it to the dead rank's
+    world rank, and rebuilds its state from the buddy's in-memory
+    replica. The finish is arithmetically identical to a failure-free
+    run, 5/5 deterministic."""
+    r = run_mpi(3, "tests/procmode/check_diskless.py", "respawn",
+                timeout=150,
+                mca=FT_CKPT + (("ft_inject_plan", "kill(1,after=14)"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("DISKLESS-RESPAWN-OK") == 3, \
+        r.stdout + r.stderr
+    assert "src=mem" in r.stdout, r.stdout
+    # exactness witnesses (one per original rank, newcomer included)
+    for x in ("x=136.0", "x=236.0", "x=336.0"):
+        assert x in r.stdout, r.stdout
+
+
+def test_respawn_from_xor_parity():
+    """Second variant: the dead rank's state is XOR-reconstructed from
+    the group parity plus the survivors' own blobs."""
+    r = run_mpi(3, "tests/procmode/check_diskless.py", "parity",
+                timeout=150,
+                mca=FT_CKPT + (("ft_ckpt_mode", "parity"),
+                               ("ft_ckpt_group", "3"),
+                               ("ft_inject_plan", "kill(1,after=14)")))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("DISKLESS-PARITY-OK") == 3, \
+        r.stdout + r.stderr
+    assert "src=parity" in r.stdout, r.stdout
+    for x in ("x=136.0", "x=236.0", "x=336.0"):
+        assert x in r.stdout, r.stdout
+
+
+def test_respawn_after_preemption_grace_flush():
+    """The TPU preemption model: the doomed rank's notice hook flushes
+    one final epoch to its buddy inside the grace window; recovery
+    skips the rollback (survivors keep live state) and the newcomer
+    restores from the flush."""
+    r = run_mpi(3, "tests/procmode/check_diskless.py", "preempt",
+                timeout=150,
+                mca=FT_CKPT + (("ft_inject_plan",
+                                "preempt(1,after=14,grace_ms=600)"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("DISKLESS-PREEMPT-OK") == 3, \
+        r.stdout + r.stderr
+    assert "src=final" in r.stdout, r.stdout
+    for x in ("x=136.0", "x=236.0", "x=336.0"):
+        assert x in r.stdout, r.stdout
+
+
+def test_spawn_failure_is_bounded_and_clean():
+    """Satellite: a child that dies before wireup fails the spawn with
+    MPI_ERR_SPAWN on every rank within dpm_spawn_timeout (no hang),
+    maxprocs=0 raises uniformly, and the job stays usable."""
+    r = run_mpi(2, "tests/procmode/check_diskless.py", "spawnfail",
+                timeout=90, mca=(("dpm_spawn_timeout", "10"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("DISKLESS-SPAWNFAIL-OK") == 2, \
+        r.stdout + r.stderr
