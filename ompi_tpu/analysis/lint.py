@@ -12,8 +12,9 @@ rule id                   contract
 hot-guard                 in hot modules (parallel/mesh.py, pml/ob1.py,
                           coll/xla.py, runtime/progress.py) every trace/
                           sanitizer/metrics instrumentation call — and every
-                          ft/inject.py chaos hook and ft/diskless.py
-                          replication hook (framework code allowed on
+                          ft/inject.py chaos hook, ft/diskless.py
+                          replication hook, and reshard/ accounting
+                          hook (framework code allowed on
                           the wire path) — sits behind a live-Var
                           guard: ``X.enabled()`` / ``X._enable_var._value`` (or
                           a local name assigned from one) — context-manager
@@ -92,7 +93,8 @@ VERB_LAYER_DIRS = ("comm/", "parallel/")
 ENVIRON_EXEMPT = ("mca/var.py", "tools/")
 # the instrumentation implementations themselves (they define the guards)
 INSTR_IMPL = ("runtime/trace.py", "runtime/sanitizer.py", "runtime/spc.py",
-              "runtime/metrics.py", "ft/inject.py", "ft/diskless.py")
+              "runtime/metrics.py", "ft/inject.py", "ft/diskless.py",
+              "reshard/plan.py", "reshard/exec.py", "reshard/elastic.py")
 
 TRACE_ALIASES = {"trace", "_trace", "_tr"}
 SAN_ALIASES = {"sanitizer", "_san", "_sanitizer"}
@@ -105,6 +107,9 @@ METRICS_ALIASES = {"metrics", "_metrics", "_mx"}
 # ft/diskless.py replication hooks: an epoch save or preemption flush
 # reached from hot code must sit behind the ft_ckpt_enable live Var
 DISKLESS_ALIASES = {"diskless", "_diskless"}
+# reshard/ accounting hooks (plan/exec pvar + spc bumps): a reshard
+# note reached from hot code rides the same live-Var guard contract
+RESHARD_ALIASES = {"reshard", "_reshard", "_rs"}
 INSTR_TRACE_ATTRS = {"span", "record_span", "instant", "counter",
                      "wrap_span"}
 INSTR_SAN_ATTRS = {"wrap_coll", "on_collective", "check_p2p",
@@ -113,6 +118,7 @@ INSTR_INJECT_ATTRS = {"on_op", "wire_send", "wrap_deliver"}
 INSTR_METRICS_ATTRS = {"on_coll_entry", "observe", "ewma_update",
                        "gauge_set"}
 INSTR_DISKLESS_ATTRS = {"save", "flush_final", "attach"}
+INSTR_RESHARD_ATTRS = {"note_plan", "note_exec"}
 
 _SUPPRESS_RE = re.compile(r"#\s*mpilint:\s*disable=([A-Za-z0-9_,\- ]+)")
 
@@ -219,6 +225,9 @@ def _instr_call(node: ast.AST) -> Optional[str]:
             if v.id in DISKLESS_ALIASES and \
                     node.func.attr in INSTR_DISKLESS_ATTRS:
                 return "diskless"
+            if v.id in RESHARD_ALIASES and \
+                    node.func.attr in INSTR_RESHARD_ATTRS:
+                return "reshard"
     return None
 
 
@@ -616,6 +625,7 @@ SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
     "hot-guard": ("ompi_tpu/pml/ob1.py", """
 from ompi_tpu.ft import diskless as _diskless
 from ompi_tpu.ft import inject as _inject
+from ompi_tpu.reshard import exec as _reshard
 from ompi_tpu.runtime import metrics as _metrics
 from ompi_tpu.runtime import trace as _trace
 
@@ -623,6 +633,7 @@ def isend(self, dst):
     _inject.on_op(self.my_rank, 0)
     _metrics.observe("pml_send_latency_us", 1.0, peer=dst)
     _diskless.flush_final(0.1)
+    _reshard.note_exec(1, 2)
     with _trace.span("pml.send", cat="pml"):
         return self._isend(dst)
 """),

@@ -116,7 +116,9 @@ def _agree_survivors(comm) -> None:
 def recover(comm, checkpoint_dir: Optional[str] = None,
             step: Optional[int] = None, policy: str = "shrink",
             command: Optional[str] = None,
-            args: Optional[Tuple[str, ...]] = None
+            args: Optional[Tuple[str, ...]] = None,
+            elastic: bool = False,
+            replicated: Tuple[str, ...] = ()
             ) -> Tuple[Any, Optional[dict]]:
     """One full ULFM recovery: revoke ``comm``, agree on the survivor
     set, shrink, then apply ``policy`` (see the module docstring).
@@ -137,7 +139,13 @@ def recover(comm, checkpoint_dir: Optional[str] = None,
     member to the same committed epoch. For ``policy="respawn"``, ``command``/``args`` name
     the replacement's program (default: this process's own argv) and
     the returned comm has the ORIGINAL size with every survivor at its
-    original rank. Collective over the survivors."""
+    original rank. ``elastic=True`` (shrink policy) restores the disk
+    checkpoint REPARTITIONED onto the shrunk world instead of handing
+    each survivor its old same-size partition: the checkpoint taken by
+    N ranks is redistributed over the M survivors through an N->M
+    reshard plan (reshard/elastic.py; ``replicated`` names state keys
+    broadcast verbatim instead of row-concatenated). Collective over
+    the survivors."""
     if policy not in ("shrink", "respawn"):
         raise MPIError(ERR_ARG, f"unknown recovery policy {policy!r}")
     from ompi_tpu.runtime import spc
@@ -146,12 +154,13 @@ def recover(comm, checkpoint_dir: Optional[str] = None,
         with _trace.span("ft.recover", cat="ft", cid=comm.cid,
                          policy=policy):
             return _recover(comm, checkpoint_dir, step, policy,
-                            command, args, spc)
+                            command, args, spc, elastic, replicated)
     return _recover(comm, checkpoint_dir, step, policy, command, args,
-                    spc)
+                    spc, elastic, replicated)
 
 
-def _recover(comm, checkpoint_dir, step, policy, command, args, spc):
+def _recover(comm, checkpoint_dir, step, policy, command, args, spc,
+             elastic=False, replicated=()):
     old_rank = comm.Get_rank()
     comm.Revoke()
     _agree_survivors(comm)
@@ -165,8 +174,23 @@ def _recover(comm, checkpoint_dir, step, policy, command, args, spc):
                         command, args)
     state = None
     if checkpoint_dir is not None:
-        state = _disk_restore(shrunk, checkpoint_dir, step, old_rank)
+        if elastic:
+            state = _elastic_restore(shrunk, checkpoint_dir, step,
+                                     replicated)
+        else:
+            state = _disk_restore(shrunk, checkpoint_dir, step, old_rank)
     return shrunk, state
+
+
+def _elastic_restore(shrunk, checkpoint_dir, step, replicated):
+    from ompi_tpu.reshard.elastic import restore_elastic
+    from ompi_tpu.runtime.checkpoint import latest_ranked_step
+
+    use = latest_ranked_step(checkpoint_dir) if step is None else step
+    if use is None:
+        return None
+    return restore_elastic(shrunk, checkpoint_dir, use,
+                           replicated=replicated)
 
 
 def _disk_restore(comm, checkpoint_dir, step, old_rank):
@@ -508,7 +532,8 @@ def rejoin() -> Tuple[Any, Optional[dict], dict]:
 def resilient(checkpoint_dir: Optional[str] = None,
               max_failovers: int = 2,
               codes: Tuple[int, ...] = FAILURE_CODES,
-              policy: str = "shrink"):
+              policy: str = "shrink", elastic: bool = False,
+              replicated: Tuple[str, ...] = ()):
     """Decorator running ``fn(comm, state, *args, **kwargs)`` with the
     retry-on-the-recovered-comm loop::
 
@@ -540,7 +565,9 @@ def resilient(checkpoint_dir: Optional[str] = None,
                                 "(failover %d/%d)", fn.__name__, e,
                                 failures, max_failovers)
                     comm, restored = recover(comm, checkpoint_dir,
-                                             policy=policy)
+                                             policy=policy,
+                                             elastic=elastic,
+                                             replicated=replicated)
                     if restored is not None:
                         state = restored
                     from ompi_tpu.runtime import spc

@@ -530,6 +530,19 @@ class XlaComm(Intracomm):
         perm = tuple((i, (i + steps) % n) for i in range(n))
         return self.permute(x, perm)
 
+    # ---------------------------------------------------------- resharding
+    def reshard(self, x, src_spec, dst_spec):
+        """Redistribute the canonical [W, *local] distributed buffer
+        between layouts, lowered to ONE coll/xla verb (allgather /
+        alltoall / local slicing) by the reshard engine — never
+        allgather-then-slice (reshard/exec.py mesh_reshard; the plan
+        layer is ompi_tpu/reshard/plan.py). Not a resolved-table verb:
+        each call re-derives the lowering (cache the result, or use the
+        underlying verbs directly, for per-step resharding loops)."""
+        from ompi_tpu.reshard.exec import mesh_reshard
+
+        return mesh_reshard(self, x, src_spec, dst_spec)
+
     # ------------------------------------------------------------ topology
     # Reference: ompi/mca/topo projected TPU-native — cart coordinates are
     # a row-major reshape of the mesh axis, shifts are collective-permute

@@ -1,0 +1,37 @@
+"""Resharding engine: (mesh, spec) -> (mesh', spec') redistribution.
+
+The only way this tree could move an array between layouts used to be
+allgather-then-slice: full-array peak memory on every rank and
+(N-1) x full-array bytes on the wire. "Memory-efficient array
+redistribution through portable collective communication" (arxiv
+2112.01075) shows every such transfer factors into small
+alltoall(v)/allgather schedules with bounded peak memory; HiCCL (arxiv
+2408.05962) supplies the per-topology-level composition patterns. This
+package is that factoring, as a workload on top of the existing verbs:
+
+- :mod:`ompi_tpu.reshard.plan` — the plan compiler. Pure computation:
+  ``compile_plan(gshape, dtype, src, dst)`` takes two
+  :class:`~ompi_tpu.reshard.plan.Layout` s (mesh shape +
+  PartitionSpec-style dim mapping, optionally explicit shard bounds)
+  and emits a deterministic, rank-indexed schedule of contiguous
+  blocks grouped into p2p rounds, with chunking bounded by the
+  ``reshard_max_inflight_bytes`` cvar. Plans are frozen objects —
+  exactly the cacheable schedules ROADMAP item 5 wants.
+- :mod:`ompi_tpu.reshard.exec` — the executor. Lowers a plan onto the
+  verbs that exist: coll alltoallv/allgatherv where the communicator
+  maps onto the plan's rank space, chunked ob1 p2p rounds elsewhere,
+  coll/xla allgather/alltoall for mesh-mode (XlaComm) arrays. Entry
+  point: ``reshard(comm, arr, src_spec, dst_spec)``.
+- :mod:`ompi_tpu.reshard.elastic` — elastic world-size changes: a
+  ranked checkpoint saved at world size N restores at M != N
+  (``restore_elastic``), live states redistribute N -> M over a
+  communicator (``reshard_states``), and PR 5's diskless epoch blobs
+  repartition onto the survivors after a shrink (``reshard_epoch``).
+
+Every plan/execute carries trace spans, ``reshard_*`` pvars, and
+metrics-plane histograms behind the established one-live-Var-load
+guard discipline.
+"""
+
+from ompi_tpu.reshard.plan import Layout, compile_plan  # noqa: F401
+from ompi_tpu.reshard.exec import reshard, run_local  # noqa: F401

@@ -1,0 +1,373 @@
+"""Elastic world-size changes: restore/redistribute N-rank state at M.
+
+Three entry points, all built on the plan compiler:
+
+- :func:`restore_elastic` — a ranked two-phase-commit checkpoint
+  (runtime/checkpoint.py) saved by N ranks restores onto a communicator
+  of M != N ranks: the manifest's recorded geometry becomes the source
+  :class:`~ompi_tpu.reshard.plan.Layout` (explicit bounds — checkpoints
+  record what was written, not a rule), the destination is the even
+  block rule over M, and each rank reads ONLY the source partitions its
+  plan blocks overlap. Peak memory per rank = one source partition + its
+  own destination shard, never the full array. No communication — the
+  filesystem is the transport (every rank's reads are independent).
+- :func:`reshard_states` — live in-memory states keyed by ORIGINAL rank
+  redistribute over a communicator onto the even M-rank layout; any rank
+  may serve any subset of the original states (survivors holding
+  replicas). This is the piece that composes with PR 5's diskless
+  blobs.
+- :func:`reshard_epoch` — the diskless composition: after a
+  shrink recovery, survivors redistribute the newest committed diskless
+  epoch (their own blob + any buddy replicas / final-flush blobs they
+  hold for the dead) onto the shrunk world, so the job continues at M
+  ranks with NO disk and no respawn.
+
+Sharding convention: every array key is the row-wise (dim 0)
+concatenation of the per-rank pieces; keys named in ``replicated`` are
+instead taken verbatim from the lowest-ranked source (step counters,
+RNG keys — per-rank metadata that must not be concatenated).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu.core.errors import (
+    MPIError,
+    ERR_ARG,
+    ERR_FILE,
+    ERR_PROC_FAILED,
+)
+from ompi_tpu.runtime import metrics as _metrics
+from ompi_tpu.runtime import trace as _trace
+from ompi_tpu.runtime.checkpoint import allgather_json as _allgather_json
+from ompi_tpu.reshard.plan import Layout, compile_plan, chunk_block
+from ompi_tpu.reshard import exec as _exec
+
+#: user-plane tag for the mapped state exchange (RESHARD_TAG + 1)
+STATE_TAG = 4301
+
+
+def _row_layout(nranks: int, ndim: int,
+                dim0_sizes: Optional[Sequence[int]] = None) -> Layout:
+    """1-D mesh, dim-0 sharded, optionally with explicit row counts."""
+    spec = (0,) + (None,) * (ndim - 1)
+    bounds = None
+    if dim0_sizes is not None:
+        offs = [0]
+        for s in dim0_sizes:
+            offs.append(offs[-1] + int(s))
+        bounds = {0: tuple(offs)}
+    return Layout((nranks,), spec, bounds)
+
+
+# --------------------------------------------------------- disk restore
+def restore_elastic(comm, directory: str, step: Optional[int] = None,
+                    replicated: Sequence[str] = ()
+                    ) -> Dict[str, np.ndarray]:
+    """Restore a ranked checkpoint taken at world size N onto ``comm``
+    (size M, any M >= 1) by compiling an N->M plan per key and reading
+    only the overlapping source partitions (see module docstring).
+    M == N degenerates to the plain per-rank restore. Uses only this
+    rank's view of the filesystem — safe on any comm, including a
+    post-shrink survivor comm."""
+    from ompi_tpu.runtime.checkpoint import (
+        _read_manifest,
+        _step_dir,
+        latest_ranked_step,
+    )
+
+    t0 = time.monotonic_ns()
+    if step is None:
+        step = latest_ranked_step(directory)
+        if step is None:
+            raise MPIError(ERR_FILE, f"no checkpoint in {directory}")
+    d = _step_dir(directory, step)
+    manifest = _read_manifest(d)
+    if manifest is None:
+        raise MPIError(ERR_FILE, f"step {step} has no committed manifest")
+    geom = manifest.get("geometry")
+    if geom is None:
+        raise MPIError(
+            ERR_FILE,
+            f"checkpoint step {step} predates the geometry manifest "
+            "(pre-reshard format): restore at the original "
+            f"{manifest['size']} ranks, or re-save with the current "
+            "save_ranked")
+    n = int(manifest["size"])
+    m, rank = comm.Get_size(), comm.Get_rank()
+
+    def rank_path(r: int) -> str:
+        import os
+
+        if "attempt" in manifest:
+            return os.path.join(
+                d, f"rank_{r}.a{manifest['attempt']}.npz")
+        return os.path.join(d, f"rank_{r}.npz")
+
+    # plan every key first, then batch reads per SOURCE file so each
+    # npz opens once (zip member reads are whole-member: staging floor
+    # is one source partition, the bound the baseline can't meet)
+    out: Dict[str, np.ndarray] = {}
+    reads: Dict[int, List[Tuple[str, Any]]] = {}
+    st = _exec._Staging()
+    bytes_read = 0
+    for key in manifest["keys"]:
+        g = geom[key]
+        dt = np.dtype(str(g["dtype"]))
+        shapes = [tuple(int(x) for x in s) for s in g["shapes"]]
+        if key in replicated:
+            reads.setdefault(0, []).append((key, None))
+            continue
+        _check_rowwise(key, [(dt, s) for s in shapes])
+        ndim = len(shapes[0])
+        gshape = (sum(s[0] for s in shapes),) + shapes[0][1:]
+        src = _row_layout(n, ndim, [s[0] for s in shapes])
+        dst = _row_layout(m, ndim)
+        plan = compile_plan(gshape, dt, src, dst)
+        out[key] = np.empty(plan.dst.local_shape(gshape, rank), dt)
+        for b in plan.recv_blocks(rank):
+            reads.setdefault(b.src, []).append((key, b))
+            bytes_read += b.nbytes
+    for srank in sorted(reads):
+        with np.load(rank_path(srank)) as z:
+            for key, b in reads[srank]:
+                if b is None:  # replicated key: verbatim from source 0
+                    arr = z[key]
+                    st.alloc(arr.nbytes)
+                    out[key] = arr.copy()
+                    st.free(arr.nbytes)
+                    continue
+                piece = z[key]  # whole-member read (zip format)
+                st.alloc(piece.nbytes)
+                out[key][_exec._np_slices(b.dst_sl)] = \
+                    piece[_exec._np_slices(b.src_sl)]
+                st.free(piece.nbytes)
+    _exec.note_exec(bytes_read, st.peak)
+    if _trace.enabled():
+        _trace.instant("reshard.restore_elastic", cat="reshard",
+                       n=n, m=m, step=step, bytes=bytes_read)
+    if _metrics.enabled():
+        _metrics.observe("reshard_exec_us",
+                         (time.monotonic_ns() - t0) / 1000.0,
+                         lowering="disk")
+    return out
+
+
+# ------------------------------------------------- live state exchange
+def reshard_states(comm, held: Dict[int, Dict[str, np.ndarray]],
+                   n_old: int, my_old_rank: Optional[int] = None,
+                   replicated: Sequence[str] = ()
+                   ) -> Dict[str, np.ndarray]:
+    """Redistribute states keyed by ORIGINAL rank (0..n_old-1) onto the
+    even row layout over ``comm`` (size M). ``held`` maps each original
+    rank whose state THIS comm rank can serve to that state (its own
+    live state, a buddy replica, a final-flush blob...). Every original
+    rank must be served by someone; the serving rank for original rank
+    o is o's own survivor when alive (``my_old_rank``), else the
+    lowest comm rank holding it. Collective over ``comm``; returns this
+    rank's repartitioned state."""
+    rank, m = comm.Get_rank(), comm.Get_size()
+    # 1) agree who serves whom + per-key geometry (one json allgather)
+    card = {
+        "old": my_old_rank,
+        "have": {str(o): {k: [str(v.dtype), list(v.shape)]
+                          for k, v in sorted(s.items())}
+                 for o, s in held.items()},
+    }
+    cards = _allgather_json(comm, card)
+    serve: Dict[int, int] = {}
+    for o in range(n_old):
+        owner = next((i for i, c in enumerate(cards)
+                      if c["old"] == o and str(o) in c["have"]), None)
+        if owner is None:
+            owner = next((i for i, c in enumerate(cards)
+                          if str(o) in c["have"]), None)
+        if owner is None:
+            raise MPIError(
+                ERR_PROC_FAILED,
+                f"reshard_states: no rank can serve original rank {o} "
+                f"(served: {sorted(int(k) for c in cards for k in c['have'])})")
+        serve[o] = owner
+    geom: Dict[str, List[Tuple[np.dtype, Tuple[int, ...]]]] = {}
+    for o in range(n_old):
+        meta = cards[serve[o]]["have"][str(o)]
+        for k, (dt, shape) in meta.items():
+            geom.setdefault(k, [None] * n_old)[o] = \
+                (np.dtype(dt), tuple(int(x) for x in shape))
+    out: Dict[str, np.ndarray] = {}
+    for key in sorted(geom):
+        per_old = geom[key]
+        if any(g is None for g in per_old):
+            raise MPIError(
+                ERR_ARG,
+                f"reshard_states: key {key!r} missing from some "
+                "original ranks' states")
+        if key in replicated:
+            out[key] = _bcast_from(comm, serve[0],
+                                   held.get(0, {}).get(key),
+                                   per_old[0][0], per_old[0][1])
+            continue
+        _check_rowwise(key, per_old)
+        dt = per_old[0][0]
+        gshape = (sum(s[0] for _dt, s in per_old),) + per_old[0][1][1:]
+        src = _row_layout(n_old, len(per_old[0][1]),
+                          [s[0] for _dt, s in per_old])
+        dst = _row_layout(m, len(per_old[0][1]))
+        plan = compile_plan(gshape, dt, src, dst)
+        out[key] = _exchange_mapped(comm, plan, serve,
+                                    {o: s[key] for o, s in held.items()},
+                                    rank)
+    return out
+
+
+def reshard_epoch(comm, my_old_rank: int, n_old: int,
+                  epoch: Optional[int] = None,
+                  replicated: Sequence[str] = ()
+                  ) -> Tuple[Dict[str, np.ndarray], int]:
+    """PR 5 composition: redistribute the newest diskless epoch every
+    survivor shares onto the (shrunk) ``comm`` — each survivor serves
+    its own committed blob plus any buddy replicas and final-flush
+    blobs it holds for dead ranks. Returns ``(my repartitioned state,
+    epoch used)``. Collective over ``comm``."""
+    from ompi_tpu.core import op as _op
+    from ompi_tpu.ft import diskless
+    from ompi_tpu.runtime import spc
+
+    if epoch is None:
+        mine = np.array([diskless.committed_epoch()], np.int64)
+        agreed = np.zeros(1, np.int64)
+        with spc.suppressed():
+            comm.Allreduce(mine, agreed, op=_op.MIN)
+        epoch = int(agreed[0])
+    if epoch < 0:
+        raise MPIError(ERR_ARG,
+                       "reshard_epoch: no committed diskless epoch")
+    held: Dict[int, Dict[str, np.ndarray]] = {}
+    own = diskless.my_state(epoch)
+    if own is not None:
+        held[my_old_rank] = own
+    for o in range(n_old):
+        if o == my_old_rank or o in held:
+            continue
+        blob = diskless.replica_blob(o, epoch)
+        if blob is None:
+            fb = diskless.final_blob(o)
+            blob = fb[0] if fb is not None else None
+        if blob is not None:
+            held[o] = diskless.decode_state(blob)
+    state = reshard_states(comm, held, n_old,
+                           my_old_rank=my_old_rank,
+                           replicated=replicated)
+    return state, epoch
+
+
+# ----------------------------------------------------------- primitives
+def _check_rowwise(key: str, per_old: Sequence[Tuple[np.dtype,
+                                                     Tuple[int, ...]]]
+                   ) -> None:
+    """A key is row-concatenable only when every original rank's piece
+    has >= 1 dim, the SAME dtype, and the same trailing dims — anything
+    else must fail with a clean, symmetric error (every rank evaluates
+    the same agreed geometry), not corrupt a transfer or crash in
+    indexing mid-recovery."""
+    dt0, shape0 = per_old[0]
+    for dt, shape in per_old:
+        if len(shape) == 0:
+            raise MPIError(
+                ERR_ARG,
+                f"state key {key!r} is 0-d and cannot be row-"
+                "concatenated: name it in replicated=")
+        if dt != dt0 or shape[1:] != shape0[1:]:
+            raise MPIError(
+                ERR_ARG,
+                f"state key {key!r} disagrees across original ranks "
+                f"({dt0}{shape0} vs {dt}{shape}): not row-"
+                "concatenable — name it in replicated= or repartition "
+                "it yourself")
+
+
+def _bcast_from(comm, root: int, arr, dt, shape) -> np.ndarray:
+    from ompi_tpu.runtime import spc
+
+    buf = np.empty(tuple(shape), dt) if comm.Get_rank() != root \
+        else np.ascontiguousarray(arr)
+    with spc.suppressed():
+        comm.Bcast(buf, root=root)
+    return buf
+
+
+def _exchange_mapped(comm, plan, serve: Dict[int, int],
+                     mine: Dict[int, np.ndarray], rank: int) -> np.ndarray:
+    """Run a plan whose SOURCE rank space is original ranks served
+    through ``serve`` (original -> comm rank). Blocks are rescheduled
+    into rounds on the (serving rank, dst) pairing, then run with the
+    lockstep chunk discipline, so staging stays ~2 chunks even though
+    one comm rank may serve several original ranks."""
+    st = _exec._Staging()
+    out = np.empty(plan.dst.local_shape(plan.gshape, rank), plan.dtype)
+    local = remote = 0
+    entries = []  # (owner, dst, block) in deterministic plan order
+    for b in plan.blocks:
+        owner = serve[b.src]
+        if owner == b.dst:
+            if b.dst == rank:
+                out[_exec._np_slices(b.dst_sl)] = \
+                    mine[b.src][_exec._np_slices(b.src_sl)]
+            local += b.nbytes
+        else:
+            entries.append((owner, b))
+            remote += b.nbytes
+    # greedy rounds over (owner, dst): one send + one recv per rank
+    rounds: List[Tuple[set, set, List[Tuple[int, Any]]]] = []
+    for owner, b in entries:
+        for srcs, dsts, items in rounds:
+            if owner not in srcs and b.dst not in dsts:
+                srcs.add(owner)
+                dsts.add(b.dst)
+                items.append((owner, b))
+                break
+        else:
+            rounds.append(({owner}, {b.dst}, [(owner, b)]))
+    for _s, _d, items in rounds:
+        send = next(((o, b) for o, b in items if o == rank), None)
+        recv = next(((o, b) for o, b in items if b.dst == rank), None)
+        if send is None and recv is None:
+            continue
+        schunks = list(chunk_block(
+            send[1].src_sl, send[1].dst_sl, send[1].shape,
+            plan.dtype.itemsize, plan.max_inflight)) \
+            if send is not None else []
+        rchunks = list(chunk_block(
+            recv[1].src_sl, recv[1].dst_sl, recv[1].shape,
+            plan.dtype.itemsize, plan.max_inflight)) \
+            if recv is not None else []
+        for k in range(max(len(schunks), len(rchunks))):
+            reqs = []
+            rbuf = dsl = None
+            nb = 0
+            if k < len(rchunks):
+                _ssl, dsl, shape = rchunks[k]
+                rbuf = np.empty(shape, plan.dtype)
+                nb += rbuf.nbytes
+                st.alloc(rbuf.nbytes)
+                reqs.append(comm.Irecv(rbuf, source=serve[recv[1].src],
+                                       tag=STATE_TAG))
+            if k < len(schunks):
+                ssl, _dsl, shape = schunks[k]
+                sbuf = np.ascontiguousarray(
+                    mine[send[1].src][_exec._np_slices(ssl)])
+                nb += sbuf.nbytes
+                st.alloc(sbuf.nbytes)
+                reqs.append(comm.Isend(sbuf, dest=send[1].dst,
+                                       tag=STATE_TAG))
+            for r in reqs:
+                r.Wait()
+            if rbuf is not None:
+                out[_exec._np_slices(dsl)] = rbuf
+            st.free(nb)
+    _exec.note_exec(remote, st.peak)
+    return out

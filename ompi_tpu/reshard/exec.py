@@ -1,0 +1,504 @@
+"""Reshard executor: lower a compiled plan onto live verbs.
+
+Three lowerings, picked by what the communicator can do:
+
+- **Packed collective** (process mode, same-size rank spaces): every
+  rank packs its outbound blocks into one contiguous byte buffer and a
+  single ``Alltoallv`` moves the whole schedule — one collective step,
+  staging = my send pack + my recv pack (each bounded by the local
+  shard size, never the full array). Chosen when
+  ``reshard_use_collective`` is on and the pack fits
+  ``reshard_max_inflight_bytes``.
+- **Chunked p2p rounds** (process mode, the general/elastic path):
+  the plan's rounds run in sequence — per round each rank has at most
+  one peer to send to and one to receive from, each block split into
+  lockstep chunks of at most ``reshard_max_inflight_bytes`` — so peak
+  staging is ~2 chunks per rank no matter the array size.
+- **Mesh lowering** (XlaComm): the plan's classification maps onto the
+  coll/xla verbs — ``allgather`` for shard->replicate, ``alltoall``
+  for moving the sharded dim between array axes, pure-jnp slicing for
+  replicate->shard — so the whole redistribution stays one XLA
+  program over ICI.
+
+:func:`run_local` executes a plan over in-process per-rank arrays (the
+oracle-sweep and bench harness — same chunking, same staging
+accounting, no transport).
+
+Accounting: ``reshard_execs`` / ``reshard_bytes_moved`` /
+``reshard_peak_staging_bytes`` pvars (peak is a high-water mark,
+measured from real staging allocations, not estimated), the
+``reshard_exec_us`` / ``reshard_plan_us`` metrics histograms, and
+``reshard.exec`` trace spans — all behind the one-live-Var-load guard.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu.core.errors import (
+    MPIError,
+    ERR_ARG,
+    ERR_UNSUPPORTED_OPERATION,
+)
+from ompi_tpu.mca.var import register_pvar
+from ompi_tpu.runtime import metrics as _metrics
+from ompi_tpu.runtime import trace as _trace
+from ompi_tpu.reshard.plan import (
+    Layout,
+    Plan,
+    chunk_block,
+    compile_plan,
+    _max_inflight_var,
+    _use_coll_var,
+)
+
+#: user-plane tag reserved for reshard p2p rounds (clear of the ft
+#: RESPAWN_STATE_TAG 4242 / parity 4243 neighborhood)
+RESHARD_TAG = 4300
+
+_counts: Dict[str, int] = {"execs": 0, "bytes": 0, "peak": 0}
+
+register_pvar("reshard", "execs", lambda: _counts["execs"],
+              help="Reshard plans executed (any lowering)")
+register_pvar("reshard", "bytes_moved", lambda: _counts["bytes"],
+              help="Cross-rank bytes moved by reshard executions "
+                   "(local copies excluded)")
+register_pvar("reshard", "peak_staging_bytes", lambda: _counts["peak"],
+              help="High-water mark of reshard staging memory on this "
+                   "rank (measured from live staging allocations; the "
+                   "allgather-then-slice baseline would be full-array "
+                   "bytes)")
+
+
+def note_exec(bytes_moved: int, peak_staging: int) -> None:
+    """One plan executed (pvar + spc bumps; reshard accounting hooks
+    reached from hot modules must sit behind a live-Var guard — the
+    mpilint RESHARD hot-guard contract)."""
+    from ompi_tpu.runtime import spc
+
+    _counts["execs"] += 1
+    _counts["bytes"] += int(bytes_moved)
+    _counts["peak"] = max(_counts["peak"], int(peak_staging))
+    spc.record("reshard_exec")
+    spc.record_bytes("reshard", int(bytes_moved))
+
+
+def reset_for_testing() -> None:
+    _counts.update(execs=0, bytes=0, peak=0)
+
+
+class _Staging:
+    """Live staging-byte meter: the peak over one execution is the
+    number the ISSUE's memory claim is judged on, so it is measured at
+    allocation time, never estimated."""
+
+    __slots__ = ("cur", "peak")
+
+    def __init__(self):
+        self.cur = 0
+        self.peak = 0
+
+    def alloc(self, n: int) -> None:
+        self.cur += int(n)
+        if self.cur > self.peak:
+            self.peak = self.cur
+
+    def free(self, n: int) -> None:
+        self.cur -= int(n)
+
+
+def _np_slices(sl) -> Tuple[slice, ...]:
+    return tuple(slice(a, b) for a, b in sl)
+
+
+# ------------------------------------------------------------ local runner
+def run_local(plan: Plan, pieces: Dict[int, np.ndarray]
+              ) -> Tuple[Dict[int, np.ndarray], Dict[str, int]]:
+    """Execute ``plan`` over in-process per-rank source arrays
+    (``pieces[src_rank]``). Returns ``(dst_pieces, info)`` where info
+    carries the measured ``bytes_moved`` / ``peak_staging_bytes``.
+    Chunking and staging follow the p2p lowering exactly, so the
+    memory numbers are the ones a real job would see."""
+    t0 = time.monotonic_ns()
+    st = _Staging()
+    for r in range(plan.src.nranks):
+        want = plan.src.local_shape(plan.gshape, r)
+        if r not in pieces:
+            raise MPIError(ERR_ARG, f"missing source piece for rank {r}")
+        if tuple(pieces[r].shape) != want:
+            raise MPIError(
+                ERR_ARG,
+                f"source rank {r}: piece shape {pieces[r].shape} != "
+                f"layout shard {want}")
+    out = {d: np.empty(plan.dst.local_shape(plan.gshape, d), plan.dtype)
+           for d in range(plan.dst.nranks)}
+    for b in plan.blocks:
+        if b.src == b.dst:
+            out[b.dst][_np_slices(b.dst_sl)] = \
+                pieces[b.src][_np_slices(b.src_sl)]
+    for rnd in plan.rounds:
+        for i in rnd:
+            b = plan.blocks[i]
+            for ssl, dsl, shape in chunk_block(
+                    b.src_sl, b.dst_sl, b.shape, plan.dtype.itemsize,
+                    plan.max_inflight):
+                nb = int(np.prod(shape)) * plan.dtype.itemsize
+                st.alloc(2 * nb)  # send pack + recv buffer, like p2p
+                buf = np.ascontiguousarray(
+                    pieces[b.src][_np_slices(ssl)])
+                out[b.dst][_np_slices(dsl)] = buf
+                st.free(2 * nb)
+    info = {"bytes_moved": plan.bytes_moved,
+            "peak_staging_bytes": st.peak,
+            "lowering": "local"}
+    note_exec(plan.bytes_moved, st.peak)
+    if _metrics.enabled():
+        _metrics.observe("reshard_exec_us",
+                         (time.monotonic_ns() - t0) / 1000.0,
+                         lowering="local")
+    return out, info
+
+
+# --------------------------------------------------------- oracle reference
+def gather_then_slice(plan: Plan, pieces: Dict[int, np.ndarray]
+                      ) -> Dict[int, np.ndarray]:
+    """The baseline this engine replaces, as the correctness oracle:
+    materialize the full array from the source pieces, then slice every
+    destination shard out of it. Peak memory = full-array bytes."""
+    full = np.empty(plan.gshape, plan.dtype)
+    for r in range(plan.src.nranks):
+        full[_np_slices(plan.src.slices(plan.gshape, r))] = pieces[r]
+    return {d: np.ascontiguousarray(
+                full[_np_slices(plan.dst.slices(plan.gshape, d))])
+            for d in range(plan.dst.nranks)}
+
+
+# ----------------------------------------------------------- process mode
+def reshard(comm, arr: Optional[np.ndarray], src_spec, dst_spec,
+            src_mesh: Optional[Sequence[int]] = None,
+            dst_mesh: Optional[Sequence[int]] = None,
+            gshape: Optional[Sequence[int]] = None,
+            max_inflight: Optional[int] = None) -> Optional[np.ndarray]:
+    """Redistribute a globally-sharded array between layouts over
+    ``comm``: ``arr`` is THIS rank's source shard (None when this rank
+    holds nothing under the source layout), the return value is this
+    rank's destination shard (None when the destination layout assigns
+    it nothing). ``src_spec`` / ``dst_spec`` are per-array-dim mesh-dim
+    indices or None (:class:`~ompi_tpu.reshard.plan.Layout`); meshes
+    default to the 1-D ``(comm.size,)``. Collective over ``comm``.
+
+    Mesh-mode communicators (XlaComm) take the coll/xla lowering —
+    see :func:`mesh_reshard`, which this delegates to."""
+    if getattr(comm, "pml", None) is None:
+        return mesh_reshard(comm, arr, src_spec, dst_spec)
+    n = comm.Get_size()
+    rank = comm.Get_rank()
+    src = Layout(src_mesh if src_mesh is not None else (n,), src_spec)
+    dst = Layout(dst_mesh if dst_mesh is not None else (n,), dst_spec)
+    if src.nranks > n or dst.nranks > n:
+        raise MPIError(
+            ERR_ARG,
+            f"plan rank spaces ({src.nranks} -> {dst.nranks}) exceed "
+            f"communicator size {n}")
+    if gshape is None:
+        mine = _infer_gshape(arr, src) if arr is not None else None
+        gshape = _agree_gshape(comm, mine, len(src.spec))
+    gshape = tuple(int(x) for x in gshape)
+    dtype = _agree_dtype(comm, arr)
+    plan = compile_plan(gshape, dtype, src, dst,
+                        max_inflight=max_inflight)
+    bad = ""
+    if rank < src.nranks:
+        want = src.local_shape(gshape, rank)
+        if arr is None or tuple(arr.shape) != want:
+            bad = (f"rank {rank}: source shard shape "
+                   f"{None if arr is None else tuple(arr.shape)} != "
+                   f"layout shard {want} (pass gshape= for uneven "
+                   "shards)")
+    _agree_ok(comm, not bad,
+              bad or "a peer rank's source shard does not match the "
+                     "source layout")
+    out, _info = execute(comm, plan, arr)
+    return out
+
+
+def _infer_gshape(arr, src: Layout) -> Tuple[int, ...]:
+    """Global shape from this rank's shard, assuming the default even
+    block rule (uneven layouts must pass gshape explicitly)."""
+    out = []
+    for d, m in enumerate(src.spec):
+        out.append(arr.shape[d] if m is None
+                   else arr.shape[d] * src.mesh[m])
+    return tuple(out)
+
+
+def _agree_all(comm, vec: np.ndarray) -> np.ndarray:
+    from ompi_tpu.core import op as _op
+    from ompi_tpu.runtime import spc
+
+    agreed = np.zeros_like(vec)
+    with spc.suppressed():
+        comm.Allreduce(vec, agreed, op=_op.MAX)
+    return agreed
+
+
+def _agree_ok(comm, ok: bool, what: str) -> None:
+    """Symmetric failure: every rank learns whether ANY rank rejected,
+    so a bad argument raises everywhere instead of stranding the
+    well-formed ranks inside a torn collective."""
+    from ompi_tpu.core import op as _op
+    from ompi_tpu.runtime import spc
+
+    flag = np.array([1 if ok else 0], np.int64)
+    out = np.zeros(1, np.int64)
+    with spc.suppressed():
+        comm.Allreduce(flag, out, op=_op.MIN)
+    if not int(out[0]):
+        raise MPIError(ERR_ARG, what)
+
+
+def _agree_gshape(comm, mine: Optional[Tuple[int, ...]],
+                  ndim: int) -> Tuple[int, ...]:
+    """MAX-agree the inferred global shape; ranks without a source
+    shard contribute -1 and adopt the agreement. Uneven default-rule
+    shards make per-rank inference disagree — detected symmetrically
+    and reported as "pass gshape="."""
+    vec = np.asarray(mine if mine is not None else (-1,) * ndim,
+                     np.int64)
+    agreed = _agree_all(comm, vec)
+    ok = mine is None or np.array_equal(vec, agreed)
+    _agree_ok(comm, ok and int(agreed.min()) >= 0,
+              "global shape inference disagrees across ranks (uneven "
+              "layout, or no rank holds a source shard) — pass "
+              "gshape= explicitly")
+    return tuple(int(x) for x in agreed)
+
+
+_DTYPE_CODES = {np.dtype(c).str: i for i, c in enumerate(
+    ("|b1", "|i1", "|u1", "<i2", "<u2", "<i4", "<u4", "<i8", "<u8",
+     "<f2", "<f4", "<f8", "<c8", "<c16"))}
+
+
+def _agree_dtype(comm, arr) -> np.dtype:
+    """All ranks must run the plan with one dtype; ranks without a
+    source shard learn it from the agreement. Symmetric on failure."""
+    mine = -1 if arr is None \
+        else _DTYPE_CODES.get(np.dtype(arr.dtype).str, -2)
+    agreed = int(_agree_all(comm, np.array([max(mine, -1)],
+                                           np.int64))[0])
+    ok = mine != -2 and (mine < 0 or mine == agreed) and agreed >= 0
+    _agree_ok(comm, ok,
+              "reshard dtype unsupported, inconsistent across ranks, "
+              "or no rank holds a source shard")
+    inv = {i: c for c, i in _DTYPE_CODES.items()}
+    return np.dtype(inv[agreed])
+
+
+def execute(comm, plan: Plan, arr: Optional[np.ndarray]
+            ) -> Tuple[Optional[np.ndarray], Dict[str, Any]]:
+    """Run a compiled plan over a process-mode communicator. The plan's
+    rank indices are communicator ranks. Returns (my destination shard
+    or None, execution info)."""
+    t0 = time.monotonic_ns()
+    rank = comm.Get_rank()
+    st = _Staging()
+    out: Optional[np.ndarray] = None
+    if rank < plan.dst.nranks:
+        shape = plan.dst.local_shape(plan.gshape, rank)
+        out = np.empty(shape, plan.dtype)
+    snd, rcv = plan.rank_io_bytes()
+    # the lowering choice must be SYMMETRIC: every rank decides from
+    # the global worst-case pack (the plan is global and deterministic,
+    # so this costs no communication) — a rank-local decision could mix
+    # one rank's Alltoallv with another's p2p and deadlock
+    pack = max(list(snd.values()) + list(rcv.values()) + [0])
+    use_coll = (bool(_use_coll_var._value)
+                and plan.src.nranks == plan.dst.nranks == comm.Get_size()
+                and pack <= plan.max_inflight
+                and plan.classification != "identity")
+    lowering = "alltoallv" if use_coll and plan.remote_blocks() \
+        else "p2p"
+    if _trace.enabled():
+        with _trace.span("reshard.exec", cat="reshard",
+                         lowering=lowering, cls=plan.classification,
+                         bytes=plan.bytes_moved):
+            _execute_body(comm, plan, arr, out, rank, st, lowering)
+    else:
+        _execute_body(comm, plan, arr, out, rank, st, lowering)
+    note_exec(plan.bytes_moved, st.peak)
+    info = {"bytes_moved": plan.bytes_moved,
+            "peak_staging_bytes": st.peak, "lowering": lowering}
+    if _metrics.enabled():
+        _metrics.observe("reshard_exec_us",
+                         (time.monotonic_ns() - t0) / 1000.0,
+                         lowering=lowering)
+        _metrics.gauge_set("reshard_peak_staging_bytes", _counts["peak"])
+    return out, info
+
+
+def _execute_body(comm, plan, arr, out, rank, st, lowering) -> None:
+    # local copies first: pure views, no staging
+    for b in plan.local_blocks(rank):
+        out[_np_slices(b.dst_sl)] = arr[_np_slices(b.src_sl)]
+    if lowering == "alltoallv":
+        _exec_alltoallv(comm, plan, arr, out, rank, st)
+    else:
+        _exec_p2p(comm, plan, arr, out, rank, st)
+
+
+def _exec_p2p(comm, plan, arr, out, rank, st) -> None:
+    """Chunked p2p rounds: per round at most one send + one recv peer;
+    chunks run in lockstep so staging stays ~2 chunks."""
+    for rnd in plan.rounds:
+        send = next((plan.blocks[i] for i in rnd
+                     if plan.blocks[i].src == rank), None)
+        recv = next((plan.blocks[i] for i in rnd
+                     if plan.blocks[i].dst == rank), None)
+        if send is None and recv is None:
+            continue
+        schunks = list(chunk_block(
+            send.src_sl, send.dst_sl, send.shape, plan.dtype.itemsize,
+            plan.max_inflight)) if send is not None else []
+        rchunks = list(chunk_block(
+            recv.src_sl, recv.dst_sl, recv.shape, plan.dtype.itemsize,
+            plan.max_inflight)) if recv is not None else []
+        for k in range(max(len(schunks), len(rchunks))):
+            reqs: List[Any] = []
+            rbuf = None
+            rinfo = None
+            nb_r = nb_s = 0
+            if k < len(rchunks):
+                _ssl, dsl, shape = rchunks[k]
+                nb_r = int(np.prod(shape)) * plan.dtype.itemsize
+                st.alloc(nb_r)
+                rbuf = np.empty(shape, plan.dtype)
+                rinfo = dsl
+                reqs.append(comm.Irecv(rbuf, source=recv.src,
+                                       tag=RESHARD_TAG))
+            if k < len(schunks):
+                ssl, _dsl, shape = schunks[k]
+                nb_s = int(np.prod(shape)) * plan.dtype.itemsize
+                st.alloc(nb_s)
+                sbuf = np.ascontiguousarray(arr[_np_slices(ssl)])
+                reqs.append(comm.Isend(sbuf, dest=send.dst,
+                                       tag=RESHARD_TAG))
+            for r in reqs:
+                r.Wait()
+            if rbuf is not None:
+                out[_np_slices(rinfo)] = rbuf
+            st.free(nb_r + nb_s)
+
+
+def _exec_alltoallv(comm, plan, arr, out, rank, st) -> None:
+    """One packed byte Alltoallv carrying every remote block. Pack and
+    unpack order is the plan's deterministic block order, so both
+    endpoints agree without negotiation."""
+    n = comm.Get_size()
+    mysend = sorted(plan.send_blocks(rank),
+                    key=lambda b: (b.dst, b.dst_sl))
+    myrecv = sorted((b for b in plan.recv_blocks(rank)
+                     if b.src != b.dst),
+                    key=lambda b: (b.src, b.dst_sl))
+    scounts = [0] * n
+    rcounts = [0] * n
+    for b in mysend:
+        scounts[b.dst] += b.nbytes
+    for b in myrecv:
+        rcounts[b.src] += b.nbytes
+    sdispl = np.concatenate([[0], np.cumsum(scounts)[:-1]]).astype(int)
+    rdispl = np.concatenate([[0], np.cumsum(rcounts)[:-1]]).astype(int)
+    st.alloc(sum(scounts) + sum(rcounts))
+    sbuf = np.empty(sum(scounts), np.uint8)
+    rbuf = np.empty(sum(rcounts), np.uint8)
+    off = {d: int(sdispl[d]) for d in range(n)}
+    for b in mysend:
+        raw = np.ascontiguousarray(
+            arr[_np_slices(b.src_sl)]).view(np.uint8).reshape(-1)
+        sbuf[off[b.dst]:off[b.dst] + b.nbytes] = raw
+        off[b.dst] += b.nbytes
+    comm.Alltoallv(sbuf, rbuf, scounts, sdispl.tolist(),
+                   rcounts, rdispl.tolist())
+    off = {s: int(rdispl[s]) for s in range(n)}
+    for b in myrecv:
+        raw = rbuf[off[b.src]:off[b.src] + b.nbytes]
+        out[_np_slices(b.dst_sl)] = \
+            raw.view(plan.dtype).reshape(b.shape)
+        off[b.src] += b.nbytes
+    st.free(sum(scounts) + sum(rcounts))
+
+
+# --------------------------------------------------------------- mesh mode
+def _one_sharded_dim(spec) -> Optional[int]:
+    dims = [d for d, s in enumerate(spec) if s is not None]
+    if len(dims) > 1:
+        raise MPIError(
+            ERR_UNSUPPORTED_OPERATION,
+            "mesh reshard supports one sharded dim per layout "
+            f"(spec {tuple(spec)}); use process-mode reshard() for "
+            "multi-dim layouts")
+    return dims[0] if dims else None
+
+
+def _merge_axes(x, ax: int):
+    """Merge adjacent axes (ax, ax+1) of a jax array."""
+    shape = x.shape[:ax] + (x.shape[ax] * x.shape[ax + 1],) \
+        + x.shape[ax + 2:]
+    return x.reshape(shape)
+
+
+def mesh_reshard(comm, x, src_spec, dst_spec):
+    """XlaComm lowering: ``x`` is the canonical mesh-mode distributed
+    buffer — ``[W, *local]`` with row r holding rank r's shard of the
+    logical global array under ``src_spec`` (1-D mesh, entries are 0 or
+    None). Returns the ``[W, *local']`` buffer under ``dst_spec``,
+    lowered to ONE coll/xla verb: allgather (shard -> replicate),
+    alltoall (sharded dim moves between array axes), or pure jnp
+    slicing (replicate -> shard). General multi-dim redistributions
+    belong to process-mode :func:`reshard`."""
+    import jax.numpy as jnp
+
+    if getattr(comm, "groups", None) is not None:
+        raise MPIError(ERR_UNSUPPORTED_OPERATION,
+                       "mesh reshard runs on the whole-axis comm "
+                       "(Split colors hold different layouts)")
+    W = comm.size
+    a = _one_sharded_dim(src_spec)
+    b = _one_sharded_dim(dst_spec)
+    if len(src_spec) != len(dst_spec):
+        raise MPIError(ERR_ARG, "src/dst specs must have equal rank")
+    if a == b:
+        return x
+    gshape = list(x.shape[1:])
+    if a is not None:
+        gshape[a] *= W
+    for d in (a, b):
+        if d is not None and gshape[d] % W != 0:
+            raise MPIError(
+                ERR_ARG,
+                f"mesh reshard needs dim {d} ({gshape[d]}) divisible "
+                f"by {W}; use process-mode reshard() for uneven shards")
+    if a is None:
+        # replicate -> shard: every row slices its own block (no comm)
+        cb = gshape[b] // W
+        z = x.reshape(x.shape[:b + 1] + (W, cb) + x.shape[b + 2:])
+        z = jnp.moveaxis(z, b + 1, 1)  # [W, W, ...]
+        idx = jnp.arange(W).reshape((W, 1) + (1,) * (z.ndim - 2))
+        return jnp.take_along_axis(z, idx, axis=1)[:, 0]
+    if b is None:
+        # shard -> replicate: allgather, reassemble along a
+        y = comm.allgather(x)          # [W, W, *local]
+        y = jnp.moveaxis(y, 1, a + 1)  # gathered index left of a-chunk
+        return _merge_axes(y, a + 1)
+    # shard dim a -> shard dim b: the classic resharding alltoall
+    cb = gshape[b] // W
+    z = x.reshape(x.shape[:b + 1] + (W, cb) + x.shape[b + 2:])
+    z = jnp.moveaxis(z, b + 1, 1)      # [W, W(block for dst), ...]
+    r = comm.alltoall(z)               # [W, W(from src), ...]
+    # the a-chunk sits at axis a+2 (row + gather axes precede it);
+    # place the gather axis immediately left of it and merge: global
+    # a index = src_rank * chunk + offset
+    r = jnp.moveaxis(r, 1, a + 1)
+    return _merge_axes(r, a + 1)

@@ -1,0 +1,519 @@
+"""Reshard plan compiler: (mesh, spec) x2 -> minimal transfer schedule.
+
+A :class:`Layout` is the PartitionSpec idiom reduced to what a schedule
+needs: a mesh shape (ranks = row-major linearization of the mesh
+coordinates) and, per array dimension, which mesh dimension shards it
+(``None`` = replicated over every mesh dim the spec leaves unused).
+Shard boundaries default to the contiguous block rule
+``[i*D//P, (i+1)*D//P)`` — identical to even sharding when ``P | D``,
+well-defined when it doesn't (the elastic N->M path needs uneven) — and
+may be overridden with explicit per-dim offsets (checkpoints record the
+geometry that was actually written, not a rule).
+
+:func:`compile_plan` intersects every destination shard with every
+source shard and emits the exact set of contiguous blocks that must
+move, chooses ONE source replica per block (spread deterministically
+over the destination rank so replicated sources share the load), groups
+cross-rank blocks into p2p rounds (per round each rank sends at most
+one block and receives at most one block — bipartite greedy coloring),
+and bounds staging memory by splitting any block larger than
+``reshard_max_inflight_bytes`` into sub-block chunks along its
+outermost splittable dims. The result is a frozen, deterministic,
+rank-indexed :class:`Plan` — byte-identical for identical inputs, safe
+to cache or ship (reference point for the factoring: arxiv 2112.01075's
+redistribution-as-collectives decomposition).
+
+Compilation is pure (no communication); the executor
+(:mod:`ompi_tpu.reshard.exec`) lowers plans onto live verbs.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, Iterator, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIError, ERR_ARG
+from ompi_tpu.mca.var import register_var, register_pvar
+from ompi_tpu.runtime import metrics as _metrics
+from ompi_tpu.runtime import trace as _trace
+
+_max_inflight_var = register_var(
+    "reshard", "max_inflight_bytes", 8 << 20,
+    help="Per-transfer staging budget: any block larger than this is "
+         "split into sub-block chunks, so peak reshard staging memory "
+         "per rank stays ~2x this bound on the p2p path (one in-flight "
+         "send chunk + one recv chunk); the packed-collective lowering "
+         "is only chosen when its full pack fits this budget",
+    level=4)
+_use_coll_var = register_var(
+    "reshard", "use_collective", True,
+    help="Lower same-world-size plans to one packed Alltoallv/"
+         "Allgatherv step when the pack fits reshard_max_inflight_bytes "
+         "(otherwise, and always when disabled, chunked p2p rounds)",
+    level=5)
+
+_counts: Dict[str, int] = {"plans": 0}
+
+register_pvar("reshard", "plans_compiled",
+              lambda: _counts["plans"],
+              help="Reshard transfer schedules compiled by "
+                   "reshard.plan.compile_plan")
+
+
+def note_plan() -> None:
+    """One plan compiled (pvar + spc bump; reshard accounting hooks
+    reached from hot modules must sit behind a live-Var guard — the
+    mpilint RESHARD hot-guard contract)."""
+    from ompi_tpu.runtime import spc
+
+    _counts["plans"] += 1
+    spc.record("reshard_plan")
+
+
+Slice = Tuple[int, int]            # half-open [start, stop) on one dim
+Slices = Tuple[Slice, ...]         # one per array dim
+
+
+class Layout:
+    """One side of a redistribution: mesh shape + dim mapping.
+
+    ``spec[d]`` is the mesh dim sharding array dim ``d`` (or None);
+    each mesh dim may shard at most one array dim; mesh dims the spec
+    never references replicate the array across their coordinates.
+    ``bounds[d]`` optionally fixes the shard offsets of array dim ``d``
+    explicitly (len = mesh[spec[d]] + 1, starting 0, ending gshape[d]).
+    """
+
+    __slots__ = ("mesh", "spec", "bounds")
+
+    def __init__(self, mesh: Sequence[int],
+                 spec: Sequence[Optional[int]],
+                 bounds: Optional[Dict[int, Sequence[int]]] = None):
+        self.mesh = tuple(int(m) for m in mesh)
+        self.spec = tuple(None if s is None else int(s) for s in spec)
+        self.bounds = {int(d): tuple(int(x) for x in b)
+                       for d, b in (bounds or {}).items()}
+        if not self.mesh or any(m < 1 for m in self.mesh):
+            raise MPIError(ERR_ARG, f"bad mesh shape {self.mesh}")
+        used = [s for s in self.spec if s is not None]
+        if len(set(used)) != len(used):
+            raise MPIError(
+                ERR_ARG,
+                f"spec {self.spec} maps one mesh dim to two array dims")
+        for s in used:
+            if not 0 <= s < len(self.mesh):
+                raise MPIError(
+                    ERR_ARG,
+                    f"spec references mesh dim {s}, mesh is {self.mesh}")
+        for d in self.bounds:
+            if d >= len(self.spec) or self.spec[d] is None:
+                raise MPIError(
+                    ERR_ARG,
+                    f"bounds given for unsharded array dim {d}")
+
+    @property
+    def nranks(self) -> int:
+        return int(np.prod(self.mesh))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Layout) and self.mesh == other.mesh
+                and self.spec == other.spec and self.bounds == other.bounds)
+
+    def __hash__(self) -> int:
+        return hash((self.mesh, self.spec,
+                     tuple(sorted(self.bounds.items()))))
+
+    def __repr__(self) -> str:
+        b = f", bounds={self.bounds}" if self.bounds else ""
+        return f"Layout(mesh={self.mesh}, spec={self.spec}{b})"
+
+    # ------------------------------------------------------------ geometry
+    def _check_gshape(self, gshape: Tuple[int, ...]) -> None:
+        if len(gshape) != len(self.spec):
+            raise MPIError(
+                ERR_ARG,
+                f"spec {self.spec} has {len(self.spec)} dims, array "
+                f"shape {gshape} has {len(gshape)}")
+        for d, b in self.bounds.items():
+            p = self.mesh[self.spec[d]]
+            if len(b) != p + 1 or b[0] != 0 or b[-1] != gshape[d] or \
+                    any(b[i] > b[i + 1] for i in range(p)):
+                raise MPIError(
+                    ERR_ARG,
+                    f"bounds {b} for dim {d} must be {p + 1} "
+                    f"monotonic offsets from 0 to {gshape[d]}")
+
+    def dim_bounds(self, gshape: Tuple[int, ...], d: int) -> Tuple[int, ...]:
+        """Shard offsets of array dim ``d`` (len P+1)."""
+        b = self.bounds.get(d)
+        if b is not None:
+            return b
+        p = self.mesh[self.spec[d]]
+        return tuple(i * gshape[d] // p for i in range(p + 1))
+
+    def coords(self, rank: int) -> Tuple[int, ...]:
+        return tuple(int(c) for c in
+                     np.unravel_index(int(rank), self.mesh))
+
+    def rank_of(self, coords: Sequence[int]) -> int:
+        return int(np.ravel_multi_index(tuple(coords), self.mesh))
+
+    def slices(self, gshape: Sequence[int], rank: int) -> Slices:
+        """This rank's global region, one (start, stop) per array dim."""
+        gshape = tuple(int(x) for x in gshape)
+        self._check_gshape(gshape)
+        c = self.coords(rank)
+        out: List[Slice] = []
+        for d, m in enumerate(self.spec):
+            if m is None:
+                out.append((0, gshape[d]))
+            else:
+                b = self.dim_bounds(gshape, d)
+                i = c[m]
+                out.append((b[i], b[i + 1]))
+        return tuple(out)
+
+    def local_shape(self, gshape: Sequence[int],
+                    rank: int) -> Tuple[int, ...]:
+        return tuple(b - a for a, b in self.slices(gshape, rank))
+
+    def replica_dims(self) -> Tuple[int, ...]:
+        """Mesh dims the spec leaves unused (replication dims)."""
+        used = {s for s in self.spec if s is not None}
+        return tuple(m for m in range(len(self.mesh)) if m not in used)
+
+
+class Block(NamedTuple):
+    """One contiguous transfer: global region ``gsl`` moves from rank
+    ``src`` (local coords ``src_sl``) to rank ``dst`` (``dst_sl``)."""
+
+    src: int
+    dst: int
+    src_sl: Slices
+    dst_sl: Slices
+    shape: Tuple[int, ...]
+    nbytes: int
+
+
+def chunk_block(src_sl: Slices, dst_sl: Slices, shape: Tuple[int, ...],
+                itemsize: int, max_bytes: int
+                ) -> Iterator[Tuple[Slices, Slices, Tuple[int, ...]]]:
+    """Split one block into sub-blocks of at most ``max_bytes`` each,
+    greedily along the outermost splittable dim (recursing inward when
+    one outer index still exceeds the budget). A single element larger
+    than the budget is yielded whole — it cannot shrink further. Both
+    endpoints iterate this identically, so chunk sequences stay in
+    lockstep with no negotiation."""
+    nbytes = int(np.prod(shape)) * itemsize if shape else itemsize
+    if nbytes <= max_bytes or not shape:
+        yield src_sl, dst_sl, shape
+        return
+    ax = next((i for i, s in enumerate(shape) if s > 1), None)
+    if ax is None:
+        yield src_sl, dst_sl, shape  # one element: unsplittable
+        return
+    per = int(np.prod(shape[ax + 1:])) * itemsize
+    step = max(1, max_bytes // per) if per <= max_bytes else 1
+    for off in range(0, shape[ax], step):
+        n = min(step, shape[ax] - off)
+        ssl = src_sl[:ax] + ((src_sl[ax][0] + off,
+                              src_sl[ax][0] + off + n),) + src_sl[ax + 1:]
+        dsl = dst_sl[:ax] + ((dst_sl[ax][0] + off,
+                              dst_sl[ax][0] + off + n),) + dst_sl[ax + 1:]
+        sub = shape[:ax] + (n,) + shape[ax + 1:]
+        if n * per > max_bytes:
+            yield from chunk_block(ssl, dsl, sub, itemsize, max_bytes)
+        else:
+            yield ssl, dsl, sub
+
+
+class Plan:
+    """Frozen transfer schedule (see module docstring). ``rounds`` index
+    into ``blocks``; blocks with ``src == dst`` are local copies and
+    appear in no round."""
+
+    __slots__ = ("gshape", "dtype", "src", "dst", "blocks", "rounds",
+                 "classification", "max_inflight")
+
+    def __init__(self, gshape, dtype, src, dst, blocks, rounds,
+                 classification, max_inflight):
+        self.gshape: Tuple[int, ...] = gshape
+        self.dtype = np.dtype(dtype)
+        self.src: Layout = src
+        self.dst: Layout = dst
+        self.blocks: Tuple[Block, ...] = blocks
+        self.rounds: Tuple[Tuple[int, ...], ...] = rounds
+        self.classification: str = classification
+        self.max_inflight: int = max_inflight
+
+    # ------------------------------------------------------------- queries
+    def local_blocks(self, rank: Optional[int] = None) -> List[Block]:
+        return [b for b in self.blocks if b.src == b.dst
+                and (rank is None or b.dst == rank)]
+
+    def remote_blocks(self) -> List[Block]:
+        return [b for b in self.blocks if b.src != b.dst]
+
+    def recv_blocks(self, rank: int) -> List[Block]:
+        return [b for b in self.blocks if b.dst == rank]
+
+    def send_blocks(self, rank: int) -> List[Block]:
+        return [b for b in self.blocks if b.src == rank and b.src != b.dst]
+
+    @property
+    def full_bytes(self) -> int:
+        return int(np.prod(self.gshape)) * self.dtype.itemsize
+
+    @property
+    def bytes_moved(self) -> int:
+        """Cross-rank traffic (local copies excluded)."""
+        return sum(b.nbytes for b in self.remote_blocks())
+
+    def rank_io_bytes(self) -> Tuple[Dict[int, int], Dict[int, int]]:
+        """(send_bytes, recv_bytes) per rank, remote blocks only."""
+        snd: Dict[int, int] = {}
+        rcv: Dict[int, int] = {}
+        for b in self.remote_blocks():
+            snd[b.src] = snd.get(b.src, 0) + b.nbytes
+            rcv[b.dst] = rcv.get(b.dst, 0) + b.nbytes
+        return snd, rcv
+
+    def predicted_peak_staging(self) -> int:
+        """Upper bound on per-rank staging under the chunked p2p
+        lowering: one in-flight send chunk + one recv chunk."""
+        if not self.remote_blocks():
+            return 0
+        biggest = max(b.nbytes for b in self.remote_blocks())
+        return 2 * min(biggest, max(self.max_inflight,
+                                    self.dtype.itemsize))
+
+    def baseline(self) -> Dict[str, int]:
+        """The allgather-then-slice cost this plan replaces: every
+        destination rank materializes the FULL array (peak memory =
+        full-array bytes) and fetches every byte it does not already
+        hold under the source layout (same rank-id space assumed; a
+        rank outside the source space fetches everything)."""
+        full = self.full_bytes
+        moved = 0
+        n = self.src.nranks
+        for d in range(self.dst.nranks):
+            if d < n:
+                own = int(np.prod(self.src.local_shape(self.gshape, d))) \
+                    * self.dtype.itemsize
+            else:
+                own = 0
+            moved += full - own
+        return {"peak_bytes": full, "bytes_moved": moved}
+
+    def describe(self) -> str:
+        snd, rcv = self.rank_io_bytes()
+        base = self.baseline()
+        lines = [
+            f"reshard plan: {self.gshape} {self.dtype.name}  "
+            f"{self.src} -> {self.dst}",
+            f"  classification : {self.classification}",
+            f"  blocks         : {len(self.blocks)} "
+            f"({len(self.remote_blocks())} remote, "
+            f"{len(self.local_blocks())} local) in "
+            f"{len(self.rounds)} p2p round(s)",
+            f"  bytes moved    : {self.bytes_moved:,} "
+            f"(baseline allgather-then-slice: "
+            f"{base['bytes_moved']:,})",
+            f"  peak staging   : <= {self.predicted_peak_staging():,} "
+            f"bytes/rank (baseline: {base['peak_bytes']:,})",
+            f"  max inflight   : {self.max_inflight:,} bytes",
+        ]
+        if snd:
+            hot = max(snd.values())
+            lines.append(f"  busiest sender : {hot:,} bytes "
+                         f"(rank {max(snd, key=lambda r: snd[r])})")
+        return "\n".join(lines)
+
+    def validate(self) -> None:
+        """Invariant check: every destination cell is written exactly
+        once (coverage + no overlap — a per-cell mask, not a count, so
+        an overlap cannot cancel against a gap), block shapes are
+        consistent, and rounds contain each rank at most once per
+        side. O(array cells) — a structural safety net for the CLI and
+        tests, not an executor-path cost."""
+        for b in self.blocks:
+            for (a0, a1), (b0, b1), s in zip(b.src_sl, b.dst_sl, b.shape):
+                if a1 - a0 != s or b1 - b0 != s or s <= 0:
+                    raise MPIError(ERR_ARG,
+                                   f"inconsistent block geometry {b}")
+        for d in range(self.dst.nranks):
+            seen = np.zeros(self.dst.local_shape(self.gshape, d),
+                            dtype=bool)
+            for b in self.recv_blocks(d):
+                sl = tuple(slice(a, b_) for a, b_ in b.dst_sl)
+                if seen[sl].any():
+                    raise MPIError(
+                        ERR_ARG,
+                        f"dst rank {d}: block {b} overlaps an earlier "
+                        "write")
+                seen[sl] = True
+            if not seen.all():
+                raise MPIError(
+                    ERR_ARG,
+                    f"dst rank {d}: {int((~seen).sum())} cell(s) "
+                    "uncovered")
+        for rnd in self.rounds:
+            srcs = [self.blocks[i].src for i in rnd]
+            dsts = [self.blocks[i].dst for i in rnd]
+            if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+                raise MPIError(ERR_ARG, "round reuses a rank")
+
+
+def _overlap_1d(bounds: Tuple[int, ...], lo: int, hi: int) -> range:
+    """Shard indices of ``bounds`` intersecting [lo, hi)."""
+    first = bisect.bisect_right(bounds, lo) - 1
+    first = min(max(first, 0), max(len(bounds) - 2, 0))
+    while first < len(bounds) - 1 and bounds[first + 1] <= lo:
+        first += 1
+    last = first
+    while last < len(bounds) - 2 and bounds[last + 1] < hi:
+        last += 1
+    return range(first, last + 1)
+
+
+def _classify(gshape, src: Layout, dst: Layout,
+              remote: List[Block]) -> str:
+    if src == dst:
+        return "identity"
+    if not remote:
+        return "local"
+    n, m = src.nranks, dst.nranks
+    if n == m and all(s is None for s in dst.spec):
+        return "allgather"
+    s_sharded = [d for d, s in enumerate(src.spec) if s is not None]
+    d_sharded = [d for d, s in enumerate(dst.spec) if s is not None]
+    if (n == m and src.mesh == dst.mesh and len(src.mesh) == 1
+            and len(s_sharded) == 1 and len(d_sharded) == 1
+            and s_sharded != d_sharded
+            and not src.bounds and not dst.bounds
+            and gshape[s_sharded[0]] % n == 0
+            and gshape[d_sharded[0]] % n == 0):
+        return "alltoall"
+    return "general"
+
+
+def compile_plan(gshape: Sequence[int], dtype, src: Layout, dst: Layout,
+                 max_inflight: Optional[int] = None) -> Plan:
+    """Compile the deterministic transfer schedule moving an array of
+    ``gshape``/``dtype`` from layout ``src`` to layout ``dst`` (the two
+    rank spaces are independent: N -> M is first-class). Pure — no
+    communication, no randomness."""
+    import time
+
+    t0 = time.monotonic_ns()
+    gshape = tuple(int(x) for x in gshape)
+    dt = np.dtype(dtype)
+    src._check_gshape(gshape)
+    dst._check_gshape(gshape)
+    if max_inflight is None:
+        max_inflight = int(_max_inflight_var._value)
+    max_inflight = max(int(max_inflight), dt.itemsize)
+
+    if _trace.enabled():
+        with _trace.span("reshard.plan", cat="reshard",
+                         gshape=str(gshape), src=repr(src),
+                         dst=repr(dst)):
+            blocks = _compile_blocks(gshape, dt, src, dst)
+    else:
+        blocks = _compile_blocks(gshape, dt, src, dst)
+
+    remote = [b for b in blocks if b.src != b.dst]
+    rounds = _schedule_rounds(blocks)
+    plan = Plan(gshape, dt, src, dst, tuple(blocks), rounds,
+                _classify(gshape, src, dst, remote), max_inflight)
+    note_plan()
+    if _metrics.enabled():
+        _metrics.observe("reshard_plan_us",
+                         (time.monotonic_ns() - t0) / 1000.0,
+                         cls=plan.classification)
+    return plan
+
+
+def _compile_blocks(gshape, dt, src: Layout, dst: Layout) -> List[Block]:
+    src_sharded = [(d, src.spec[d], src.dim_bounds(gshape, d))
+                   for d in range(len(gshape)) if src.spec[d] is not None]
+    rep_dims = src.replica_dims()
+    rep_sizes = [src.mesh[m] for m in rep_dims]
+    nrep = int(np.prod(rep_sizes)) if rep_dims else 1
+    blocks: List[Block] = []
+    for d in range(dst.nranks):
+        dslab = dst.slices(gshape, d)
+        # cartesian product of overlapping shard indices per sharded dim
+        ranges = [
+            _overlap_1d(b, dslab[ad][0], dslab[ad][1])
+            for ad, _m, b in src_sharded]
+        for combo in _product(ranges):
+            coords: Dict[int, int] = {}
+            degenerate = False
+            for (ad, m, b), i in zip(src_sharded, combo):
+                coords[m] = i
+                lo = max(b[i], dslab[ad][0])
+                hi = min(b[i + 1], dslab[ad][1])
+                if hi <= lo:
+                    degenerate = True
+                    break
+            if degenerate:
+                continue
+            # the replica combo serving this block: spread over the
+            # destination rank so replicated sources share the load
+            rep = d % nrep
+            if rep_dims:
+                for m, c in zip(rep_dims,
+                                np.unravel_index(rep, rep_sizes)):
+                    coords[m] = int(c)
+            s = src.rank_of([coords.get(m, 0)
+                             for m in range(len(src.mesh))])
+            sslab = src.slices(gshape, s)
+            gsl: List[Slice] = []
+            for ad in range(len(gshape)):
+                lo = max(sslab[ad][0], dslab[ad][0])
+                hi = min(sslab[ad][1], dslab[ad][1])
+                gsl.append((lo, hi))
+            shape = tuple(hi - lo for lo, hi in gsl)
+            if any(x <= 0 for x in shape):
+                continue
+            blocks.append(Block(
+                src=s, dst=d,
+                src_sl=tuple((lo - sslab[ad][0], hi - sslab[ad][0])
+                             for ad, (lo, hi) in enumerate(gsl)),
+                dst_sl=tuple((lo - dslab[ad][0], hi - dslab[ad][0])
+                             for ad, (lo, hi) in enumerate(gsl)),
+                shape=shape,
+                nbytes=int(np.prod(shape)) * dt.itemsize))
+    blocks.sort(key=lambda b: (b.dst, b.dst_sl, b.src))
+    return blocks
+
+
+def _product(ranges: List[range]) -> Iterator[Tuple[int, ...]]:
+    if not ranges:
+        yield ()
+        return
+    for i in ranges[0]:
+        for rest in _product(ranges[1:]):
+            yield (i,) + rest
+
+
+def _schedule_rounds(blocks: List[Block]) -> Tuple[Tuple[int, ...], ...]:
+    """Greedy bipartite coloring: per round, each rank sends at most one
+    block and receives at most one (ob1 rendezvous keeps per-pair
+    ordering; the round barrier is implicit in the executor's waits)."""
+    rounds: List[Tuple[set, set, List[int]]] = []
+    for i, b in enumerate(blocks):
+        if b.src == b.dst:
+            continue
+        for srcs, dsts, idxs in rounds:
+            if b.src not in srcs and b.dst not in dsts:
+                srcs.add(b.src)
+                dsts.add(b.dst)
+                idxs.append(i)
+                break
+        else:
+            rounds.append(({b.src}, {b.dst}, [i]))
+    return tuple(tuple(idxs) for _s, _d, idxs in rounds)

@@ -28,7 +28,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from ompi_tpu.core.errors import MPIError, ERR_FILE, ERR_OTHER
+from ompi_tpu.core.errors import MPIError, ERR_FILE
 
 
 # ------------------------------------------------------------ mesh mode
@@ -88,6 +88,27 @@ class MeshCheckpointer:
 _MANIFEST = "MANIFEST.json"
 
 
+def allgather_json(comm, obj) -> list:
+    """JSON allgather over ``comm`` (suppressed from user counters) —
+    the runtime-layer primitive save_ranked's geometry exchange and the
+    reshard package's serve-map agreement both ride."""
+    from ompi_tpu.runtime import spc
+
+    data = json.dumps(obj, sort_keys=True).encode()
+    n = comm.Get_size()
+    lens = np.zeros(n, np.int64)
+    with spc.suppressed():
+        comm.Allgather(np.array([len(data)], np.int64), lens)
+        buf = np.zeros(max(int(lens.sum()), 1), np.uint8)
+        comm.Allgatherv(np.frombuffer(data, np.uint8), buf,
+                        counts=lens.tolist())
+    out, pos = [], 0
+    for ln in lens.tolist():
+        out.append(json.loads(bytes(buf[pos:pos + ln]).decode()))
+        pos += ln
+    return out
+
+
 def _step_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step:010d}")
 
@@ -132,11 +153,21 @@ def save_ranked(comm, directory: str, step: int,
     os.replace(tmp, final)
     with spc.suppressed():
         comm.Barrier()          # phase 1: every rank staged attempt a
+    # per-rank geometry rides the manifest so an elastic N->M restore
+    # (reshard/elastic.py) can plan block reads without opening every
+    # rank file; one small collective — checkpointing is not hot
+    metas = allgather_json(
+        comm, {k: [np.dtype(v.dtype).str, list(np.shape(v))]
+               for k, v in sorted(state.items())})
     if rank == 0:
+        geometry = {
+            k: {"dtype": metas[0][k][0],
+                "shapes": [m.get(k, [None, None])[1] for m in metas]}
+            for k in metas[0]}
         mtmp = os.path.join(d, _MANIFEST + ".tmp")
         with open(mtmp, "w") as f:
             json.dump({"step": step, "size": size, "attempt": a,
-                       "keys": sorted(state)}, f)
+                       "keys": sorted(state), "geometry": geometry}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(mtmp, os.path.join(d, _MANIFEST))
@@ -187,11 +218,16 @@ def restore_ranked(comm, directory: str, step: Optional[int] = None,
     if manifest is None:
         raise MPIError(ERR_FILE, f"step {step} has no committed manifest")
     if rank is None and manifest["size"] != comm.Get_size():
+        # clean geometry error at the manifest layer — without this the
+        # mismatch used to surface as a shape/missing-file error deep in
+        # npz decode. ERR_FILE: the checkpoint's geometry, not the
+        # caller's arguments, is what disagrees.
         raise MPIError(
-            ERR_OTHER,
-            f"checkpoint was taken by {manifest['size']} ranks, "
-            f"restoring with {comm.Get_size()} (repartitioning is the "
-            "application's job)")
+            ERR_FILE,
+            f"checkpoint step {step} was taken by {manifest['size']} "
+            f"ranks but this communicator has {comm.Get_size()}: use "
+            "ompi_tpu.reshard.elastic.restore_elastic for N->M "
+            "repartitioning, or rank= to read one original partition")
     use_rank = comm.Get_rank() if rank is None else int(rank)
     if rank is not None and not 0 <= use_rank < int(manifest["size"]):
         # an out-of-range override would otherwise surface as a missing

