@@ -91,23 +91,27 @@ def _chained_time(world, fn, x, n_iters, rtt):
                1e-9) / n_iters
 
 
-def _chained_pair(world, fn_a, fn_b, x, n_iters, rtt, rounds: int = 3):
+def _chained_pair(world, fn_a, fn_b, x, n_iters, rtt, rounds: int = 3,
+                  b_arg=None):
     """Chained times for two implementations, INTERLEAVED round-by-round
     so slow host-load drift hits both sides equally (the r3 one-then-the-
     other ordering let a load transient skew single fractions to 1.5x on
-    the shared CPU host)."""
+    the shared CPU host). ``b_arg`` feeds fn_b its own input when the two
+    sides live on different meshes (sharing x would hide a reshard inside
+    fn_b's timed program if the mesh constructions ever diverge)."""
     import time as _t
 
+    xb = x if b_arg is None else b_arg
     ca = _chain_fn(world, fn_a, n_iters)
     cb = _chain_fn(world, fn_b, n_iters)
     float(ca(x))  # compile both before any timing
-    float(cb(x))
+    float(cb(xb))
     ta, tb = [], []
     for _ in range(rounds):
         t0 = _t.perf_counter()
         float(ca(x))
         t1 = _t.perf_counter()
-        float(cb(x))
+        float(cb(xb))
         t2 = _t.perf_counter()
         ta.append(t1 - t0)
         tb.append(t2 - t1)
@@ -145,6 +149,87 @@ def bench_allreduce_sweep(world, n):
             "fraction": round(t_raw / t_ours, 4),
         })
     return out
+
+
+def bench_quant_sweep(world, n):
+    """Quantized (block-scaled per the live quant_* cvars, coll/quant)
+    vs fp32 allreduce — the EQuARX headroom probe, same chained-ops
+    methodology as the main sweep. The quantized leg runs on its OWN
+    mesh (``mpi_quant`` axis, its own sharded input via ``b_arg``): the
+    legs negotiate different coll tables, and sharing one mesh/input
+    would either hide a reshard inside the timed program or let one
+    leg's negotiation verdict leak into the other's. ``fraction`` > 1
+    means the quantized program is faster; ``max_err_vs_bound`` < 1
+    proves the measurement input stayed inside the closed-form codec
+    bound. Results mirror into the metrics registry (gauges) so the
+    Prometheus export and the BENCH json agree (the PR 4/6
+    discipline)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ompi_tpu.mca.var import get_var, set_var
+    from ompi_tpu.parallel import mesh_world
+    from ompi_tpu.quant.codec import make_codec
+    from ompi_tpu.runtime import metrics
+
+    saved_enable = get_var("quant", "enable")
+    saved_min_bytes = get_var("quant", "min_bytes")
+    set_var("quant", "enable", True)
+    set_var("quant", "min_bytes", 4096)
+    try:
+        qworld = mesh_world(axis_name="mpi_quant")
+        # both legs must run the path their label claims, or the sweep
+        # silently measures quant-vs-quant (env quant_enable=1 makes the
+        # caller's baseline mesh negotiate quant too) or fp32-vs-fp32
+        # (1-device hosts de-select quant)
+        qprov = qworld.coll.providers.get("allreduce")
+        if qprov != "quant":
+            return [{"skipped": f"quant path unavailable "
+                                f"(allreduce provider={qprov!r})"}]
+        if world.coll.providers.get("allreduce") == "quant":
+            set_var("quant", "enable", False)
+            world = mesh_world(axis_name="mpi_fp32")
+            set_var("quant", "enable", True)
+        # the quantized leg negotiates its codec from the live cvars
+        # (env/mca-params may override the defaults) — the bound must be
+        # computed against that SAME codec or err_vs_bound lies
+        codec = make_codec(get_var("quant", "mode"),
+                           get_var("quant", "bits"),
+                           get_var("quant", "block"))
+        rtt = _rtt(world)
+        rng = np.random.RandomState(0)
+        out = []
+        for nbytes in (1 << 16, 1 << 20, 1 << 24):
+            per_rank = max(nbytes // 4, 1)
+            xs = (rng.randn(n, per_rank) * 3).astype(np.float32)
+            x = world.shard(jnp.asarray(xs))
+            xq = qworld.shard(jnp.asarray(xs))
+            iters = 60 if nbytes <= (1 << 20) else 12
+            # accuracy first (one un-chained dispatch)
+            res = np.asarray(qworld.allreduce(xq))[0].astype(np.float64)
+            err = np.abs(res - xs.astype(np.float64).sum(axis=0))
+            bound = codec.error_bound(xs)
+            rel = float(np.max(err / np.maximum(bound, 1e-300)))
+            t_fp32, t_q = _chained_pair(world, world.allreduce,
+                                        qworld.allreduce, x, iters, rtt,
+                                        b_arg=xq)
+            row = {
+                "bytes": per_rank * 4,
+                "fp32_s": round(t_fp32, 6),
+                "quant_s": round(t_q, 6),
+                "fraction": round(t_fp32 / t_q, 4),
+                "max_err_vs_bound": round(rel, 4),
+            }
+            out.append(row)
+            metrics.gauge_set("bench_quant_fraction", row["fraction"],
+                              bytes=row["bytes"])
+            metrics.gauge_set("bench_quant_err_vs_bound", rel,
+                              bytes=row["bytes"])
+        return out
+    finally:
+        set_var("quant", "enable", saved_enable)
+        set_var("quant", "min_bytes", saved_min_bytes)
 
 
 def bench_dispatch_tax(world):
@@ -510,6 +595,7 @@ def _cpu_mesh_child() -> int:
     out = {
         "collective_device": f"cpu-mesh-{n} (virtual)",
         "allreduce_sweep": bench_allreduce_sweep(world, n),
+        "quant_allreduce_sweep": bench_quant_sweep(world, n),
         "verbs": bench_verbs(world, n),
     }
     print(json.dumps(out))
@@ -616,6 +702,7 @@ def main() -> int:
         world = mesh_world(devices)
         detail["collective_device"] = detail["devices"][0]
         detail["allreduce_sweep"] = bench_allreduce_sweep(world, n)
+        detail["quant_allreduce_sweep"] = bench_quant_sweep(world, n)
         detail["verbs"] = bench_verbs(world, n)
         detail["dispatch_tax"] = bench_dispatch_tax(world)
     else:

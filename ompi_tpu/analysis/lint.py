@@ -13,8 +13,9 @@ hot-guard                 in hot modules (parallel/mesh.py, pml/ob1.py,
                           coll/xla.py, runtime/progress.py) every trace/
                           sanitizer/metrics instrumentation call — and every
                           ft/inject.py chaos hook, ft/diskless.py
-                          replication hook, and reshard/ accounting
-                          hook (framework code allowed on
+                          replication hook, reshard/ accounting
+                          hook, and quant/ codec-accounting hook
+                          (framework code allowed on
                           the wire path) — sits behind a live-Var
                           guard: ``X.enabled()`` / ``X._enable_var._value`` (or
                           a local name assigned from one) — context-manager
@@ -92,9 +93,13 @@ HOT_MODULES = {
 VERB_LAYER_DIRS = ("comm/", "parallel/")
 ENVIRON_EXEMPT = ("mca/var.py", "tools/")
 # the instrumentation implementations themselves (they define the guards)
+# — for the quant plane that is ONLY quant/__init__.py (it owns the
+# note_coll/note_wire hooks); codec/negotiate/coll-quant/btl-tcp are
+# the plane those hooks instrument and keep full span-ctx coverage
 INSTR_IMPL = ("runtime/trace.py", "runtime/sanitizer.py", "runtime/spc.py",
               "runtime/metrics.py", "ft/inject.py", "ft/diskless.py",
-              "reshard/plan.py", "reshard/exec.py", "reshard/elastic.py")
+              "reshard/plan.py", "reshard/exec.py", "reshard/elastic.py",
+              "quant/__init__.py")
 
 TRACE_ALIASES = {"trace", "_trace", "_tr"}
 SAN_ALIASES = {"sanitizer", "_san", "_sanitizer"}
@@ -110,6 +115,9 @@ DISKLESS_ALIASES = {"diskless", "_diskless"}
 # reshard/ accounting hooks (plan/exec pvar + spc bumps): a reshard
 # note reached from hot code rides the same live-Var guard contract
 RESHARD_ALIASES = {"reshard", "_reshard", "_rs"}
+# quant/ codec-accounting hooks (quantized-collective byte counters and
+# the btl compress counters): same contract in hot modules
+QUANT_ALIASES = {"quant", "_quant", "_qc"}
 INSTR_TRACE_ATTRS = {"span", "record_span", "instant", "counter",
                      "wrap_span"}
 INSTR_SAN_ATTRS = {"wrap_coll", "on_collective", "check_p2p",
@@ -119,6 +127,7 @@ INSTR_METRICS_ATTRS = {"on_coll_entry", "observe", "ewma_update",
                        "gauge_set"}
 INSTR_DISKLESS_ATTRS = {"save", "flush_final", "attach"}
 INSTR_RESHARD_ATTRS = {"note_plan", "note_exec"}
+INSTR_QUANT_ATTRS = {"note_coll", "note_wire"}
 
 _SUPPRESS_RE = re.compile(r"#\s*mpilint:\s*disable=([A-Za-z0-9_,\- ]+)")
 
@@ -228,6 +237,9 @@ def _instr_call(node: ast.AST) -> Optional[str]:
             if v.id in RESHARD_ALIASES and \
                     node.func.attr in INSTR_RESHARD_ATTRS:
                 return "reshard"
+            if v.id in QUANT_ALIASES and \
+                    node.func.attr in INSTR_QUANT_ATTRS:
+                return "quant"
     return None
 
 
@@ -623,6 +635,7 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
 # --self-test` lints each and verifies its rule fires.
 SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
     "hot-guard": ("ompi_tpu/pml/ob1.py", """
+from ompi_tpu import quant as _quant
 from ompi_tpu.ft import diskless as _diskless
 from ompi_tpu.ft import inject as _inject
 from ompi_tpu.reshard import exec as _reshard
@@ -634,6 +647,7 @@ def isend(self, dst):
     _metrics.observe("pml_send_latency_us", 1.0, peer=dst)
     _diskless.flush_final(0.1)
     _reshard.note_exec(1, 2)
+    _quant.note_wire(4096, 512)
     with _trace.span("pml.send", cat="pml"):
         return self._isend(dst)
 """),

@@ -11,6 +11,22 @@ Frame format: [u32 total_len][header HDR_SIZE bytes][payload]. One frame
 per pml message/fragment; TCP ordering per connection preserves MPI
 ordering per peer (the reference's per-peer seq numbers guard reordering
 across *multiple* btls; with one link per peer ordering is structural).
+
+On-wire compression (``btl_tcp_compress`` = zlib level 1-9, 0 = off):
+large rendezvous payloads (>= ``btl_tcp_compress_min_bytes``) go out
+zlib-deflated with the top bit of the length word flagging the frame;
+the header stays plaintext so frame parsing is unchanged. The framing
+is negotiated per connection during the rank handshake — a capability
+bit meaning "I can DECODE flagged frames" rides the connector's rank
+word (advertised unconditionally by this build, so engagement never
+depends on which side dialed first) and the acceptor answers with an
+ack word. A peer launched with ``btl_tcp_compress`` unset still
+decodes. Forward-compat scope: a build WITHOUT this framing is safe as
+the CONNECTOR (its bare rank word parses unchanged here, it never
+advertises, and no flagged frame or ack is ever emitted toward it);
+dialing such a build is NOT supported — its acceptor would parse the
+capability bit as part of the rank. All ranks of one job run one
+build, so the one-directional guarantee covers the real topology.
 """
 
 from __future__ import annotations
@@ -23,12 +39,13 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Callable, Dict, Optional, Tuple
 
 from ompi_tpu.btl.base import Btl, btl_framework
 from ompi_tpu.ft import inject as _inject
 from ompi_tpu.mca.component import Component
-from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.mca.var import register_var, register_pvar, get_var
 from ompi_tpu.pml.base import HDR_SIZE
 from ompi_tpu.utils.output import get_logger
 
@@ -55,12 +72,53 @@ register_var("btl_tcp", "bind_host", "",
              help="Interface to bind/advertise (empty=auto; "
                   "reference: btl_tcp_if_*)",
              level=4)
+_compress_var = register_var(
+    "btl_tcp", "compress", 0,
+    help="zlib level (1-9) for on-wire payload compression of frames "
+         "at or above btl_tcp_compress_min_bytes; 0 (default) = off. "
+         "Negotiated per connection during the rank handshake, so a "
+         "non-compressing peer interops (it simply never receives a "
+         "compressed frame)", level=4)
+_compress_min_var = register_var(
+    "btl_tcp", "compress_min_bytes", 1 << 16,
+    help="Payload bytes below which frames are never compressed (the "
+         "deflate cost beats the wire saving on small/eager traffic; "
+         "the default targets rendezvous DATA fragments)", level=5)
 
 _LEN = struct.Struct("<I")
 
+# rank-handshake capability bit + frame compression flag: both ride the
+# top bit of their u32 word (ranks and frame lengths stay < 2^31)
+_CAP_COMPRESS = 1 << 31
+_ZFLAG = 1 << 31
+_LEN_MASK = _ZFLAG - 1
+# acceptor's handshake ack: magic in the high byte + capability bits
+_ZACK_MAGIC = 0x5A << 24
+_ZACK_ACCEPT = 1
+
+
+def _compress_counters():
+    """Wire-compression counters live in the quant plane (one
+    observable subsystem for both reduced-precision paths)."""
+    from ompi_tpu import quant
+
+    return quant.counters()
+
+
+register_pvar("btl_tcp", "compress_ratio",
+              lambda: (lambda c: round(c["wire_raw"] / c["wire_comp"], 4)
+                       if c["wire_comp"] else 0.0)(_compress_counters()),
+              help="Cumulative raw/compressed payload-byte ratio over "
+                   "frames that went out zlib-compressed")
+register_pvar("btl_tcp", "compress_saved_bytes",
+              lambda: (lambda c: c["wire_raw"] - c["wire_comp"])(
+                  _compress_counters()),
+              help="Payload bytes kept off the wire by tcp compression")
+
 
 class _Conn:
-    __slots__ = ("sock", "rbuf", "wbuf", "wlock", "peer", "dead")
+    __slots__ = ("sock", "rbuf", "wbuf", "wlock", "peer", "dead",
+                 "peer_z", "await_ack")
 
     def __init__(self, sock: socket.socket, peer: Optional[int] = None):
         self.sock = sock
@@ -73,6 +131,14 @@ class _Conn:
         self.wlock = threading.RLock()
         self.peer = peer
         self.dead: Optional[OSError] = None
+        # negotiated at handshake: True once the peer advertised it
+        # understands (and accepts) zlib-flagged frames on this link
+        self.peer_z = False
+        # connector side: an ack word is due before frame traffic; it is
+        # consumed ASYNCHRONOUSLY by _drain (a blocking wait here could
+        # deadlock two polling-only ranks dialing each other — each
+        # stuck in its own handshake, neither accepting)
+        self.await_ack = False
 
 
 class TcpBtl(Btl):
@@ -173,9 +239,21 @@ class TcpBtl(Btl):
                 # beyond the 30s bound the deadline exists to keep
                 time.sleep(min(delay, left))
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # identify ourselves so the acceptor can map conn -> rank
-        s.sendall(_LEN.pack(self.my_rank))
         conn = _Conn(s, peer)
+        # identify ourselves so the acceptor can map conn -> rank. The
+        # capability bit means "I can DECODE zlib-flagged frames" (every
+        # build with this code can), NOT "I will compress" — advertising
+        # it unconditionally keeps engagement symmetric: whether a
+        # compress-enabled peer may flag frames to us must not depend on
+        # which side happened to dial first (gating the bit on our own
+        # compress level silently disabled the feature whenever the
+        # compress=0 side connected first). The acceptor answers with an
+        # ack word, consumed asynchronously by _drain — sends stay
+        # uncompressed on this link until it lands, so a peer that never
+        # acks (a build without this framing) simply keeps the link at
+        # plain framing.
+        s.sendall(_LEN.pack(self.my_rank | _CAP_COMPRESS))
+        conn.await_ack = True
         s.setblocking(False)
         with self._sel_lock:
             self.sel.register(s, selectors.EVENT_READ, ("peer", conn))
@@ -195,6 +273,20 @@ class TcpBtl(Btl):
         opportunistically, otherwise from progress()). Never blocks the
         caller on a full socket — the head-to-head large-send deadlock the
         reference's pending-frag design exists to avoid."""
+        if not isinstance(payload, (bytes, bytearray)):
+            payload = bytes(memoryview(payload))
+        if HDR_SIZE + len(payload) > _LEN_MASK:
+            # bit 31 of the length word carries the compression flag,
+            # so one frame tops out at 2 GiB; beyond it the receiver
+            # would mask a wrong length AND misparse the frame as
+            # compressed — fail loudly here instead (callers shipping
+            # blobs that large must split them)
+            from ompi_tpu.core.errors import MPIError, ERR_OTHER
+
+            raise MPIError(
+                ERR_OTHER,
+                f"tcp frame of {HDR_SIZE + len(payload)} bytes exceeds "
+                f"the {_LEN_MASK}-byte framing limit")
         dup = False
         if _inject._enable_var._value:  # chaos wire hook (ft/inject.py)
             verdict = _inject.wire_send(self.my_rank, peer)
@@ -208,9 +300,19 @@ class TcpBtl(Btl):
                     return
                 dup = bool(verdict & _inject.DUP)
         conn = self._get_conn(peer)
-        if not isinstance(payload, (bytes, bytearray)):
-            payload = bytes(memoryview(payload))
-        frame = _LEN.pack(HDR_SIZE + len(payload)) + header + payload
+        zflag = 0
+        level = int(_compress_var._value)  # one live-Var load when off
+        if level > 0 and conn.peer_z and \
+                len(payload) >= int(_compress_min_var._value):
+            z = zlib.compress(payload, level)
+            if len(z) < len(payload):  # incompressible data stays raw
+                from ompi_tpu import quant as _quant
+
+                _quant.note_wire(len(payload), len(z))
+                payload = z
+                zflag = _ZFLAG
+        frame = _LEN.pack((HDR_SIZE + len(payload)) | zflag) \
+            + header + payload
         with conn.wlock:
             # dead-check under wlock: _conn_failed flips dead/clears wbuf
             # under the same lock, so a frame can't slip past the check
@@ -329,8 +431,24 @@ class TcpBtl(Btl):
             if not chunk:
                 return 0
             raw += chunk
-        peer = _LEN.unpack(raw)[0]
+        word = _LEN.unpack(raw)[0]
+        peer = word & ~_CAP_COMPRESS
         conn = _Conn(s, peer)
+        if word & _CAP_COMPRESS:
+            # the connector understands zlib-flagged frames; answer with
+            # our ack so it knows we do too (decoding is always
+            # available in this build — acceptance is unconditional)
+            conn.peer_z = True
+            try:
+                s.sendall(_LEN.pack(_ZACK_MAGIC | _ZACK_ACCEPT))
+            except OSError:
+                # the dialer died mid-handshake; under PR 3's connect
+                # retry it will redial — close or each attempt leaks a fd
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                return 0
         s.setblocking(False)
         with self._conn_lock:
             # keep one canonical conn per peer for sending; both sides may
@@ -370,14 +488,43 @@ class TcpBtl(Btl):
         n = 0
         buf = conn.rbuf
         off = 0
+        if conn.await_ack and len(buf) >= 4:
+            # the compress-handshake ack leads every frame on a dialed
+            # link. Match the FULL word (magic byte + reserved-zero
+            # bits + accept bit), not just the high byte: a non-acking
+            # peer's first frame could legally be ~1.41 GiB long under
+            # the 2 GiB cap, and a high-byte-only match would eat its
+            # length word and desync the whole stream
+            word = _LEN.unpack_from(buf, 0)[0]
+            conn.await_ack = False
+            if word in (_ZACK_MAGIC, _ZACK_MAGIC | _ZACK_ACCEPT):
+                conn.peer_z = bool(word & _ZACK_ACCEPT)
+                off = 4
         while len(buf) - off >= 4:
-            total = _LEN.unpack_from(buf, off)[0]
+            word = _LEN.unpack_from(buf, off)[0]
+            total = word & _LEN_MASK
             if len(buf) - off - 4 < total:
                 break
             start = off + 4
             hdr = bytes(buf[start : start + HDR_SIZE])
             payload = bytes(buf[start + HDR_SIZE : start + total])
             off += 4 + total
+            if word & _ZFLAG:
+                # negotiated framing: only a handshake-capable peer ever
+                # sets the flag, so this build always knows how to undo
+                # it. A decompress failure means stream integrity is
+                # gone — silently dropping the frame would leave the
+                # pml's per-peer sequence waiting forever on a hole, so
+                # fail the LINK and let the PR 3 failover/dead-letter
+                # machinery take over (same contract as a read error)
+                try:
+                    payload = zlib.decompress(payload)
+                except zlib.error as e:
+                    self.log.exception("corrupt compressed frame")
+                    self._conn_failed(conn, OSError(
+                        f"corrupt compressed frame from rank "
+                        f"{conn.peer}: {e}"))
+                    return n
             # A frame handler may itself send (ob1 replies with CTS/DATA
             # from inside deliver); if that send hits a dead peer the
             # MPIError must not escape — it would skip the rbuf trim below

@@ -69,6 +69,13 @@ class CollTable:
     def __init__(self):
         self.slots = {}
         self.providers = {}  # op -> component name, for introspection
+        # op -> the next-best module's fn for slots a higher-priority
+        # module won (reference keeps the whole priority-ordered module
+        # list on the comm; conditional components — coll/quant — route
+        # ineligible calls here so winning a slot can't silently
+        # downgrade the rest of the traffic to tuned/basic)
+        self.fallbacks = {}
+        self.fallback_providers = {}  # op -> component name, ditto
 
     def get(self, op: str):
         fn = self.slots.get(op)
@@ -96,10 +103,14 @@ def _select_coll(comm) -> CollTable:
     for prio, name, module in modules:
         module.enable(comm)
         for op in COLL_OPS:
-            if op in table.slots:
-                continue
             fn = getattr(module, op, None)
-            if fn is not None:
+            if fn is None:
+                continue
+            if op in table.slots:
+                if op not in table.fallbacks:
+                    table.fallbacks[op] = fn
+                    table.fallback_providers[op] = name
+            else:
                 table.slots[op] = fn
                 table.providers[op] = name
     return table

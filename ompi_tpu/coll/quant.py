@@ -1,0 +1,361 @@
+"""coll/quant — block-scaled quantized collectives component.
+
+Reference direction: EQuARX (arxiv 2506.17615) — near-2x XLA allreduce
+speedups from block-scaled quantization with negligible quality loss —
+packaged as a composable coll component (HiCCL's layering argument,
+arxiv 2408.05962) so the existing per-communicator selection stack
+picks it per message class instead of hard-wiring one verb.
+
+Selection (the no-torn-collective invariant): the component queries the
+*negotiated* per-communicator verdict (quant/negotiate.py — a pure
+function over modex cards every member shares), never the local cvar.
+A rank launched without ``quant_enable`` therefore de-selects the
+module on EVERY rank; the disabled path costs nothing because the slot
+stays with tuned/basic. With ``quant_strict``, a config mismatch keeps
+the module selected in an error-armed state that raises the SAME
+MPIError on every rank's quant-eligible call — mismatch surfaces as a
+clean error, not a hang.
+
+Two modules:
+
+- :class:`QuantProcColl` (process mode) — quantize -> flat
+  reduce-scatter exchange -> requantize -> allgather over the existing
+  sched round machinery (coll/sched.py) in the collective CID plane.
+  Accumulation is in ascending rank order and rounding is
+  round-to-nearest-even, so results are bitwise-deterministic for a
+  fixed (world, block, bits, mode) config and bitwise-identical to
+  ``codec.simulate_allreduce``.
+- :class:`QuantXlaColl` (mesh mode) — lowers to the jnp-native
+  block-scaled body in coll/xla.py (``quant_allreduce_body``) so the
+  compiled path stays ONE XLA program; the executable lands in the
+  communicator's ``_jit_cache`` under the standard allreduce key, so
+  XlaComm's resolved fast table serves it with the unchanged
+  one-dict-hit prologue.
+
+Ineligible calls (integer/pair dtypes, non-SUM ops, payloads under the
+negotiated ``quant_min_bytes``) delegate to the module that would own
+the slot had quant not been selected (``CollTable.fallbacks`` — e.g.
+coll/sm on a single node, han across nodes, tuned otherwise) — which
+also keeps every library-internal collective (CID agreement, Split's
+allgather) exact.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ompi_tpu.coll.base import CollModule, coll_framework
+from ompi_tpu.coll.basic import COLL_CID_BIT
+from ompi_tpu.coll.sched import Round, run_blocking
+from ompi_tpu.comm.communicator import parse_buffer
+from ompi_tpu.core import op as _op
+from ompi_tpu.core.convertor import pack as cv_pack, unpack as cv_unpack
+from ompi_tpu.core.errors import MPIError, ERR_UNSUPPORTED_OPERATION
+from ompi_tpu.mca.component import Component
+from ompi_tpu.quant import negotiate as _negotiate
+from ompi_tpu.quant import note_coll as _note_coll
+from ompi_tpu.quant.codec import _work_dtype, chunk_layout
+from ompi_tpu.runtime import trace as _trace
+
+TAG_QUANT = -35  # dedicated tag inside the collective CID plane
+
+_QUANT_DTYPES = (np.dtype(np.float16), np.dtype(np.float32),
+                 np.dtype(np.float64))
+
+
+class QuantProcColl(CollModule):
+    """Quantized allreduce / reduce_scatter_block / allgather for
+    process-mode communicators; everything ineligible delegates."""
+
+    def _delegate(self, comm, op_name: str):
+        """Ineligible calls run on the module that would own this slot
+        had quant not been selected (CollTable.fallbacks — smcoll/han/
+        adaptive outrank tuned, so hard-wiring tuned here would
+        silently downgrade every non-quantized collective on a
+        quant-negotiated communicator). coll/basic provides every op,
+        so a runner-up is always recorded for any slot quant won."""
+        return comm.coll.fallbacks[op_name]
+
+    # ------------------------------------------------------- eligibility
+    @staticmethod
+    def _eligible(st, dt, nbytes: int, op: Optional[_op.Op]) -> bool:
+        if dt.np_dtype is None or dt.np_dtype not in _QUANT_DTYPES:
+            return False
+        if nbytes < st.min_bytes:
+            return False
+        if op is not None and not (op.name == "MPI_SUM" and op.commutative):
+            return False
+        return True
+
+    @staticmethod
+    def _check_armed(comm, st) -> None:
+        if not st.active:
+            # strict-armed negotiation failure: the SAME verdict (and
+            # the same call-site eligibility) on every rank makes this
+            # raise symmetric — a clean error instead of a torn hang
+            raise MPIError(
+                ERR_UNSUPPORTED_OPERATION,
+                f"quantized collectives requested on '{comm.name}' but "
+                f"negotiation failed under quant_strict: {st.reason}")
+
+    def _run(self, comm, gen, span: str) -> None:
+        if _trace.enabled():
+            with _trace.span(span, cat="coll", comm=comm.name):
+                run_blocking(comm, gen, TAG_QUANT,
+                             comm.cid | COLL_CID_BIT)
+        else:
+            run_blocking(comm, gen, TAG_QUANT, comm.cid | COLL_CID_BIT)
+
+    # --------------------------------------------------------- allreduce
+    def allreduce(self, comm, sendbuf, recvbuf, op: _op.Op) -> None:
+        st = comm._quant_state
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        if not self._eligible(st, rdt, rcount * rdt.size, op):
+            return self._delegate(comm, "allreduce")(
+                comm, sendbuf, recvbuf, op)
+        self._check_armed(comm, st)
+        src = recvbuf if sendbuf is None else sendbuf  # IN_PLACE
+        sobj, scount, sdt = parse_buffer(src)
+        x = np.ascontiguousarray(
+            cv_pack(sobj, scount, sdt)).view(sdt.np_dtype)
+        n, r = comm.size, comm.rank
+        codec = st.codec
+        wdt = _work_dtype(rdt.np_dtype)
+        per, padded = chunk_layout(rcount, n, codec.block)
+        buf = np.zeros(padded, dtype=wdt)
+        buf[:rcount] = x
+        chunks = buf.reshape(n, per)
+        wire = codec.wire_nbytes(per)
+        enc_own = [codec.encode(chunks[j]) for j in range(n)]
+        peers = [j for j in range(n) if j != r]
+        pidx = {p: k for k, p in enumerate(peers)}  # O(1) recv lookup
+        out: List[Optional[np.ndarray]] = [None]
+
+        def sched():
+            got = yield Round(
+                sends=[(enc_own[j], j) for j in peers],
+                recvs=[(wire, j) for j in peers])
+            # reduce chunk r: every contribution quantized (own included,
+            # so all ranks dequantize identical values), ascending rank
+            # order — the codec.simulate_allreduce contract, bitwise
+            enc = [enc_own[r] if i == r else got[pidx[i]]
+                   for i in range(n)]
+            red = codec.reduce_encoded(enc, per, wdt)
+            enc_red = codec.encode(red)
+            got2 = yield Round(
+                sends=[(enc_red, j) for j in peers],
+                recvs=[(wire, j) for j in peers])
+            res = np.empty(padded, dtype=wdt)
+            for i in range(n):
+                payload = enc_red if i == r else got2[pidx[i]]
+                res[i * per:(i + 1) * per] = codec.decode(payload, per,
+                                                          wdt)
+            out[0] = res
+
+        self._run(comm, sched(), "coll.quant.allreduce")
+        # raw baseline = what a full-precision schedule would move:
+        # UNPADDED ceil(rcount/n) per chunk (counting the block padding
+        # would inflate quant_bytes_saved)
+        raw = 2 * len(peers) * (-(-rcount // n)) * rdt.size
+        _note_coll("allreduce", raw, 2 * len(peers) * wire)
+        res = out[0][:rcount].astype(rdt.np_dtype)
+        cv_unpack(np.ascontiguousarray(res).view(np.uint8),
+                  robj, rcount, rdt)
+
+    # ------------------------------------------------ reduce_scatter_block
+    def reduce_scatter_block(self, comm, sendbuf, recvbuf,
+                             op: _op.Op) -> None:
+        st = comm._quant_state
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        n, r = comm.size, comm.rank
+        if sendbuf is None or not self._eligible(
+                st, rdt, n * rcount * rdt.size, op):
+            return self._delegate(comm, "reduce_scatter_block")(
+                comm, sendbuf, recvbuf, op)
+        self._check_armed(comm, st)
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        x = np.ascontiguousarray(
+            cv_pack(sobj, scount, sdt)).view(sdt.np_dtype)
+        codec = st.codec
+        wdt = _work_dtype(rdt.np_dtype)
+        wire = codec.wire_nbytes(rcount)
+        enc_own = [codec.encode(
+            x[j * rcount:(j + 1) * rcount].astype(wdt, copy=False))
+            for j in range(n)]
+        peers = [j for j in range(n) if j != r]
+        pidx = {p: k for k, p in enumerate(peers)}
+        out: List[Optional[np.ndarray]] = [None]
+
+        def sched():
+            got = yield Round(
+                sends=[(enc_own[j], j) for j in peers],
+                recvs=[(wire, j) for j in peers])
+            enc = [enc_own[r] if i == r else got[pidx[i]]
+                   for i in range(n)]
+            out[0] = codec.reduce_encoded(enc, rcount, wdt)
+
+        self._run(comm, sched(), "coll.quant.reduce_scatter")
+        _note_coll("reduce_scatter_block", len(peers) * rcount * rdt.size,
+                   len(peers) * wire)
+        res = out[0][:rcount].astype(rdt.np_dtype)
+        cv_unpack(np.ascontiguousarray(res).view(np.uint8),
+                  robj, rcount, rdt)
+
+    # --------------------------------------------------------- allgather
+    def allgather(self, comm, sendbuf, recvbuf) -> None:
+        st = comm._quant_state
+        robj, rcount, rdt = parse_buffer(recvbuf)
+        # gate on THIS rank's contribution (rcount is the total recv
+        # surface, world x that) — the min_bytes cvar reasons about the
+        # per-message wire cost, same as allreduce's per-rank payload
+        if sendbuf is None or not self._eligible(
+                st, rdt, rcount * rdt.size // comm.size, None):
+            return self._delegate(comm, "allgather")(
+                comm, sendbuf, recvbuf)
+        self._check_armed(comm, st)
+        sobj, scount, sdt = parse_buffer(sendbuf)
+        x = np.ascontiguousarray(
+            cv_pack(sobj, scount, sdt)).view(sdt.np_dtype)
+        n, r = comm.size, comm.rank
+        codec = st.codec
+        wdt = _work_dtype(rdt.np_dtype)
+        wire = codec.wire_nbytes(scount)
+        enc = codec.encode(x.astype(wdt, copy=False))
+        peers = [j for j in range(n) if j != r]
+        pidx = {p: k for k, p in enumerate(peers)}
+        out: List[Optional[np.ndarray]] = [None]
+
+        def sched():
+            got = yield Round(sends=[(enc, j) for j in peers],
+                              recvs=[(wire, j) for j in peers])
+            res = np.empty(n * scount, dtype=wdt)
+            for i in range(n):
+                payload = enc if i == r else got[pidx[i]]
+                res[i * scount:(i + 1) * scount] = codec.decode(
+                    payload, scount, wdt)
+            out[0] = res
+
+        self._run(comm, sched(), "coll.quant.allgather")
+        _note_coll("allgather", len(peers) * scount * rdt.size,
+                   len(peers) * wire)
+        res = out[0][:rcount].astype(rdt.np_dtype)
+        cv_unpack(np.ascontiguousarray(res).view(np.uint8),
+                  robj, rcount, rdt)
+
+
+class QuantXlaColl(CollModule):
+    """Mesh-mode quantized allreduce: one compiled XLA program via the
+    block-scaled body in coll/xla.py. Only the allreduce slot is
+    provided — every other verb falls through to the xla component."""
+
+    def __init__(self):
+        from ompi_tpu.coll.xla import XlaColl
+
+        self._xla = XlaColl()
+
+    def allreduce(self, comm, x, op: _op.Op = _op.SUM):
+        from ompi_tpu.coll.xla import (
+            _check_device_op,
+            cache_key,
+            quant_allreduce_body,
+        )
+
+        st = comm._quant_state
+        _check_device_op(op, x)
+        # the key carries a "quant" discriminator: XlaColl.reduce shares
+        # the PLAIN allreduce executable under cache_key("allreduce", op)
+        # on this same comm, so reusing that key would make which body
+        # runs (quantized vs exact) depend on reduce/allreduce call
+        # order. XlaComm._allreduce_slow promotes this key into the fast
+        # table when present.
+        key = cache_key("allreduce", op, extra=("quant",))
+
+        def build():
+            plain = self._xla._allreduce_body(comm, op)
+            body = quant_allreduce_body(comm, plain, op, st.mode,
+                                        st.block, st.min_bytes)
+            import jax
+            import jax.numpy as jnp
+
+            fn = self._xla._wrap(comm, body)
+            _Tracer = jax.core.Tracer
+            W = comm.world_size
+            is_psum = op.jax_kind == "psum" and comm.groups is None
+            codec = st.codec
+            min_bytes = st.min_bytes
+
+            def counted(b, _fn=fn):
+                # rides the fast table too (_promote installs this
+                # wrapper), so quant_colls/bytes pvars track the mesh
+                # path live; only quant-negotiated comms pay it and the
+                # mirror of the trace-time eligibility test keeps the
+                # counters honest about which calls actually quantized
+                out = _fn(b)
+                try:
+                    if isinstance(b, _Tracer):
+                        # under an outer jit/scan this wrapper runs once
+                        # at trace time while the collective executes per
+                        # call — counting here would be wrong in both
+                        # directions, so traced calls go unaccounted
+                        return out
+                    n = b.size // W
+                    item = b.dtype.itemsize
+                    # jnp.issubdtype, NOT np: the traced body gates on
+                    # jnp's lattice, where bfloat16 IS floating —
+                    # np.issubdtype says it isn't, so bf16 calls would
+                    # quantize on the wire yet never be counted
+                    if (is_psum and W >= 2
+                            and jnp.issubdtype(b.dtype, jnp.floating)
+                            and n * item >= min_bytes):
+                        per, _ = chunk_layout(n, W, codec.block)
+                        wire = codec.wire_nbytes(per)
+                        # whole-mesh accounting (single controller =
+                        # every rank): each of W ranks exchanges
+                        # 2*(W-1) chunks (reduce-scatter + allgather);
+                        # the raw baseline counts UNPADDED chunks
+                        _note_coll("allreduce", 2 * W * (W - 1)
+                                   * (-(-n // W)) * item,
+                                   2 * W * (W - 1) * wire)
+                except (AttributeError, TypeError):
+                    pass  # tracers/unsized inputs: skip accounting
+                return out
+
+            return counted
+
+        return self._xla._dispatch(comm, key, build, x)
+
+
+class QuantCollComponent(Component):
+    NAME = "quant"
+    PRIORITY = 110  # above xla (100) and tuned (30): owns its slots
+    # only where the NEGOTIATED verdict selected it
+
+    _proc: Optional[QuantProcColl] = None
+    _mesh: Optional[QuantXlaColl] = None
+
+    def query(self, comm=None, **ctx):
+        from ompi_tpu.comm.communicator import ProcComm
+
+        if isinstance(comm, ProcComm) and comm.size > 1:
+            st = _negotiate.for_proc_comm(comm)
+            if st.active or st.strict:
+                comm._quant_state = st
+                if QuantCollComponent._proc is None:
+                    QuantCollComponent._proc = QuantProcColl()
+                return QuantCollComponent._proc
+            return None
+        from ompi_tpu.parallel.mesh import XlaComm
+
+        if isinstance(comm, XlaComm):
+            st = _negotiate.for_mesh_comm(comm)
+            if st.active:
+                comm._quant_state = st
+                if QuantCollComponent._mesh is None:
+                    QuantCollComponent._mesh = QuantXlaColl()
+                return QuantCollComponent._mesh
+        return None
+
+
+coll_framework.register(QuantCollComponent())

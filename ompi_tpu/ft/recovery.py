@@ -165,6 +165,12 @@ def _recover(comm, checkpoint_dir, step, policy, command, args, spc,
     comm.Revoke()
     _agree_survivors(comm)
     shrunk = comm.Shrink()
+    # world membership is changing: stale cached quant cards (a dead
+    # rank's, or a respawned replacement's predecessor's) would split
+    # the per-communicator codec verdict across survivors
+    from ompi_tpu.quant import negotiate as _qneg
+
+    _qneg.invalidate_cards()
     _counts["failovers"] += 1
     spc.record("ft_failover")
     log.warning("recovered: %s (%d ranks) -> %s (%d ranks)",

@@ -205,7 +205,14 @@ class XlaComm(Intracomm):
             # contract must hold on every call, not just the first
             _check_device_op(op, x)
         out = self._verb_fn("allreduce")(self, x, op)
-        self._promote(("allreduce", op.uid), cache_key("allreduce", op))
+        # a quant-negotiated comm caches its executable under a
+        # discriminated key (coll/quant.py) so it can't collide with the
+        # plain body XlaColl.reduce shares; prefer it when present
+        qkey = cache_key("allreduce", op, extra=("quant",))
+        if qkey in self._jit_cache:
+            self._promote(("allreduce", op.uid), qkey)
+        else:
+            self._promote(("allreduce", op.uid), cache_key("allreduce", op))
         return out
 
     def reduce(self, x, op: _op.Op = _op.SUM, root: int = 0):

@@ -1,0 +1,346 @@
+"""Block-scaled quantization codecs with closed-form error bounds.
+
+EQuARX-style block scaling (arxiv 2506.17615): a float vector is cut
+into blocks of ``block`` elements; each block carries one f32 scale
+derived from its amax, and the elements ride the wire as int8, packed
+int4, or float8_e4m3fn. Rounding is deterministic round-to-nearest-even
+(``np.rint`` / the IEEE cast), so a fixed (world, block, bits, mode)
+config reproduces bitwise.
+
+Wire layout of one encoded vector of ``n`` elements::
+
+    [nblocks * f32 little-endian scales][quantized payload]
+
+``nblocks = ceil(n / block)``; the payload is ``n`` bytes (int8/fp8) or
+``ceil(n/2)`` bytes (int4 nibbles, low nibble first).
+
+Non-finite blocks (amax inf or nan — the adversarial inputs the test
+sweep feeds) are carried losslessly in *shape*: the block's scale is the
+``+inf`` sentinel and the code points encode {+inf, -inf, nan, other}.
+Finite values inside such a block decode to 0 — legal, because the
+error bound for that block is infinite.
+
+Closed-form worst-case error (the ``error_bound`` contract): one
+quantize/dequantize round trip of a block with amax ``A`` errs at most
+``A * eps`` per element, with ``eps`` = 1/254 (int8, half a step of
+amax/127), 1/14 (int4), 2**-4 (fp8 e4m3: 3 mantissa bits after the
+amax -> 224 scaling keeps everything in the normal range). The
+quantized allreduce quantizes every rank's contribution once (error
+<= S * eps, S = sum over ranks of the block amax) and requantizes the
+reduced block once more (its amax <= S * (1 + eps)), so::
+
+    |allreduce_quant - allreduce_exact|  <=  S * eps * (2 + eps) + slack
+
+where ``slack = S * 4 * (W + 2) * finfo(out_dtype).eps`` covers f32
+scale storage, the W-term dequant-sum rounding, and the final cast back
+to the caller's dtype (dominant only for f16 outputs, where it is the
+honest cast cost). Block scales are clamped to >= f32 tiny (a
+tiny-denormal amax would underflow ``amax/divisor`` to 0), so ``A`` and
+``S`` in the bound are really ``max(amax, tiny * divisor)`` — the
+clamped scale's own rounding step. Symmetrically, a float64 block whose
+amax exceeds ``f32max * divisor`` cannot ship its scale in f32: encode
+clamps the scale to f32max (values saturate near ``qmax * f32max``
+instead of the inf-scale sentinel silently zeroing the block) and
+``error_bound`` is infinite there — no finite guarantee.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["BlockCodec", "make_codec", "chunk_layout"]
+
+
+def chunk_layout(count: int, world: int, block: int) -> Tuple[int, int]:
+    """(per, padded): the canonical chunking shared by the procmode and
+    mesh schedules AND by the error bound — ``count`` elements pad up to
+    ``padded = per * world`` with ``per`` a multiple of ``block``; chunk
+    ``j`` (destined for rank ``j``) is ``padded[j*per:(j+1)*per]``."""
+    per = -(-max(count, 1) // world)
+    per = -(-per // block) * block
+    return per, per * world
+
+
+def _work_dtype(dtype) -> np.dtype:
+    return np.dtype(np.float64 if np.dtype(dtype) == np.float64
+                    else np.float32)
+
+
+class BlockCodec:
+    """One (mode, bits, block) codec instance. ``mode`` is ``int8`` or
+    ``fp8``; ``bits`` is 8, or 4 for packed-nibble int quantization."""
+
+    def __init__(self, mode: str = "int8", bits: int = 8, block: int = 64):
+        if mode not in ("int8", "fp8"):
+            raise ValueError(f"unknown quant mode {mode!r}")
+        if bits not in (8, 4):
+            raise ValueError(f"unsupported quant bits {bits}")
+        if mode == "fp8":
+            if bits != 8:
+                raise ValueError("fp8 requires bits=8")
+            import ml_dtypes  # jax dependency; gate, never pip install
+
+            self._f8 = np.dtype(ml_dtypes.float8_e4m3fn)
+        if block < 1:
+            raise ValueError(f"quant block must be >= 1, got {block}")
+        self.mode = mode
+        self.bits = bits
+        self.block = int(block)
+        if mode == "fp8":
+            self.qmax = 448.0           # e4m3fn finite max (sentinel code)
+            self.eps = 2.0 ** -4
+        else:
+            self.qmax = (1 << (bits - 1)) - 1   # 127 / 7
+            self.eps = 0.5 / self.qmax
+        # fp8 scaling target: amax -> 224 keeps every rounded value in
+        # the normal range (< 448), so the relative-eps bound holds
+        self._fp8_target = 224.0
+        # encode clamps the block scale to >= f32 tiny (a tiny-denormal
+        # amax underflows amax/divisor to 0); below this amax the error
+        # is governed by the clamped scale, so the bound uses
+        # max(amax, _amax_floor) — _amax_floor * eps == the clamped
+        # scale's worst rounding error
+        divisor = self._fp8_target if mode == "fp8" else self.qmax
+        self._amax_floor = float(np.finfo(np.float32).tiny) * divisor
+        # scales ship as f32 on the wire: a float64 block whose amax
+        # exceeds f32max * divisor cannot be represented — encode clamps
+        # the scale to f32max (values saturate at ~qmax * f32max instead
+        # of the inf-scale SENTINEL misread silently zeroing the block)
+        # and error_bound reports inf for such blocks (no guarantee)
+        self._amax_ceiling = float(np.finfo(np.float32).max) * divisor
+
+    # ------------------------------------------------------------ sizing
+    def nblocks(self, n: int) -> int:
+        return -(-n // self.block)
+
+    def payload_nbytes(self, n: int) -> int:
+        return -(-n // 2) if self.bits == 4 else n
+
+    def wire_nbytes(self, n: int) -> int:
+        """Encoded size of an n-element vector (scales + payload)."""
+        return 4 * self.nblocks(n) + self.payload_nbytes(n)
+
+    def ratio(self, n: int, itemsize: int = 4) -> float:
+        """Full-precision bytes / quantized wire bytes."""
+        return (n * itemsize) / self.wire_nbytes(n)
+
+    # ---------------------------------------------------------- encoding
+    def _blocks(self, x: np.ndarray) -> np.ndarray:
+        n = x.size
+        nb = self.nblocks(n)
+        padded = np.zeros(nb * self.block, dtype=_work_dtype(x.dtype))
+        padded[:n] = np.asarray(x, dtype=padded.dtype).reshape(-1)
+        return padded.reshape(nb, self.block)
+
+    def encode(self, x: np.ndarray) -> np.ndarray:
+        """Quantize a 1-D float vector into one contiguous uint8 wire
+        payload (deterministic round-to-nearest-even)."""
+        blocks = self._blocks(x)
+        nb = blocks.shape[0]
+        amax = np.max(np.abs(blocks), axis=1)  # nan propagates
+        finite = np.isfinite(amax)
+        scale = np.ones(nb, dtype=np.float32)
+        # over=: the f64-amax-past-f32-range divide overflows to inf BY
+        # DESIGN (clamped to f32max right below) — the warning would
+        # spam stderr per encode and raise under warnings-as-errors
+        with np.errstate(divide="ignore", invalid="ignore",
+                         over="ignore"):
+            if self.mode == "fp8":
+                np.divide(amax, self._fp8_target, out=scale,
+                          where=finite & (amax > 0), casting="unsafe")
+            else:
+                np.divide(amax, self.qmax, out=scale,
+                          where=finite & (amax > 0), casting="unsafe")
+        # clamp to the smallest NORMAL f32: a tiny-denormal amax
+        # underflows amax/qmax to exactly 0 (div-by-zero in the encode
+        # below, block decodes to 0 with a 0 bound), and a subnormal
+        # scale's rounding error alone can exceed amax*eps — the clamp
+        # keeps the divide finite and error_bound carries the matching
+        # additive tiny term
+        np.maximum(scale, np.finfo(np.float32).tiny, out=scale,
+                   where=finite & (amax > 0))
+        # f64 blocks with amax > f32max * divisor overflow the f32
+        # divide to inf — which decode would misread as the non-finite
+        # sentinel and zero the block; clamp to f32max (saturating the
+        # values, bound reports inf there)
+        np.minimum(scale, np.finfo(np.float32).max, out=scale,
+                   where=finite & (amax > 0))
+        scale[~finite] = np.inf  # sentinel: block carries non-finite data
+
+        if self.mode == "fp8":
+            q = np.zeros(blocks.shape, dtype=self._f8)
+            if finite.any():
+                t = blocks[finite] / scale[finite, None]
+                q[finite] = t.astype(self._f8)  # IEEE RTE cast
+            if not finite.all():
+                xb = blocks[~finite]
+                qb = np.zeros(xb.shape, dtype=self._f8)
+                qb[xb == np.inf] = self.qmax      # 448 = +inf code point
+                qb[xb == -np.inf] = -self.qmax
+                qb[np.isnan(xb)] = np.nan
+                q[~finite] = qb
+            payload = np.ascontiguousarray(q).view(np.uint8).reshape(-1)
+        else:
+            q = np.zeros(blocks.shape, dtype=np.int8)
+            if finite.any():
+                t = blocks[finite] / scale[finite, None]
+                q[finite] = np.clip(np.rint(t), -self.qmax,
+                                    self.qmax).astype(np.int8)
+            if not finite.all():
+                xb = blocks[~finite]
+                qb = np.zeros(xb.shape, dtype=np.int8)
+                qb[xb == np.inf] = int(self.qmax)
+                qb[xb == -np.inf] = -int(self.qmax)
+                qb[np.isnan(xb)] = -int(self.qmax) - 1  # nan code point
+                q[~finite] = qb
+            flat = q.reshape(-1)[: x.size] if self.bits == 4 else q
+            if self.bits == 4:
+                nibbles = (flat.astype(np.int16) + 8).astype(np.uint8)
+                if nibbles.size % 2:
+                    nibbles = np.concatenate(
+                        [nibbles, np.full(1, 8, np.uint8)])
+                pairs = nibbles.reshape(-1, 2)
+                payload = (pairs[:, 0] | (pairs[:, 1] << 4)).astype(np.uint8)
+            else:
+                payload = np.ascontiguousarray(q).view(np.uint8).reshape(-1)
+        payload = payload[: self.payload_nbytes(x.size)] \
+            if self.bits == 4 else payload[: x.size]
+        out = np.empty(self.wire_nbytes(x.size), dtype=np.uint8)
+        out[: 4 * nb] = scale.astype("<f4").view(np.uint8)
+        out[4 * nb:] = payload
+        return out
+
+    def decode(self, payload: np.ndarray, n: int,
+               dtype=np.float32) -> np.ndarray:
+        """Dequantize ``n`` elements from one wire payload into the work
+        dtype for ``dtype`` (f64 in, f64 math; everything else f32)."""
+        nb = self.nblocks(n)
+        raw = np.frombuffer(bytes(payload), dtype=np.uint8)
+        scale = raw[: 4 * nb].view("<f4").astype(np.float32)
+        body = raw[4 * nb: 4 * nb + self.payload_nbytes(n)]
+        wdt = _work_dtype(dtype)
+        if self.mode == "fp8":
+            q = body.view(self._f8).astype(wdt)
+        elif self.bits == 4:
+            lo = (body & 0x0F).astype(np.int16) - 8
+            hi = (body >> 4).astype(np.int16) - 8
+            q = np.empty(body.size * 2, dtype=np.int16)
+            q[0::2] = lo
+            q[1::2] = hi
+            q = q[:n].astype(wdt)
+        else:
+            q = body.view(np.int8).astype(wdt)
+        q = q[:n]
+        pad = nb * self.block - n
+        if pad:
+            q = np.concatenate([q, np.zeros(pad, dtype=wdt)])
+        blocks = q.reshape(nb, self.block)
+        bad = np.isinf(scale)
+        with np.errstate(invalid="ignore"):
+            out = blocks * scale[:, None].astype(wdt)
+        if bad.any():
+            qb = blocks[bad]
+            ob = np.zeros(qb.shape, dtype=wdt)
+            if self.mode == "fp8":
+                ob[qb == self.qmax] = np.inf
+                ob[qb == -self.qmax] = -np.inf
+                ob[np.isnan(qb)] = np.nan
+            else:
+                ob[qb == self.qmax] = np.inf
+                ob[qb == -self.qmax] = -np.inf
+                ob[qb == -self.qmax - 1] = np.nan
+            out[bad] = ob
+        return out.reshape(-1)[:n]
+
+    # ------------------------------------------------------ error bounds
+    def _slack(self, world: int, out_dtype) -> float:
+        return 4.0 * (world + 2) * float(np.finfo(np.dtype(out_dtype)).eps)
+
+    def error_bound(self, x: np.ndarray, out_dtype=None) -> np.ndarray:
+        """Closed-form worst-case absolute error, per element.
+
+        - 1-D ``x``: one encode/decode round trip of ``x`` —
+          ``bound = A' * (eps + slack)`` with ``A'`` the element's block
+          amax floored at ``_amax_floor`` (the encode-side scale clamp:
+          tiny-denormal blocks err by the clamped scale's rounding step,
+          not by ``A * eps``).
+        - 2-D ``x`` of shape [world, n] (the stacked per-rank
+          contributions): the full quantized allreduce —
+          ``bound = S' * (eps * (2 + eps) + slack)`` with ``S'`` the sum
+          over ranks of the floored block amax under the allreduce's
+          ``chunk_layout`` chunking. Non-finite blocks get an infinite
+          bound (they are carried as sentinels, not values). All bound
+          math runs in f64 so the bound itself cannot underflow.
+        """
+        x = np.asarray(x)
+        od = np.dtype(out_dtype) if out_dtype is not None else \
+            (x.dtype if x.dtype.kind == "f" else np.dtype(np.float32))
+        if x.ndim == 1:
+            blocks = self._blocks(x)
+            amax = np.max(np.abs(blocks), axis=1).astype(np.float64)
+            eff = np.where(amax > 0,
+                           np.maximum(amax, self._amax_floor), 0.0)
+            bound = eff * (self.eps + self._slack(1, od))
+            # beyond the f32-representable scale range the encode
+            # saturates — no finite guarantee
+            bound = np.where(np.isfinite(amax)
+                             & (eff <= self._amax_ceiling), bound, np.inf)
+            per_el = np.repeat(bound, self.block)[: x.size]
+            return per_el.astype(np.float64)
+        if x.ndim != 2:
+            raise ValueError("error_bound wants a vector or a "
+                             "[world, n] stack")
+        world, n = x.shape
+        per, padded = chunk_layout(n, world, self.block)
+        a = np.zeros((world, padded), dtype=np.float64)
+        a[:, :n] = np.abs(x.astype(np.float64, copy=False))
+        # [world(src), world(chunk), blocks/chunk]
+        amax = a.reshape(world, world, per // self.block,
+                         self.block).max(axis=-1)
+        eff = np.where(amax > 0, np.maximum(amax, self._amax_floor), 0.0)
+        S = eff.sum(axis=0)  # per (chunk, block), floored amaxes
+        bound = S * (self.eps * (2.0 + self.eps) + self._slack(world, od))
+        # S bounds the reduced block's amax too (the requantize step):
+        # past the f32 scale ceiling either encode saturates — inf bound
+        bound = np.where(np.isfinite(amax.sum(axis=0))
+                         & (S <= self._amax_ceiling), bound, np.inf)
+        return np.repeat(bound.reshape(-1), self.block)[:n]
+
+    # --------------------------------------------------------- reference
+    def reduce_encoded(self, encoded, per: int, dtype=np.float32):
+        """Sum decoded chunks in ascending-rank order (THE canonical
+        accumulation order — procmode ranks and the offline simulator
+        share it so results agree bitwise)."""
+        acc = self.decode(encoded[0], per, dtype)
+        # invalid=: sentinel blocks legitimately reduce inf + (-inf) ->
+        # nan (the adversarial sweep's contract); the warning would
+        # raise under warnings-as-errors embedders
+        with np.errstate(invalid="ignore"):
+            for e in encoded[1:]:
+                acc = acc + self.decode(e, per, dtype)
+        return acc
+
+    def simulate_allreduce(self, xs: np.ndarray) -> np.ndarray:
+        """Offline oracle of the quantized allreduce: quantize every
+        rank's chunk, reduce in rank order, requantize, dequantize —
+        exactly the wire schedule, bitwise (tests + tools/quantreport)."""
+        xs = np.asarray(xs)
+        world, n = xs.shape
+        per, padded = chunk_layout(n, world, self.block)
+        wdt = _work_dtype(xs.dtype)
+        buf = np.zeros((world, padded), dtype=wdt)
+        buf[:, :n] = xs
+        out = np.empty(padded, dtype=wdt)
+        for c in range(world):
+            enc = [self.encode(buf[r, c * per:(c + 1) * per])
+                   for r in range(world)]
+            red = self.reduce_encoded(enc, per, wdt)
+            out[c * per:(c + 1) * per] = self.decode(
+                self.encode(red), per, wdt)
+        return out[:n].astype(xs.dtype if xs.dtype.kind == "f" else wdt)
+
+
+def make_codec(mode: str, bits: int, block: int) -> BlockCodec:
+    return BlockCodec(mode, bits, block)

@@ -1,0 +1,261 @@
+"""Per-communicator quant codec negotiation over the modex card plane.
+
+The torn-collective hazard: if the quantized module were selected from
+each rank's LOCAL cvars, a rank launched with ``quant_enable`` unset
+would run the tuned schedule while its peers run the quantized one —
+mismatched tags, permanent hang. The reference fix is the same one the
+btl endpoints use: publish config as a modex business card during
+wireup (before the first fence), so by the time any communicator is
+built every rank holds every member's card and the verdict is a pure
+local computation over SHARED data. All ranks reach the same decision:
+quantize, fall back to full precision, or (``quant_strict``) raise the
+same error on every rank's quant-eligible collectives.
+
+Mesh mode is single-controller — there is nobody to disagree with — so
+its verdict reads the local cvars directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from typing import Dict, List, Optional
+
+from ompi_tpu.quant import (
+    _bits_var,
+    _block_var,
+    _enable_var,
+    _min_bytes_var,
+    _mode_var,
+    _strict_var,
+)
+from ompi_tpu.utils.show_help import register_topic, show_help
+
+register_topic(
+    "quant", "negotiate-fallback",
+    "Quantized collectives requested but not negotiated on "
+    "communicator '%(comm)s': %(reason)s.\n"
+    "All members fell back to full precision together (set "
+    "quant_strict to turn this into an error). Every rank must "
+    "launch with quant_enable set and matching quant_bits / "
+    "quant_block / quant_mode for the quantized path to engage.")
+register_topic(
+    "quant", "codec-unavailable",
+    "The negotiated quant codec (%(mode)s/%(bits)s) is unavailable "
+    "on this build: %(err)s. Falling back to full precision.")
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantState:
+    """The per-communicator verdict (identical on every member)."""
+
+    active: bool
+    bits: int = 8
+    block: int = 64
+    mode: str = "int8"
+    min_bytes: int = 65536
+    strict: bool = False
+    reason: str = ""
+
+    _codec_cache: dict = dataclasses.field(default_factory=dict,
+                                           compare=False, repr=False)
+
+    @property
+    def codec(self):
+        c = self._codec_cache.get("c")
+        if c is None:
+            from ompi_tpu.quant.codec import make_codec
+
+            c = make_codec(self.mode, self.bits, self.block)
+            self._codec_cache["c"] = c
+        return c
+
+
+INACTIVE = QuantState(active=False, reason="quant_enable unset")
+
+
+def _fp8_available() -> int:
+    try:
+        import ml_dtypes  # noqa: F401  (jax dependency; may be absent)
+    except ImportError:
+        return 0
+    return 1
+
+
+def local_card() -> Dict[str, int]:
+    """This rank's negotiation card, straight off the cvars (read at
+    wireup — later set_var calls do not re-publish; per-job config is
+    launch-time config, like every other modex card). Codec
+    AVAILABILITY rides the card too: probing ml_dtypes locally inside
+    decide() would let heterogeneous builds reach opposite verdicts —
+    the torn-collective hazard this plane exists to prevent."""
+    return {
+        "enable": int(bool(_enable_var._value)),
+        "bits": int(_bits_var._value),
+        "block": int(_block_var._value),
+        "mode": str(_mode_var._value),
+        "min_bytes": int(_min_bytes_var._value),
+        "strict": int(bool(_strict_var._value)),
+        "fp8_ok": _fp8_available(),
+    }
+
+
+def card_json() -> str:
+    return json.dumps(local_card())
+
+
+CARD_KEY = "quant.card"
+
+_card_lock = threading.Lock()
+_card_cache: Dict[int, Dict] = {}
+
+
+def _member_card(modex, world_rank: int) -> Dict:
+    with _card_lock:
+        c = _card_cache.get(world_rank)
+    if c is not None:
+        return c
+    try:
+        # cards are published before the publisher's first fence, and a
+        # comm can only contain ranks whose init (hence card put) has
+        # completed — post-fence a missing card will never appear, so
+        # don't wait (the wireup.py sm-card discipline); a 10s poll here
+        # would stall coll selection per card-less cross-job member
+        c = json.loads(modex.get(world_rank, CARD_KEY, timeout=0.0))
+    except TimeoutError:
+        # a peer without a card (pre-quant build) negotiates as
+        # disabled — the conservative verdict every rank reaches
+        # identically, because the key is symmetrically absent for all.
+        # Anything OTHER than key-absent (a transport hiccup, a broken
+        # card) must propagate: silently mapping it to disabled would
+        # let ONE rank's hiccup split the verdict — the torn-collective
+        # hazard this plane exists to prevent — so fail loudly instead
+        c = {"enable": 0, "_missing": True}
+    with _card_lock:
+        _card_cache[world_rank] = c
+    return c
+
+
+def invalidate_cards() -> None:
+    """Drop every cached member card. Recovery calls this whenever
+    world membership changes (shrink/respawn): a respawned replacement
+    re-publishes its card under the dead predecessor's world rank, and
+    a survivor serving the stale cached card would negotiate a
+    different verdict than the ranks reading fresh."""
+    with _card_lock:
+        _card_cache.clear()
+
+
+def decide(cards: List[Dict]) -> QuantState:
+    """Pure verdict over the member cards — every rank feeds the same
+    cards in the same (comm-rank) order and lands on the same state."""
+    if not cards:
+        return INACTIVE
+    # inactive verdicts still carry the ENABLED members' negotiated
+    # floor: a strict-armed state gates _check_armed through _eligible,
+    # and reverting to the dataclass default 65536 would silently no-op
+    # quant_strict for every payload between the configured floor and
+    # 64 KiB (symmetric — a pure function of the shared cards)
+    def _floor() -> int:
+        return max((int(c.get("min_bytes", 65536))
+                    for c in cards if c.get("enable")), default=65536)
+
+    if not all(c.get("enable") for c in cards):
+        off = sum(1 for c in cards if not c.get("enable"))
+        reason = f"{off}/{len(cards)} member rank(s) have " \
+                 "quant_enable unset"
+        strict = any(c.get("enable") and c.get("strict") for c in cards)
+        wanted = any(c.get("enable") for c in cards)
+        return QuantState(active=False, strict=strict and wanted,
+                          min_bytes=_floor(), reason=reason)
+    configs = {(int(c["bits"]), int(c["block"]), str(c["mode"]))
+               for c in cards}
+    strict = any(c.get("strict") for c in cards)
+    if len(configs) != 1:
+        return QuantState(
+            active=False, strict=strict, min_bytes=_floor(),
+            reason="mismatched quant config across members: "
+                   + ", ".join(f"bits={b}/block={k}/mode={m}"
+                               for b, k, m in sorted(configs)))
+    bits, block, mode = next(iter(configs))
+    if mode == "fp8" and bits != 8:
+        return QuantState(active=False, strict=strict,
+                          min_bytes=_floor(),
+                          reason="fp8 requires quant_bits=8")
+    if mode == "fp8" and not all(c.get("fp8_ok") for c in cards):
+        # availability comes from the SHARED cards, never a local
+        # probe: one build without ml_dtypes must flip every rank to
+        # the same fallback, not just itself
+        off = sum(1 for c in cards if not c.get("fp8_ok"))
+        return QuantState(
+            active=False, strict=strict, min_bytes=_floor(),
+            reason=f"fp8 codec unavailable on {off}/{len(cards)} "
+                   "member build(s) (ml_dtypes missing)")
+    # symmetric threshold: the LARGEST requested floor wins, so no rank
+    # quantizes a message a peer expected at full precision
+    min_bytes = max(int(c["min_bytes"]) for c in cards)
+    st = QuantState(active=True, bits=bits, block=block, mode=mode,
+                    min_bytes=min_bytes, strict=strict)
+    try:
+        st.codec  # validate availability (fp8 needs ml_dtypes)
+    except Exception as e:
+        show_help("quant", "codec-unavailable", mode=mode, bits=bits,
+                  err=str(e))
+        return QuantState(active=False, strict=strict,
+                          min_bytes=min_bytes,
+                          reason=f"codec unavailable: {e}")
+    return st
+
+
+_warned = set()
+
+
+def for_proc_comm(comm) -> QuantState:
+    """Negotiate for a process-mode communicator (called once, at coll
+    selection time). Reads members' modex cards; never communicates."""
+    from ompi_tpu.runtime import wireup
+
+    if comm.size < 2:
+        return INACTIVE
+    ctx = wireup._ctx
+    if ctx is None:
+        # no modex plane (unit-test comms): local card only, and only
+        # ever size >= 2 via hand-built groups — treat as single-config
+        cards = [local_card()] * comm.size
+    else:
+        modex = ctx["modex"]
+        cards = [_member_card(modex, comm.group.world_rank(i))
+                 for i in range(comm.size)]
+    st = decide(cards)
+    if not st.active and not st.strict and \
+            any(c.get("enable") for c in cards):
+        key = (st.reason,)
+        if key not in _warned:
+            _warned.add(key)
+            show_help("quant", "negotiate-fallback",
+                      comm=getattr(comm, "name", "?"), reason=st.reason)
+    return st
+
+
+def for_mesh_comm(comm) -> QuantState:
+    """Mesh-mode verdict: single controller, local cvars only. The
+    compiled path supports whole-axis comms at 8-bit codecs; anything
+    else rides the plain XLA schedule."""
+    if not _enable_var._value:
+        return INACTIVE
+    card = local_card()
+    st = decide([card] * max(comm.world_size, 1))
+    if st.active and (st.bits != 8 or comm.groups is not None
+                      or comm.world_size < 2):
+        return QuantState(
+            active=False, strict=False,
+            reason="mesh quant path needs an 8-bit codec on a "
+                   "whole-axis comm with >= 2 devices")
+    return st
+
+
+def _reset_for_testing() -> None:
+    with _card_lock:
+        _card_cache.clear()
+    _warned.clear()

@@ -66,6 +66,7 @@ import ompi_tpu.coll.neighbor  # noqa: F401,E402
 import ompi_tpu.coll.han  # noqa: F401,E402
 import ompi_tpu.coll.smcoll  # noqa: F401,E402
 import ompi_tpu.coll.adaptive  # noqa: F401,E402
+import ompi_tpu.coll.quant  # noqa: F401,E402  (quantized collectives)
 import ompi_tpu.hook.comm_method  # noqa: F401,E402
 import ompi_tpu.runtime.sanitizer  # noqa: F401,E402  (cvars + hooks)
 import ompi_tpu.ft.diskless  # noqa: F401,E402  (ckpt cvars + init hook)

@@ -106,6 +106,14 @@ def init_process_mode():
         # single-copy into this process are exactly the same-node job
         # peers, and scoping PR_SET_PTRACER needs their pids (ADVICE r5)
         modex.put("smsc.pid", str(os.getpid()))
+    # quant negotiation card: published BEFORE the fence so every rank
+    # holds every member's config by the time any communicator selects
+    # its coll table — the verdict becomes a pure local computation and
+    # a rank with quant_enable unset can never tear a collective
+    # (quant/negotiate.py)
+    from ompi_tpu.quant import negotiate as _qneg
+
+    modex.put(_qneg.CARD_KEY, _qneg.card_json())
     modex.fence()  # reference: PMIx_Fence_nb at instance.c:575-625
 
     job_peers = [base + i for i in range(size)]  # universe ranks of my job

@@ -42,6 +42,10 @@ def _load_everything() -> None:
     import ompi_tpu.runtime.dpm  # dynamic-process spawn vars
     import ompi_tpu.reshard.plan  # reshard cvars + plans_compiled pvar
     import ompi_tpu.reshard.exec  # reshard exec/bytes/staging pvars
+    import ompi_tpu.quant  # quant_* cvars + colls/bytes pvars
+    import ompi_tpu.quant.negotiate  # negotiation topics
+    import ompi_tpu.coll.quant  # quantized-collectives component
+    import ompi_tpu.btl.tcp  # btl_tcp compress cvars + ratio pvars
 
 
 def print_header(out) -> None:

@@ -1,0 +1,197 @@
+"""Quantized-collectives procmode scenarios, selected by argv[1]:
+
+``quant`` — 3 ranks, quant negotiated ON (the launcher exports
+    quant_enable for every rank). Allreduce/allgather/
+    reduce_scatter_block take the quantized path: results satisfy the
+    codec's closed-form error bound, the allreduce is
+    bitwise-deterministic AND bitwise-identical to the offline oracle
+    (codec.simulate_allreduce), integer collectives stay exact via
+    delegation, and the quant_bytes_saved pvar proves >= 3.5x fewer
+    payload bytes than full precision at int8.
+
+``fallback`` — the negotiation proof: the script unsets quant_enable
+    for RANK 1 ONLY (before importing ompi_tpu), so the modex cards
+    disagree. Every rank must fall back to the exact fp32 path
+    together — no torn collective, no hang, quant_colls == 0.
+
+``compress`` — 2 ranks over the tcp btl only (sm excluded), zlib
+    framing on, chaos delay/dup injection armed on the wire: large
+    rendezvous payloads (compressible and incompressible) round-trip
+    byte-identically both directions and the compression counters
+    prove flagged frames moved.
+"""
+
+import os
+import sys
+
+RANK = int(os.environ.get("OMPI_TPU_RANK", "0"))
+MODE = sys.argv[1] if len(sys.argv) > 1 else "quant"
+
+if MODE == "fallback" and RANK != 1:
+    # ranks 0 and 2 WANT quantization; rank 1 launches without it —
+    # set before any ompi_tpu import so the modex card carries it
+    os.environ["OMPI_TPU_MCA_quant_enable"] = "1"
+    os.environ["OMPI_TPU_MCA_quant_min_bytes"] = "2048"
+
+import numpy as np  # noqa: E402
+
+import ompi_tpu  # noqa: E402
+from ompi_tpu import COMM_WORLD  # noqa: E402
+from ompi_tpu.mca.var import all_pvars  # noqa: E402
+from ompi_tpu.quant.codec import make_codec  # noqa: E402
+
+
+def quant_mode() -> int:
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+    assert COMM_WORLD.coll.providers.get("allreduce") == "quant", \
+        COMM_WORLD.coll.providers
+    codec = make_codec("int8", 8, 64)
+    count = 6000
+    rng = np.random.RandomState(7)
+    xs = (rng.randn(n, count) * rng.uniform(0.1, 20.0, (n, 1))) \
+        .astype(np.float32)  # identical on every rank
+
+    # ---- allreduce: bound + bitwise determinism + oracle equality
+    out = np.zeros(count, np.float32)
+    COMM_WORLD.Allreduce(xs[r].copy(), out)
+    exact = xs.astype(np.float64).sum(axis=0)
+    bound = codec.error_bound(xs)
+    err = np.abs(out.astype(np.float64) - exact)
+    assert np.all(err <= bound), float(np.max(err - bound))
+    assert np.array_equal(out, codec.simulate_allreduce(xs)), \
+        "not bitwise-identical to codec.simulate_allreduce"
+    out2 = np.zeros(count, np.float32)
+    COMM_WORLD.Allreduce(xs[r].copy(), out2)
+    assert np.array_equal(out, out2), "not deterministic across calls"
+
+    # ---- adversarial block: +inf amax rides the sentinel encoding
+    adv = xs.copy()
+    adv[:, 100] = np.inf
+    outa = np.zeros(count, np.float32)
+    COMM_WORLD.Allreduce(adv[r].copy(), outa)
+    assert outa[100] == np.inf, outa[100]
+    ba = codec.error_bound(adv)
+    fin = np.isfinite(ba)
+    with np.errstate(invalid="ignore"):
+        erra = np.abs(outa.astype(np.float64)
+                      - adv.astype(np.float64).sum(axis=0))
+    assert np.all(erra[fin] <= ba[fin])
+
+    # ---- integer allreduce stays exact (delegation) — and it routes
+    # to the recorded runner-up module, not a hard-wired tuned instance
+    fp = COMM_WORLD.coll.fallback_providers.get("allreduce")
+    assert fp is not None and fp != "quant", \
+        COMM_WORLD.coll.fallback_providers
+    iv = np.full(8, r + 1, np.int64)
+    io = np.zeros(8, np.int64)
+    COMM_WORLD.Allreduce(iv, io)
+    assert io[0] == n * (n + 1) // 2, io
+
+    # ---- allgather: per-sender round-trip bound
+    ag = np.zeros(n * count, np.float32)
+    COMM_WORLD.Allgather(xs[r].copy(), ag)
+    for i in range(n):
+        bi = codec.error_bound(np.ascontiguousarray(xs[i]))
+        ei = np.abs(ag[i * count:(i + 1) * count].astype(np.float64)
+                    - xs[i])
+        assert np.all(ei <= bi), (i, float(np.max(ei - bi)))
+
+    # ---- reduce_scatter_block: each destination chunk is encoded as
+    # its own vector, so the bound is the per-chunk round-trip sum
+    rc = 1500
+    send = np.ascontiguousarray(xs[r, : n * rc])
+    rb = np.zeros(rc, np.float32)
+    COMM_WORLD.Reduce_scatter_block(send, rb)
+    exact_rs = xs[:, : n * rc].astype(np.float64).sum(axis=0)[
+        r * rc:(r + 1) * rc]
+    brs = sum(codec.error_bound(
+        np.ascontiguousarray(xs[i, r * rc:(r + 1) * rc]))
+        for i in range(n))
+    # + the W-term f32 accumulation slack the allreduce bound carries
+    brs = brs + np.abs(exact_rs) * 4 * (n + 2) * np.finfo(np.float32).eps
+    errrs = np.abs(rb.astype(np.float64) - exact_rs)
+    assert np.all(errrs <= brs), float(np.max(errrs - brs))
+
+    # ---- the >= 3.5x payload-byte claim, measured by the pvars
+    pv = all_pvars()
+    colls = pv["quant_colls"].value
+    saved = pv["quant_bytes_saved"].value
+    wire = pv["quant_bytes_wire"].value
+    assert colls >= 5, colls
+    ratio = (saved + wire) / wire
+    assert ratio >= 3.5, ratio
+    COMM_WORLD.Barrier()
+    ompi_tpu.Finalize()
+    print(f"rank {r}: QUANT-OK ratio={ratio:.2f} colls={colls}",
+          flush=True)
+    return 0
+
+
+def fallback_mode() -> int:
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+    # negotiation must have de-selected quant on EVERY rank (rank 1's
+    # card says disabled) — the slot belongs to tuned and stays exact
+    assert COMM_WORLD.coll.providers.get("allreduce") != "quant", \
+        COMM_WORLD.coll.providers
+    count = 4096
+    mine = (np.arange(count, dtype=np.float32) + r)
+    out = np.zeros(count, np.float32)
+    COMM_WORLD.Allreduce(mine, out)
+    expect = np.arange(count, dtype=np.float32) * n + n * (n - 1) / 2
+    np.testing.assert_array_equal(out, expect)
+    assert all_pvars()["quant_colls"].value == 0
+    COMM_WORLD.Barrier()
+    ompi_tpu.Finalize()
+    print(f"rank {r}: FALLBACK-OK", flush=True)
+    return 0
+
+
+def compress_mode() -> int:
+    from ompi_tpu.runtime import spc
+
+    r = COMM_WORLD.Get_rank()
+    rng = np.random.RandomState(3)
+    compressible = np.zeros(1 << 21, np.uint8)
+    compressible[::7] = 42
+    incompressible = rng.randint(0, 256, 1 << 21).astype(np.uint8)
+    if r == 0:
+        COMM_WORLD.Send(compressible, dest=1, tag=1)
+        COMM_WORLD.Send(incompressible, dest=1, tag=2)
+        back = np.zeros(1 << 21, np.uint8)
+        COMM_WORLD.Recv(back, source=1, tag=3)
+        assert np.array_equal(back, compressible), "round trip corrupt"
+    else:
+        a = np.zeros(1 << 21, np.uint8)
+        b = np.zeros(1 << 21, np.uint8)
+        COMM_WORLD.Recv(a, source=0, tag=1)
+        COMM_WORLD.Recv(b, source=0, tag=2)
+        assert np.array_equal(a, compressible), "compressible corrupt"
+        assert np.array_equal(b, incompressible), "incompressible corrupt"
+        COMM_WORLD.Send(a, dest=0, tag=3)
+    COMM_WORLD.Barrier()
+    frames = spc.get("btl_tcp_compressed_frames")
+    from ompi_tpu import quant
+
+    c = quant.counters()
+    assert frames >= 1, "no compressed frames moved"
+    assert c["wire_comp"] < c["wire_raw"], c
+    ompi_tpu.Finalize()
+    print(f"rank {r}: COMPRESS-OK frames={frames}", flush=True)
+    return 0
+
+
+def main() -> int:
+    if MODE == "quant":
+        return quant_mode()
+    if MODE == "fallback":
+        return fallback_mode()
+    if MODE == "compress":
+        return compress_mode()
+    print(f"unknown mode {MODE}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,146 @@
+"""quantreport — offline codec report: measured error vs the closed-form
+bound, plus compression ratios, per (mode, bits, block) config.
+
+For each config the tool runs the quantized-allreduce oracle
+(``codec.simulate_allreduce`` — bitwise the wire schedule) over random
+and adversarial inputs and reports:
+
+- ``max_err``            worst measured |quant - exact| per sweep
+- ``headroom``           min(bound / err) over elements (>= 1 == bound holds)
+- ``bound_holds``        True when every element stayed inside its bound
+- ``wire_ratio``         full-precision bytes / quantized wire bytes
+
+Output: a table on stdout and ``quant-report.json`` under the
+``metrics_dir`` cvar (never the CWD — the PR 4/6 output discipline).
+
+Usage::
+
+    python -m tools.quantreport                 # full sweep
+    python -m tools.quantreport --fast          # tier-1 subset
+    python -m tools.quantreport --world 8 --n 100000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+FULL_CONFIGS = [
+    ("int8", 8, 32), ("int8", 8, 64), ("int8", 8, 128),
+    ("int8", 4, 64), ("fp8", 8, 64), ("fp8", 8, 128),
+]
+FAST_CONFIGS = [("int8", 8, 64), ("int8", 4, 64), ("fp8", 8, 64)]
+
+
+def _inputs(world: int, n: int, seed: int, fast: bool):
+    rng = np.random.RandomState(seed)
+    cases = {
+        "gauss": (rng.randn(world, n) * rng.uniform(
+            0.1, 50.0, (world, 1))).astype(np.float32),
+        "mixed_scale": (rng.randn(world, n)
+                        * np.logspace(-6, 6, n)[None, :]).astype(np.float32),
+    }
+    if not fast:
+        adv = np.zeros((world, n), dtype=np.float32)
+        adv[:, : n // 3] = 1e-40                       # denormals
+        mid = slice(n // 3, 2 * n // 3)
+        adv[:, mid] = rng.randn(
+            world, adv[:, mid].shape[1]) * 1e30        # near-amax-overflow
+        cases["adversarial_finite"] = adv
+    return cases
+
+
+def run_report(configs, world: int, n: int, seed: int, fast: bool):
+    from ompi_tpu.quant.codec import make_codec
+
+    rows = []
+    cases = _inputs(world, n, seed, fast)
+    for mode, bits, block in configs:
+        try:
+            codec = make_codec(mode, bits, block)
+        except Exception as e:  # e.g. fp8 without ml_dtypes
+            rows.append({"mode": mode, "bits": bits, "block": block,
+                         "error": str(e)})
+            continue
+        worst_err = 0.0
+        worst_head = np.inf
+        for name, xs in cases.items():
+            res = codec.simulate_allreduce(xs)
+            exact = xs.astype(np.float64).sum(axis=0)
+            bound = codec.error_bound(xs)
+            err = np.abs(res.astype(np.float64) - exact)
+            ok = np.isfinite(bound)
+            if np.any(err[ok] > bound[ok]):
+                worst_head = 0.0
+            with np.errstate(divide="ignore", invalid="ignore"):
+                head = np.where(err[ok] > 0, bound[ok] / err[ok], np.inf)
+            worst_head = min(worst_head,
+                             float(head.min()) if head.size else np.inf)
+            worst_err = max(worst_err, float(err[ok].max()) if ok.any()
+                            else 0.0)
+        rows.append({
+            "mode": mode, "bits": bits, "block": block,
+            "max_err": worst_err,
+            "headroom": round(worst_head, 3) if np.isfinite(worst_head)
+            else "inf",
+            "bound_holds": bool(worst_head >= 1.0),
+            "wire_ratio": round(codec.ratio(n), 3),
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="quantreport",
+        description="Offline quant-codec error/compression report")
+    ap.add_argument("--fast", action="store_true",
+                    help="small tier-1 subset (3 configs, small vectors)")
+    ap.add_argument("--world", type=int, default=4)
+    ap.add_argument("--n", type=int, default=20000,
+                    help="elements per rank")
+    ap.add_argument("--seed", type=int, default=0)
+    opts = ap.parse_args(argv)
+
+    configs = FAST_CONFIGS if opts.fast else FULL_CONFIGS
+    n = min(opts.n, 4096) if opts.fast else opts.n
+    rows = run_report(configs, opts.world, n, opts.seed, opts.fast)
+
+    print(f"{'mode':<6} {'bits':>4} {'block':>5} {'max_err':>12} "
+          f"{'headroom':>9} {'holds':>6} {'ratio':>7}")
+    bad = 0
+    for r in rows:
+        if "error" in r:
+            print(f"{r['mode']:<6} {r['bits']:>4} {r['block']:>5} "
+                  f"  unavailable: {r['error']}")
+            continue
+        print(f"{r['mode']:<6} {r['bits']:>4} {r['block']:>5} "
+              f"{r['max_err']:>12.3e} {str(r['headroom']):>9} "
+              f"{str(r['bound_holds']):>6} {r['wire_ratio']:>7}")
+        if not r["bound_holds"]:
+            bad += 1
+
+    # output under metrics_dir, never CWD (reshardplan discipline)
+    from ompi_tpu.runtime import metrics
+
+    out_path = os.path.join(metrics._dir_var._value or ".",
+                            "quant-report.json")
+    try:
+        with open(out_path, "w") as f:
+            json.dump({"world": opts.world, "n": n, "configs": rows}, f,
+                      indent=1)
+        print(f"wrote {out_path}")
+    except OSError as e:
+        print(f"quantreport: cannot write {out_path}: {e}",
+              file=sys.stderr)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
