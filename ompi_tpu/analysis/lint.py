@@ -14,7 +14,8 @@ hot-guard                 in hot modules (parallel/mesh.py, pml/ob1.py,
                           sanitizer/metrics instrumentation call — and every
                           ft/inject.py chaos hook, ft/diskless.py
                           replication hook, reshard/ accounting
-                          hook, and quant/ codec-accounting hook
+                          hook, quant/ codec-accounting hook, and
+                          coll/hier note_* observability hook
                           (framework code allowed on
                           the wire path) — sits behind a live-Var
                           guard: ``X.enabled()`` / ``X._enable_var._value`` (or
@@ -99,7 +100,9 @@ ENVIRON_EXEMPT = ("mca/var.py", "tools/")
 INSTR_IMPL = ("runtime/trace.py", "runtime/sanitizer.py", "runtime/spc.py",
               "runtime/metrics.py", "ft/inject.py", "ft/diskless.py",
               "reshard/plan.py", "reshard/exec.py", "reshard/elastic.py",
-              "quant/__init__.py")
+              "quant/__init__.py", "coll/hier/__init__.py",
+              "coll/hier/plan.py", "coll/hier/decide.py",
+              "coll/hier/compose.py")
 
 TRACE_ALIASES = {"trace", "_trace", "_tr"}
 SAN_ALIASES = {"sanitizer", "_san", "_sanitizer"}
@@ -118,6 +121,10 @@ RESHARD_ALIASES = {"reshard", "_reshard", "_rs"}
 # quant/ codec-accounting hooks (quantized-collective byte counters and
 # the btl compress counters): same contract in hot modules
 QUANT_ALIASES = {"quant", "_quant", "_qc"}
+# coll/hier observability hooks (plan-cache counters + per-stage
+# latency observations): a note_* reached from hot code must ride the
+# same one-live-Var guard
+HIER_ALIASES = {"hier", "_hier"}
 INSTR_TRACE_ATTRS = {"span", "record_span", "instant", "counter",
                      "wrap_span"}
 INSTR_SAN_ATTRS = {"wrap_coll", "on_collective", "check_p2p",
@@ -128,6 +135,8 @@ INSTR_METRICS_ATTRS = {"on_coll_entry", "observe", "ewma_update",
 INSTR_DISKLESS_ATTRS = {"save", "flush_final", "attach"}
 INSTR_RESHARD_ATTRS = {"note_plan", "note_exec"}
 INSTR_QUANT_ATTRS = {"note_coll", "note_wire"}
+INSTR_HIER_ATTRS = {"note_stage", "note_plan_hit", "note_plan_miss",
+                    "note_retune"}
 
 _SUPPRESS_RE = re.compile(r"#\s*mpilint:\s*disable=([A-Za-z0-9_,\- ]+)")
 
@@ -240,6 +249,9 @@ def _instr_call(node: ast.AST) -> Optional[str]:
             if v.id in QUANT_ALIASES and \
                     node.func.attr in INSTR_QUANT_ATTRS:
                 return "quant"
+            if v.id in HIER_ALIASES and \
+                    node.func.attr in INSTR_HIER_ATTRS:
+                return "hier"
     return None
 
 
@@ -636,6 +648,7 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
 SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
     "hot-guard": ("ompi_tpu/pml/ob1.py", """
 from ompi_tpu import quant as _quant
+from ompi_tpu.coll import hier as _hier
 from ompi_tpu.ft import diskless as _diskless
 from ompi_tpu.ft import inject as _inject
 from ompi_tpu.reshard import exec as _reshard
@@ -648,6 +661,7 @@ def isend(self, dst):
     _diskless.flush_final(0.1)
     _reshard.note_exec(1, 2)
     _quant.note_wire(4096, 512)
+    _hier.note_stage("allreduce", "cross", 1.0)
     with _trace.span("pml.send", cat="pml"):
         return self._isend(dst)
 """),

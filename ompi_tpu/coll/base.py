@@ -69,13 +69,17 @@ class CollTable:
     def __init__(self):
         self.slots = {}
         self.providers = {}  # op -> component name, for introspection
-        # op -> the next-best module's fn for slots a higher-priority
-        # module won (reference keeps the whole priority-ordered module
-        # list on the comm; conditional components — coll/quant — route
-        # ineligible calls here so winning a slot can't silently
-        # downgrade the rest of the traffic to tuned/basic)
-        self.fallbacks = {}
-        self.fallback_providers = {}  # op -> component name, ditto
+        # op -> the FULL priority-ordered list of losing modules' fns for
+        # slots a higher-priority module won (reference keeps the whole
+        # priority-ordered module list on the comm). Conditional
+        # components (coll/quant, coll/hier) route ineligible calls down
+        # this chain so winning a slot can't silently downgrade the rest
+        # of the traffic to tuned/basic — and with more than one
+        # conditional component contesting a slot (quant over hier over
+        # han), a single runner-up entry would make the second delegation
+        # re-enter the module that just declined.
+        self.fallbacks = {}           # op -> [fn, ...] after the winner
+        self.fallback_providers = {}  # op -> [component name, ...], ditto
 
     def get(self, op: str):
         fn = self.slots.get(op)
@@ -84,6 +88,29 @@ class CollTable:
                 f"no collective module provides '{op}' for this communicator"
             )
         return fn
+
+    def next_after(self, op: str, name: str):
+        """The fn of the module ranked immediately below component
+        ``name`` in this slot's priority chain — the delegation target
+        for a conditional component routing an ineligible call to
+        whatever would own the slot had it not been selected. A caller
+        that is not in the chain (or is the winner) gets the first
+        fallback. Raises KeyError when nothing ranks below the caller
+        (coll/basic provides every op, so that is an invariant
+        violation worth surfacing loudly)."""
+        names = self.fallback_providers.get(op, [])
+        fns = self.fallbacks.get(op, [])
+        if name in names:
+            # each component appears at most once per slot (one module
+            # per component in _select_coll), so the next entry is it
+            i = names.index(name) + 1
+            if i < len(fns):
+                return fns[i]
+            raise KeyError(
+                f"no module ranks below '{name}' for slot '{op}'")
+        if not fns:
+            raise KeyError(f"no fallback chain recorded for slot '{op}'")
+        return fns[0]
 
 
 def select_coll(comm) -> CollTable:
@@ -107,9 +134,8 @@ def _select_coll(comm) -> CollTable:
             if fn is None:
                 continue
             if op in table.slots:
-                if op not in table.fallbacks:
-                    table.fallbacks[op] = fn
-                    table.fallback_providers[op] = name
+                table.fallbacks.setdefault(op, []).append(fn)
+                table.fallback_providers.setdefault(op, []).append(name)
             else:
                 table.slots[op] = fn
                 table.providers[op] = name

@@ -447,6 +447,19 @@ class BasicColl(CollModule):
             cv_unpack(prefix, robj, rcount, rdt)
 
 
+_flat_singleton: Optional["BasicColl"] = None
+
+
+def flat_module() -> "BasicColl":
+    """The shared stateless BasicColl instance — the one flat-fallback
+    module han/hier/decide delegate re-entrant or agreement traffic to
+    (each caching its own copy just duplicated an allocation)."""
+    global _flat_singleton
+    if _flat_singleton is None:
+        _flat_singleton = BasicColl()
+    return _flat_singleton
+
+
 class BasicCollComponent(Component):
     NAME = "basic"
     PRIORITY = 10  # fallback (reference: coll/basic priority 10)

@@ -31,6 +31,7 @@ from ompi_tpu.mca.var import register_var, get_var
 from ompi_tpu.runtime import spc
 
 import threading
+import weakref
 
 # guard: while han builds its own sub-communicators, their coll
 # selection must not pick han again (under fake topologies the
@@ -39,6 +40,31 @@ _building = threading.local()
 
 # per-process cache of universe-rank -> node identity (world-static)
 _node_sid_cache: dict = {}
+
+# per-comm HanColl registry: when BOTH han and coll/hier select on one
+# communicator they must share ONE module instance — and therefore ONE
+# lazily-built (low, up) sub-communicator pair — instead of each Split
+# its own copy (weak VALUES: the comm's coll table holds the module via
+# its bound slot fns, so the entry dies with the comm)
+_shared_modules: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
+def shared_han(comm, node_of: "List[int]") -> "HanColl":
+    """The ONE HanColl (and its lazily-built leader sub-communicators)
+    for this comm — han's component query and coll/hier's composer both
+    resolve through here. Node ids are normalized to first-seen order
+    BEFORE the identity check: han's modex map carries first-seen-RANK
+    ids ([0,0,2,2]) while hier's DomainMap is 0..k-1 ([0,0,1,1]) for
+    the same layout, and comparing the raw forms would silently defeat
+    the sharing on every real contiguous topology."""
+    first: dict = {}
+    norm = [first.setdefault(n, len(first)) for n in node_of]
+    key = comm.cid
+    m = _shared_modules.get(key)
+    if m is None or m._node_of != norm:
+        m = HanColl(norm)
+        _shared_modules[key] = m
+    return m
 
 register_var("coll_han", "fake_nodes", 0,
              help="Pretend the comm spans N nodes (round-robin by rank) — "
@@ -106,9 +132,9 @@ class HanColl(CollModule):
         agreement's Allreduce) that dispatch back into han's own slots —
         without this delegation the first collective deadlocks on
         itself."""
-        from ompi_tpu.coll.basic import BasicColl
+        from ompi_tpu.coll.basic import flat_module
 
-        return BasicColl()
+        return flat_module()
 
     def allreduce(self, comm, sendbuf, recvbuf, op: _op.Op = _op.SUM) -> None:
         """low reduce -> leaders allreduce -> low bcast (the han
@@ -224,14 +250,14 @@ class HanCollComponent(Component):
         if fake > 1:
             if fake >= comm.size:
                 return None  # no node would hold 2+ ranks
-            return HanColl([r % fake for r in range(comm.size)])
+            return shared_han(comm, [r % fake for r in range(comm.size)])
         node_of = self._modex_node_map(comm)
         if node_of is None:
             return None
         n_nodes = len(set(node_of))
         biggest = max(node_of.count(n) for n in set(node_of))
         if n_nodes >= 2 and biggest >= 2:
-            return HanColl(node_of)
+            return shared_han(comm, node_of)
         return None
 
     @staticmethod

@@ -1,0 +1,72 @@
+"""coll/hier — hierarchical collective composer with frozen cached plans.
+
+Three modules (HiCCL's layering, arxiv 2408.05962, composed with the
+multi-process-per-accelerator split patterns of arxiv 2508.13397):
+
+- :mod:`compose` — decomposes allreduce/bcast/allgather/
+  reduce_scatter_block into per-domain stages (intra-host via the
+  sm-backed low comm, intra-slice leaders, cross-host leaders over tcp)
+  on han's lazily-built leader sub-communicators.
+- :mod:`decide` — tuned-style static tables that **self-tune** from the
+  metrics plane's observed per-stage latency EWMAs; re-scores are
+  latched with hysteresis and applied on an agreed collective index so
+  every member switches plans together (never a torn composition).
+- :mod:`plan` — the frozen :class:`~ompi_tpu.coll.hier.plan.CollPlan`
+  cache behind ``ProcComm._coll``: the steady state of EVERY proc-mode
+  collective dispatch (hier-owned or not) is one dict hit + an epoch
+  compare + execute.
+
+This package owns the observability hooks (the mpilint-covered
+``note_*`` surface) and the ``hier_plan_hits/misses/retunes`` pvars;
+keep it import-light — ``comm/communicator.py`` loads it on the verb
+dispatch path.
+"""
+
+from __future__ import annotations
+
+from ompi_tpu.mca.var import register_pvar
+
+# dispatch-plan counters (bumped inline on the ProcComm._coll fast path
+# — a list-slot add, no function call, so the cache hit stays one dict
+# hit + execute)
+_plan_hits = [0]
+_plan_misses = [0]
+_retunes = [0]
+
+register_pvar("hier", "plan_hits", lambda: _plan_hits[0],
+              help="Frozen-plan cache hits in ProcComm._coll on THIS "
+                   "rank (steady-state dispatches: one dict hit + "
+                   "execute)")
+register_pvar("hier", "plan_misses", lambda: _plan_misses[0],
+              help="Frozen-plan cache misses (first dispatch per slot "
+                   "plus every epoch invalidation: comm change, "
+                   "relevant cvar write, decide.py re-score)")
+register_pvar("hier", "retunes", lambda: _retunes[0],
+              help="Plan switches applied on THIS rank by the "
+                   "self-tuning decision engine (hier <-> flat), "
+                   "always on an agreed collective index")
+
+
+def note_plan_hit() -> None:
+    """One frozen-plan cache hit (hot call sites bump the counter
+    inline; this hook exists for tools and the lint contract)."""
+    _plan_hits[0] += 1
+
+
+def note_plan_miss() -> None:
+    """One frozen-plan rebuild (plan.py calls this on the slow path)."""
+    _plan_misses[0] += 1
+
+
+def note_retune() -> None:
+    """One applied plan switch (decide.py sync, on the agreed index)."""
+    _retunes[0] += 1
+
+
+def note_stage(verb: str, stage: str, us: float) -> None:
+    """Per-stage latency observation -> the metrics registry histogram
+    (``hier_stage_us``). Call sites outside the hier impl modules must
+    guard on ``metrics.enabled()`` (the mpilint hot-guard contract)."""
+    from ompi_tpu.runtime import metrics as _metrics
+
+    _metrics.observe("hier_stage_us", us, verb=verb, stage=stage)

@@ -71,12 +71,16 @@ class QuantProcColl(CollModule):
 
     def _delegate(self, comm, op_name: str):
         """Ineligible calls run on the module that would own this slot
-        had quant not been selected (CollTable.fallbacks — smcoll/han/
-        adaptive outrank tuned, so hard-wiring tuned here would
-        silently downgrade every non-quantized collective on a
-        quant-negotiated communicator). coll/basic provides every op,
-        so a runner-up is always recorded for any slot quant won."""
-        return comm.coll.fallbacks[op_name]
+        had quant not been selected (the CollTable fallback CHAIN —
+        smcoll/han/hier/adaptive outrank tuned, so hard-wiring tuned
+        here would silently downgrade every non-quantized collective on
+        a quant-negotiated communicator). next_after walks the full
+        priority-ordered chain: with hier also contesting the slot the
+        runner-up is itself conditional, and it must delegate onward
+        from ITS position instead of bouncing back here. coll/basic
+        provides every op, so the chain is never empty for a slot quant
+        won."""
+        return comm.coll.next_after(op_name, "quant")
 
     # ------------------------------------------------------- eligibility
     @staticmethod

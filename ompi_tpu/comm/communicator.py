@@ -40,6 +40,8 @@ from ompi_tpu.core.errors import (
 from ompi_tpu.core.group import Group
 from ompi_tpu.core.request import Request
 from ompi_tpu.core.status import Status
+from ompi_tpu.coll import hier as _hier
+from ompi_tpu.coll.hier import plan as _cplan
 from ompi_tpu.runtime import peruse, spc
 from ompi_tpu.runtime import metrics as _metrics
 from ompi_tpu.runtime import sanitizer as _san
@@ -257,6 +259,9 @@ class ProcComm(Intracomm):
         super().__init__(group, cid, name)
         self.pml = pml
         self.rank = group.rank_of(pml.my_rank)
+        # frozen dispatch plans (coll/hier/plan.py): verb -> CollPlan,
+        # rebuilt on global-epoch misses, cleared at Free
+        self._plans: Dict[str, Any] = {}
         from ompi_tpu.coll.base import select_coll
 
         self.coll = select_coll(self)
@@ -403,26 +408,21 @@ class ProcComm(Intracomm):
 
     # ---------------------------------------------------------- collectives
     def _coll(self, op: str):
-        self._check_usable()
-        # SPC_RECORD analog: one counter bump per collective invocation
-        # (reference: the SPC_RECORD(OMPI_SPC_ALLREDUCE) in every binding,
-        # allreduce.c.in:44); library-internal collectives are suppressed
-        # at their call sites so counters reflect user activity
-        spc.record(op)
-        fn = self.coll.get(op)
-        if _metrics._enable_var._value:
-            # straggler plane: stamp collective entry at dispatch and
-            # ship it to the comm root (runtime/metrics.py); one live
-            # attribute load when the metrics plane is off
-            _metrics.on_coll_entry(self, op)
-        if _san._enable_var._value:
-            # call-order matching sees the buffers, so the interposition
-            # happens here on the resolved slot, before any schedule or
-            # transport work runs
-            fn = _san.wrap_coll(self, op, fn)
-        if _trace.enabled():
-            return _trace.wrap_span(f"comm.{op}", "comm", fn)
-        return fn
+        # Frozen-plan dispatch (coll/hier/plan.py): the SPC record,
+        # metrics entry stamp, sanitizer interposition, and trace span
+        # are pre-bound into plan.fn at first dispatch, so the steady
+        # state is ONE dict hit + an epoch compare (BENCH_r05's 20-50us
+        # per-verb layer tax re-did all of it per call). Stale-config
+        # hazards are handled by invalidation: cvar watchers bump the
+        # global epoch, Free clears the comm's plans, and revocation is
+        # checked inside the frozen prologue.
+        plan = self._plans.get(op)
+        if plan is not None and plan.epoch == _cplan._EPOCH[0]:
+            _hier._plan_hits[0] += 1
+            return plan.fn
+        plan = _cplan.build(self, op)
+        self._plans[op] = plan
+        return plan.fn
 
     def Barrier(self) -> None:
         self._coll("barrier")(self)
@@ -660,8 +660,11 @@ class ProcComm(Intracomm):
         # reclaim the straggler plane's per-comm state (call index,
         # tracker rows/latches, skew EWMAs) — unconditionally: a tool
         # may have enabled metrics for a window and flipped it back off,
-        # and state recorded during the window must not outlive the comm
+        # and state recorded during the window must not outlive the comm.
+        # The sweep also runs registered forget hooks (coll/hier's
+        # decide-state reclaim rides it).
         _metrics._forget_cid(self.cid)
+        self._plans.clear()  # frozen dispatch plans die with the comm
         self.coll = None
         self._freed = True
 

@@ -98,6 +98,13 @@ class Var:
 _lock = threading.Lock()
 _registry: Dict[str, Var] = {}
 _file_params: Optional[Dict[str, str]] = None
+# full_name -> callbacks fired after a programmatic set_var lands (the
+# reference's mca_base_var notification analog). Consumers that freeze
+# config into cached state (coll/hier/plan.py's frozen dispatch plans)
+# register here so a runtime write invalidates the cache instead of
+# silently going stale. Keyed by name so watchers may be installed
+# before the Var itself is registered.
+_watchers: Dict[str, list] = {}
 
 
 def _load_param_file() -> Dict[str, str]:
@@ -182,7 +189,20 @@ def get_var(framework: str, name: str) -> Any:
 
 def set_var(framework: str, name: str, value: Any) -> None:
     """Programmatic override (reference: --mca CLI source)."""
-    _registry[f"{framework}_{name}"]._apply(value, VarSource.SET)
+    key = f"{framework}_{name}"
+    _registry[key]._apply(value, VarSource.SET)
+    with _lock:
+        cbs = list(_watchers.get(key, ()))
+    for cb in cbs:
+        cb(_registry[key])
+
+
+def watch_var(framework: str, name: str, cb: Callable[[Var], None]) -> None:
+    """Fire ``cb(var)`` after every successful ``set_var`` on the named
+    variable. File/env sources resolve at registration time (before any
+    consumer could have cached), so only programmatic writes notify."""
+    with _lock:
+        _watchers.setdefault(f"{framework}_{name}", []).append(cb)
 
 
 def all_vars() -> Dict[str, Var]:

@@ -418,6 +418,18 @@ def _root_record(pml, cid: int, idx: int, rank: int, ts_us: int,
                          "ewma": v, "detail": detail})
 
 
+# other planes keying live state by cid (coll/hier's decide engine)
+# register here so one Free/vanish sweep reclaims every layer's state
+_forget_hooks: List[Callable[[int], None]] = []
+
+
+def register_forget_hook(fn: Callable[[int], None]) -> None:
+    """Run ``fn(cid)`` whenever per-comm metrics state is reclaimed
+    (ProcComm.Free on every rank; the root's late-stamp lookup miss)."""
+    with _lock:
+        _forget_hooks.append(fn)
+
+
 def _forget_cid(cid: int) -> None:
     """Drop every piece of per-comm straggler state (tracker rows and
     latches, the local call-index counter, the per-member skew EWMAs)
@@ -431,6 +443,12 @@ def _forget_cid(cid: int) -> None:
         _idx.pop(cid, None)
         for key in [k for k in _ewmas if want in k[1]]:
             del _ewmas[key]
+        hooks = list(_forget_hooks)
+    for fn in hooks:
+        try:
+            fn(cid)
+        except Exception:
+            pass  # a broken hook must not poison Free/late-stamp paths
 
 
 def _on_system(hdr, payload) -> None:

@@ -64,6 +64,7 @@ import ompi_tpu.coll.tuned  # noqa: F401,E402
 import ompi_tpu.coll.nbc  # noqa: F401,E402
 import ompi_tpu.coll.neighbor  # noqa: F401,E402
 import ompi_tpu.coll.han  # noqa: F401,E402
+import ompi_tpu.coll.hier.compose  # noqa: F401,E402  (hierarchical composer)
 import ompi_tpu.coll.smcoll  # noqa: F401,E402
 import ompi_tpu.coll.adaptive  # noqa: F401,E402
 import ompi_tpu.coll.quant  # noqa: F401,E402  (quantized collectives)

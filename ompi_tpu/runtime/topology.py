@@ -159,3 +159,63 @@ def maybe_bind(rank: int, size: int) -> Optional[List[int]]:
     if not get_var("topo", "bind_ranks"):
         return None
     return bind_rank(rank, size)
+
+
+# ---------------------------------------------------- collective domains
+@dataclasses.dataclass(frozen=True)
+class DomainMap:
+    """Per-communicator locality hierarchy for the hierarchical
+    collective composer (coll/hier): host (sm/CMA domain) within slice
+    (ICI domain) within world (DCN). Built from the modex node identity
+    (the SAME cards on every member, so every rank derives the SAME map
+    — per-rank heuristics would tear the composition) plus an optional
+    slice grouping; ids are normalized to 0..k-1 in first-seen comm-rank
+    order so leader/offset math is stable."""
+
+    node_of: tuple          # node id per comm rank (normalized)
+    slice_of_node: tuple    # slice id per node id (normalized)
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.slice_of_node)
+
+    @property
+    def n_slices(self) -> int:
+        return len(set(self.slice_of_node)) if self.slice_of_node else 0
+
+    @property
+    def biggest_node(self) -> int:
+        counts: Dict[int, int] = {}
+        for n in self.node_of:
+            counts[n] = counts.get(n, 0) + 1
+        return max(counts.values()) if counts else 0
+
+    @property
+    def nontrivial(self) -> bool:
+        """The han decision rule: >=2 nodes AND >=2 ranks on some node —
+        otherwise the per-domain split degenerates and flat wins."""
+        return self.n_nodes >= 2 and self.biggest_node >= 2
+
+    def slice_of_rank(self, rank: int) -> int:
+        return self.slice_of_node[self.node_of[rank]]
+
+    def members_of_node(self, node: int) -> List[int]:
+        return [r for r, n in enumerate(self.node_of) if n == node]
+
+
+def domain_map(raw_node_ids, fake_slices: int = 0) -> DomainMap:
+    """Normalize raw per-rank node identities (modex card strings or
+    fake round-robin ints) into a :class:`DomainMap`. ``fake_slices``
+    groups nodes round-robin into that many slices (the single-host
+    test hook for the three-level composition); 0/1 puts every node in
+    one slice — the two-level degenerate case."""
+    first: Dict = {}
+    node_of = tuple(first.setdefault(sid, len(first))
+                    for sid in raw_node_ids)
+    n_nodes = len(first)
+    k = int(fake_slices)
+    if k > 1:
+        slice_of_node = tuple(n % min(k, n_nodes) for n in range(n_nodes))
+    else:
+        slice_of_node = tuple(0 for _ in range(n_nodes))
+    return DomainMap(node_of, slice_of_node)

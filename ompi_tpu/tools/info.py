@@ -45,6 +45,8 @@ def _load_everything() -> None:
     import ompi_tpu.quant  # quant_* cvars + colls/bytes pvars
     import ompi_tpu.quant.negotiate  # negotiation topics
     import ompi_tpu.coll.quant  # quantized-collectives component
+    import ompi_tpu.coll.hier.compose  # hier composer + coll_hier cvars
+    import ompi_tpu.coll.hier  # hier_plan_hits/misses/retunes pvars
     import ompi_tpu.btl.tcp  # btl_tcp compress cvars + ratio pvars
 
 

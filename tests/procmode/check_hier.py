@@ -1,0 +1,177 @@
+"""Hierarchical composer (coll/hier) under a fake multi-node topology.
+
+Three modes (argv[1]):
+
+- ``correctness`` (default) — hier must own the composed slots and every
+  composed verb must be BITWISE-equal to the flat fallback chain on the
+  same inputs (integer-valued payloads make float sums exact, so any
+  regrouping bug shows as a bit difference, not an epsilon).
+- ``chaos`` — a deterministic delay injected into the cross-host stage
+  after N calls must trip the self-tuning re-score EXACTLY ONCE
+  (latched) and every rank must switch plans on the SAME collective
+  index; run for 5 independent episodes (fresh Dup'd comm each).
+- ``three`` — the three-level host/slice/cross composition
+  (fake_nodes x fake_slices) stays correct.
+"""
+
+import sys
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.mca.var import get_var
+
+
+def _flat(comm, slot):
+    return comm.coll.next_after(slot, "hier")
+
+
+def _check_verbs(comm) -> None:
+    r = comm.Get_rank()
+    n = comm.Get_size()
+    rng = np.random.RandomState(100 + r)
+
+    for dtype in (np.float64, np.int64, np.float32):
+        # integer-valued payloads: float sums are exact, so hier's
+        # regrouped reduction order must match flat bit-for-bit
+        x = rng.randint(-1000, 1000, size=32).astype(dtype)
+
+        # allreduce SUM + MAX
+        for op in (mpi_op.SUM, mpi_op.MAX):
+            got = np.zeros_like(x)
+            comm.Allreduce(x, got, op=op)
+            want = np.zeros_like(x)
+            _flat(comm, "allreduce")(comm, x, want, op)
+            assert got.tobytes() == want.tobytes(), (
+                "allreduce", dtype, op.name, got, want)
+
+        # bcast from every root (crosses node boundaries both ways)
+        for root in range(n):
+            a = x.copy() if r == root else np.zeros_like(x)
+            b = a.copy()
+            comm.Bcast(a, root=root)
+            _flat(comm, "bcast")(comm, b, root)
+            assert a.tobytes() == b.tobytes(), ("bcast", dtype, root)
+
+        # allgather
+        ga = np.zeros(n * x.size, dtype)
+        gb = np.zeros(n * x.size, dtype)
+        comm.Allgather(x, ga)
+        _flat(comm, "allgather")(comm, x, gb)
+        assert ga.tobytes() == gb.tobytes(), ("allgather", dtype)
+
+        # reduce_scatter_block
+        big = rng.randint(-1000, 1000, size=n * 16).astype(dtype)
+        ra = np.zeros(16, dtype)
+        rb = np.zeros(16, dtype)
+        comm.Reduce_scatter_block(big, ra)
+        _flat(comm, "reduce_scatter_block")(comm, big, rb, mpi_op.SUM)
+        assert ra.tobytes() == rb.tobytes(), ("reduce_scatter_block",
+                                              dtype)
+
+    # non-commutative ops delegate and stay correct (exercises the
+    # full-chain delegation, not the composition)
+    nc = mpi_op.Op.Create(lambda a, b: b - a, commute=False, name="ncop")
+    y = np.full(4, float(r + 1))
+    out = np.zeros(4)
+    comm.Allreduce(y, out, op=nc)
+    want = np.full(4, 1.0)
+    for i in range(1, n):
+        want = (i + 1.0) - want
+    np.testing.assert_array_equal(out, want)
+
+
+def main_correctness() -> int:
+    r = COMM_WORLD.Get_rank()
+    for slot in ("allreduce", "bcast", "allgather",
+                 "reduce_scatter_block"):
+        assert COMM_WORLD.coll.providers[slot] == "hier", (
+            slot, COMM_WORLD.coll.providers[slot])
+        assert COMM_WORLD.coll.fallback_providers[slot], slot
+    _check_verbs(COMM_WORLD)
+
+    # the frozen-plan cache must be doing its job: repeated dispatches
+    # are hits, and the miss count stays bounded by (slots x rebuilds)
+    from ompi_tpu.mca.var import all_pvars
+
+    pv = all_pvars()
+    hits = pv["hier_plan_hits"].value
+    misses = pv["hier_plan_misses"].value
+    assert hits > misses > 0, (hits, misses)
+
+    print(f"HIER-OK rank {r}")
+    return 0
+
+
+def main_three() -> int:
+    r = COMM_WORLD.Get_rank()
+    assert int(get_var("coll_hier", "fake_slices")) >= 2
+    for slot in ("allreduce", "bcast", "allgather"):
+        assert COMM_WORLD.coll.providers[slot] == "hier", slot
+    _check_verbs(COMM_WORLD)
+    print(f"HIER3-OK rank {r}")
+    return 0
+
+
+def main_chaos() -> int:
+    """5 episodes: injected cross-stage delay -> one latched re-score,
+    applied by every rank on the same call index."""
+    from ompi_tpu.coll.hier import decide
+
+    r = COMM_WORLD.Get_rank()
+    n = COMM_WORLD.Get_size()
+    episodes = 5
+    calls = 36  # sync points at 8/16/24/32 — room for a late trip on
+    # a loaded host (the injected delay dwarfs any plausible floor, but
+    # the EWMA needs a few folds to cross factor x floor)
+    interval = int(get_var("coll_hier", "rescore_interval"))
+    ok = 0
+    for ep in range(episodes):
+        comm = COMM_WORLD.Dup()
+        x = np.ones(64, np.float64) * (r + 1)
+        y = np.zeros(64, np.float64)
+        correct = True
+        for i in range(calls):
+            comm.Allreduce(x, y)
+            correct = correct and y[0] == n * (n + 1) / 2 * 1.0
+        st = decide.state_for(comm, "allreduce")
+        # gather every rank's verdict FIRST (over the flat chain, not
+        # the composition under test), assert after: a rank bailing
+        # early on a local assert would tear the collective and turn a
+        # clean failure into a spin timeout
+        mine = np.array([st.switch_log[0] if st.switch_log else -1,
+                         len(st.switch_log),
+                         1 if st.active == "flat" else 0,
+                         st.trips if comm.rank == 0 else -1,
+                         1 if correct else 0], np.int64)
+        allv = np.zeros(5 * n, np.int64)
+        _flat(comm, "allgather")(comm, mine, allv)
+        rows = allv.reshape(n, 5)
+        comm.Free()
+        assert all(int(p[4]) == 1 for p in rows), ("arith", ep, rows)
+        # exactly one applied switch, landing on hier -> flat, on the
+        # SAME sync index on every rank
+        assert all(int(p[1]) == 1 and int(p[2]) == 1 for p in rows), (
+            ep, rows)
+        first = int(rows[0][0])
+        assert first >= 0 and first % interval == 0, (ep, rows)
+        assert all(int(p[0]) == first for p in rows), (ep, rows)
+        # the root's latch tripped exactly once (hysteresis held)
+        assert int(rows[0][3]) == 1, (ep, rows)
+        ok += 1
+    from ompi_tpu.mca.var import all_pvars
+
+    assert all_pvars()["hier_retunes"].value == episodes
+    print(f"CHAOS-OK rank {r} episodes={ok}")
+    return 0
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "correctness"
+    if mode == "chaos":
+        sys.exit(main_chaos())
+    if mode == "three":
+        sys.exit(main_three())
+    sys.exit(main_correctness())

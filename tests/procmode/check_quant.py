@@ -81,8 +81,7 @@ def quant_mode() -> int:
     # ---- integer allreduce stays exact (delegation) — and it routes
     # to the recorded runner-up module, not a hard-wired tuned instance
     fp = COMM_WORLD.coll.fallback_providers.get("allreduce")
-    assert fp is not None and fp != "quant", \
-        COMM_WORLD.coll.fallback_providers
+    assert fp and "quant" not in fp, COMM_WORLD.coll.fallback_providers
     iv = np.full(8, r + 1, np.int64)
     io = np.zeros(8, np.int64)
     COMM_WORLD.Allreduce(iv, io)

@@ -1,0 +1,302 @@
+"""Hierarchical composer + frozen plan cache (coll/hier).
+
+Unit coverage for the plan cache (hits/misses, cvar-write and re-score
+invalidation, revocation), the fallback chain walk, the decide engine's
+static tables and domain maps — plus the procmode proofs: bitwise
+equality of every composed verb with the flat chain on a faked
+2-node x 2-rank topology (and the 3-level 4-node x 2-slice shape), and
+the chaos-delay self-tuning switch landing exactly once on the same
+call index on every rank.
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.coll import hier as hier_pkg
+from ompi_tpu.coll.hier import plan as hier_plan
+from ompi_tpu.mca.var import get_var, set_var, watch_var
+from tests.test_process_mode import run_mpi
+
+# mca knobs shared by the procmode correctness runs: selftune off so a
+# load transient can't re-score the run onto the flat path mid-proof
+# (the chaos test owns the self-tuning proof)
+_CORRECT_MCA = (("coll_hier_fake_nodes", "2"),
+                ("coll_hier_selftune", "0"))
+
+_CHAOS_MCA = (("coll_hier_fake_nodes", "2"),
+              ("coll_hier_rescore_interval", "8"),
+              ("coll_hier_min_samples", "4"),
+              ("coll_hier_retune_factor", "3.0"),
+              # absolute margin >> any plausible host-noise EWMA swing,
+              # << the injected degradation
+              ("coll_hier_retune_min_us", "50000"),
+              ("coll_hier_inject_stage", "cross"),
+              ("coll_hier_inject_delay_ms", "150"),
+              ("coll_hier_inject_after", "12"))
+
+
+# ------------------------------------------------------------ plan cache
+def test_hier_not_selected_on_trivial_topology():
+    # the singleton world is one node/one rank: hier declines, self wins
+    assert COMM_WORLD.coll.providers["allreduce"] != "hier"
+
+
+def test_plan_cache_hits_and_misses():
+    comm = COMM_WORLD.Dup()
+    try:
+        h0, m0 = hier_pkg._plan_hits[0], hier_pkg._plan_misses[0]
+        x = np.ones(4)
+        y = np.zeros(4)
+        comm.Allreduce(x, y)  # first dispatch freezes the plan
+        assert hier_pkg._plan_misses[0] >= m0 + 1
+        m1 = hier_pkg._plan_misses[0]
+        comm.Allreduce(x, y)
+        comm.Allreduce(x, y)
+        assert hier_pkg._plan_hits[0] >= h0 + 2
+        assert hier_pkg._plan_misses[0] == m1  # steady state: no rebuild
+    finally:
+        comm.Free()
+
+
+def test_plan_invalidated_on_relevant_cvar_write():
+    comm = COMM_WORLD.Dup()
+    try:
+        x = np.ones(4)
+        y = np.zeros(4)
+        comm.Allreduce(x, y)
+        m0 = hier_pkg._plan_misses[0]
+        # a relevant cvar write bumps the global epoch -> rebuild once
+        set_var("trace", "enable", get_var("trace", "enable"))
+        comm.Allreduce(x, y)
+        assert hier_pkg._plan_misses[0] == m0 + 1
+        comm.Allreduce(x, y)
+        assert hier_pkg._plan_misses[0] == m0 + 1  # and only once
+    finally:
+        comm.Free()
+
+
+def test_frozen_plan_still_checks_revocation():
+    from ompi_tpu.core.errors import MPIError
+
+    comm = COMM_WORLD.Dup()
+    x = np.ones(4)
+    y = np.zeros(4)
+    comm.Allreduce(x, y)  # freeze
+    comm.revoked = True
+    with pytest.raises(MPIError):
+        comm.Allreduce(x, y)
+    comm.revoked = False
+    comm.Allreduce(x, y)  # and the plan still works after
+    comm.Free()
+
+
+def test_plan_binds_enabled_sanitizer_and_unbinds_on_disable():
+    """The frozen chain must carry the instrumentation that was enabled
+    at build time — and drop it on the cvar write, not keep a stale
+    wrapper forever."""
+    from ompi_tpu.runtime import sanitizer as san
+
+    comm = COMM_WORLD.Dup()
+    try:
+        x = np.ones(4)
+        y = np.zeros(4)
+        set_var("sanitizer", "enable", True)
+        comm.Allreduce(x, y)
+        p = comm._plans["allreduce"]
+        # the bound fn closes over the sanitizer wrapper
+        assert "checked" in repr(p.fn.__kwdefaults__["_inner"])
+        set_var("sanitizer", "enable", False)
+        comm.Allreduce(x, y)
+        p2 = comm._plans["allreduce"]
+        assert p2 is not p
+        assert "checked" not in repr(p2.fn.__kwdefaults__["_inner"])
+    finally:
+        set_var("sanitizer", "enable", False)
+        comm.Free()
+
+
+def test_plans_die_with_the_comm():
+    comm = COMM_WORLD.Dup()
+    comm.Allreduce(np.ones(4), np.zeros(4))
+    assert comm._plans
+    comm.Free()
+    assert not comm._plans
+
+
+def test_watch_var_fires_on_set():
+    from ompi_tpu.mca.var import register_var
+
+    register_var("hier_test", "knob", 1)
+    seen = []
+    watch_var("hier_test", "knob", lambda v: seen.append(v.value))
+    set_var("hier_test", "knob", 7)
+    assert seen == [7]
+
+
+# ------------------------------------------------------- fallback chain
+def test_next_after_walks_the_chain(monkeypatch):
+    from ompi_tpu.coll import base as cb
+
+    class Hi(cb.CollModule):
+        def allreduce(self, comm, *a):
+            return "hi"
+
+    class Mid(cb.CollModule):
+        def allreduce(self, comm, *a):
+            return "mid"
+
+    class Lo(cb.CollModule):
+        def allreduce(self, comm, *a):
+            return "lo"
+
+    monkeypatch.setattr(
+        cb.coll_framework, "select_all",
+        lambda comm=None: [(110, "hi", Hi()), (55, "mid", Mid()),
+                           (10, "lo", Lo())])
+    t = cb._select_coll(object())
+    # the winner's delegation target is the runner-up; a conditional
+    # runner-up delegates onward from ITS chain position — the
+    # three-deep contested slot that a single-fn fallback got wrong
+    assert t.next_after("allreduce", "hi")(None) == "mid"
+    assert t.next_after("allreduce", "mid")(None) == "lo"
+    with pytest.raises(KeyError):
+        t.next_after("allreduce", "lo")  # nothing below the last
+    with pytest.raises(KeyError):
+        t.next_after("allgather", "hi")  # unprovided slot
+
+
+def test_shared_han_normalizes_node_ids():
+    """han's modex map carries first-seen-RANK node ids while hier's
+    DomainMap is 0..k-1 — shared_han must normalize before the identity
+    check or the one-Split-per-comm sharing silently never happens on
+    contiguous layouts."""
+    from ompi_tpu.coll.han import shared_han
+
+    class FakeComm:
+        cid = 55555
+
+    a = shared_han(FakeComm(), [0, 0, 2, 2])  # han's raw form
+    b = shared_han(FakeComm(), [0, 0, 1, 1])  # hier's normalized form
+    assert a is b
+    assert a._node_of == [0, 0, 1, 1]
+    # a genuinely different layout still gets its own module
+    c = shared_han(FakeComm(), [0, 1, 0, 1])
+    assert c is not a
+
+
+# ------------------------------------------------------- decide/domains
+def test_domain_map_normalizes_and_classifies():
+    from ompi_tpu.runtime.topology import domain_map
+
+    dm = domain_map(["b", "a", "b", "a"])
+    assert dm.node_of == (0, 1, 0, 1)
+    assert dm.n_nodes == 2 and dm.biggest_node == 2
+    assert dm.nontrivial
+    assert dm.members_of_node(0) == [0, 2]
+    # degenerate shapes decline
+    assert not domain_map(["a", "a", "a"]).nontrivial     # one node
+    assert not domain_map(["a", "b", "c"]).nontrivial     # all solo
+
+    dm3 = domain_map([r % 4 for r in range(8)], fake_slices=2)
+    assert dm3.n_slices == 2
+    assert dm3.slice_of_rank(0) == 0 and dm3.slice_of_rank(1) == 1
+
+
+def test_decide_static_state_and_forget():
+    from ompi_tpu.coll.hier import decide
+
+    class FakeComm:
+        cid = 987654
+        size = 4
+        rank = 0
+
+    st = decide.state_for(FakeComm(), "allreduce")
+    assert st.active == "hier" and st.idx == 0
+    assert decide.state_for(FakeComm(), "allreduce") is st
+    # interval boundaries only, and never at call 0
+    saved = get_var("coll_hier", "selftune")
+    set_var("coll_hier", "selftune", True)
+    try:
+        assert not decide.sync_due(0)
+        interval = int(get_var("coll_hier", "rescore_interval"))
+        assert decide.sync_due(interval)
+        assert not decide.sync_due(interval + 1)
+        set_var("coll_hier", "selftune", False)
+        assert not decide.sync_due(interval)
+    finally:
+        set_var("coll_hier", "selftune", saved)
+    decide._forget_cid(987654)
+    assert (987654, "allreduce") not in decide._states
+
+
+def test_decide_fold_latches_once_with_hysteresis():
+    from ompi_tpu.coll.hier import decide
+
+    st = decide.VerbState(111, "allreduce", "hier")
+    saved = (get_var("coll_hier", "min_samples"),
+             get_var("coll_hier", "retune_factor"),
+             get_var("coll_hier", "retune_min_us"))
+    set_var("coll_hier", "min_samples", 2)
+    set_var("coll_hier", "retune_factor", 3.0)
+    set_var("coll_hier", "retune_min_us", 10.0)
+    try:
+        for _ in range(8):
+            decide._fold(st, "hier", 100.0, {})
+        assert st.pending is None
+        # degradation: EWMA climbs past 3x the 100us floor
+        for _ in range(20):
+            decide._fold(st, "hier", 5000.0, {})
+        assert st.pending == "flat" and st.trips == 1
+        # latched: more bad samples must not re-trip
+        for _ in range(5):
+            decide._fold(st, "hier", 5000.0, {})
+        assert st.trips == 1
+        # apply the switch; folds for the old plan are stale -> ignored
+        st.root_active, st.pending = "flat", None
+        decide._fold(st, "hier", 5000.0, {})
+        # the new plan warms up, recovers, and the latch re-arms
+        for _ in range(20):
+            decide._fold(st, "flat", 100.0, {})
+        assert not st.latched
+    finally:
+        set_var("coll_hier", "min_samples", saved[0])
+        set_var("coll_hier", "retune_factor", saved[1])
+        set_var("coll_hier", "retune_min_us", saved[2])
+        decide._forget_cid(111)
+
+
+def test_hier_component_declines_without_topology():
+    from ompi_tpu.coll.hier.compose import HierCollComponent
+
+    # singleton world: size 1, no domain map -> decline
+    assert HierCollComponent().query(comm=ompi_tpu.get_world()) is None
+
+
+# ---------------------------------------------------------- procmode
+def test_hier_fake_2x2_bitwise_equal_to_flat():
+    r = run_mpi(4, "tests/procmode/check_hier.py", mca=_CORRECT_MCA,
+                timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("HIER-OK") == 4
+
+
+def test_hier_three_level_slices():
+    r = run_mpi(8, "tests/procmode/check_hier.py", "three",
+                mca=(("coll_hier_fake_nodes", "4"),
+                     ("coll_hier_fake_slices", "2"),
+                     ("coll_hier_selftune", "0")), timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("HIER3-OK") == 8
+
+
+def test_hier_chaos_rescore_switches_once_on_same_index():
+    """The ISSUE's determinism proof: 5 episodes of injected cross-host
+    delay; each trips the re-score exactly once (latched) and every
+    rank switches plans on the same collective index."""
+    r = run_mpi(4, "tests/procmode/check_hier.py", "chaos",
+                mca=_CHAOS_MCA, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("CHAOS-OK") == 4
+    assert r.stdout.count("episodes=5") == 4

@@ -129,6 +129,36 @@ def test_metrics_module_is_in_the_instrumented_impl_set():
     assert "runtime/metrics.py" in lint.INSTR_IMPL
 
 
+def test_hot_guard_covers_hier_hooks():
+    """The coll/hier observability hooks (note_stage + plan-cache
+    counters) ride the hot-guard contract: unguarded calls in a hot
+    module fire; guarded calls and non-hot modules pass."""
+    bare = (
+        "from ompi_tpu.coll import hier as _hier\n"
+        "def _coll(self, op):\n"
+        "    _hier.note_stage('allreduce', 'cross', 1.0)\n"
+        "    _hier.note_plan_hit()\n"
+    )
+    hot = lint.lint_source(bare, "ompi_tpu/pml/ob1.py")
+    assert sum(f.rule == "hot-guard" for f in hot) == 2
+    assert not any(f.rule == "hot-guard" for f in
+                   lint.lint_source(bare, "ompi_tpu/osc/window.py"))
+    guarded = (
+        "from ompi_tpu.coll import hier as _hier\n"
+        "from ompi_tpu.runtime import metrics as _metrics\n"
+        "def _coll(self, op):\n"
+        "    if _metrics._enable_var._value:\n"
+        "        _hier.note_stage('allreduce', 'cross', 1.0)\n"
+    )
+    assert lint.lint_source(guarded, "ompi_tpu/pml/ob1.py") == []
+
+
+def test_hier_modules_are_in_the_instrumented_impl_set():
+    for mod in ("coll/hier/__init__.py", "coll/hier/plan.py",
+                "coll/hier/decide.py", "coll/hier/compose.py"):
+        assert mod in lint.INSTR_IMPL
+
+
 def test_request_override_accepts_delegation():
     src = (
         "from ompi_tpu.core.request import Request\n"

@@ -224,11 +224,12 @@ def test_negotiate_card_roundtrip():
 
 
 # ------------------------------------------------- fallback delegation
-def test_coll_table_records_next_best_module(monkeypatch):
-    """Winning a slot must not orphan the runner-up: the table records
-    the next-best module's fn per contested slot so conditional
-    components (quant) can route ineligible calls to the module that
-    would otherwise own the slot instead of hard-wiring tuned."""
+def test_coll_table_records_full_priority_chain(monkeypatch):
+    """Winning a slot must not orphan the losers: the table records the
+    FULL priority-ordered chain per contested slot so conditional
+    components (quant, hier) can route ineligible calls to whatever
+    would otherwise own the slot — and a conditional runner-up can
+    delegate onward from ITS position instead of re-entering itself."""
     from ompi_tpu.coll import base as cb
 
     class Hi(cb.CollModule):
@@ -252,9 +253,9 @@ def test_coll_table_records_next_best_module(monkeypatch):
                            (30, "lo", Lo())])
     t = cb._select_coll(object())
     assert t.providers["allreduce"] == "hi"
-    # the SECOND-best module wins the fallback slot, not the lowest
-    assert t.fallback_providers["allreduce"] == "mid"
-    assert t.fallbacks["allreduce"](None) == "mid"
+    # the whole losing chain, in priority order
+    assert t.fallback_providers["allreduce"] == ["mid", "lo"]
+    assert [f(None) for f in t.fallbacks["allreduce"]] == ["mid", "lo"]
     # uncontested slots record no fallback
     assert t.providers["allgather"] == "mid"
     assert "allgather" not in t.fallbacks
@@ -262,21 +263,23 @@ def test_coll_table_records_next_best_module(monkeypatch):
 
 def test_quant_delegate_prefers_fallback_slot():
     """QuantProcColl._delegate serves the comm's recorded runner-up
-    (smcoll/han/adaptive outrank tuned, so a hard-wired tuned would
-    downgrade them); a missing runner-up is an invariant violation
-    (coll/basic provides every op) and surfaces loudly."""
+    (smcoll/han/hier/adaptive outrank tuned, so a hard-wired tuned
+    would downgrade them); a missing runner-up is an invariant
+    violation (coll/basic provides every op) and surfaces loudly."""
+    from ompi_tpu.coll.base import CollTable
     from ompi_tpu.coll.quant import QuantProcColl
 
     def runner_up(comm, *a):
         return "next-best"
 
     class WithFallback:
-        class coll:
-            fallbacks = {"allreduce": runner_up}
+        coll = CollTable()
+        coll.providers["allreduce"] = "quant"
+        coll.fallbacks["allreduce"] = [runner_up]
+        coll.fallback_providers["allreduce"] = ["mid"]
 
     class WithoutFallback:
-        class coll:
-            fallbacks = {}
+        coll = CollTable()
 
     m = QuantProcColl()
     assert m._delegate(WithFallback(), "allreduce") is runner_up

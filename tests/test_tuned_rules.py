@@ -129,6 +129,90 @@ reduce 2 0 binomial
         set_var("coll_tuned", "dynamic_rules_filename", "")
 
 
+# --------------------------------------------------- cache invalidation
+def test_rules_cache_reloads_on_rewrite(tmp_path):
+    """The mtime-keyed cache must serve the NEW rules after the file is
+    rewritten (os.utime forces a distinct mtime: same-second rewrites
+    are exactly the case a bare content check would miss)."""
+    import os
+
+    p = tmp_path / "rules.conf"
+    p.write_text("allreduce 2 0 ring\n")
+    path = str(p)
+    assert _load_rules(path) == [("allreduce", 2, 0, "ring", {})]
+    p.write_text("allreduce 2 0 recursive_doubling\n")
+    os.utime(path, (1, 10_000_000))  # guaranteed mtime change
+    assert _load_rules(path) == [
+        ("allreduce", 2, 0, "recursive_doubling", {})]
+    # and the reloaded rules actually drive the choice
+    set_var("coll_tuned", "use_dynamic_rules", True)
+    set_var("coll_tuned", "dynamic_rules_filename", path)
+    try:
+        assert dynamic_choice("allreduce", 4, 10) == \
+            ("recursive_doubling", {})
+    finally:
+        set_var("coll_tuned", "use_dynamic_rules", False)
+        set_var("coll_tuned", "dynamic_rules_filename", "")
+
+
+def test_rules_cache_same_mtime_not_reparsed(tmp_path):
+    """The documented contract of the mtime key: a rewrite that pins
+    the original mtime serves the cached rules (the parse is skipped),
+    and bumping the mtime picks the new content up."""
+    import os
+
+    p = tmp_path / "rules.conf"
+    p.write_text("allreduce 2 0 ring\n")
+    path = str(p)
+    os.utime(path, (1, 20_000_000))
+    assert _load_rules(path) == [("allreduce", 2, 0, "ring", {})]
+    p.write_text("allreduce 2 0 ring_segmented segsize=4096\n")
+    os.utime(path, (1, 20_000_000))  # pin the original mtime
+    assert _load_rules(path) == [("allreduce", 2, 0, "ring", {})]
+    os.utime(path, (1, 20_000_001))
+    assert _load_rules(path) == [
+        ("allreduce", 2, 0, "ring_segmented", {"segsize": 4096})]
+
+
+def test_rules_cache_missing_file_returns_empty_keeps_cache(tmp_path):
+    """A vanished file yields no rules but must not poison the cache:
+    restoring it (new mtime) reloads."""
+    import os
+
+    p = tmp_path / "rules.conf"
+    p.write_text("allgather 2 0 bruck\n")
+    path = str(p)
+    assert _load_rules(path) == [("allgather", 2, 0, "bruck", {})]
+    os.unlink(path)
+    assert _load_rules(path) == []
+    p.write_text("allgather 2 0 ring\n")
+    os.utime(path, (2, 0))
+    assert _load_rules(path) == [("allgather", 2, 0, "ring", {})]
+
+
+def test_most_specific_tie_break_first_rule_wins(tmp_path):
+    """Two rules with IDENTICAL (comm_size_min, msg_bytes_min)
+    specificity: file order breaks the tie — the FIRST wins (a later
+    equal rule never displaces it), matching the reference's
+    first-match-at-equal-specificity behavior."""
+    path = _write(tmp_path, """
+allreduce 2 1024 ring
+allreduce 2 1024 recursive_doubling
+allreduce 4 1024 ring_segmented segsize=2048
+""")
+    set_var("coll_tuned", "use_dynamic_rules", True)
+    set_var("coll_tuned", "dynamic_rules_filename", path)
+    try:
+        # tie at (2, 1024): first rule in file order wins
+        assert dynamic_choice("allreduce", 3, 4096) == ("ring", {})
+        # a strictly more specific rule still beats both
+        assert dynamic_choice("allreduce", 4, 4096) == \
+            ("ring_segmented", {"segsize": 2048})
+    finally:
+        set_var("coll_tuned", "use_dynamic_rules", False)
+        set_var("coll_tuned", "dynamic_rules_filename", "")
+
+
 def test_disabled_returns_none(tmp_path):
     path = _write(tmp_path, "allreduce 2 0 ring\n")
     set_var("coll_tuned", "dynamic_rules_filename", path)
