@@ -14,6 +14,7 @@ because transports are socket-based (the GIL is released in select()).
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Callable, List
 
@@ -24,7 +25,13 @@ from ompi_tpu.runtime import trace as _trace
 _callbacks: List[Callable[[], int]] = []
 _low_priority: List[Callable[[], int]] = []
 _lock = threading.Lock()
-_call_count = 0
+# low-priority cadence counter. itertools.count, NOT a bare int += 1:
+# the app thread's wait loops and the ProgressThread both call
+# progress(), and the unlocked read-modify-write raced — two threads
+# could observe the same value so the every-8th low-priority slot
+# (watchdog scans, sanitizer polls) double-fired or skipped a beat.
+# next() on a C-level iterator is atomic under the GIL.
+_call_count = itertools.count(1)
 
 register_var(
     "runtime", "progress_thread", True,
@@ -50,14 +57,12 @@ def progress() -> int:
     (the reference's event-library yield cadence). Under tracing, only
     iterations that actually handled events become spans (recorded
     retroactively) — an idle spin loop would flood the ring with noise."""
-    global _call_count
-    _call_count += 1
     tracing = _trace.enabled()
     t0 = _trace.now() if tracing else 0
     n = 0
     for fn in list(_callbacks):
         n += fn()
-    if _call_count % 8 == 0:
+    if next(_call_count) % 8 == 0:
         for fn in list(_low_priority):
             n += fn()
     if tracing and n:
