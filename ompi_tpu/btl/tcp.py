@@ -47,6 +47,7 @@ from ompi_tpu.ft import inject as _inject
 from ompi_tpu.mca.component import Component
 from ompi_tpu.mca.var import register_var, register_pvar, get_var
 from ompi_tpu.pml.base import HDR_SIZE
+from ompi_tpu.runtime import mpool as _mpool
 from ompi_tpu.utils.output import get_logger
 
 register_var("btl_tcp", "eager_limit", 1 << 20,
@@ -86,6 +87,12 @@ _compress_min_var = register_var(
          "the default targets rendezvous DATA fragments)", level=5)
 
 _LEN = struct.Struct("<I")
+
+# receive staging block: sized for a full default rendezvous DATA frame
+# (pml_frag_size 1 MiB + framing) so the common bulk frame fits without
+# growing, shared by every TcpBtl through one mpool.BufferPool
+_RX_BLOCK = (1 << 20) + (1 << 12)
+_rx_pool = _mpool.BufferPool(_RX_BLOCK)
 
 # rank-handshake capability bit + frame compression flag: both ride the
 # top bit of their u32 word (ranks and frame lengths stay < 2^31)
@@ -184,6 +191,7 @@ class TcpBtl(Btl):
         # (the app thread's wait-loop and the progress thread both call
         # progress(); concurrent drains would interleave frame parsing)
         self._progress_lock = threading.Lock()
+        self._rx_scratch = _rx_pool.acquire()
         self._closed = False
 
     # ------------------------------------------------------------- wiring
@@ -459,14 +467,19 @@ class TcpBtl(Btl):
         return 1
 
     def _drain(self, conn: _Conn) -> int:
+        # pooled receive staging: recv_into a reusable block (one pool
+        # hit) instead of a fresh 1 MiB allocation per recv — a 4-byte
+        # ack used to cost a megabyte of garbage. Safe to share across
+        # conns: _drain only ever runs under _progress_lock.
+        block = self._rx_scratch
         try:
-            data = conn.sock.recv(1 << 20)
+            n_in = conn.sock.recv_into(block)
         except socket.error as e:
             if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
                 return 0
             self._conn_failed(conn, e)
             return 0
-        if not data:
+        if not n_in:
             # EOF: could be a peer crash OR a clean peer Finalize — mark
             # the conn dead so later sends raise instead of vanishing.
             # With the ULFM detector armed (ft_enable) the EOF is also
@@ -484,7 +497,7 @@ class TcpBtl(Btl):
                     mark_failed(conn.peer)
             self._unregister(conn)
             return 0
-        conn.rbuf += data
+        conn.rbuf += memoryview(block)[:n_in]
         n = 0
         buf = conn.rbuf
         off = 0
@@ -570,6 +583,9 @@ class TcpBtl(Btl):
                 self.sel.close()
             except OSError:
                 pass
+        if self._rx_scratch is not None:
+            _rx_pool.release(self._rx_scratch)
+            self._rx_scratch = None
 
 
 class TcpBtlComponent(Component):

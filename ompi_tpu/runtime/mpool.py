@@ -145,3 +145,85 @@ register_pvar("mpool", "segments", lambda: stats()[0],
               help="Live shared-memory segments (rcache stats analog)")
 register_pvar("mpool", "bytes", lambda: stats()[1],
               help="Bytes mapped across live shared segments")
+
+
+# --------------------------------------------------------------- BufferPool
+# Host-memory staging pool (reference: the mpool "default" allocator that
+# hands out registered eager/max frags, btl.h's per-size free lists).
+# Transports that would otherwise allocate a fresh receive buffer per
+# event (btl/tcp's old 1 MiB-per-recv) acquire a reusable block here
+# instead; the registry/pvar discipline mirrors the segment rcache above.
+_pools: Dict[int, "BufferPool"] = {}
+
+
+class BufferPool:
+    """Reusable fixed-size ``bytearray`` blocks.
+
+    ``acquire`` pops a free block (or allocates on a miss); ``release``
+    returns it for reuse, keeping at most ``max_free`` parked. Blocks of
+    the wrong size (a caller grew one for a jumbo frame) are rejected at
+    release so the pool's accounting stays exact. Thread-safe: a btl's
+    progress thread and the app thread's opportunistic drains both hit
+    the pool.
+    """
+
+    def __init__(self, block_size: int, max_free: int = 16):
+        self.block_size = int(block_size)
+        self.max_free = int(max_free)
+        self._free: list = []
+        self._plock = threading.Lock()
+        self.outstanding = 0
+        self.hits = 0
+        self.misses = 0
+        with _lock:
+            self.pid = _next_id[0]
+            _next_id[0] += 1
+            _pools[self.pid] = self
+
+    def acquire(self) -> bytearray:
+        with self._plock:
+            self.outstanding += 1
+            if self._free:
+                self.hits += 1
+                return self._free.pop()
+            self.misses += 1
+        return bytearray(self.block_size)
+
+    def release(self, block) -> None:
+        with self._plock:
+            if self.outstanding > 0:
+                self.outstanding -= 1
+            if len(block) == self.block_size and \
+                    len(self._free) < self.max_free:
+                self._free.append(block)
+
+    def close(self) -> None:
+        with _lock:
+            _pools.pop(self.pid, None)
+        with self._plock:
+            self._free.clear()
+
+
+def pool_stats() -> Tuple[int, int, int, int]:
+    """(blocks live, bytes held, hits, misses) across every BufferPool."""
+    with _lock:
+        pools = list(_pools.values())
+    blocks = bytes_ = hits = misses = 0
+    for p in pools:
+        with p._plock:
+            n = p.outstanding + len(p._free)
+            blocks += n
+            bytes_ += n * p.block_size
+            hits += p.hits
+            misses += p.misses
+    return blocks, bytes_, hits, misses
+
+
+register_pvar("mpool", "pool_blocks", lambda: pool_stats()[0],
+              help="bytearray blocks held by BufferPools (in use + free)")
+register_pvar("mpool", "pool_bytes", lambda: pool_stats()[1],
+              help="Bytes across every BufferPool block")
+register_pvar("mpool", "pool_hits", lambda: pool_stats()[2],
+              help="BufferPool acquires served from the free list")
+register_pvar("mpool", "pool_misses", lambda: pool_stats()[3],
+              help="BufferPool acquires that had to allocate")
