@@ -12,6 +12,21 @@ per pml message/fragment; TCP ordering per connection preserves MPI
 ordering per peer (the reference's per-peer seq numbers guard reordering
 across *multiple* btls; with one link per peer ordering is structural).
 
+Zero-copy datapath (the opal convertor / btl writev discipline): a send
+is a vector [length word, header, payload view] pushed with
+``socket.sendmsg`` — no frame materialization, no eager-payload copy.
+Only bytes the kernel would not take are copied, into an owned
+write-queue entry (a deque of buffers drained by vectored I/O — the
+reference's pending-frag list, minus the O(n^2) bytes-concat the old
+``wbuf += frame`` paid under backlog). The receive side ``recv_into``s
+a pooled block per connection and hands the pml *slices* of it; a copy
+happens only at the pml delivery boundary when a payload must outlive
+the block (unexpected-queue stash, system-plane blobs). The remaining
+copies are measured, not estimated: ``btl_tcp_bytes_copied`` /
+``btl_tcp_writev_calls`` / ``btl_tcp_wire_bytes`` pvars, and
+``btl_tcp_copy_mode=1`` re-materializes the legacy copies so bench can
+A/B the tax in one process.
+
 On-wire compression (``btl_tcp_compress`` = zlib level 1-9, 0 = off):
 large rendezvous payloads (>= ``btl_tcp_compress_min_bytes``) go out
 zlib-deflated with the top bit of the length word flagging the frame;
@@ -32,6 +47,7 @@ build, so the one-directional guarantee covers the real topology.
 from __future__ import annotations
 
 import errno
+import itertools
 import os
 import random
 import selectors
@@ -40,7 +56,8 @@ import struct
 import threading
 import time
 import zlib
-from typing import Callable, Dict, Optional, Tuple
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ompi_tpu.btl.base import Btl, btl_framework
 from ompi_tpu.ft import inject as _inject
@@ -85,6 +102,37 @@ _compress_min_var = register_var(
     help="Payload bytes below which frames are never compressed (the "
          "deflate cost beats the wire saving on small/eager traffic; "
          "the default targets rendezvous DATA fragments)", level=5)
+_vecs_var = register_var(
+    "btl_tcp", "writev_max_vecs", 64,
+    help="Max iovecs handed to one sendmsg() when draining the "
+         "vectored write queue (IOV_MAX guard; reference: the btl "
+         "writev scatter-gather of opal's tcp frag lists)", level=5)
+_copy_mode_var = register_var(
+    "btl_tcp", "copy_mode", 0,
+    help="1 = legacy copying datapath: materialize the eager-payload "
+         "copy, the frame concat, and the receive parse copies the "
+         "zero-copy vectored path eliminates. A/B baseline for "
+         "bench.py's p2p section — the copies feed "
+         "btl_tcp_bytes_copied either way, so copies-per-wire-byte "
+         "is measured, not estimated", level=9)
+
+# datapath counters (plain int bumps — no instrumentation framework on
+# the per-frame path), exported as pvars below
+_ctr = {"copied": 0, "writev": 0, "wire": 0}
+
+register_pvar("btl_tcp", "bytes_copied",
+              lambda: _ctr["copied"],
+              help="Payload/frame bytes the tcp datapath had to copy "
+                   "(write-queue ownership under backpressure, rx "
+                   "compaction/grow, legacy copy_mode re-adds)")
+register_pvar("btl_tcp", "writev_calls",
+              lambda: _ctr["writev"],
+              help="Vectored sendmsg() syscalls issued by the write "
+                   "path")
+register_pvar("btl_tcp", "wire_bytes",
+              lambda: _ctr["wire"],
+              help="Frame bytes moved through the sockets (tx + rx), "
+                   "the denominator of copies-per-wire-byte")
 
 _LEN = struct.Struct("<I")
 
@@ -124,15 +172,24 @@ register_pvar("btl_tcp", "compress_saved_bytes",
 
 
 class _Conn:
-    __slots__ = ("sock", "rbuf", "wbuf", "wlock", "peer", "dead",
-                 "peer_z", "await_ack")
+    __slots__ = ("sock", "rxb", "rstart", "rend", "wq", "wlock", "peer",
+                 "dead", "peer_z", "await_ack")
 
     def __init__(self, sock: socket.socket, peer: Optional[int] = None):
         self.sock = sock
-        self.rbuf = bytearray()
-        # pending outbound bytes (reference: btl/tcp's per-endpoint pending
-        # frag list flushed on write-ready events)
-        self.wbuf = bytearray()
+        # receive staging: a pooled block filled by recv_into, with the
+        # unparsed span at [rstart, rend). Acquired lazily on first
+        # drain, returned to the pool when the conn unregisters.
+        self.rxb: Optional[bytearray] = None
+        self.rstart = 0
+        self.rend = 0
+        # pending outbound buffers, drained by vectored sendmsg
+        # (reference: btl/tcp's per-endpoint pending frag list flushed
+        # on write-ready events). Entries are OWNED bytes-likes — a
+        # borrowed payload view is copied exactly once, at the moment
+        # the kernel declines it (buffered-send semantics: the caller
+        # may reuse its buffer the instant send() returns).
+        self.wq: deque = deque()
         # RLock: _conn_failed runs both under wlock (from _flush_locked)
         # and without it (from _drain's read-error path)
         self.wlock = threading.RLock()
@@ -191,7 +248,6 @@ class TcpBtl(Btl):
         # (the app thread's wait-loop and the progress thread both call
         # progress(); concurrent drains would interleave frame parsing)
         self._progress_lock = threading.Lock()
-        self._rx_scratch = _rx_pool.acquire()
         self._closed = False
 
     # ------------------------------------------------------------- wiring
@@ -277,13 +333,27 @@ class TcpBtl(Btl):
 
     # --------------------------------------------------------------- send
     def send(self, peer: int, header: bytes, payload) -> None:
-        """Enqueue a frame; bytes move via non-blocking flushes (here
-        opportunistically, otherwise from progress()). Never blocks the
-        caller on a full socket — the head-to-head large-send deadlock the
-        reference's pending-frag design exists to avoid."""
-        if not isinstance(payload, (bytes, bytearray)):
-            payload = bytes(memoryview(payload))
-        if HDR_SIZE + len(payload) > _LEN_MASK:
+        """Vectored zero-copy enqueue: the frame is pushed as
+        [length word, header, payload view] via sendmsg with NO
+        intermediate materialization; only bytes the kernel declines
+        are copied into the owned write queue (buffered-send semantics
+        — the caller may reuse its buffer the moment we return). Never
+        blocks the caller on a full socket — the head-to-head
+        large-send deadlock the reference's pending-frag design exists
+        to avoid."""
+        if isinstance(payload, bytes):
+            mv = payload  # immutable: safe to queue without owning
+        else:
+            mv = memoryview(payload)
+            if mv.ndim != 1 or mv.format != "B":
+                try:
+                    mv = mv.cast("B")
+                except TypeError:
+                    # non-contiguous source: ownership copy is forced
+                    _ctr["copied"] += mv.nbytes
+                    mv = bytes(mv)  # mpilint: disable=hot-copy — non-contiguous buffers cannot be viewed flat
+        nbytes = len(mv)
+        if HDR_SIZE + nbytes > _LEN_MASK:
             # bit 31 of the length word carries the compression flag,
             # so one frame tops out at 2 GiB; beyond it the receiver
             # would mask a wrong length AND misparse the frame as
@@ -293,7 +363,7 @@ class TcpBtl(Btl):
 
             raise MPIError(
                 ERR_OTHER,
-                f"tcp frame of {HDR_SIZE + len(payload)} bytes exceeds "
+                f"tcp frame of {HDR_SIZE + nbytes} bytes exceeds "
                 f"the {_LEN_MASK}-byte framing limit")
         dup = False
         if _inject._enable_var._value:  # chaos wire hook (ft/inject.py)
@@ -311,20 +381,36 @@ class TcpBtl(Btl):
         zflag = 0
         level = int(_compress_var._value)  # one live-Var load when off
         if level > 0 and conn.peer_z and \
-                len(payload) >= int(_compress_min_var._value):
-            z = zlib.compress(payload, level)
-            if len(z) < len(payload):  # incompressible data stays raw
+                nbytes >= int(_compress_min_var._value):
+            z = zlib.compress(mv, level)
+            if len(z) < nbytes:  # incompressible data stays raw
                 from ompi_tpu import quant as _quant
 
-                _quant.note_wire(len(payload), len(z))
-                payload = z
+                _quant.note_wire(nbytes, len(z))
+                mv = z
+                nbytes = len(z)
                 zflag = _ZFLAG
-        frame = _LEN.pack((HDR_SIZE + len(payload)) | zflag) \
-            + header + payload
+        lenw = _LEN.pack((HDR_SIZE + nbytes) | zflag)
+        if _copy_mode_var._value:
+            # legacy copying datapath (A/B baseline, see the cvar): the
+            # pre-vectored queue paid an eager-payload copy, a frame
+            # concat, and a bytes-concat append — re-materialize all
+            # three so the measured copy tax is the old path's, not a
+            # back-of-envelope estimate
+            pb = bytes(mv)
+            frame = lenw + header + pb
+            _ctr["copied"] += nbytes + 2 * len(frame)
+            vecs: List = [bytearray(frame)]
+        elif nbytes:
+            vecs = [lenw, header, mv]
+        else:
+            vecs = [lenw, header]
+        if dup:
+            vecs = vecs + vecs
         with conn.wlock:
-            # dead-check under wlock: _conn_failed flips dead/clears wbuf
-            # under the same lock, so a frame can't slip past the check
-            # into a cleared buffer
+            # dead-check under wlock: _conn_failed flips dead/clears the
+            # write queue under the same lock, so a frame can't slip
+            # past the check into a cleared queue
             if conn.dead is not None:
                 from ompi_tpu.core.errors import (
                     MPIError,
@@ -340,29 +426,90 @@ class TcpBtl(Btl):
                 raise MPIError(
                     code,
                     f"connection to rank {peer} is dead: {conn.dead}")
-            conn.wbuf += frame
-            if dup:
-                conn.wbuf += frame
-            self._flush_locked(conn)
+            backlog = bool(conn.wq)
+            if not backlog:
+                # fast path: push straight from the caller's buffer
+                vecs = self._try_send(conn, vecs)
+                if not vecs:
+                    return  # fully on the wire (or conn failed): 0 copies
+            # backpressure: own the unsent remainder — the ONE copy the
+            # zero-copy path ever pays, and only for bytes the kernel
+            # would not take now
+            for v in vecs:
+                if isinstance(v, memoryview):
+                    _ctr["copied"] += len(v)
+                    v = bytes(v)
+                conn.wq.append(v)
+            if backlog:
+                self._flush_locked(conn)
+            else:
+                self._want_write(conn, True)
+
+    def _try_send(self, conn: _Conn, vecs: List) -> List:
+        """Vectored push of ``vecs`` until the socket blocks; returns
+        the unsent remainder as views (the caller owns copying them).
+        Caller holds conn.wlock. On a fatal error the conn is failed
+        and [] returned — the bytes are lost and the NEXT send to this
+        peer raises (same contract as the old flush path)."""
+        max_vecs = int(_vecs_var._value)
+        while vecs:
+            try:
+                sent = conn.sock.sendmsg(vecs[:max_vecs])
+            except socket.error as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    return vecs
+                # Fatal send error: queued (and eagerly-completed) bytes
+                # are lost. Surface it — mark the conn dead, tell the
+                # failure detector, fail future sends (ADVICE r1).
+                self._conn_failed(conn, e)
+                return []
+            if sent <= 0:
+                return vecs
+            _ctr["writev"] += 1
+            _ctr["wire"] += sent
+            while sent:
+                l0 = len(vecs[0])
+                if sent >= l0:
+                    sent -= l0
+                    vecs.pop(0)
+                else:
+                    # O(1) partial-consume: slice the view, no copy
+                    vecs[0] = memoryview(vecs[0])[sent:]
+                    sent = 0
+        return vecs
 
     def _flush_locked(self, conn: _Conn) -> None:
-        """Push queued bytes; caller holds conn.wlock."""
-        while conn.wbuf:
+        """Drain the owned write queue with vectored sends; caller
+        holds conn.wlock."""
+        wq = conn.wq
+        max_vecs = int(_vecs_var._value)
+        while wq:
             try:
-                sent = conn.sock.send(conn.wbuf)
+                sent = conn.sock.sendmsg(
+                    list(itertools.islice(wq, max_vecs)))
             except socket.error as e:
                 if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
                     self._want_write(conn, True)
                     return
-                # Fatal send error: queued (and eagerly-completed) bytes are
-                # lost. Surface it — mark the conn dead, tell the failure
-                # detector, fail future sends to this peer (ADVICE r1).
                 self._conn_failed(conn, e)
                 return
             if sent <= 0:
                 self._want_write(conn, True)
                 return
-            del conn.wbuf[:sent]
+            _ctr["writev"] += 1
+            _ctr["wire"] += sent
+            while sent:
+                l0 = len(wq[0])
+                if sent >= l0:
+                    sent -= l0
+                    wq.popleft()
+                else:
+                    # partial first buffer: O(1) reslice over the OWNED
+                    # bytes (the deque keeps them alive) — the old
+                    # bytearray queue paid an O(n) del wbuf[:sent] here,
+                    # O(n^2) across a backlog
+                    wq[0] = memoryview(wq[0])[sent:]
+                    sent = 0
         self._want_write(conn, False)
 
     def _conn_failed(self, conn: _Conn, err: OSError) -> None:
@@ -371,7 +518,7 @@ class TcpBtl(Btl):
         the ULFM detector is the propagation plane)."""
         with conn.wlock:
             conn.dead = err
-            conn.wbuf.clear()
+            conn.wq.clear()
         self.log.error("i/o with rank %s failed: %s", conn.peer, err)
         self._unregister(conn)
         # The dead conn stays in self.conns: bytes already queued (and
@@ -467,13 +614,35 @@ class TcpBtl(Btl):
         return 1
 
     def _drain(self, conn: _Conn) -> int:
-        # pooled receive staging: recv_into a reusable block (one pool
-        # hit) instead of a fresh 1 MiB allocation per recv — a 4-byte
-        # ack used to cost a megabyte of garbage. Safe to share across
-        # conns: _drain only ever runs under _progress_lock.
-        block = self._rx_scratch
+        # pooled receive staging: recv_into this conn's reusable block
+        # (one pool hit) instead of a fresh 1 MiB allocation per recv —
+        # a 4-byte ack used to cost a megabyte of garbage plus an rbuf
+        # concat. Frames are then SLICED out of the block; anything
+        # that must outlive it is copied at the pml delivery boundary.
+        buf = conn.rxb
+        if buf is None:
+            buf = conn.rxb = _rx_pool.acquire()
+            conn.rstart = conn.rend = 0
+        if conn.rend == len(buf):
+            # no room left: slide the parked partial frame to the
+            # front, or grow into a private (unpooled) buffer when one
+            # frame is bigger than the block — bounded boundary copies,
+            # both charged to btl_tcp_bytes_copied
+            pending = conn.rend - conn.rstart
+            if conn.rstart > 0:
+                buf[:pending] = buf[conn.rstart:conn.rend]
+            else:
+                total = 0
+                if pending >= 4:
+                    total = _LEN.unpack_from(buf, 0)[0] & _LEN_MASK
+                nbuf = bytearray(max(4 + total, 2 * len(buf)))
+                nbuf[:pending] = buf
+                _rx_pool.release(buf)
+                conn.rxb = buf = nbuf
+            _ctr["copied"] += pending
+            conn.rstart, conn.rend = 0, pending
         try:
-            n_in = conn.sock.recv_into(block)
+            n_in = conn.sock.recv_into(memoryview(buf)[conn.rend:])
         except socket.error as e:
             if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
                 return 0
@@ -497,31 +666,43 @@ class TcpBtl(Btl):
                     mark_failed(conn.peer)
             self._unregister(conn)
             return 0
-        conn.rbuf += memoryview(block)[:n_in]
+        _ctr["wire"] += n_in
+        conn.rend += n_in
         n = 0
-        buf = conn.rbuf
-        off = 0
-        if conn.await_ack and len(buf) >= 4:
+        mv = memoryview(buf)
+        off = conn.rstart
+        end = conn.rend
+        if conn.await_ack and end - off >= 4:
             # the compress-handshake ack leads every frame on a dialed
             # link. Match the FULL word (magic byte + reserved-zero
             # bits + accept bit), not just the high byte: a non-acking
             # peer's first frame could legally be ~1.41 GiB long under
             # the 2 GiB cap, and a high-byte-only match would eat its
             # length word and desync the whole stream
-            word = _LEN.unpack_from(buf, 0)[0]
+            word = _LEN.unpack_from(buf, off)[0]
             conn.await_ack = False
             if word in (_ZACK_MAGIC, _ZACK_MAGIC | _ZACK_ACCEPT):
                 conn.peer_z = bool(word & _ZACK_ACCEPT)
-                off = 4
-        while len(buf) - off >= 4:
+                off += 4
+        copy_mode = _copy_mode_var._value
+        while end - off >= 4:
             word = _LEN.unpack_from(buf, off)[0]
             total = word & _LEN_MASK
-            if len(buf) - off - 4 < total:
+            if end - off - 4 < total:
                 break
             start = off + 4
-            hdr = bytes(buf[start : start + HDR_SIZE])
-            payload = bytes(buf[start + HDR_SIZE : start + total])
-            off += 4 + total
+            # zero-copy parse: header and payload are views over the
+            # pool block, valid for the synchronous deliver below; the
+            # pml copies at its boundary when a payload must survive it
+            hdr = mv[start:start + HDR_SIZE]
+            payload = mv[start + HDR_SIZE:start + total]
+            off = start + total
+            if copy_mode:
+                # legacy copying datapath (A/B baseline): re-add the
+                # per-frame parse copies the sliced path eliminates
+                _ctr["copied"] += total
+                hdr = bytes(hdr)
+                payload = bytes(payload)
             if word & _ZFLAG:
                 # negotiated framing: only a handshake-capable peer ever
                 # sets the flag, so this build always knows how to undo
@@ -534,21 +715,30 @@ class TcpBtl(Btl):
                     payload = zlib.decompress(payload)
                 except zlib.error as e:
                     self.log.exception("corrupt compressed frame")
+                    conn.rstart = off
                     self._conn_failed(conn, OSError(
                         f"corrupt compressed frame from rank "
                         f"{conn.peer}: {e}"))
                     return n
             # A frame handler may itself send (ob1 replies with CTS/DATA
             # from inside deliver); if that send hits a dead peer the
-            # MPIError must not escape — it would skip the rbuf trim below
-            # (re-delivering frames) and kill the progress thread.
+            # MPIError must not escape — it would skip the cursor
+            # advance below (re-delivering frames) and kill the
+            # progress thread.
             try:
                 self.deliver(hdr, payload)
             except Exception:
                 self.log.exception("frame handler failed (frame dropped)")
             n += 1
-        if off:
-            del buf[:off]
+        if off >= end:
+            # block fully parsed: reset the cursors — no memmove, and a
+            # buffer grown for a jumbo frame is dropped so the conn
+            # reacquires a pooled block on the next drain
+            conn.rstart = conn.rend = 0
+            if len(buf) != _RX_BLOCK:
+                conn.rxb = None
+        else:
+            conn.rstart = off
         return n
 
     def _unregister(self, conn: _Conn) -> None:
@@ -561,6 +751,17 @@ class TcpBtl(Btl):
             conn.sock.close()
         except OSError:
             pass
+        # drop the receive block. discard, NOT release: _unregister can
+        # run from the app thread's _conn_failed while the progress
+        # thread is mid-_drain on this very block — recycling it would
+        # hand live memory to the next acquire. (A buffer grown past
+        # the pool size was never pooled; its accounting was settled at
+        # grow time.)
+        if conn.rxb is not None:
+            if len(conn.rxb) == _RX_BLOCK:
+                _rx_pool.discard(conn.rxb)
+            conn.rxb = None
+            conn.rstart = conn.rend = 0
 
     def finalize(self) -> None:
         self._closed = True
@@ -583,9 +784,6 @@ class TcpBtl(Btl):
                 self.sel.close()
             except OSError:
                 pass
-        if self._rx_scratch is not None:
-            _rx_pool.release(self._rx_scratch)
-            self._rx_scratch = None
 
 
 class TcpBtlComponent(Component):

@@ -144,6 +144,13 @@ class Convertor:
         return (p // dt.size) * dt.extent + bm[p % dt.size]
 
     def pack_frag(self, max_bytes: int) -> np.ndarray:
+        """Next fragment of the packed stream. Contiguous fast path: a
+        BORROWED view of the caller's buffer — no materialization
+        anywhere between here and the socket (the tcp btl sends it as
+        an iovec and copies only what the kernel declines). The view is
+        only guaranteed stable until the transport's send() returns,
+        which is exactly the buffered-send window ob1 completes in.
+        Non-contiguous types gather into a fresh (owned) array."""
         n = min(max_bytes, self.remaining)
         dt = self.datatype
         if dt.is_contiguous:
@@ -154,6 +161,10 @@ class Convertor:
         return out
 
     def unpack_frag(self, data) -> int:
+        # `data` may be a borrowed view of a transport pool block (the
+        # zero-copy tcp rx path): _as_byte_view wraps it without a
+        # copy, and the scatter below is the message's ONE landing copy
+        # into the posted buffer
         src = _as_byte_view(data)
         n = min(src.nbytes, self.remaining)
         dt = self.datatype

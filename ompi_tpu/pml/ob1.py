@@ -98,6 +98,19 @@ register_var("pml", "peer_timeout", 0.0,
 # cma-offer blob a receiver appends to its CTS: target pid + buffer addr
 _CMA_OFFER = struct.Struct("<qQ")
 
+def _owned(payload):
+    """Pml delivery boundary copy: the zero-copy tcp rx path hands us
+    BORROWED views of its pool block, valid only for the synchronous
+    delivery. System-plane handlers (json planes, diskless blobs, osc
+    active messages) may stash their payload past that window — and
+    json.loads wants real bytes — so a borrowed view is copied exactly
+    here, and only here. User-plane traffic never pays this: matched
+    payloads unpack straight from the view, unexpected-queue stashes
+    already copy."""
+    return payload if isinstance(payload, (bytes, bytearray)) \
+        else bytes(payload)
+
+
 # watchdog-failed requests, all pml instances (pvar + spc mirror)
 _wd_trips = [0]
 register_pvar("pml", "watchdog_trips", lambda: _wd_trips[0],
@@ -584,7 +597,7 @@ class Ob1Pml:
         if hdr.tag <= self.SYSTEM_TAG_BASE:
             fn = self.system_handlers.get(hdr.tag)
             if fn is not None:
-                fn(hdr, payload)
+                fn(hdr, _owned(payload))
             return
         if hdr.kind == EAGER:
             self._incoming_eager(hdr, payload)
@@ -678,7 +691,7 @@ class Ob1Pml:
             if req is None:
                 fn = self.system_handlers.get(h.tag)
                 if fn is not None:
-                    fn(h, pl)
+                    fn(h, _owned(pl))
             else:
                 self._deliver_matched(req, h, pl)
 

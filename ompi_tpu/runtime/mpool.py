@@ -190,12 +190,23 @@ class BufferPool:
         return bytearray(self.block_size)
 
     def release(self, block) -> None:
+        """Recycle a block. Only call when the caller can prove sole
+        ownership — a recycled block is handed to the next acquire."""
         with self._plock:
             if self.outstanding > 0:
                 self.outstanding -= 1
             if len(block) == self.block_size and \
                     len(self._free) < self.max_free:
                 self._free.append(block)
+
+    def discard(self, block) -> None:
+        """Account a block as gone WITHOUT recycling it: teardown paths
+        that may race a concurrent reader (a conn dying under an
+        in-flight drain) must not let the pool hand the block to
+        someone else."""
+        with self._plock:
+            if self.outstanding > 0:
+                self.outstanding -= 1
 
     def close(self) -> None:
         with _lock:

@@ -531,8 +531,11 @@ def test_tcp_compress_roundtrip_and_negotiation():
     set_var("btl_tcp", "compress", 6)
     set_var("btl_tcp", "compress_min_bytes", 1024)
     got = {"a": [], "b": []}
-    a = TcpBtl(lambda h, p: got["a"].append(p), my_rank=90)
-    b = TcpBtl(lambda h, p: got["b"].append(p), my_rank=91)
+    # deliver hands BORROWED views of the rx pool block: a test
+    # that stashes payloads must copy at its boundary, exactly
+    # like the pml does
+    a = TcpBtl(lambda h, p: got["a"].append(bytes(p)), my_rank=90)
+    b = TcpBtl(lambda h, p: got["b"].append(bytes(p)), my_rank=91)
     a.set_peers({91: f"{b.host}:{b.port}"})
     b.set_peers({90: f"{a.host}:{a.port}"})
     try:
@@ -576,8 +579,8 @@ def test_tcp_compress_direction_independent():
     set_var("btl_tcp", "compress", 0)       # the DIALER stays at 0
     set_var("btl_tcp", "compress_min_bytes", 1024)
     got = {"e": [], "f": []}
-    e = TcpBtl(lambda h, p: got["e"].append(p), my_rank=86)
-    f = TcpBtl(lambda h, p: got["f"].append(p), my_rank=87)
+    e = TcpBtl(lambda h, p: got["e"].append(bytes(p)), my_rank=86)
+    f = TcpBtl(lambda h, p: got["f"].append(bytes(p)), my_rank=87)
     e.set_peers({87: f"{f.host}:{f.port}"})
     f.set_peers({86: f"{e.host}:{e.port}"})
     hdr = pack_header(1, 0, 0, 7, 0, 0, 0, 0)
@@ -632,7 +635,7 @@ def test_tcp_corrupt_compressed_frame_fails_link():
 
     set_var("btl_tcp", "compress", 6)
     got = []
-    b = TcpBtl(lambda h, p: got.append(p), my_rank=95)
+    b = TcpBtl(lambda h, p: got.append(bytes(p)), my_rank=95)
     s = None
     try:
         s = socklib.create_connection((b.host, b.port))
