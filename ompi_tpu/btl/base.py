@@ -22,10 +22,19 @@ btl_framework = framework(
 
 class Btl:
     """Transport module. eager_limit=None means the transport has no
-    rendezvous threshold (loopback/shm can move any size in one frame)."""
+    rendezvous threshold (loopback/shm can move any size in one frame).
+
+    Idle-blocking contract: a transport whose traffic is visible to
+    select() exports ``idle_fds() -> (rfds, wfds)`` and sets
+    ``NEEDS_POLL = False`` so the progress engine may PARK while idle
+    (runtime/progress.py idle_block). The conservative default —
+    NEEDS_POLL True, no exporter — marks a transport that discovers
+    work only by polling (the sm rings): its presence caps every park
+    at the caller's legacy poll interval."""
 
     NAME = "base"
     eager_limit: Optional[int] = 65536
+    NEEDS_POLL = True
 
     def __init__(self, deliver: Callable[[bytes, bytes], None]):
         # deliver(header_bytes, payload) — the PML's handle_incoming.

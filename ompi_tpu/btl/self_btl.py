@@ -14,6 +14,9 @@ from ompi_tpu.mca.component import Component
 class SelfBtl(Btl):
     NAME = "self"
     eager_limit = None  # any size moves in one "frame"
+    # delivery is inline in send(): progress() never discovers work, so
+    # this transport neither needs polling nor caps the idle park
+    NEEDS_POLL = False
 
     def send(self, peer: int, header: bytes, payload) -> None:
         self.deliver(header, payload)

@@ -209,6 +209,9 @@ class TcpBtl(Btl):
     bandwidth = 1  # stripe weight (reference: opal btl_bandwidth)
 
     NAME = "tcp"
+    # fd-driven: the progress engine may park in select over idle_fds()
+    # instead of polling this transport
+    NEEDS_POLL = False
 
     def __init__(self, deliver: Callable[[bytes, bytes], None], my_rank: int):
         super().__init__(deliver)
@@ -444,6 +447,13 @@ class TcpBtl(Btl):
                 self._flush_locked(conn)
             else:
                 self._want_write(conn, True)
+        # a backlog was (or may still be) queued: wake a progress loop
+        # parked in the idle select so the flush doesn't wait out the
+        # park interval — the park's write-fd list was computed before
+        # this conn wanted write
+        from ompi_tpu.runtime import progress as _progress
+
+        _progress.poke()
 
     def _try_send(self, conn: _Conn, vecs: List) -> List:
         """Vectored push of ``vecs`` until the socket blocks; returns
@@ -544,6 +554,28 @@ class TcpBtl(Btl):
                 pass
 
     # ----------------------------------------------------------- progress
+    def idle_fds(self) -> Tuple[list, list]:
+        """Export (read-fds, write-interest-fds) for the progress
+        engine's idle-blocking select: the listener plus every live
+        conn, and — so a parked loop resumes flushing — every conn
+        with queued writes. A socket closing between export and the
+        select is handled by the caller (select raises, treated as a
+        wake)."""
+        rfds: list = []
+        wfds: list = []
+        if self._closed:
+            return rfds, wfds
+        with self._sel_lock:
+            try:
+                keys = list(self.sel.get_map().values())
+            except RuntimeError:  # selector closed by a finalize race
+                return rfds, wfds
+        for key in keys:
+            rfds.append(key.fd)
+            if key.events & selectors.EVENT_WRITE:
+                wfds.append(key.fd)
+        return rfds, wfds
+
     def progress(self) -> int:
         """Drain ready sockets; called from the progress engine
         (reference: btl progress fns registered at opal_progress.c:416)."""

@@ -309,6 +309,20 @@ def _bind_progress(fn: Callable[[], int]) -> None:
     _progress_fn = fn
 
 
+# Idle-block wakeup, bound lazily by runtime/progress.py (same
+# core-must-not-import-runtime pattern as _bind_progress): a request
+# completing must wake any wait parked in the progress engine's idle
+# select, or a pred that flips off-transport could sleep out the full
+# park interval. The bound fn is the _parked-gated poke — one list load
+# and a branch when nobody is parked.
+_wakeup_fn: Optional[Callable[[], None]] = None
+
+
+def _bind_wakeup(fn: Callable[[], None]) -> None:
+    global _wakeup_fn
+    _wakeup_fn = fn
+
+
 def _progress_once() -> int:
     if _progress_fn is None:
         return 0
@@ -318,6 +332,8 @@ def _progress_once() -> int:
 def _completion_cond_notify() -> None:
     with _completion_cond:
         _completion_cond.notify_all()
+    if _wakeup_fn is not None:
+        _wakeup_fn()
 
 
 def _completion_cond_wait(timeout: float) -> None:

@@ -62,6 +62,11 @@ def send_system(pml, dst: int, obj: dict, tag: int) -> None:
             pml.isend(payload, len(payload), BYTE, dst, tag, 0)
     except Exception:
         pass
+    # a diagnostic post must not wait out an idle park: wake any loop
+    # blocked in the progress engine's select (no-op when none is)
+    from ompi_tpu.runtime import progress as _progress
+
+    _progress.poke()
 
 
 def world_pml():

@@ -10,16 +10,30 @@ Process mode can additionally run a dedicated progress *thread* (MCA var
 ``runtime_progress_thread``) so blocked Python code still progresses — the
 analog of the reference's async-progress option, and the right default here
 because transports are socket-based (the GIL is released in select()).
+
+Idle blocking (the libevent block-when-idle discipline the reference
+gets for free): when the IdleBackoff goes cold, ``progress_until`` and
+the ProgressThread PARK in select() over the fds the transports export
+(``set_idle_sources``) plus a self-pipe wakeup, instead of spinning a
+full core on ``sleep(0)`` / blind millisecond sleeps. Any inbound frame
+wakes the parked loop at fd latency; local producers (a tcp send that
+left a backlog, a system-plane post, a request completion) ``poke()``
+the pipe so nothing waits out a backoff interval. A transport that
+polls memory instead of fds (the sm rings) caps the park at the
+caller's old blind-sleep interval, so sm latency is unchanged while
+fd-only (DCN) jobs park for up to ``runtime_idle_block_us``.
 """
 
 from __future__ import annotations
 
 import itertools
+import os as _os
+import select as _select
 import threading
-from typing import Callable, List
+from typing import Callable, List, Optional
 
 from ompi_tpu.core import request as _request
-from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.mca.var import register_var, register_pvar, get_var
 from ompi_tpu.runtime import trace as _trace
 
 _callbacks: List[Callable[[], int]] = []
@@ -37,6 +51,127 @@ register_var(
     "runtime", "progress_thread", True,
     help="Run a dedicated progress thread in process mode", level=4,
 )
+_idle_var = register_var(
+    "runtime", "idle_block_us", 50000,
+    help="Max microseconds an idle progress loop parks in select() "
+         "over the transports' exported fds + the self-pipe wakeup "
+         "once its backoff goes cold (the libevent block-when-idle "
+         "analog). Poll-only transports (sm rings) cap the park at "
+         "the caller's legacy sleep interval regardless. 0 restores "
+         "the pure sleep backoff", level=5,
+)
+
+# --------------------------------------------------------- idle blocking
+# transports' exported fd sources: each entry is a fn() -> (rfds, wfds),
+# or None for a live transport that can only be POLLED (sm rings) and
+# therefore caps how long anyone may park. Installed by wireup, cleared
+# at shutdown.
+_idle_sources: List[Optional[Callable]] = []
+_wakeup: List[Optional[int]] = [None, None]  # self-pipe (r, w), lazy
+_wake_lock = threading.Lock()
+_parked = [0]       # threads currently inside the idle select
+_idle_blocks = [0]  # pvar: completed parks
+
+register_pvar("runtime", "progress_idle_blocks",
+              lambda: _idle_blocks[0],
+              help="Times an idle progress loop parked in select() "
+                   "instead of spin/sleep polling")
+
+
+def set_idle_sources(srcs: List[Optional[Callable]]) -> None:
+    """Install the live transports' fd exporters (wireup). ``None``
+    entries flag poll-only transports; they bound the park interval."""
+    global _idle_sources
+    _idle_sources = list(srcs)
+
+
+def _wakeup_fd() -> int:
+    if _wakeup[0] is None:
+        with _wake_lock:
+            if _wakeup[0] is None:
+                r, w = _os.pipe()
+                _os.set_blocking(r, False)
+                _os.set_blocking(w, False)
+                _wakeup[1] = w  # writer first: poke() checks [0]
+                _wakeup[0] = r
+    return _wakeup[0]
+
+
+def _poke_now() -> None:
+    _wakeup_fd()
+    try:
+        _os.write(_wakeup[1], b"\0")
+    except (OSError, TypeError):
+        pass  # pipe full: a wakeup is already pending
+
+
+def poke() -> None:
+    """Wake any thread parked in the idle select. Cheap when nobody is
+    parked — one list load and a branch — so producers (local sends,
+    system-plane posts, request completions) can call it per event."""
+    if _parked[0]:
+        _poke_now()
+
+
+def idle_block(max_wait: float, base: float,
+               recheck: Optional[Callable[[], bool]] = None) -> bool:
+    """Park in select() for up to min(max_wait, runtime_idle_block_us)
+    seconds — or ``base`` (the caller's legacy blind-sleep interval)
+    when a poll-only transport is live or the cvar is 0, in which case
+    a plain sleep happens instead. ``recheck`` closes the lost-wakeup
+    race: it runs after this thread becomes visible to poke() and
+    cancels the park if the condition already holds. Returns True when
+    the loop actually parked in select."""
+    import time
+
+    cap = _idle_var._value / 1e6
+    if max_wait <= 0:
+        return False
+    if cap <= 0:
+        time.sleep(min(base, max_wait))
+        return False
+    rfds = [_wakeup_fd()]
+    wfds: List[int] = []
+    # parking is always SAFE (pokes + fd readiness wake it; the timeout
+    # bounds the rest) — only the park DURATION depends on the sources:
+    # a poll-only transport means select can't see its traffic, so the
+    # park must stay within the caller's legacy poll interval
+    long_ok = True
+    for fn in list(_idle_sources):
+        if fn is None:
+            long_ok = False
+            continue
+        try:
+            r, w = fn()
+        except Exception:
+            long_ok = False
+            continue
+        rfds += r
+        wfds += w
+    if not long_ok:
+        cap = min(cap, base)
+    timeout = min(max_wait, cap)
+    _parked[0] += 1
+    try:
+        if recheck is not None and recheck():
+            return False
+        try:
+            ready, _, _ = _select.select(rfds, wfds, [], timeout)
+        except (OSError, ValueError):
+            return False  # a conn raced shut mid-export: treat as a wake
+    finally:
+        _parked[0] -= 1
+    _idle_blocks[0] += 1
+    r = _wakeup[0]
+    if r is not None and r in ready:
+        try:
+            _os.read(r, 4096)  # drain coalesced pokes
+        except OSError:
+            pass
+    return True
+
+
+_request._bind_wakeup(poke)
 
 
 def register_progress(fn: Callable[[], int], low_priority: bool = False) -> None:
@@ -80,10 +215,19 @@ def progress_until(pred: Callable[[], bool],
     IdleBackoff discipline. Every blocking wait outside Request.Wait must
     funnel through here — a pure ``while: progress()`` spin starves the
     peer rank on one-core hosts (r2 lesson; reference: the single
-    opal_progress() loop all waits share, opal_progress.c:216)."""
+    opal_progress() loop all waits share, opal_progress.c:216). Once the
+    backoff goes cold the wait PARKS in select over the transports' fds
+    (idle_block) — a frame or a poke wakes it immediately, and the park
+    is always capped by the remaining deadline so timeouts hold."""
     if pred():
         return True
     deadline = None if timeout is None else _time.monotonic() + timeout
+
+    def _idle_wait(base: float) -> None:
+        rem = float("inf") if deadline is None \
+            else deadline - _time.monotonic()
+        idle_block(min(rem, 3600.0), base, recheck=pred)
+
     backoff = _request.IdleBackoff()
     while True:
         made = progress()
@@ -91,7 +235,7 @@ def progress_until(pred: Callable[[], bool],
             return True
         if deadline is not None and _time.monotonic() > deadline:
             return False
-        backoff.step(made)
+        backoff.step(made, _idle_wait)
 
 
 _request._bind_progress(progress)
@@ -136,10 +280,22 @@ class ProgressThread:
                 idle += 1
                 time.sleep(0)
             else:
-                self._stop.wait(self.interval)
+                # deep idle: PARK in select instead of interval polling
+                # — a blocked rank used to burn a core here (and starve
+                # the peer on one-core hosts). Inbound frames wake via
+                # their fds, local producers via poke(), stop() pokes
+                # unconditionally; a poll-only transport (sm) caps the
+                # park at the legacy poll interval
+                if not idle_block(3600.0, self.interval,
+                                  recheck=self._stop.is_set):
+                    self._stop.wait(self.interval)
 
     def stop(self) -> None:
         self._stop.set()
+        # unconditional poke (not the _parked-gated one): the thread may
+        # be between its stop-check and the park — the pipe byte makes
+        # that select return immediately either way
+        _poke_now()
         if self._thread is not None:
             self._thread.join(timeout=1.0)
             self._thread = None

@@ -188,6 +188,21 @@ def init_process_mode():
     for _, _, mod in modules:
         register_progress(mod.progress)
 
+    # idle-blocking sources: fd-driven transports export their fds so
+    # idle loops can park in select; a poll-only transport (sm rings)
+    # registers as None, capping every park at the legacy poll
+    # interval; self (inline delivery) registers nothing
+    from ompi_tpu.runtime.progress import set_idle_sources
+
+    idle_srcs = []
+    for _, _, mod in modules:
+        exporter = getattr(mod, "idle_fds", None)
+        if exporter is not None:
+            idle_srcs.append(exporter)
+        elif getattr(mod, "NEEDS_POLL", True):
+            idle_srcs.append(None)
+    set_idle_sources(idle_srcs)
+
     pthread = None
     if get_var("runtime", "progress_thread"):
         pthread = ProgressThread()
@@ -316,6 +331,11 @@ def shutdown() -> None:
         _ctx["detector"].stop()
     if _ctx.get("progress_thread") is not None:
         _ctx["progress_thread"].stop()
+    # stale fd exporters must not survive into the next epoch (their
+    # btls are about to close)
+    from ompi_tpu.runtime.progress import set_idle_sources
+
+    set_idle_sources([])
     for btl in _ctx.get("btls", []):
         try:
             btl.finalize()
