@@ -80,6 +80,10 @@ RULES: Dict[str, str] = {
     "mutable-default": "no mutable default arguments",
     "swallowed-mpierror": "verb layer must not swallow MPIError",
     "show-help-topic": "show_help topics must be registered",
+    "hot-copy": "no payload duplication on the datapath: "
+                "bytes(memoryview(...)), bytes(buf[...]) slicing, and "
+                "+= bytes-concat on connection buffers are the copy "
+                "tax the zero-copy vectored tcp path exists to kill",
     "parse-error": "every linted file must parse (a broken file would "
                    "silently escape every other rule)",
 }
@@ -92,6 +96,19 @@ HOT_MODULES = {
     "runtime/progress.py",
 }
 VERB_LAYER_DIRS = ("comm/", "parallel/")
+# the process-mode wire datapath (hot-copy rule): modules where a frame
+# or payload byte should move as a view, never a fresh bytes object —
+# an intentional ownership/boundary copy carries an inline suppression
+# with justification
+HOT_COPY_MODULES = (
+    "btl/tcp.py",
+    "btl/sm.py",
+    "btl/base.py",
+    "btl/self_btl.py",
+    "pml/ob1.py",
+    "pml/base.py",
+    "core/convertor.py",
+)
 ENVIRON_EXEMPT = ("mca/var.py", "tools/")
 # the instrumentation implementations themselves (they define the guards)
 # — for the quant plane that is ONLY quant/__init__.py (it owns the
@@ -522,6 +539,57 @@ def _check_mutable_default(tree: ast.Module, scan: FileScan) -> None:
                     hint="default to None and materialize inside the body")
 
 
+# ---------------------------------------------------------------- hot-copy
+# conn-buffer attribute names for the += concat check: the old wbuf/rbuf
+# bytes-concat queues were O(n^2) under backlog, and any new *buf
+# accumulator on a connection object is the same trap
+_BUF_ATTR_SUFFIXES = ("buf",)
+
+
+def _check_hot_copy(tree: ast.Module, scan: FileScan) -> None:
+    if scan.relp not in HOT_COPY_MODULES:
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id in ("bytes", "bytearray") and node.args:
+            arg = node.args[0]
+            # bytes(memoryview(...)) / bytes(mv.cast(...)): a full
+            # payload materialization of something that was already a
+            # view
+            if any(isinstance(n, ast.Call)
+                   and isinstance(n.func, ast.Name)
+                   and n.func.id == "memoryview"
+                   for n in ast.walk(arg)):
+                scan.add(
+                    "hot-copy", node.lineno,
+                    "bytes(memoryview(...)) materializes a payload that "
+                    "was already a zero-copy view",
+                    hint="pass the view through (sendmsg/recv_into take "
+                         "buffers); if ownership is genuinely required "
+                         "at this boundary, suppress with justification")
+            # bytes(buf[a:b]) parse-copy: slice the view instead
+            elif isinstance(arg, ast.Subscript):
+                scan.add(
+                    "hot-copy", node.lineno,
+                    "bytes(<buffer>[...]) duplicates a frame slice — "
+                    "the datapath hands out views, copies happen only "
+                    "at the delivery boundary",
+                    hint="use a memoryview slice; a deliberate boundary "
+                         "copy takes an inline suppression")
+        elif isinstance(node, ast.AugAssign) and \
+                isinstance(node.op, ast.Add) and \
+                isinstance(node.target, ast.Attribute) and \
+                node.target.attr.endswith(_BUF_ATTR_SUFFIXES):
+            scan.add(
+                "hot-copy", node.lineno,
+                f"`{ast.unparse(node.target)} +=` rebuilds a connection "
+                "buffer per frame (O(n^2) under backlog — the wbuf/rbuf "
+                "concat tax)",
+                hint="queue views in a deque and drain with vectored "
+                     "I/O (btl/tcp.py's wq/sendmsg pattern)")
+
+
 # ------------------------------------------------------ swallowed-mpierror
 def _check_swallowed_mpierror(tree: ast.Module, scan: FileScan) -> None:
     if not any(scan.relp.startswith(d) for d in VERB_LAYER_DIRS):
@@ -559,6 +627,7 @@ def scan_source(src: str, path: str) -> FileScan:
     _check_progress_blocking(tree, scan)
     _check_mutable_default(tree, scan)
     _check_swallowed_mpierror(tree, scan)
+    _check_hot_copy(tree, scan)
     if relp not in INSTR_IMPL:
         _check_span_ctx(tree, scan)
     if relp in HOT_MODULES:
@@ -728,6 +797,13 @@ from ompi_tpu.utils.show_help import show_help
 
 def revoke(comm):
     show_help("ft", "no-such-topic", name=comm.name)
+"""),
+    "hot-copy": ("ompi_tpu/btl/tcp.py", """
+def _drain(self, conn, data):
+    conn.rbuf += data
+    hdr = bytes(conn.rbuf[0:49])
+    payload = bytes(memoryview(data))
+    return hdr, payload
 """),
     "parse-error": ("ompi_tpu/coll/basic.py", """
 def broken(:

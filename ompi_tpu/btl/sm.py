@@ -183,10 +183,16 @@ class SmBtl(Btl):
                 if rc < 0:  # unreachable after the pre-screen; keep safe
                     self._send_overflow(ring, pend, peer, header, payload)
                     return
-            # ring full: queue, preserve per-peer order (tcp wbuf pattern)
+            # ring full: queue, preserve per-peer order (the tcp write-
+            # queue pattern). Ownership boundary: the caller may reuse
+            # its buffer once send() returns, so queued payloads must
+            # be owned — same one-copy-under-backpressure contract as
+            # tcp's write queue.
             if not isinstance(payload, (bytes, bytearray)):
-                payload = bytes(memoryview(payload).cast("B")) \
-                    if not hasattr(payload, "tobytes") else payload.tobytes()
+                if hasattr(payload, "tobytes"):
+                    payload = payload.tobytes()
+                else:
+                    payload = bytes(memoryview(payload).cast("B"))  # mpilint: disable=hot-copy — ownership copy at the queue boundary (buffered-send semantics)
             pend.append((self._INLINE + header, payload))
 
     def drain_pending(self, peer: int):
@@ -274,9 +280,9 @@ class SmBtl(Btl):
                         break
                     try:
                         flags = struct.unpack_from("<Q", frame, 0)[0]
-                        hdr = bytes(frame[8 : 8 + HDR_SIZE])
+                        hdr = bytes(frame[8 : 8 + HDR_SIZE])  # mpilint: disable=hot-copy — 49-byte header outlives ring.advance(); the slot is recycled under it
                         if flags == 1:  # overflow: body is the spill path
-                            path = bytes(frame[8 + HDR_SIZE :]).decode()
+                            path = bytes(frame[8 + HDR_SIZE :]).decode()  # mpilint: disable=hot-copy — tiny spill-file path, consumed before the slot recycles
                             with open(path, "rb") as f:
                                 payload = f.read()
                             os.unlink(path)
