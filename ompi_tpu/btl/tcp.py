@@ -110,11 +110,11 @@ _vecs_var = register_var(
 _copy_mode_var = register_var(
     "btl_tcp", "copy_mode", 0,
     help="1 = legacy copying datapath: materialize the eager-payload "
-         "copy, the frame concat, and the receive parse copies the "
-         "zero-copy vectored path eliminates. A/B baseline for "
-         "bench.py's p2p section — the copies feed "
-         "btl_tcp_bytes_copied either way, so copies-per-wire-byte "
-         "is measured, not estimated", level=9)
+         "copy, the frame concat, the per-recv 1 MiB allocation + "
+         "rbuf concat, and the receive parse copies the zero-copy "
+         "vectored path eliminates. A/B baseline for bench.py's p2p "
+         "section — the copies feed btl_tcp_bytes_copied either way, "
+         "so copies-per-wire-byte is measured, not estimated", level=9)
 
 # datapath counters (plain int bumps — no instrumentation framework on
 # the per-frame path), exported as pvars below
@@ -172,11 +172,15 @@ register_pvar("btl_tcp", "compress_saved_bytes",
 
 
 class _Conn:
-    __slots__ = ("sock", "rxb", "rstart", "rend", "wq", "wlock", "peer",
-                 "dead", "peer_z", "await_ack")
+    __slots__ = ("sock", "rxb", "rstart", "rend", "wq", "wbuf", "rbuf",
+                 "wlock", "peer", "dead", "peer_z", "await_ack")
 
     def __init__(self, sock: socket.socket, peer: Optional[int] = None):
         self.sock = sock
+        # legacy concat queues, used ONLY under btl_tcp_copy_mode=1
+        # (the bench A/B baseline) — empty otherwise
+        self.wbuf = bytearray()
+        self.rbuf = bytearray()
         # receive staging: a pooled block filled by recv_into, with the
         # unparsed span at [rstart, rend). Acquired lazily on first
         # drain, returned to the pool when the conn unregisters.
@@ -348,7 +352,7 @@ class TcpBtl(Btl):
             mv = payload  # immutable: safe to queue without owning
         else:
             mv = memoryview(payload)
-            if mv.ndim != 1 or mv.format != "B":
+            if mv.ndim != 1 or mv.format != "B" or not mv.c_contiguous:
                 try:
                     mv = mv.cast("B")
                 except TypeError:
@@ -394,18 +398,8 @@ class TcpBtl(Btl):
                 nbytes = len(z)
                 zflag = _ZFLAG
         lenw = _LEN.pack((HDR_SIZE + nbytes) | zflag)
-        if _copy_mode_var._value:
-            # legacy copying datapath (A/B baseline, see the cvar): the
-            # pre-vectored queue paid an eager-payload copy, a frame
-            # concat, and a bytes-concat append — re-materialize all
-            # three so the measured copy tax is the old path's, not a
-            # back-of-envelope estimate
-            pb = bytes(mv)
-            frame = lenw + header + pb
-            _ctr["copied"] += nbytes + 2 * len(frame)
-            vecs: List = [bytearray(frame)]
-        elif nbytes:
-            vecs = [lenw, header, mv]
+        if nbytes:
+            vecs: List = [lenw, header, mv]
         else:
             vecs = [lenw, header]
         if dup:
@@ -429,6 +423,14 @@ class TcpBtl(Btl):
                 raise MPIError(
                     code,
                     f"connection to rank {peer} is dead: {conn.dead}")
+            if _copy_mode_var._value:
+                self._send_legacy(conn, lenw, header, mv, dup)
+                return
+            if conn.wbuf:
+                # legacy residue after a copy_mode flip: older frames
+                # must hit the wire first
+                conn.wq.append(bytes(conn.wbuf))
+                conn.wbuf.clear()
             backlog = bool(conn.wq)
             if not backlog:
                 # fast path: push straight from the caller's buffer
@@ -454,6 +456,52 @@ class TcpBtl(Btl):
         from ompi_tpu.runtime import progress as _progress
 
         _progress.poke()
+
+    def _fold_wq_legacy(self, conn: _Conn) -> None:
+        """Vectored residue after a copy_mode flip: fold the deque into
+        the legacy concat queue, oldest first. Caller holds wlock."""
+        while conn.wq:
+            conn.wbuf += conn.wq.popleft()  # mpilint: disable=hot-copy — mode-flip bridge into the legacy A/B queue
+
+    def _send_legacy(self, conn: _Conn, lenw: bytes, header: bytes,
+                     mv, dup: bool) -> None:
+        """The pre-vectored datapath, verbatim (btl_tcp_copy_mode=1,
+        the bench A/B baseline): unconditional eager-payload copy,
+        frame concat, bytes-concat queue append, byte-wise flush. The
+        copies feed btl_tcp_bytes_copied so copies-per-wire-byte is
+        MEASURED on the real legacy code, not modeled. Caller holds
+        conn.wlock and has done the dead-check."""
+        payload = bytes(mv)  # the old eager copy (pre-PR tcp.py:277)  # mpilint: disable=hot-copy — legacy A/B path reproduces the old copies on purpose
+        frame = lenw + header + payload
+        _ctr["copied"] += len(payload) + len(frame)
+        self._fold_wq_legacy(conn)
+        conn.wbuf += frame  # mpilint: disable=hot-copy — legacy A/B path reproduces the old concat queue on purpose
+        _ctr["copied"] += len(frame)
+        if dup:
+            conn.wbuf += frame  # mpilint: disable=hot-copy — legacy A/B path
+            _ctr["copied"] += len(frame)
+        self._flush_legacy(conn)
+
+    def _flush_legacy(self, conn: _Conn) -> None:
+        """The pre-vectored flush: byte-wise send + O(n) front-trim of
+        the concat queue (O(n^2) across a backlog — the measured tax).
+        Caller holds conn.wlock."""
+        self._fold_wq_legacy(conn)
+        while conn.wbuf:
+            try:
+                sent = conn.sock.send(conn.wbuf)
+            except socket.error as e:
+                if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                    self._want_write(conn, True)
+                    return
+                self._conn_failed(conn, e)
+                return
+            if sent <= 0:
+                self._want_write(conn, True)
+                return
+            _ctr["wire"] += sent
+            del conn.wbuf[:sent]
+        self._want_write(conn, False)
 
     def _try_send(self, conn: _Conn, vecs: List) -> List:
         """Vectored push of ``vecs`` until the socket blocks; returns
@@ -491,6 +539,10 @@ class TcpBtl(Btl):
     def _flush_locked(self, conn: _Conn) -> None:
         """Drain the owned write queue with vectored sends; caller
         holds conn.wlock."""
+        if conn.wbuf:
+            # legacy residue after a copy_mode flip: ordered first
+            conn.wq.appendleft(bytes(conn.wbuf))
+            conn.wbuf.clear()
         wq = conn.wq
         max_vecs = int(_vecs_var._value)
         while wq:
@@ -529,6 +581,7 @@ class TcpBtl(Btl):
         with conn.wlock:
             conn.dead = err
             conn.wq.clear()
+            conn.wbuf.clear()
         self.log.error("i/o with rank %s failed: %s", conn.peer, err)
         self._unregister(conn)
         # The dead conn stays in self.conns: bytes already queued (and
@@ -597,7 +650,10 @@ class TcpBtl(Btl):
                     continue
                 if mask & selectors.EVENT_WRITE:
                     with conn.wlock:
-                        self._flush_locked(conn)
+                        if _copy_mode_var._value:
+                            self._flush_legacy(conn)
+                        else:
+                            self._flush_locked(conn)
                 if mask & selectors.EVENT_READ:
                     n += self._drain(conn)
             return n
@@ -646,11 +702,17 @@ class TcpBtl(Btl):
         return 1
 
     def _drain(self, conn: _Conn) -> int:
+        if _copy_mode_var._value:
+            return self._drain_legacy(conn)
         # pooled receive staging: recv_into this conn's reusable block
         # (one pool hit) instead of a fresh 1 MiB allocation per recv —
         # a 4-byte ack used to cost a megabyte of garbage plus an rbuf
         # concat. Frames are then SLICED out of the block; anything
         # that must outlive it is copied at the pml delivery boundary.
+        if conn.rbuf:
+            # legacy residue after a copy_mode flip: replay it through
+            # the block so frame parsing stays continuous
+            self._adopt_legacy_rbuf(conn)
         buf = conn.rxb
         if buf is None:
             buf = conn.rxb = _rx_pool.acquire()
@@ -716,7 +778,6 @@ class TcpBtl(Btl):
             if word in (_ZACK_MAGIC, _ZACK_MAGIC | _ZACK_ACCEPT):
                 conn.peer_z = bool(word & _ZACK_ACCEPT)
                 off += 4
-        copy_mode = _copy_mode_var._value
         while end - off >= 4:
             word = _LEN.unpack_from(buf, off)[0]
             total = word & _LEN_MASK
@@ -729,12 +790,6 @@ class TcpBtl(Btl):
             hdr = mv[start:start + HDR_SIZE]
             payload = mv[start + HDR_SIZE:start + total]
             off = start + total
-            if copy_mode:
-                # legacy copying datapath (A/B baseline): re-add the
-                # per-frame parse copies the sliced path eliminates
-                _ctr["copied"] += total
-                hdr = bytes(hdr)
-                payload = bytes(payload)
             if word & _ZFLAG:
                 # negotiated framing: only a handshake-capable peer ever
                 # sets the flag, so this build always knows how to undo
@@ -771,6 +826,102 @@ class TcpBtl(Btl):
                 conn.rxb = None
         else:
             conn.rstart = off
+        return n
+
+    def _adopt_legacy_rbuf(self, conn: _Conn) -> None:
+        """Move legacy rbuf residue (a copy_mode flip mid-stream) into
+        the pooled block, growing it if needed. Runs under the drain's
+        single-drainer exclusivity."""
+        pending = len(conn.rbuf)
+        if conn.rxb is None:
+            conn.rxb = _rx_pool.acquire()
+            conn.rstart = conn.rend = 0
+        live = conn.rend - conn.rstart
+        if live + pending > len(conn.rxb):
+            nbuf = bytearray(max(live + pending, 2 * len(conn.rxb)))
+            nbuf[:live] = conn.rxb[conn.rstart:conn.rend]
+            if len(conn.rxb) == _RX_BLOCK:
+                _rx_pool.release(conn.rxb)
+            conn.rxb = nbuf
+            conn.rstart, conn.rend = 0, live
+        elif conn.rend + pending > len(conn.rxb):
+            conn.rxb[:live] = conn.rxb[conn.rstart:conn.rend]
+            conn.rstart, conn.rend = 0, live
+        conn.rxb[conn.rend:conn.rend + pending] = conn.rbuf
+        conn.rend += pending
+        _ctr["copied"] += pending
+        conn.rbuf.clear()
+
+    def _drain_legacy(self, conn: _Conn) -> int:
+        """The pre-vectored read path, verbatim (btl_tcp_copy_mode=1,
+        the bench A/B baseline): a fresh 1 MiB allocation per recv, an
+        rbuf concat, and per-frame header/payload parse copies — all
+        charged to btl_tcp_bytes_copied so the legacy copy tax is
+        measured on the real legacy code."""
+        if conn.rxb is not None and conn.rend > conn.rstart:
+            # vectored residue after a copy_mode flip
+            conn.rbuf += memoryview(conn.rxb)[conn.rstart:conn.rend]  # mpilint: disable=hot-copy — legacy A/B path adopts the pooled residue
+            _ctr["copied"] += conn.rend - conn.rstart
+        if conn.rxb is not None:
+            if len(conn.rxb) == _RX_BLOCK:
+                _rx_pool.discard(conn.rxb)
+            conn.rxb = None
+            conn.rstart = conn.rend = 0
+        try:
+            data = conn.sock.recv(1 << 20)
+        except socket.error as e:
+            if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                return 0
+            self._conn_failed(conn, e)
+            return 0
+        if not data:
+            if conn.dead is None:
+                conn.dead = ConnectionResetError("closed by peer")
+            if conn.peer is not None:
+                from ompi_tpu.ft.detector import mark_failed
+
+                if get_var("ft", "enable"):
+                    mark_failed(conn.peer)
+            self._unregister(conn)
+            return 0
+        _ctr["wire"] += len(data)
+        conn.rbuf += data  # mpilint: disable=hot-copy — legacy A/B path reproduces the old rbuf concat on purpose
+        _ctr["copied"] += len(data)
+        n = 0
+        buf = conn.rbuf
+        off = 0
+        if conn.await_ack and len(buf) >= 4:
+            word = _LEN.unpack_from(buf, 0)[0]
+            conn.await_ack = False
+            if word in (_ZACK_MAGIC, _ZACK_MAGIC | _ZACK_ACCEPT):
+                conn.peer_z = bool(word & _ZACK_ACCEPT)
+                off = 4
+        while len(buf) - off >= 4:
+            word = _LEN.unpack_from(buf, off)[0]
+            total = word & _LEN_MASK
+            if len(buf) - off - 4 < total:
+                break
+            start = off + 4
+            hdr = bytes(buf[start:start + HDR_SIZE])  # mpilint: disable=hot-copy — legacy A/B path reproduces the old parse copy on purpose
+            payload = bytes(buf[start + HDR_SIZE:start + total])  # mpilint: disable=hot-copy — legacy A/B path reproduces the old parse copy on purpose
+            _ctr["copied"] += total
+            off += 4 + total
+            if word & _ZFLAG:
+                try:
+                    payload = zlib.decompress(payload)
+                except zlib.error as e:
+                    self.log.exception("corrupt compressed frame")
+                    self._conn_failed(conn, OSError(
+                        f"corrupt compressed frame from rank "
+                        f"{conn.peer}: {e}"))
+                    return n
+            try:
+                self.deliver(hdr, payload)
+            except Exception:
+                self.log.exception("frame handler failed (frame dropped)")
+            n += 1
+        if off:
+            del buf[:off]
         return n
 
     def _unregister(self, conn: _Conn) -> None:
