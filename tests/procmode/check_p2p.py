@@ -120,7 +120,7 @@ assert ratios["legacy"] > 0.9, ratios  # legacy really copies
 # timing legs: interleaved min-of-rounds
 t_small = {0: float("inf"), 1: float("inf")}
 t_big = {0: float("inf"), 1: float("inf")}
-for _ in range(3):
+for _ in range(5):
     for mode in (0, 1):
         set_var("btl_tcp", "copy_mode", mode)
         t_small[mode] = min(t_small[mode], timed(small_rate, N_BATCH))
