@@ -29,8 +29,8 @@ class Btl:
     ``NEEDS_POLL = False`` so the progress engine may PARK while idle
     (runtime/progress.py idle_block). The conservative default —
     NEEDS_POLL True, no exporter — marks a transport that discovers
-    work only by polling (the sm rings): its presence caps every park
-    at the caller's legacy poll interval."""
+    work only by polling (the sm rings): its presence keeps idle
+    loops on the legacy sleep backoff instead of select-parking."""
 
     NAME = "base"
     eager_limit: Optional[int] = 65536

@@ -19,9 +19,10 @@ full core on ``sleep(0)`` / blind millisecond sleeps. Any inbound frame
 wakes the parked loop at fd latency; local producers (a tcp send that
 left a backlog, a system-plane post, a request completion) ``poke()``
 the pipe so nothing waits out a backoff interval. A transport that
-polls memory instead of fds (the sm rings) caps the park at the
-caller's old blind-sleep interval, so sm latency is unchanged while
-fd-only (DCN) jobs park for up to ``runtime_idle_block_us``.
+polls memory instead of fds (the sm rings) keeps the caller on the old
+blind-sleep interval — same latency, and cheaper than per-park fd
+exports at that cadence — while fd-only (DCN) transport sets park for
+up to ``runtime_idle_block_us``.
 """
 
 from __future__ import annotations
@@ -116,12 +117,13 @@ def poke() -> None:
 def idle_block(max_wait: float, base: float,
                recheck: Optional[Callable[[], bool]] = None) -> bool:
     """Park in select() for up to min(max_wait, runtime_idle_block_us)
-    seconds — or ``base`` (the caller's legacy blind-sleep interval)
-    when a poll-only transport is live or the cvar is 0, in which case
-    a plain sleep happens instead. ``recheck`` closes the lost-wakeup
-    race: it runs after this thread becomes visible to poke() and
-    cancels the park if the condition already holds. Returns True when
-    the loop actually parked in select."""
+    seconds. When the cvar is 0 or a poll-only transport is live, a
+    plain ``base``-second sleep happens instead (the legacy backoff —
+    same latency bound, cheaper than fd exports at that cadence).
+    ``recheck`` closes the lost-wakeup race: it runs after this thread
+    becomes visible to poke() and cancels the park if the condition
+    already holds. Returns True when the loop actually parked in
+    select."""
     import time
 
     cap = _idle_var._value / 1e6
@@ -130,25 +132,29 @@ def idle_block(max_wait: float, base: float,
     if cap <= 0:
         time.sleep(min(base, max_wait))
         return False
+    # a poll-only transport (sm rings) means select can't see all
+    # traffic, so the park may not exceed the caller's legacy poll
+    # interval — and at that sub-millisecond cadence the blind sleep
+    # is CHEAPER than building fd lists + a select syscall per park
+    # (measured load on oversubscribed hosts). Parking in select is
+    # reserved for fd-complete (DCN) transport sets.
+    if any(fn is None for fn in _idle_sources):
+        time.sleep(min(base, max_wait))
+        return False
     rfds = [_wakeup_fd()]
     wfds: List[int] = []
-    # parking is always SAFE (pokes + fd readiness wake it; the timeout
-    # bounds the rest) — only the park DURATION depends on the sources:
-    # a poll-only transport means select can't see its traffic, so the
-    # park must stay within the caller's legacy poll interval
-    long_ok = True
+    ok = True
     for fn in list(_idle_sources):
-        if fn is None:
-            long_ok = False
-            continue
         try:
             r, w = fn()
         except Exception:
-            long_ok = False
+            ok = False
             continue
         rfds += r
         wfds += w
-    if not long_ok:
+    if not ok:
+        # a transport raced shutdown mid-export: fall back to the
+        # legacy interval so its traffic can't stall a long park
         cap = min(cap, base)
     timeout = min(max_wait, cap)
     _parked[0] += 1
