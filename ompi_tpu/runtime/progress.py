@@ -132,46 +132,62 @@ def idle_block(max_wait: float, base: float,
     if cap <= 0:
         time.sleep(min(base, max_wait))
         return False
-    # a poll-only transport (sm rings) means select can't see all
+    # a poll-only transport (sm rings) means no fd set can see all
     # traffic, so the park may not exceed the caller's legacy poll
     # interval — and at that sub-millisecond cadence the blind sleep
-    # is CHEAPER than building fd lists + a select syscall per park
-    # (measured load on oversubscribed hosts). Parking in select is
-    # reserved for fd-complete (DCN) transport sets.
+    # is CHEAPER than building fd lists + a poll syscall per park
+    # (measured load on oversubscribed hosts). fd-parking is reserved
+    # for fd-complete (DCN) transport sets.
     if any(fn is None for fn in _idle_sources):
         time.sleep(min(base, max_wait))
         return False
-    rfds = [_wakeup_fd()]
-    wfds: List[int] = []
-    ok = True
-    for fn in list(_idle_sources):
-        try:
-            r, w = fn()
-        except Exception:
-            ok = False
-            continue
-        rfds += r
-        wfds += w
-    if not ok:
-        # a transport raced shutdown mid-export: fall back to the
-        # legacy interval so its traffic can't stall a long park
-        cap = min(cap, base)
-    timeout = min(max_wait, cap)
-    _parked[0] += 1
+    # become poke-visible BEFORE snapshotting fds: a producer whose
+    # event lands mid-snapshot (a send queueing a backlog on a conn
+    # whose write interest we would miss) must find _parked set so its
+    # poke puts a byte in the pipe and the poll returns immediately —
+    # increment-first closes that lost-wakeup window
+    with _wake_lock:
+        _parked[0] += 1
     try:
         if recheck is not None and recheck():
             return False
+        wake_r = _wakeup_fd()
+        # select.poll, NOT select.select: fds >= FD_SETSIZE (1024 —
+        # easily exceeded by a large world's conns) make select raise
+        # on every call, which would silently degrade every park.
+        # (A closed-raced fd yields a POLLNVAL wake, not an error.)
+        masks = {wake_r: _select.POLLIN}
+        ok = True
         try:
-            ready, _, _ = _select.select(rfds, wfds, [], timeout)
-        except (OSError, ValueError):
-            return False  # a conn raced shut mid-export: treat as a wake
+            for fn in list(_idle_sources):
+                r, w = fn()
+                for fd in r:
+                    if fd >= 0:
+                        masks[fd] = masks.get(fd, 0) | _select.POLLIN
+                for fd in w:
+                    if fd >= 0:
+                        masks[fd] = masks.get(fd, 0) | _select.POLLOUT
+        except Exception:
+            # an exporter (or a racing close) broke mid-snapshot: fall
+            # back to the legacy interval so untracked traffic can't
+            # stall a long park
+            ok = False
+        try:
+            poller = _select.poll()
+            for fd, m in masks.items():
+                poller.register(fd, m)
+            timeout = min(max_wait, cap if ok else min(cap, base))
+            ready = poller.poll(max(timeout, 0) * 1000.0)
+        except (OSError, ValueError, OverflowError):
+            time.sleep(min(base, max_wait))  # NEVER busy-spin the loop
+            return False
     finally:
-        _parked[0] -= 1
+        with _wake_lock:
+            _parked[0] -= 1
     _idle_blocks[0] += 1
-    r = _wakeup[0]
-    if r is not None and r in ready:
+    if any(fd == wake_r for fd, _ev in ready):
         try:
-            _os.read(r, 4096)  # drain coalesced pokes
+            _os.read(wake_r, 4096)  # drain coalesced pokes
         except OSError:
             pass
     return True
@@ -286,15 +302,16 @@ class ProgressThread:
                 idle += 1
                 time.sleep(0)
             else:
-                # deep idle: PARK in select instead of interval polling
+                # deep idle: PARK in poll() instead of interval polling
                 # — a blocked rank used to burn a core here (and starve
                 # the peer on one-core hosts). Inbound frames wake via
                 # their fds, local producers via poke(), stop() pokes
-                # unconditionally; a poll-only transport (sm) caps the
-                # park at the legacy poll interval
-                if not idle_block(3600.0, self.interval,
-                                  recheck=self._stop.is_set):
-                    self._stop.wait(self.interval)
+                # unconditionally; poll-only transport sets (sm) make
+                # this the legacy interval sleep instead. Every
+                # non-waking idle_block path sleeps internally — no
+                # extra wait here, or deep-idle latency would double
+                idle_block(3600.0, self.interval,
+                           recheck=self._stop.is_set)
 
     def stop(self) -> None:
         self._stop.set()
