@@ -716,6 +716,24 @@ def _cpu_mesh_sweep():
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+def _procmode_env():
+    """Environment for spawning mpirun procmode children from bench:
+    strip the caller's rank identity and the axon sitecustomize (the
+    children must run the CPU backend from this worktree), and put the
+    repo first on PYTHONPATH. Shared by every procmode bench section —
+    an env quirk fixed here reaches all of them."""
+    import os
+
+    env = dict(os.environ)
+    env.pop("OMPI_TPU_RANK", None)
+    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+          if p and not any("axon" in part for part in p.split(os.sep))]
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.abspath(__file__))] + pp)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
 def bench_p2p():
     """Process-mode DCN datapath A/B: the zero-copy vectored tcp path
     vs the legacy copying datapath (``btl_tcp_copy_mode=1`` runs the
@@ -733,13 +751,7 @@ def bench_p2p():
 
     from ompi_tpu.runtime import metrics
 
-    env = dict(os.environ)
-    env.pop("OMPI_TPU_RANK", None)
-    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-          if p and not any("axon" in part for part in p.split(os.sep))]
-    env["PYTHONPATH"] = os.pathsep.join(
-        [os.path.dirname(os.path.abspath(__file__))] + pp)
-    env["JAX_PLATFORMS"] = "cpu"
+    env = _procmode_env()
     out = {}
     attempts = []
     for attempt in range(3):
@@ -799,6 +811,66 @@ def bench_p2p():
     return out
 
 
+def bench_coll_datapath():
+    """Collective round-engine A/B: the zero-copy pooled/windowed engine
+    vs the legacy engine (``coll_round_copy_mode=1`` runs the real
+    pre-PR-10 staging), measured by tests/procmode/check_coll_round.py —
+    interleaved min-of-rounds for the timing leg, with
+    copies-per-byte-moved taken from the coll_round_bytes_copied /
+    bytes_moved pvars (count-based, deterministic) plus the pool-hit and
+    windowed-round proofs. Gauges mirror into the metrics registry so
+    the BENCH json and the Prometheus export agree. Timing ratios are
+    print-only upstream (the stripe noise lesson); here the count-based
+    claims gate and the ratio is just recorded."""
+    import os
+    import re
+    import subprocess
+
+    from ompi_tpu.runtime import metrics
+
+    env = _procmode_env()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "4",
+             "--mca", "coll_coll", "^sm,adapt,han,hier,quant",
+             "tests/procmode/check_coll_round.py"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except Exception as e:  # pragma: no cover
+        return {"error": str(e)[:300]}
+    copies = re.search(
+        r"COLLROUND-COPIES rank 0 new=([0-9.]+) legacy=([0-9.]+) "
+        r"drop=([0-9.]+)x", r.stdout)
+    pool = re.search(r"COLLROUND-POOL rank 0 hits=(\d+) windowed=(\d+)",
+                     r.stdout)
+    tm = re.search(r"COLLROUND-TIME big_new=([0-9.]+)s "
+                   r"big_legacy=([0-9.]+)s ratio=([0-9.]+)", r.stdout)
+    if not (copies and pool and tm):
+        return {"error": r.stdout[-300:] + r.stderr[-300:]}
+    out = {
+        "copies_per_byte_moved": {"new": float(copies.group(1)),
+                                  "legacy": float(copies.group(2)),
+                                  "drop": float(copies.group(3))},
+        "pool_hits": int(pool.group(1)),
+        "windowed_rounds": int(pool.group(2)),
+        # >=1 MB allreduce+alltoall pair, interleaved min-of-rounds;
+        # timing is informational — the copy counts are the gate
+        "big_pair_s": {"new": float(tm.group(1)),
+                       "legacy": float(tm.group(2)),
+                       "ratio": float(tm.group(3))},
+        "bitwise_equal_ranks": r.stdout.count("COLLROUND-EQ"),
+    }
+    for mode in ("new", "legacy"):
+        metrics.gauge_set("bench_coll_copies_per_byte_moved",
+                          out["copies_per_byte_moved"][mode], mode=mode)
+        metrics.gauge_set("bench_coll_big_pair_s",
+                          out["big_pair_s"][mode], mode=mode)
+    metrics.gauge_set("bench_coll_pool_hits", out["pool_hits"])
+    metrics.gauge_set("bench_coll_windowed_rounds",
+                      out["windowed_rounds"])
+    return out
+
+
 def bench_host_paths():
     """Process-mode fast paths vs their frame-based fallbacks: coll/sm
     segment collectives (xhc analog) and the zero-copy shared-segment
@@ -807,13 +879,7 @@ def bench_host_paths():
     import re
     import subprocess
 
-    env = dict(os.environ)
-    env.pop("OMPI_TPU_RANK", None)
-    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-          if p and not any("axon" in part for part in p.split(os.sep))]
-    env["PYTHONPATH"] = os.pathsep.join(
-        [os.path.dirname(os.path.abspath(__file__))] + pp)
-    env["JAX_PLATFORMS"] = "cpu"
+    env = _procmode_env()
     cores = len(os.sched_getaffinity(0)) \
         if hasattr(os, "sched_getaffinity") else os.cpu_count()
     # single-core hosts serialize both rails/paths: the stripe ratio in
@@ -898,6 +964,7 @@ def main() -> int:
     # acceptance number
     detail["dispatch_tax"]["plan_cache"] = bench_plan_cache()
     detail["p2p"] = bench_p2p()
+    detail["coll_datapath"] = bench_coll_datapath()
     detail["host_paths"] = bench_host_paths()
     detail["model_step"] = bench_mfu()
 

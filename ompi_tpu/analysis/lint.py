@@ -108,6 +108,11 @@ HOT_COPY_MODULES = (
     "pml/ob1.py",
     "pml/base.py",
     "core/convertor.py",
+    # the collective round engine rides the same discipline (PR 10):
+    # round sends are borrowed views, recvs are pooled or land direct —
+    # a staging materialization here re-taxes every proc-mode collective
+    "coll/sched.py",
+    "coll/algorithms.py",
 )
 ENVIRON_EXEMPT = ("mca/var.py", "tools/")
 # the instrumentation implementations themselves (they define the guards)
@@ -119,7 +124,12 @@ INSTR_IMPL = ("runtime/trace.py", "runtime/sanitizer.py", "runtime/spc.py",
               "reshard/plan.py", "reshard/exec.py", "reshard/elastic.py",
               "quant/__init__.py", "coll/hier/__init__.py",
               "coll/hier/plan.py", "coll/hier/decide.py",
-              "coll/hier/compose.py")
+              "coll/hier/compose.py",
+              # the round engine is instrumentation-bearing framework
+              # code (PR 10): listed here so the span-ctx pairing check
+              # doesn't apply to it — like the other entries, any
+              # trace spans it grows are its own implementation detail
+              "coll/sched.py")
 
 TRACE_ALIASES = {"trace", "_trace", "_tr"}
 SAN_ALIASES = {"sanitizer", "_san", "_sanitizer"}
@@ -577,6 +587,27 @@ def _check_hot_copy(tree: ast.Module, scan: FileScan) -> None:
                     "at the delivery boundary",
                     hint="use a memoryview slice; a deliberate boundary "
                          "copy takes an inline suppression")
+        elif isinstance(node, ast.Call) and (
+                (isinstance(node.func, ast.Attribute)
+                 and node.func.attr in ("ascontiguousarray",
+                                        "concatenate"))
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id in ("ascontiguousarray",
+                                         "concatenate"))):
+            # np.ascontiguousarray / np.concatenate: the coll-round
+            # staging tax (a defensive ascontiguousarray on an
+            # already-contiguous view is dead weight; a real one is a
+            # payload materialization that must be counted)
+            scan.add(
+                "hot-copy", node.lineno,
+                f"np.{getattr(node.func, 'attr', None) or node.func.id}"
+                "(...) stages a payload on the datapath — round sends "
+                "borrow contiguous views, recvs land in pooled blocks "
+                "or their final slot",
+                hint="pass the view through (1-D slices of contiguous "
+                     "buffers are already contiguous); a genuine "
+                     "non-contiguous fallback or legacy A/B copy takes "
+                     "an inline suppression and a note_copied() charge")
         elif isinstance(node, ast.AugAssign) and \
                 isinstance(node.op, ast.Add) and \
                 isinstance(node.target, ast.Attribute) and \
@@ -798,12 +829,16 @@ from ompi_tpu.utils.show_help import show_help
 def revoke(comm):
     show_help("ft", "no-such-topic", name=comm.name)
 """),
-    "hot-copy": ("ompi_tpu/btl/tcp.py", """
+    "hot-copy": ("ompi_tpu/coll/sched.py", """
+import numpy as np
+
 def _drain(self, conn, data):
     conn.rbuf += data
     hdr = bytes(conn.rbuf[0:49])
     payload = bytes(memoryview(data))
-    return hdr, payload
+    staged = np.ascontiguousarray(payload)
+    train = np.concatenate([staged, staged])
+    return hdr, train
 """),
     "parse-error": ("ompi_tpu/coll/basic.py", """
 def broken(:

@@ -12,6 +12,18 @@ All algorithms are datatype-agnostic: payloads travel as convertor-packed
 bytes; reductions view packed streams with the datatype's element dtype
 (homogeneous or value/index pair typemaps, as in coll/basic).
 
+Datapath discipline (the PR 9 borrowed-view contract, one layer up):
+sends are contiguous VIEWS over the caller's packed/accumulator buffers;
+receives land either in a pooled staging block (reduction operands) or
+directly in their final location — a slice of the caller's receive
+buffer or the ring accumulator — via the ``(nbytes, src, dest)`` recv
+form. A staging copy happens only where the data genuinely cannot be
+borrowed (non-contiguous layouts, the bruck rotation, padded ring
+tails) and every such copy is charged to ``coll_round_bytes_copied``.
+The pre-PR-10 staging (fresh recv buffers, recv->out copies, the bruck
+concatenate, the ring segment scratch + gather) is kept VERBATIM behind
+``coll_round_copy_mode=1`` as the measured A/B baseline.
+
 Reduction-bearing schedules (recursive doubling, ring, binomial reduce)
 require a commutative op — the decision layer (coll/tuned.py) routes
 non-commutative ops to the rank-ordered linear algorithms, matching the
@@ -25,25 +37,65 @@ from typing import List, Optional
 import numpy as np
 
 from ompi_tpu.coll.basic import _np_reduce_typed, _typed_view
+from ompi_tpu.coll import sched as _sched
 from ompi_tpu.coll.sched import Round
 from ompi_tpu.comm.communicator import parse_buffer
 from ompi_tpu.core import op as _op
-from ompi_tpu.core.convertor import pack as cv_pack, unpack as cv_unpack
+from ompi_tpu.core.convertor import (
+    _as_byte_view as _as_bytes,
+    pack as cv_pack,
+    unpack as cv_unpack,
+)
 from ompi_tpu.core.datatype import Datatype
 
 
 def _packed(buf):
+    """Packed wire bytes of ``buf`` — the convertor's contiguous fast
+    path is a borrowed view; only a genuinely non-contiguous pack output
+    pays a counted staging copy."""
     obj, count, dt = parse_buffer(buf)
-    return np.ascontiguousarray(cv_pack(obj, count, dt)), count, dt
+    data = cv_pack(obj, count, dt)
+    if not data.flags.c_contiguous:
+        _sched.note_copied(data.nbytes)
+        data = np.ascontiguousarray(data)  # mpilint: disable=hot-copy — non-contiguous pack output, counted
+    return data, count, dt
 
 
 def _bytes(a: np.ndarray) -> np.ndarray:
-    return np.ascontiguousarray(a).view(np.uint8)
+    """Flat uint8 VIEW of ``a``; a non-contiguous source is the one
+    counted fallback copy the borrowed-view contract allows."""
+    if a.flags.c_contiguous:
+        return a.view(np.uint8)
+    _sched.note_copied(a.nbytes)
+    return np.ascontiguousarray(a).view(np.uint8)  # mpilint: disable=hot-copy — non-contiguous fallback, counted
 
 
 def _unpack_into(data: np.ndarray, buf) -> None:
     obj, count, dt = parse_buffer(buf)
     cv_unpack(_bytes(data), obj, count, dt)
+
+
+def _direct_view(buf) -> Optional[np.ndarray]:
+    """Flat uint8 view over the receive buffer so rounds can land
+    payloads in their FINAL location (no staging, no final unpack), or
+    None when staging is required: non-contiguous datatype or layout —
+    or the legacy engine, which always stages (that difference is
+    exactly what the copy_mode A/B measures)."""
+    if _sched.copy_mode():
+        return None
+    obj, count, dt = parse_buffer(buf)
+    if dt.is_contiguous and isinstance(obj, np.ndarray) \
+            and obj.flags.c_contiguous and obj.flags.writeable:
+        return _as_bytes(obj)[:count * dt.size]
+    return None
+
+
+def _unpack_staging(data: np.ndarray, buf) -> None:
+    """Final unpack from a STAGING array into the user's receive buffer
+    — a counted copy (the direct-landing path skips it entirely)."""
+    obj, count, dt = parse_buffer(buf)
+    cv_unpack(data, obj, count, dt)
+    _sched.note_copied(data.nbytes)
 
 
 # ----------------------------------------------------------------- barrier
@@ -59,21 +111,29 @@ def barrier_dissemination(comm):
 
 # ------------------------------------------------------------------- bcast
 def bcast_binomial(comm, buf, root: int):
-    """Binomial tree (coll_base_bcast.c binomial)."""
+    """Binomial tree (coll_base_bcast.c binomial). Non-root ranks with a
+    contiguous buffer receive STRAIGHT into it and forward borrowed
+    views of it — zero staging on the whole tree."""
     n, r = comm.size, comm.rank
     obj, count, dt = parse_buffer(buf)
     nbytes = count * dt.size
     vrank = (r - root) % n
+    dest: Optional[np.ndarray] = None
     data: Optional[np.ndarray] = None
     if vrank == 0:
-        data = np.ascontiguousarray(cv_pack(obj, count, dt))
+        data = _packed(buf)[0]
     else:
         mask = 1
         while not (vrank & mask):
             mask <<= 1
         src = (vrank - mask + root) % n
-        bufs = yield Round(recvs=[(nbytes, src)])
-        data = bufs[0]
+        dest = _direct_view(buf)
+        if dest is not None:
+            yield Round(recvs=[(nbytes, src, dest)])
+            data = dest
+        else:
+            bufs = yield Round(recvs=[(nbytes, src)])
+            data = bufs[0]
         # children live below the bit that connected us to our parent
         mask >>= 1
     if vrank == 0:
@@ -88,14 +148,15 @@ def bcast_binomial(comm, buf, root: int):
         mask >>= 1
     if sends:
         yield Round(sends=sends)
-    if vrank != 0:
-        cv_unpack(data, obj, count, dt)
+    if vrank != 0 and dest is None:
+        _unpack_staging(data, buf)
 
 
 # ------------------------------------------------------------------ reduce
 def reduce_linear(comm, sendbuf, recvbuf, op: _op.Op, root: int):
     """Rank-ordered linear fan-in — correct for non-commutative ops
-    (coll/basic linear reduce)."""
+    (coll/basic linear reduce). Contributions arrive in pooled blocks
+    (they are reduction operands, not final data)."""
     n, r = comm.size, comm.rank
     packed, _, dt = _packed(recvbuf if sendbuf is None else sendbuf)
     if r != root:
@@ -190,29 +251,57 @@ def allreduce_ring(comm, sendbuf, recvbuf, op: _op.Op, nseg: int = 1):
     (coll_base_allreduce.c:345); with ``nseg > 1`` the element space is
     split into segments whose rings run pipelined — segment s executes its
     step t in global round s + t, so communication of one segment overlaps
-    reduction of the next (the segmented ring of :622)."""
+    reduction of the next (the segmented ring of :622).
+
+    Datapath: the accumulator lives directly in the user's receive
+    buffer when its layout allows (in-place reduction — no private copy,
+    no final unpack), segments ALIAS it instead of staging into padded
+    scratch (scratch only for a non-divisible tail, counted), allgather-
+    phase blocks land in their final slot via dest-view recvs, and the
+    reduce-scatter staging blocks recycle through ``Round.free`` each
+    step — the pool's steady state."""
     n, r = comm.size, comm.rank
     packed, _, dt = _packed(recvbuf if sendbuf is None else sendbuf)
-    typed = _typed_view(packed.copy(), dt)
+    legacy = _sched.copy_mode()
+    rdest = None if legacy else _direct_view(recvbuf)
+    if rdest is not None and rdest.nbytes == packed.nbytes \
+            and dt.np_dtype is not None:
+        # accumulate in the receive buffer itself: seed it with the send
+        # payload (free for IN_PLACE — packed already aliases recvbuf)
+        if sendbuf is not None:
+            rdest[:] = _bytes(packed)
+        typed = rdest.view(dt.np_dtype)
+        in_dest = True
+    else:
+        typed = _typed_view(packed.copy(), dt)
+        in_dest = False
     if n == 1:
-        _unpack_into(typed, recvbuf)
+        if not in_dest:
+            _unpack_into(typed, recvbuf)
         return
     total = typed.size
     nseg = max(1, min(int(nseg), max(1, total // n)))
     bounds = [total * s // nseg for s in range(nseg + 1)]
-    segs = []  # (padded flat array of n*k elements, k, orig_len, offset)
+    segs = []  # [arr of n*k elements, k, orig_len, offset, staged]
     for s in range(nseg):
         a, b = bounds[s], bounds[s + 1]
         ln = b - a
         k = max(1, -(-ln // n))
-        arr = np.zeros(n * k, dtype=typed.dtype)
-        arr[:ln] = typed[a:b]
-        segs.append([arr, k, ln, a])
+        if not legacy and ln == n * k:
+            segs.append([typed[a:b], k, ln, a, False])  # alias, no copy
+        else:
+            # legacy engine verbatim — and the padded-tail fallback: a
+            # non-divisible segment stages into padded scratch, counted
+            arr = np.zeros(n * k, dtype=typed.dtype)
+            arr[:ln] = typed[a:b]
+            _sched.note_copied(ln * typed.itemsize)
+            segs.append([arr, k, ln, a, True])
     steps = 2 * n - 2
     left, right = (r - 1) % n, (r + 1) % n
+    done_blocks: List[np.ndarray] = []
     for g in range(steps + nseg - 1):
         sends, recvs, meta = [], [], []
-        for s, (arr, k, ln, off) in enumerate(segs):
+        for s, (arr, k, ln, off, staged) in enumerate(segs):
             t = g - s
             if not (0 <= t < steps):
                 continue
@@ -225,65 +314,127 @@ def allreduce_ring(comm, sendbuf, recvbuf, op: _op.Op, nseg: int = 1):
                 sb, rb = (r + 1 - ag) % n, (r - ag) % n
                 kind = "ag"
             sends.append((_bytes(arr[sb * k:(sb + 1) * k]), right))
-            recvs.append((k * isz, left))
-            meta.append((s, kind, rb))
-        bufs = yield Round(sends=sends, recvs=recvs)
-        for (s, kind, rb), b in zip(meta, bufs):
-            arr, k, ln, off = segs[s]
-            got = b.view(arr.dtype)
-            blk = arr[rb * k:(rb + 1) * k]
-            if kind == "rs":
-                arr[rb * k:(rb + 1) * k] = _np_reduce_typed(op, blk, got)
+            if kind == "ag" and not legacy:
+                # the forwarded block IS final data: land it in place
+                recvs.append((k * isz, left,
+                              _bytes(arr[rb * k:(rb + 1) * k])))
             else:
-                arr[rb * k:(rb + 1) * k] = got
-    out = np.empty(total, dtype=typed.dtype)
-    for arr, k, ln, off in segs:
-        out[off:off + ln] = arr[:ln]
-    _unpack_into(out, recvbuf)
+                recvs.append((k * isz, left))
+            meta.append((s, kind, rb))
+        bufs = yield Round(sends=sends, recvs=recvs, free=done_blocks)
+        done_blocks = []
+        for (s, kind, rb), b in zip(meta, bufs):
+            arr, k, ln, off, staged = segs[s]
+            if kind == "rs":
+                got = b.view(arr.dtype)
+                blk = arr[rb * k:(rb + 1) * k]
+                arr[rb * k:(rb + 1) * k] = _np_reduce_typed(op, blk, got)
+                done_blocks.append(b)  # operand consumed: recycle next yield
+            elif legacy:
+                arr[rb * k:(rb + 1) * k] = b.view(arr.dtype)
+                _sched.note_copied(k * arr.itemsize)
+            # (new engine: ag blocks landed in their final slot already)
+    if legacy:
+        out = np.empty(total, dtype=typed.dtype)
+        for arr, k, ln, off, _staged in segs:
+            out[off:off + ln] = arr[:ln]
+        _sched.note_copied(total * typed.itemsize)
+        _unpack_staging(out, recvbuf)
+        return
+    for arr, k, ln, off, staged in segs:
+        if staged:  # padded-tail scratch folds back, counted
+            typed[off:off + ln] = arr[:ln]
+            _sched.note_copied(ln * typed.itemsize)
+    if not in_dest:
+        # the non-contiguous/pair-dtype fallback stages: its final
+        # unpack is a counted copy the in-recvbuf path avoids
+        _unpack_staging(_bytes(typed), recvbuf)
 
 
 # --------------------------------------------------------------- allgather
 def allgather_ring(comm, sendbuf, recvbuf):
     """n-1 rounds, each forwarding the block received last round
-    (coll_base_allgather.c ring)."""
+    (coll_base_allgather.c ring). Blocks land straight in the receive
+    buffer and are forwarded as borrowed views of it."""
     n, r = comm.size, comm.rank
     block, _, _ = _packed(sendbuf)
     nb = block.nbytes
-    out = np.empty(n * nb, dtype=np.uint8)
+    dest = _direct_view(recvbuf)
+    out = dest if dest is not None else np.empty(n * nb, dtype=np.uint8)
     out[r * nb:(r + 1) * nb] = block
-    cur = block
+    _sched.note_copied(nb)  # own-block placement (both engines)
+    cur = out[r * nb:(r + 1) * nb]
     for d in range(1, n):
-        bufs = yield Round(sends=[(cur, (r + 1) % n)],
-                           recvs=[(nb, (r - 1) % n)])
-        cur = bufs[0]
         src = (r - d) % n
-        out[src * nb:(src + 1) * nb] = cur
-    _unpack_into(out, recvbuf)
+        slot = out[src * nb:(src + 1) * nb]
+        if dest is not None:
+            yield Round(sends=[(cur, (r + 1) % n)],
+                        recvs=[(nb, (r - 1) % n, slot)])
+            cur = slot
+        else:
+            bufs = yield Round(sends=[(cur, (r + 1) % n)],
+                               recvs=[(nb, (r - 1) % n)])
+            cur = bufs[0]
+            out[src * nb:(src + 1) * nb] = cur
+            _sched.note_copied(nb)
+    if dest is None:
+        _unpack_staging(out, recvbuf)
 
 
 def allgather_bruck(comm, sendbuf, recvbuf):
     """Bruck: ceil(log2 n) rounds of doubling block trains
-    (coll_base_allgather.c bruck) — latency-optimal for small messages."""
+    (coll_base_allgather.c bruck) — latency-optimal for small messages.
+    The train lives in ONE flat accumulator: each send is a contiguous
+    view of its head, each recv lands at its tail — the per-round
+    concatenate of the legacy engine is gone; only the final bruck
+    rotation copies (counted)."""
     n, r = comm.size, comm.rank
     block, _, _ = _packed(sendbuf)
     nb = block.nbytes
-    acc: List[np.ndarray] = [block]  # acc[i] = block of rank (r+i) % n
+    if _sched.copy_mode():
+        # legacy engine verbatim: list-of-blocks train, concatenated
+        # into a fresh send buffer every round — the measured baseline
+        acc: List[np.ndarray] = [block]
+        dist = 1
+        while dist < n:
+            cnt = min(dist, n - dist)
+            if cnt > 1:
+                send_data = _bytes(np.concatenate(  # mpilint: disable=hot-copy — legacy copy_mode=1 A/B baseline, counted
+                    [np.frombuffer(b, np.uint8) for b in acc[:cnt]]))
+                _sched.note_copied(send_data.nbytes)
+            else:
+                send_data = _bytes(acc[0])
+            bufs = yield Round(sends=[(send_data, (r - dist) % n)],
+                               recvs=[(cnt * nb, (r + dist) % n)])
+            got = bufs[0]
+            acc.extend(got[i * nb:(i + 1) * nb] for i in range(cnt))
+            dist <<= 1
+        out = np.empty(n * nb, dtype=np.uint8)
+        for i in range(n):
+            src = (r + i) % n
+            out[src * nb:(src + 1) * nb] = acc[i]
+        _sched.note_copied(n * nb)
+        _unpack_staging(out, recvbuf)
+        return
+    accbuf = np.empty(n * nb, dtype=np.uint8)
+    accbuf[:nb] = block
+    _sched.note_copied(nb)
     dist = 1
     while dist < n:
         cnt = min(dist, n - dist)
-        send_data = _bytes(np.concatenate([np.frombuffer(b, np.uint8)
-                                           for b in acc[:cnt]])
-                           if cnt > 1 else acc[0])
-        bufs = yield Round(sends=[(send_data, (r - dist) % n)],
-                           recvs=[(cnt * nb, (r + dist) % n)])
-        got = bufs[0]
-        acc.extend(got[i * nb:(i + 1) * nb] for i in range(cnt))
+        yield Round(
+            sends=[(accbuf[:cnt * nb], (r - dist) % n)],
+            recvs=[(cnt * nb, (r + dist) % n,
+                    accbuf[dist * nb:(dist + cnt) * nb])])
         dist <<= 1
-    out = np.empty(n * nb, dtype=np.uint8)
-    for i in range(n):
+    dest = _direct_view(recvbuf)
+    out = dest if dest is not None else np.empty(n * nb, dtype=np.uint8)
+    for i in range(n):  # the bruck rotation: a genuine reorder, counted
         src = (r + i) % n
-        out[src * nb:(src + 1) * nb] = acc[i]
-    _unpack_into(out, recvbuf)
+        out[src * nb:(src + 1) * nb] = accbuf[i * nb:(i + 1) * nb]
+    _sched.note_copied(n * nb)
+    if dest is None:
+        _unpack_staging(out, recvbuf)
 
 
 def allgatherv_ring(comm, sendbuf, recvbuf, counts, displs):
@@ -294,33 +445,61 @@ def allgatherv_ring(comm, sendbuf, recvbuf, counts, displs):
     if displs is None:
         displs = np.cumsum([0] + counts[:-1]).tolist()
     esz = rdt.size
-    out = np.zeros(rcount * esz, dtype=np.uint8)
+    dest = _direct_view(recvbuf)
+    out = dest if dest is not None \
+        else np.zeros(rcount * esz, dtype=np.uint8)
     out[displs[r] * esz:displs[r] * esz + block.nbytes] = block
-    cur = block
+    _sched.note_copied(block.nbytes)
+    cur = out[displs[r] * esz:displs[r] * esz + block.nbytes]
     for d in range(1, n):
         src = (r - d) % n
-        bufs = yield Round(sends=[(cur, (r + 1) % n)],
-                           recvs=[(counts[src] * esz, (r - 1) % n)])
-        cur = bufs[0]
-        out[displs[src] * esz:displs[src] * esz + cur.nbytes] = cur
-    cv_unpack(out, robj, rcount, rdt)
+        nb_src = counts[src] * esz
+        slot = out[displs[src] * esz:displs[src] * esz + nb_src]
+        if dest is not None:
+            yield Round(sends=[(cur, (r + 1) % n)],
+                        recvs=[(nb_src, (r - 1) % n, slot)])
+            cur = slot
+        else:
+            bufs = yield Round(sends=[(cur, (r + 1) % n)],
+                               recvs=[(nb_src, (r - 1) % n)])
+            cur = bufs[0]
+            out[displs[src] * esz:displs[src] * esz + nb_src] = cur
+            _sched.note_copied(nb_src)
+    if dest is None:
+        cv_unpack(out, robj, rcount, rdt)
+        _sched.note_copied(out.nbytes)
 
 
 # ---------------------------------------------------------------- alltoall
 def alltoall_pairwise(comm, sendbuf, recvbuf):
-    """n-1 pairwise exchange rounds (coll_base_alltoall.c pairwise)."""
+    """n-1 pairwise exchange rounds (coll_base_alltoall.c pairwise).
+    Every round is INDEPENDENT — disjoint send slices of the packed
+    buffer, disjoint landing slots in the receive buffer — so rounds
+    are yielded ``ordered=False`` and up to ``coll_round_window`` stay
+    in flight instead of a barrier per peer."""
     n, r = comm.size, comm.rank
     packed, _, _ = _packed(sendbuf)
-    robj, rcount, rdt = parse_buffer(recvbuf)
     nb = packed.nbytes // n
-    out = np.empty(rcount * rdt.size, dtype=np.uint8)
+    robj, rcount, rdt = parse_buffer(recvbuf)
+    dest = _direct_view(recvbuf)
+    out = dest if dest is not None \
+        else np.empty(rcount * rdt.size, dtype=np.uint8)
     out[r * nb:(r + 1) * nb] = packed[r * nb:(r + 1) * nb]
+    _sched.note_copied(nb)
     for d in range(1, n):
         dst, src = (r + d) % n, (r - d) % n
-        chunk = np.ascontiguousarray(packed[dst * nb:(dst + 1) * nb])
-        bufs = yield Round(sends=[(chunk, dst)], recvs=[(nb, src)])
-        out[src * nb:(src + 1) * nb] = bufs[0]
-    cv_unpack(out, robj, rcount, rdt)
+        chunk = _bytes(packed[dst * nb:(dst + 1) * nb])
+        if dest is not None:
+            yield Round(sends=[(chunk, dst)],
+                        recvs=[(nb, src, out[src * nb:(src + 1) * nb])],
+                        ordered=False)
+        else:
+            bufs = yield Round(sends=[(chunk, dst)], recvs=[(nb, src)])
+            out[src * nb:(src + 1) * nb] = bufs[0]
+            _sched.note_copied(nb)
+    if dest is None:
+        cv_unpack(out, robj, rcount, rdt)
+        _sched.note_copied(out.nbytes)
 
 
 # ----------------------------------------------------------- gather/scatter
@@ -331,13 +510,21 @@ def gather_linear(comm, sendbuf, recvbuf, root: int):
         yield Round(sends=[(block, root)])
         return
     nb = block.nbytes
+    dest = _direct_view(recvbuf)
+    out = dest if dest is not None else np.empty(n * nb, dtype=np.uint8)
     others = [i for i in range(n) if i != root]
-    bufs = yield Round(recvs=[(nb, i) for i in others])
-    out = np.empty(n * nb, dtype=np.uint8)
+    if dest is not None:
+        yield Round(recvs=[(nb, i, out[i * nb:(i + 1) * nb])
+                           for i in others])
+    else:
+        bufs = yield Round(recvs=[(nb, i) for i in others])
+        for i, b in zip(others, bufs):
+            out[i * nb:(i + 1) * nb] = b
+            _sched.note_copied(nb)
     out[root * nb:(root + 1) * nb] = block
-    for i, b in zip(others, bufs):
-        out[i * nb:(i + 1) * nb] = b
-    _unpack_into(out, recvbuf)
+    _sched.note_copied(nb)
+    if dest is None:
+        _unpack_staging(out, recvbuf)
 
 
 def scatter_linear(comm, sendbuf, recvbuf, root: int):
@@ -348,7 +535,7 @@ def scatter_linear(comm, sendbuf, recvbuf, root: int):
         packed, _, _ = _packed(sendbuf)
         sends = []
         for i in range(n):
-            chunk = np.ascontiguousarray(packed[i * nb:(i + 1) * nb])
+            chunk = _bytes(packed[i * nb:(i + 1) * nb])
             if i == root:
                 cv_unpack(chunk, robj, rcount, rdt)
             else:
@@ -356,8 +543,13 @@ def scatter_linear(comm, sendbuf, recvbuf, root: int):
         if sends:
             yield Round(sends=sends)
     else:
-        bufs = yield Round(recvs=[(nb, root)])
-        cv_unpack(bufs[0], robj, rcount, rdt)
+        dest = _direct_view(recvbuf)
+        if dest is not None:
+            yield Round(recvs=[(nb, root, dest)])
+        else:
+            bufs = yield Round(recvs=[(nb, root)])
+            cv_unpack(bufs[0], robj, rcount, rdt)
+            _sched.note_copied(nb)
 
 
 # -------------------------------------------------------------- scan family

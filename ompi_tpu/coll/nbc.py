@@ -10,6 +10,11 @@ isolated by the NBC CID plane + per-comm sequence tags (sched.py).
 
 Algorithm choice mirrors coll/tuned's decision rules where a choice
 exists (commutativity gates the reduction trees).
+
+Datapath: schedules ride the PR 10 round engine — pooled/direct-landing
+recvs, borrowed-view sends, and ``ordered=False`` windowing (ialltoall
+keeps up to ``coll_round_window`` pairwise rounds in flight, advanced
+from completion callbacks without a per-round barrier).
 """
 
 from __future__ import annotations

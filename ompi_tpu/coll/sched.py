@@ -19,18 +19,49 @@ Traffic isolation: nonblocking schedules run in a dedicated CID plane
 (NBC_CID_BIT) with a per-communicator sequence number as the tag, so
 overlapping schedules on one communicator never cross-match (libnbc's
 per-comm tag counter, nbc_internal.h SCHED tag logic).
+
+Datapath discipline (the PR 9 btl contract, extended up to this layer):
+
+- **sends are borrowed views** over the caller's packed/accumulator
+  buffers — a payload is copied only when the source is genuinely
+  non-contiguous, and that copy is counted;
+- **recvs are pooled or land direct**: a ``(nbytes, src)`` recv draws a
+  size-classed block from ``runtime/mpool.class_pool`` (recycled on
+  clean completion or ``Round.free``; DISCARDED — never recycled — when
+  the schedule fails, so a racing drain can't alias the next owner); a
+  ``(nbytes, src, dest)`` recv unpacks straight into the caller's view
+  (the final out/accumulator slice) with no staging at all;
+- **windowing**: a ``Round(ordered=False)`` promises the generator
+  neither reads the round's results nor touches its buffers until it
+  RESUMES from the next ordered yield (or the schedule completes), so
+  up to ``coll_round_window`` such rounds stay in flight instead of a
+  full barrier per round — in both ``run_blocking`` and ``NbcRequest``.
+  Unordered rounds to the SAME peer must be order-insensitive (the
+  built-in user is alltoall pairwise: every round targets a distinct
+  peer). An ordered round is a barrier on RESUME — its own sends/recvs
+  are issued before the window drains (recvs pre-post), so they must
+  not depend on in-flight unordered results; only when the generator
+  resumes has every earlier round completed.
+- **measured, not estimated**: ``coll_round_bytes_copied`` /
+  ``bytes_moved`` / ``pool_hits`` / ``windowed`` pvars, with the legacy
+  engine (fresh ``np.empty`` per recv, staged recv->dest copies) kept
+  behind ``coll_round_copy_mode=1`` as the A/B baseline.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Generator, List, Optional, Sequence, Tuple
+from collections import deque
+from typing import (Callable, Dict, Generator, List, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
 from ompi_tpu.core.datatype import BYTE
 from ompi_tpu.core.errors import MPIError, ERR_REQUEST
 from ompi_tpu.core.request import Request
+from ompi_tpu.mca.var import register_var, register_pvar
+from ompi_tpu.runtime import mpool
 
 # Distinct CID plane per traffic class: COLL_CID_BIT = 1<<30 (coll/basic),
 # PART_CID_BIT = 1<<29 (pml/partitioned) — NBC takes 1<<28 so overlapping
@@ -38,60 +69,251 @@ from ompi_tpu.core.request import Request
 # the same communicator can never cross-match.
 NBC_CID_BIT = 1 << 28
 
+_window_var = register_var(
+    "coll_round", "window", 4,
+    help="Max unordered rounds kept in flight per schedule (1 = "
+         "lockstep, the pre-PR-10 barrier-per-round behavior). Only "
+         "rounds yielded with ordered=False window; an ordered round "
+         "is a full barrier.", level=6)
+_copy_mode_var = register_var(
+    "coll_round", "copy_mode", 0,
+    help="1 = legacy round engine (fresh np.empty per recv, staged "
+         "recv->dest copies, algorithm-side concat/ascontiguousarray "
+         "staging) kept verbatim for the bench A/B — the copies feed "
+         "coll_round_bytes_copied either way, so copies-per-byte-moved "
+         "is measured, not estimated", level=8)
+
+# measured datapath counters (read via the coll_round_* pvars):
+# copied = staging bytes the round engine/algorithms duplicated;
+# moved  = payload bytes carried by round sends+recvs;
+# pool_hits = recv blocks served from a size-class free list;
+# windowed  = rounds issued without waiting (ordered=False, in-window).
+# Bumps go through _bump: the app thread (run_blocking) and the
+# progress thread (NbcRequest callbacks) both count, and an unlocked
+# dict read-modify-write loses increments under that interleaving (the
+# progress._call_count lesson) — the lock is per ROUND, not per byte,
+# so the hot path pays one uncontended acquire per bump site.
+_ctr = {"copied": 0, "moved": 0, "pool_hits": 0, "windowed": 0}
+_ctr_lock = threading.Lock()
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _ctr_lock:
+        _ctr[key] += n
+
+register_pvar("coll_round", "bytes_copied", lambda: _ctr["copied"],
+              help="Staging bytes copied by the collective round engine "
+                   "and its algorithms (legacy A/B baseline included)")
+register_pvar("coll_round", "bytes_moved", lambda: _ctr["moved"],
+              help="Payload bytes carried by round sends+recvs — the "
+                   "denominator of copies-per-byte-moved")
+register_pvar("coll_round", "pool_hits", lambda: _ctr["pool_hits"],
+              help="Round recv blocks served from the mpool size-class "
+                   "free lists (steady-state recycling proof)")
+register_pvar("coll_round", "windowed", lambda: _ctr["windowed"],
+              help="Rounds issued without a barrier (ordered=False "
+                   "inside the coll_round_window)")
+
+
+def copy_mode() -> bool:
+    """True when the legacy (copying) round engine is armed — the
+    algorithms branch to their verbatim pre-PR-10 staging on it."""
+    return bool(_copy_mode_var._value)
+
+
+def note_copied(nbytes: int) -> None:
+    """Charge a staging copy to the round-engine copy budget."""
+    _bump("copied", int(nbytes))
+
 
 class Round:
     """One communication round: isend all ``sends``, irecv all ``recvs``,
-    then hand the received payloads back to the generator in order."""
+    then hand the received payloads back to the generator in order.
 
-    __slots__ = ("sends", "recvs")
+    ``sends``  — (contiguous uint8 view, dst comm-rank): the engine
+    borrows the view; the caller must not mutate it until the round (or,
+    for unordered rounds, the schedule's next barrier) completes.
+    ``recvs``  — (nbytes, src) for a pooled staging block, or
+    (nbytes, src, dest_view) to land the payload directly in ``dest_view``
+    (a writable contiguous uint8 view of exactly ``nbytes``).
+    ``ordered`` — False marks the round independent: the engine may
+    window it. Contract precision: an unordered round's results and
+    buffers are guaranteed only when the generator RESUMES from the
+    next ordered yield (or the schedule completes) — both engines issue
+    an ordered round's sends/recvs BEFORE draining the window (the
+    recvs pre-post), so the ordered round's own payloads must not
+    depend on any in-flight unordered result.
+    ``free``   — previously-received pooled views the generator is done
+    with: recycled immediately instead of at schedule end (the
+    segmented-ring steady-state path)."""
+
+    __slots__ = ("sends", "recvs", "ordered", "free")
 
     def __init__(self,
                  sends: Sequence[Tuple[np.ndarray, int]] = (),
-                 recvs: Sequence[Tuple[int, int]] = ()):
-        self.sends = list(sends)   # (contiguous uint8 data, dst comm-rank)
-        self.recvs = list(recvs)   # (nbytes, src comm-rank)
+                 recvs: Sequence[Tuple] = (),
+                 ordered: bool = True,
+                 free: Sequence[np.ndarray] = ()):
+        self.sends = list(sends)
+        self.recvs = list(recvs)
+        self.ordered = ordered
+        self.free = free
 
 
 Schedule = Generator[Round, List[np.ndarray], None]
 
 
-def _issue(comm, rnd: Round, tag: int, cid: int):
-    """Post the round's receives then sends; returns (requests, recv_bufs)."""
+class _RoundState:
+    """Pool-block ownership for one schedule — the explicit contract:
+    blocks recycle on clean completion (or early, via ``Round.free``);
+    a failing/abandoned schedule DISCARDS them, never recycles (the
+    PR 9 dying-conn lesson: an in-flight drain may still land in a
+    block, and a recycled block would alias its next owner)."""
+
+    __slots__ = ("_held",)
+
+    def __init__(self):
+        # id(view) -> (pool, block, view): the view keeps id() stable
+        self._held: Dict[int, tuple] = {}
+
+    def alloc(self, nbytes: int) -> np.ndarray:
+        pool = mpool.class_pool(nbytes)
+        if pool is None:  # zero-byte tokens / jumbo past the class cap
+            return np.empty(nbytes, dtype=np.uint8)
+        block, hit = pool.acquire_pair()
+        if hit:
+            _bump("pool_hits")
+        view = np.frombuffer(block, np.uint8, nbytes)
+        self._held[id(view)] = (pool, block, view)
+        return view
+
+    def free(self, views) -> None:
+        for v in views:
+            ent = self._held.pop(id(v), None)
+            if ent is not None:
+                ent[0].release(ent[1])
+
+    def release_all(self) -> None:
+        held, self._held = self._held, {}
+        for pool, block, _ in held.values():
+            pool.release(block)
+
+    def discard_all(self) -> None:
+        held, self._held = self._held, {}
+        for pool, block, _ in held.values():
+            pool.discard(block)
+
+
+def _issue(comm, rnd: Round, tag: int, cid: int, state: _RoundState):
+    """Post the round's receives then sends. Returns
+    (requests, recv_bufs, postcopies): ``postcopies`` is the legacy
+    engine's deferred recv->dest staging — (dest, staging, nbytes)
+    triples applied (and counted) after the round completes, exactly
+    where the pre-PR-10 algorithms did ``out[...] = bufs[i]``."""
     reqs = []
-    bufs = []
-    for nbytes, src in rnd.recvs:
-        buf = np.empty(nbytes, dtype=np.uint8)
-        bufs.append(buf)
+    bufs: List[np.ndarray] = []
+    post: List[tuple] = []
+    legacy = _copy_mode_var._value
+    moved = 0
+    for rec in rnd.recvs:
+        nbytes, src = rec[0], rec[1]
+        dest = rec[2] if len(rec) > 2 else None
+        moved += nbytes
+        if legacy:
+            # the legacy engine, verbatim: a fresh allocation per recv,
+            # then a staged copy into the caller's destination
+            buf = np.empty(nbytes, dtype=np.uint8)
+            if dest is not None:
+                post.append((dest, buf, nbytes))
+                bufs.append(dest)
+            else:
+                bufs.append(buf)
+        elif dest is not None:
+            buf = dest  # zero staging: the payload lands in place
+            bufs.append(dest)
+        else:
+            buf = state.alloc(nbytes)
+            bufs.append(buf)
         reqs.append(comm.pml.irecv(buf, nbytes, BYTE,
                                    comm.group.world_rank(src), tag, cid))
     for data, dst in rnd.sends:
+        if not data.flags.c_contiguous:
+            # the one allowed send-side staging copy: a genuinely
+            # non-contiguous source can't be borrowed as a flat view
+            data = np.ascontiguousarray(data)  # mpilint: disable=hot-copy — non-contiguous fallback, counted
+            _bump("copied", data.nbytes)
+        moved += data.nbytes
         reqs.append(comm.pml.isend(data, data.nbytes, BYTE,
                                    comm.group.world_rank(dst), tag, cid))
-    return reqs, bufs
+    _bump("moved", moved)
+    return reqs, bufs, post
+
+
+def _apply_post(post) -> None:
+    """Legacy staged recv->dest copies, charged to the copy budget."""
+    for dest, staging, nbytes in post:
+        dest[:nbytes] = staging[:nbytes]
+        _bump("copied", nbytes)
 
 
 def run_blocking(comm, gen: Schedule, tag: int, cid: int) -> None:
-    """Drive a schedule to completion, waiting out each round. A failing
-    request must not abandon the round's remaining requests mid-loop
-    (the Waitsome lesson): outstanding sends left unwaited would
-    cross-match the NEXT schedule on this communicator — wait them all,
-    then surface the first error."""
-    bufs: Optional[List[np.ndarray]] = None
-    while True:
-        try:
-            rnd = next(gen) if bufs is None else gen.send(bufs)
-        except StopIteration:
-            return
-        reqs, bufs = _issue(comm, rnd, tag, cid)
-        first_error: Optional[MPIError] = None
+    """Drive a schedule to completion. Ordered rounds are barriers
+    (every outstanding round drains first, then the round itself);
+    unordered rounds stay in flight up to ``coll_round_window``. A
+    failing request must not abandon outstanding requests mid-schedule
+    (the Waitsome lesson): unwaited sends would cross-match the NEXT
+    schedule on this communicator — wait them all, then surface the
+    first error. Pool blocks recycle only on clean completion;
+    any failure path discards them."""
+    state = _RoundState()
+    inflight: deque = deque()  # (reqs, postcopies) of unordered rounds
+    first_error: Optional[MPIError] = None
+
+    def retire(reqs, post) -> None:
+        nonlocal first_error
         for r in reqs:
             try:
                 r.Wait()
             except MPIError as e:
                 if first_error is None:
                     first_error = e
+        if first_error is None:
+            _apply_post(post)
+
+    bufs: Optional[List[np.ndarray]] = None
+    first = True
+    try:
+        while True:
+            try:
+                rnd = next(gen) if first else gen.send(bufs)
+            except StopIteration:
+                break
+            first = False
+            if rnd.free:
+                state.free(rnd.free)
+            reqs, bufs, post = _issue(comm, rnd, tag, cid, state)
+            window = _window_var._value
+            if rnd.ordered or window <= 1:
+                while inflight:
+                    retire(*inflight.popleft())
+                retire(reqs, post)
+            else:
+                _bump("windowed")
+                inflight.append((reqs, post))
+                while len(inflight) >= max(1, window):
+                    retire(*inflight.popleft())
+            if first_error is not None:
+                raise first_error
+        while inflight:
+            retire(*inflight.popleft())
         if first_error is not None:
             raise first_error
+    except BaseException:
+        while inflight:
+            retire(*inflight.popleft())
+        state.discard_all()
+        raise
+    state.release_all()
 
 
 def alloc_nbc_tag(comm) -> int:
@@ -103,8 +325,17 @@ def alloc_nbc_tag(comm) -> int:
 
 
 class NbcRequest(Request):
-    """A nonblocking collective in flight: advances its schedule one round
-    at a time from completion callbacks (libnbc's NBC_Progress analog)."""
+    """A nonblocking collective in flight: advances its schedule from
+    request completion callbacks (libnbc's NBC_Progress analog), keeping
+    up to ``coll_round_window`` unordered rounds in flight.
+
+    Concurrency contract: exactly one thread drives the generator at a
+    time (``_gen_running``); every other mutation — child errors, batch
+    retirement, park/resume decisions, the pool-block release on the
+    completion path — happens under ``self._lock``. ``_child_error`` in
+    particular is written ONLY under the lock (the pre-PR-10 engine
+    wrote it unlocked from the progress thread while ``_advance`` read
+    it mid-loop, so a losing error could be dropped)."""
 
     def __init__(self, comm, gen: Schedule):
         super().__init__()
@@ -114,22 +345,32 @@ class NbcRequest(Request):
         self._cid = comm.cid | NBC_CID_BIT
         self._lock = threading.Lock()
         self._child_error = 0
+        self._state = _RoundState()
+        self._inflight = 0          # issued-but-unretired batches
+        self._wait_batch = None     # ordered batch the generator awaits
+        self._park_bufs = None      # bufs pending a free window slot
+        self._gen_done = False
+        self._finishing = False
+        self._gen_running = True
         self._advance(None, first=True)
 
+    # ------------------------------------------------------------ engine
     def _advance(self, bufs: Optional[List[np.ndarray]],
                  first: bool = False) -> None:
+        # invariant: the caller claimed _gen_running under the lock
         while True:
-            if self._child_error:
-                self._gen.close()
-                self._set_complete(self._child_error)
+            with self._lock:
+                err = self._child_error
+            if err:
+                self._gen_stopped()
                 return
             try:
                 rnd = next(self._gen) if first else self._gen.send(bufs)
             except StopIteration:
-                self._set_complete(0)
+                self._gen_stopped(done=True)
                 return
             except MPIError as e:
-                self._set_complete(e.code)
+                self._gen_stopped(done=True, code=e.code)
                 return
             except Exception:
                 # Rounds >= 2 run inside completion callbacks on the
@@ -140,33 +381,133 @@ class NbcRequest(Request):
 
                 get_logger("coll.nbc").warning(
                     "schedule raised", exc_info=True)
-                self._set_complete(ERR_INTERN)
+                self._gen_stopped(done=True, code=ERR_INTERN)
                 return
             first = False
-            reqs, bufs = _issue(self._comm, rnd, self._tag, self._cid)
+            if rnd.free:
+                with self._lock:
+                    self._state.free(rnd.free)
+            reqs, next_bufs, post = _issue(self._comm, rnd, self._tag,
+                                           self._cid, self._state)
+            window = max(1, _window_var._value)
+            ordered = rnd.ordered or window <= 1
             if not reqs:
+                if ordered:
+                    # a request-less ordered round is still a barrier
+                    # (run_blocking drains the window for it too):
+                    # resume only once every in-flight batch retires
+                    with self._lock:
+                        if self._inflight > 0:
+                            self._wait_batch = {"n": 0, "post": (),
+                                                "bufs": next_bufs}
+                            self._gen_running = False
+                            return
+                bufs = next_bufs
                 continue
             # Hold one extra token so synchronous completions loop here
             # instead of recursing through the callback.
-            state = {"n": len(reqs) + 1}
-            next_bufs = bufs
-
-            def child_done(r, state=state, next_bufs=next_bufs):
-                if r._error and not self._child_error:
-                    self._child_error = r._error
-                with self._lock:
-                    state["n"] -= 1
-                    fire = state["n"] == 0
-                if fire:
-                    self._advance(next_bufs)
-
-            for r in reqs:
-                r.add_completion_callback(child_done)
+            batch = {"n": len(reqs) + 1, "post": post, "bufs": next_bufs}
             with self._lock:
-                state["n"] -= 1
-                synchronous = state["n"] == 0
-            if not synchronous:
-                return  # the last callback will re-enter _advance
+                self._inflight += 1
+            for r in reqs:
+                r.add_completion_callback(
+                    lambda r, b=batch: self._child_done(r, b))
+            with self._lock:
+                batch["n"] -= 1
+                done_now = batch["n"] == 0
+                if done_now:
+                    if not self._child_error:
+                        _apply_post(batch["post"])
+                    batch["post"] = ()
+                    self._inflight -= 1
+                    barrier_ok = self._inflight == 0
+                else:
+                    barrier_ok = False
+                if ordered:
+                    if not (done_now and barrier_ok):
+                        # resume when THIS batch and the whole window
+                        # have drained (ordered == barrier)
+                        self._wait_batch = batch
+                        self._gen_running = False
+                        return
+                elif not done_now and self._inflight >= window:
+                    self._park_bufs = next_bufs
+                    self._gen_running = False
+                    return
+            if not ordered and not done_now:
+                _bump("windowed")
+            bufs = next_bufs
+
+    def _child_done(self, r, batch) -> None:
+        fire = None
+        finish = None
+        with self._lock:
+            if r._error and not self._child_error:
+                self._child_error = r._error
+            batch["n"] -= 1
+            if batch["n"] != 0:
+                return
+            # batch retired: apply its legacy staging copies while the
+            # lock orders them before any generator resume
+            if not self._child_error:
+                _apply_post(batch["post"])
+            batch["post"] = ()
+            self._inflight -= 1
+            if self._gen_running or self._finishing:
+                pass  # the driving thread observes the new state itself
+            elif self._child_error:
+                if self._inflight == 0:
+                    self._finishing = True
+                    finish = self._child_error
+            elif self._wait_batch is not None:
+                if self._inflight == 0:
+                    fire = self._wait_batch["bufs"]
+                    self._wait_batch = None
+                    self._gen_running = True
+            elif self._park_bufs is not None and \
+                    self._inflight < max(1, _window_var._value):
+                fire = self._park_bufs
+                self._park_bufs = None
+                self._gen_running = True
+                _bump("windowed")
+            elif self._gen_done and self._inflight == 0:
+                self._finishing = True
+                finish = 0
+        if finish is not None:
+            self._finish_schedule(finish)
+        elif fire is not None:
+            self._advance(fire)
+
+    def _gen_stopped(self, done: bool = False, code: int = 0) -> None:
+        """The driving thread is leaving the advance loop: either the
+        generator finished/raised (``done``) or a child error stops the
+        schedule. Completion fires once every in-flight batch retires."""
+        finish = None
+        with self._lock:
+            if code and not self._child_error:
+                self._child_error = code
+            if done:
+                self._gen_done = True
+            self._gen_running = False
+            if self._inflight == 0 and not self._finishing:
+                self._finishing = True
+                finish = self._child_error
+        if finish is not None:
+            self._finish_schedule(finish)
+
+    def _finish_schedule(self, err: int) -> None:
+        """Terminal transition (exactly once): settle pool-block
+        ownership — recycle on success, DISCARD on failure — then
+        complete the request."""
+        if err:
+            self._state.discard_all()
+            try:
+                self._gen.close()
+            except Exception:
+                pass
+        else:
+            self._state.release_all()
+        self._set_complete(err)
 
 
 class PersistentCollRequest(Request):

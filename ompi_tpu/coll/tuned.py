@@ -46,6 +46,12 @@ register_var("coll_tuned", "allreduce_segsize", 1 << 20,
              level=6)
 register_var("coll_tuned", "allgather_small_msg", 65536,
              help="Total bytes below which allgather uses bruck", level=6)
+register_var("coll_tuned", "alltoall_algorithm", "auto",
+             help="Forced alltoall algorithm: auto|pairwise|basic — "
+                  "pairwise runs the round engine's windowed pairwise "
+                  "exchange (coll_round_window rounds in flight); basic "
+                  "keeps the linear sendrecv fallback", level=5,
+             enum_values=("auto", "pairwise", "basic"))
 register_var("coll_tuned", "use_dynamic_rules", False,
              help="Consult the dynamic rules file before the fixed "
                   "heuristics (reference: coll_tuned_use_dynamic_rules)",
@@ -75,6 +81,7 @@ _KNOWN_ALGOS = {
     "allreduce": ("linear", "recursive_doubling", "ring",
                   "ring_segmented"),
     "allgather": ("ring", "bruck"),
+    "alltoall": ("pairwise", "basic"),
     "reduce": ("linear", "binomial"),
 }
 _rules_cache = {"path": None, "mtime": None, "rules": []}
@@ -227,6 +234,29 @@ class TunedColl(CollModule):
             _run(comm, alg.allgather_ring(comm, sendbuf, recvbuf))
         else:
             _run(comm, alg.allgather_bruck(comm, sendbuf, recvbuf))
+
+    # ------------------------------------------------------------- alltoall
+    def alltoall(self, comm, sendbuf, recvbuf) -> None:
+        """Pairwise exchange on the round engine: with contiguous
+        buffers the rounds are independent (ordered=False), so up to
+        coll_round_window exchanges overlap instead of the basic
+        module's lockstep sendrecv chain. Note the window is the only
+        pipelining knob here — the segmented-ring nseg/segsize pair
+        does not apply to alltoall (rings are data-dependent chains and
+        stay ordered regardless of the window)."""
+        choice = get_var("coll_tuned", "alltoall_algorithm")
+        if choice == "auto" and get_var("coll_tuned", "use_dynamic_rules"):
+            # gate BEFORE sizing (the reduce-slot lesson): _msg_bytes
+            # stages device buffers to host, a cost the default
+            # (rules-off) path must not pay
+            dyn = dynamic_choice("alltoall", comm.size,
+                                 _msg_bytes(recvbuf))
+            if dyn is not None:
+                choice = dyn[0]
+        if comm.size == 1 or choice == "basic":
+            self._basic().alltoall(comm, sendbuf, recvbuf)
+        else:
+            _run(comm, alg.alltoall_pairwise(comm, sendbuf, recvbuf))
 
     # --------------------------------------------------------------- reduce
     def reduce(self, comm, sendbuf, recvbuf, op: _op.Op, root: int) -> None:

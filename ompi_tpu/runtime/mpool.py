@@ -181,13 +181,20 @@ class BufferPool:
             _pools[self.pid] = self
 
     def acquire(self) -> bytearray:
+        return self.acquire_pair()[0]
+
+    def acquire_pair(self) -> Tuple[bytearray, bool]:
+        """(block, served_from_free_list) — callers that keep their own
+        hit accounting (the coll round engine's coll_round_pool_hits
+        pvar) need the verdict atomically with the pop, not a racy
+        before/after read of ``hits``."""
         with self._plock:
             self.outstanding += 1
             if self._free:
                 self.hits += 1
-                return self._free.pop()
+                return self._free.pop(), True
             self.misses += 1
-        return bytearray(self.block_size)
+        return bytearray(self.block_size), False
 
     def release(self, block) -> None:
         """Recycle a block. Only call when the caller can prove sole
@@ -213,6 +220,53 @@ class BufferPool:
             _pools.pop(self.pid, None)
         with self._plock:
             self._free.clear()
+
+
+# size-classed shared pools (reference: the per-size free lists of
+# btl.h's eager/max frag mpools): callers with variable block sizes —
+# the coll round engine's recv staging — round up to a power-of-two
+# class and share one pool per class, so an 8-rank ring and a 4-rank
+# ring of similar payloads recycle each other's blocks.
+_CLASS_MIN = 256
+_CLASS_MAX = 1 << 26  # above this a pooled block would pin real memory
+# parked-memory budget per class: free lists keep at most this many
+# BYTES (not blocks), so a burst of jumbo-class recvs can't pin
+# max_free * 64 MiB of idle memory for process lifetime — the big
+# classes park 1-2 blocks, the small ones the full max_free
+_CLASS_PARK_BYTES = 1 << 25
+_class_pools: Dict[int, "BufferPool"] = {}
+
+
+def size_class(nbytes: int) -> Optional[int]:
+    """Power-of-two class for ``nbytes``, or None when pooling would be
+    counterproductive (zero-byte tokens; jumbo blocks past _CLASS_MAX
+    that would sit parked forever)."""
+    if nbytes <= 0 or nbytes > _CLASS_MAX:
+        return None
+    return max(_CLASS_MIN, 1 << (nbytes - 1).bit_length())
+
+
+def class_pool(nbytes: int, max_free: int = 8) -> Optional[BufferPool]:
+    """The shared BufferPool for ``nbytes``'s size class (created on
+    first use), or None when the size is unpoolable. ``max_free`` is
+    capped by the per-class _CLASS_PARK_BYTES budget and only takes
+    effect for the caller that creates the class — later callers share
+    the existing pool as-is."""
+    cls = size_class(nbytes)
+    if cls is None:
+        return None
+    pool = _class_pools.get(cls)
+    if pool is None:
+        # constructed outside _lock (BufferPool.__init__ takes it);
+        # racing creators are resolved by setdefault — the loser
+        # unregisters its orphan
+        fresh = BufferPool(cls, max_free=max(
+            1, min(max_free, _CLASS_PARK_BYTES // cls)))
+        with _lock:
+            pool = _class_pools.setdefault(cls, fresh)
+        if pool is not fresh:
+            fresh.close()
+    return pool
 
 
 def pool_stats() -> Tuple[int, int, int, int]:

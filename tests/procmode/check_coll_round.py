@@ -1,0 +1,178 @@
+"""Collective round-engine datapath A/B + windowing proof.
+
+The coll-layer analog of check_p2p.py: the zero-copy round engine
+(borrowed-view sends, pooled/direct-landing recvs, ``ordered=False``
+windowing) against the legacy engine kept verbatim behind
+``coll_round_copy_mode=1`` (fresh np.empty per recv, staged recv->dest
+copies, concat/scratch staging in the algorithms).
+
+Three claim classes, two of them count-based (deterministic):
+
+- copies-per-byte-moved on a >= 1 MB allreduce + alltoall pair, from
+  the coll_round_bytes_copied / bytes_moved pvars — legacy must be
+  >= 2x the new engine;
+- pool recycling (coll_round_pool_hits grows in steady state) and
+  windowing (coll_round_windowed grows for the pairwise alltoall);
+- every swept verb is BITWISE identical across legacy, lockstep
+  (window=1), and windowed (window=8) runs — including the
+  nonblocking ialltoall/iallreduce path through NbcRequest;
+- timing ratios are printed for bench.py, never asserted (the stripe
+  noise lesson).
+
+Run with components that contest the round-engine slots excluded:
+``--mca coll_coll ^sm,adapt,han,hier,quant``.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.mca.var import all_pvars, set_var
+
+comm = COMM_WORLD
+r = comm.Get_rank()
+n = comm.Get_size()
+pv = all_pvars()
+
+# 1.5 MB, divisible by any test world size (2/3/4) so the segmented
+# ring's no-padding alias path is in play on every rank count
+BIG = 196608
+A2A = 32768 * n  # >= 1 MB of alltoall payload per rank at n >= 4
+
+
+def ctr():
+    return (pv["coll_round_bytes_copied"].value,
+            pv["coll_round_bytes_moved"].value,
+            pv["coll_round_pool_hits"].value,
+            pv["coll_round_windowed"].value)
+
+
+def big_pair():
+    """The gate workload: ring allreduce + pairwise alltoall, >= 1 MB."""
+    x = np.arange(BIG, dtype=np.float64) + r
+    out = np.zeros(BIG, np.float64)
+    comm.Allreduce(x, out)
+    sx = (np.arange(A2A, dtype=np.float64) + r * 10).copy()
+    sout = np.zeros(A2A, np.float64)
+    comm.Alltoall(sx, sout)
+    return out, sout
+
+
+def sweep():
+    """Every round-schedule verb on deterministic inputs; returns the
+    flattened results for bitwise comparison across engine modes."""
+    res = []
+    C = 8192
+    x = np.arange(C, dtype=np.float64) + r * 3 + 1
+    for algo in ("recursive_doubling", "ring", "ring_segmented"):
+        set_var("coll_tuned", "allreduce_algorithm", algo)
+        out = np.zeros(C, np.float64)
+        comm.Allreduce(x, out)
+        res.append(out.copy())
+    set_var("coll_tuned", "allreduce_algorithm", "auto")
+    for algo in ("ring", "bruck"):
+        set_var("coll_tuned", "allgather_algorithm", algo)
+        ag = np.zeros(n * C, np.float64)
+        comm.Allgather(x, ag)
+        res.append(ag.copy())
+    set_var("coll_tuned", "allgather_algorithm", "auto")
+    a2a_in = np.arange(n * 512, dtype=np.int64) + r * 1000
+    a2a_out = np.zeros(n * 512, np.int64)
+    comm.Alltoall(a2a_in, a2a_out)
+    res.append(a2a_out.copy().view(np.float64))
+    b = (np.arange(C, dtype=np.float64)
+         if r == 0 else np.zeros(C, np.float64))
+    comm.Bcast(b, root=0)
+    res.append(b.copy())
+    red = np.zeros(C, np.float64)
+    comm.Reduce(x, red, op=mpi_op.MAX, root=n - 1)
+    res.append(red.copy())
+    rsb = np.zeros(C // n if C % n == 0 else 1, np.float64)
+    if C % n == 0:
+        comm.Reduce_scatter_block(x, rsb)
+    res.append(rsb.copy())
+    # the nonblocking path (NbcRequest windowing + pooled recvs)
+    iar = np.zeros(C, np.float64)
+    q1 = comm.Iallreduce(x, iar)
+    ia2a = np.zeros(n * 512, np.int64)
+    q2 = comm.Ialltoall(a2a_in, ia2a)
+    q1.Wait()
+    q2.Wait()
+    res.append(iar.copy())
+    res.append(ia2a.copy().view(np.float64))
+    return np.concatenate(res)
+
+
+def timed(fn):
+    comm.Barrier()
+    t0 = time.perf_counter()
+    fn()
+    comm.Barrier()
+    return time.perf_counter() - t0
+
+
+def main() -> int:
+    # ----- bitwise equality: legacy vs lockstep vs windowed ------------
+    set_var("coll_round", "copy_mode", 1)
+    set_var("coll_round", "window", 1)
+    ref = sweep()
+    set_var("coll_round", "copy_mode", 0)
+    lock = sweep()
+    set_var("coll_round", "window", 8)
+    win = sweep()
+    np.testing.assert_array_equal(ref, lock)
+    np.testing.assert_array_equal(ref, win)
+    r_big_leg = None
+    print(f"COLLROUND-EQ rank {r}", flush=True)
+
+    # ----- count-based copy gate (deterministic) -----------------------
+    ratios = {}
+    for mode, name in ((1, "legacy"), (0, "new")):
+        set_var("coll_round", "copy_mode", mode)
+        big_pair()  # warm the pools / measure steady state
+        comm.Barrier()
+        c0, m0, h0, w0 = ctr()
+        got = big_pair()
+        comm.Barrier()
+        c1, m1, h1, w1 = ctr()
+        ratios[name] = (c1 - c0) / max(m1 - m0, 1)
+        if name == "new":
+            pool_hits, windowed = h1 - h0, w1 - w0
+        else:
+            r_big_leg = got
+    # both engines produce identical bits on the gate workload too
+    np.testing.assert_array_equal(r_big_leg[0], got[0])
+    np.testing.assert_array_equal(r_big_leg[1], got[1])
+    drop = ratios["legacy"] / max(ratios["new"], 1e-9)
+    print(f"COLLROUND-COPIES rank {r} new={ratios['new']:.3f} "
+          f"legacy={ratios['legacy']:.3f} drop={drop:.1f}x", flush=True)
+    print(f"COLLROUND-POOL rank {r} hits={pool_hits} "
+          f"windowed={windowed}", flush=True)
+    assert ratios["legacy"] >= 2.0 * ratios["new"], ratios
+    assert ratios["legacy"] > 0.3, ratios  # the legacy tax is real
+    assert pool_hits > 0, "recv blocks never recycled"
+    assert windowed > 0, "alltoall rounds never windowed"
+
+    # ----- timing, interleaved min-of-rounds (print-only) --------------
+    t_new = t_leg = float("inf")
+    for _ in range(3):
+        set_var("coll_round", "copy_mode", 0)
+        t_new = min(t_new, timed(big_pair))
+        set_var("coll_round", "copy_mode", 1)
+        t_leg = min(t_leg, timed(big_pair))
+    set_var("coll_round", "copy_mode", 0)
+    print(f"COLLROUND-TIME big_new={t_new:.4f}s big_legacy={t_leg:.4f}s "
+          f"ratio={t_leg / max(t_new, 1e-9):.2f}", flush=True)
+
+    comm.Barrier()
+    ompi_tpu.Finalize()
+    print(f"COLLROUND-OK rank {r}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
