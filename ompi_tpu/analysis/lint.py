@@ -14,8 +14,9 @@ hot-guard                 in hot modules (parallel/mesh.py, pml/ob1.py,
                           sanitizer/metrics instrumentation call — and every
                           ft/inject.py chaos hook, ft/diskless.py
                           replication hook, reshard/ accounting
-                          hook, quant/ codec-accounting hook, and
-                          coll/hier note_* observability hook
+                          hook, quant/ codec-accounting hook,
+                          coll/hier note_* observability hook, and
+                          coll/persist replay-accounting hook
                           (framework code allowed on
                           the wire path) — sits behind a live-Var
                           guard: ``X.enabled()`` / ``X._enable_var._value`` (or
@@ -129,7 +130,10 @@ INSTR_IMPL = ("runtime/trace.py", "runtime/sanitizer.py", "runtime/spc.py",
               # code (PR 10): listed here so the span-ctx pairing check
               # doesn't apply to it — like the other entries, any
               # trace spans it grows are its own implementation detail
-              "coll/sched.py")
+              "coll/sched.py",
+              # the persistent-plan compiler owns the persist note_*
+              # hooks and the replay counters (PR 11)
+              "coll/persist.py")
 
 TRACE_ALIASES = {"trace", "_trace", "_tr"}
 SAN_ALIASES = {"sanitizer", "_san", "_sanitizer"}
@@ -152,6 +156,10 @@ QUANT_ALIASES = {"quant", "_quant", "_qc"}
 # latency observations): a note_* reached from hot code must ride the
 # same one-live-Var guard
 HIER_ALIASES = {"hier", "_hier"}
+# coll/persist replay-accounting hooks (persistent-plan compiles,
+# Start/replay-latency notes, overlap-round counts): same contract in
+# hot modules — the steady-state replay path bumps list slots inline
+PERSIST_ALIASES = {"persist", "_persist"}
 INSTR_TRACE_ATTRS = {"span", "record_span", "instant", "counter",
                      "wrap_span"}
 INSTR_SAN_ATTRS = {"wrap_coll", "on_collective", "check_p2p",
@@ -164,6 +172,7 @@ INSTR_RESHARD_ATTRS = {"note_plan", "note_exec"}
 INSTR_QUANT_ATTRS = {"note_coll", "note_wire"}
 INSTR_HIER_ATTRS = {"note_stage", "note_plan_hit", "note_plan_miss",
                     "note_retune"}
+INSTR_PERSIST_ATTRS = {"note_plan", "note_start", "note_overlap"}
 
 _SUPPRESS_RE = re.compile(r"#\s*mpilint:\s*disable=([A-Za-z0-9_,\- ]+)")
 
@@ -279,6 +288,9 @@ def _instr_call(node: ast.AST) -> Optional[str]:
             if v.id in HIER_ALIASES and \
                     node.func.attr in INSTR_HIER_ATTRS:
                 return "hier"
+            if v.id in PERSIST_ALIASES and \
+                    node.func.attr in INSTR_PERSIST_ATTRS:
+                return "persist"
     return None
 
 
@@ -749,6 +761,7 @@ SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
     "hot-guard": ("ompi_tpu/pml/ob1.py", """
 from ompi_tpu import quant as _quant
 from ompi_tpu.coll import hier as _hier
+from ompi_tpu.coll import persist as _persist
 from ompi_tpu.ft import diskless as _diskless
 from ompi_tpu.ft import inject as _inject
 from ompi_tpu.reshard import exec as _reshard
@@ -762,6 +775,7 @@ def isend(self, dst):
     _reshard.note_exec(1, 2)
     _quant.note_wire(4096, 512)
     _hier.note_stage("allreduce", "cross", 1.0)
+    _persist.note_start(1.0)
     with _trace.span("pml.send", cat="pml"):
         return self._isend(dst)
 """),

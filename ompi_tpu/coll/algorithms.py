@@ -502,6 +502,42 @@ def alltoall_pairwise(comm, sendbuf, recvbuf):
         _sched.note_copied(out.nbytes)
 
 
+def alltoallv_pairwise(comm, sendbuf, recvbuf, sendcounts, sdispls,
+                       recvcounts, rdispls):
+    """Pairwise exchange with per-peer counts/displacements (element
+    units, matching the blocking basic.alltoallv semantics). Rounds are
+    independent — disjoint send slices, disjoint landing slots — so
+    they window ``ordered=False`` like the fixed-count pairwise."""
+    n, r = comm.size, comm.rank
+    packed, _, sdt = _packed(sendbuf)
+    robj, rcount, rdt = parse_buffer(recvbuf)
+    se, re_ = sdt.size, rdt.size
+    dest = _direct_view(recvbuf)
+    out = dest if dest is not None \
+        else np.zeros(rcount * re_, dtype=np.uint8)
+    own = packed[sdispls[r] * se:(sdispls[r] + sendcounts[r]) * se]
+    out[rdispls[r] * re_:rdispls[r] * re_ + own.nbytes] = own
+    _sched.note_copied(own.nbytes)
+    for d in range(1, n):
+        dst, src = (r + d) % n, (r - d) % n
+        chunk = _bytes(packed[sdispls[dst] * se:
+                              (sdispls[dst] + sendcounts[dst]) * se])
+        nb_src = recvcounts[src] * re_
+        off = rdispls[src] * re_
+        if dest is not None:
+            yield Round(sends=[(chunk, dst)],
+                        recvs=[(nb_src, src, out[off:off + nb_src])],
+                        ordered=False)
+        else:
+            bufs = yield Round(sends=[(chunk, dst)],
+                               recvs=[(nb_src, src)])
+            out[off:off + nb_src] = bufs[0]
+            _sched.note_copied(nb_src)
+    if dest is None:
+        cv_unpack(out, robj, rcount, rdt)
+        _sched.note_copied(out.nbytes)
+
+
 # ----------------------------------------------------------- gather/scatter
 def gather_linear(comm, sendbuf, recvbuf, root: int):
     n, r = comm.size, comm.rank
@@ -525,6 +561,72 @@ def gather_linear(comm, sendbuf, recvbuf, root: int):
     _sched.note_copied(nb)
     if dest is None:
         _unpack_staging(out, recvbuf)
+
+
+def gatherv_linear(comm, sendbuf, recvbuf, counts, displs, root: int):
+    """Linear fan-in with per-rank counts/displacements (element units,
+    the blocking basic.gatherv semantics): the root lands each block
+    straight in its displacement slot."""
+    n, r = comm.size, comm.rank
+    block, _, _ = _packed(sendbuf)
+    if r != root:
+        yield Round(sends=[(block, root)])
+        return
+    robj, rcount, rdt = parse_buffer(recvbuf)
+    counts = list(counts)
+    if displs is None:
+        displs = np.cumsum([0] + counts[:-1]).tolist()
+    esz = rdt.size
+    dest = _direct_view(recvbuf)
+    out = dest if dest is not None \
+        else np.zeros(rcount * esz, dtype=np.uint8)
+    others = [i for i in range(n) if i != root]
+    if dest is not None:
+        yield Round(recvs=[(counts[i] * esz, i,
+                            out[displs[i] * esz:
+                                displs[i] * esz + counts[i] * esz])
+                           for i in others])
+    else:
+        bufs = yield Round(recvs=[(counts[i] * esz, i) for i in others])
+        for i, bb in zip(others, bufs):
+            out[displs[i] * esz:displs[i] * esz + bb.nbytes] = bb
+            _sched.note_copied(bb.nbytes)
+    out[displs[root] * esz:displs[root] * esz + block.nbytes] = block
+    _sched.note_copied(block.nbytes)
+    if dest is None:
+        _unpack_staging(out, recvbuf)
+
+
+def scatterv_linear(comm, sendbuf, recvbuf, counts, displs, root: int):
+    """Linear fan-out with per-rank counts/displacements (element
+    units, the blocking basic.scatterv semantics)."""
+    n, r = comm.size, comm.rank
+    robj, rcount, rdt = parse_buffer(recvbuf)
+    if r == root:
+        packed, _, sdt = _packed(sendbuf)
+        counts = list(counts)
+        if displs is None:
+            displs = np.cumsum([0] + counts[:-1]).tolist()
+        esz = sdt.size
+        sends = []
+        for i in range(n):
+            chunk = _bytes(packed[displs[i] * esz:
+                                  (displs[i] + counts[i]) * esz])
+            if i == root:
+                cv_unpack(chunk, robj, rcount, rdt)
+            else:
+                sends.append((chunk, i))
+        if sends:
+            yield Round(sends=sends)
+    else:
+        nb = rcount * rdt.size
+        dest = _direct_view(recvbuf)
+        if dest is not None:
+            yield Round(recvs=[(nb, root, dest)])
+        else:
+            bufs = yield Round(recvs=[(nb, root)])
+            cv_unpack(bufs[0], robj, rcount, rdt)
+            _sched.note_copied(nb)
 
 
 def scatter_linear(comm, sendbuf, recvbuf, root: int):

@@ -47,8 +47,11 @@ COLL_OPS = (
     "iallgather",
     "iallgatherv",
     "ialltoall",
+    "ialltoallv",
     "igather",
+    "igatherv",
     "iscatter",
+    "iscatterv",
     "ireduce_scatter_block",
     "iscan",
     "iexscan",

@@ -136,6 +136,10 @@ def build(comm, verb: str) -> CollPlan:
 def invalidate_comm(comm, verb: Optional[str] = None) -> None:
     """Drop one comm's plan(s): the decide.py re-score seam (one verb,
     on the agreed index) and the Free path (all)."""
+    # persistent plans (coll/persist.py) freeze the same decisions one
+    # level further out: any per-comm invalidation (decide.py re-score
+    # switch, Free) must miss them too, on the same agreed index
+    comm._persist_cepoch = getattr(comm, "_persist_cepoch", 0) + 1
     plans = getattr(comm, "_plans", None)
     if plans is None:
         return

@@ -50,9 +50,19 @@ class NbcColl(CollModule):
         return NbcRequest(comm, alg.gather_linear(comm, sendbuf, recvbuf,
                                                   root))
 
+    def igatherv(self, comm, sendbuf, recvbuf, counts, displs,
+                 root: int) -> Request:
+        return NbcRequest(comm, alg.gatherv_linear(comm, sendbuf, recvbuf,
+                                                   counts, displs, root))
+
     def iscatter(self, comm, sendbuf, recvbuf, root: int) -> Request:
         return NbcRequest(comm, alg.scatter_linear(comm, sendbuf, recvbuf,
                                                    root))
+
+    def iscatterv(self, comm, sendbuf, recvbuf, counts, displs,
+                  root: int) -> Request:
+        return NbcRequest(comm, alg.scatterv_linear(comm, sendbuf, recvbuf,
+                                                    counts, displs, root))
 
     # --------------------------------------------------------------- all-ops
     def iallreduce(self, comm, sendbuf, recvbuf, op: _op.Op) -> Request:
@@ -83,6 +93,12 @@ class NbcColl(CollModule):
 
     def ialltoall(self, comm, sendbuf, recvbuf) -> Request:
         return NbcRequest(comm, alg.alltoall_pairwise(comm, sendbuf, recvbuf))
+
+    def ialltoallv(self, comm, sendbuf, recvbuf, sendcounts, sdispls,
+                   recvcounts, rdispls) -> Request:
+        return NbcRequest(comm, alg.alltoallv_pairwise(
+            comm, sendbuf, recvbuf, sendcounts, sdispls, recvcounts,
+            rdispls))
 
     def ireduce_scatter_block(self, comm, sendbuf, recvbuf,
                               op: _op.Op) -> Request:

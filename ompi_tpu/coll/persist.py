@@ -1,0 +1,1184 @@
+"""coll/persist — the persistent-plan compiler (MPI-4 ``X_init`` → ``Start``).
+
+Reference: Open MPI ships MPI-4 persistent collectives
+(``MPI_Allreduce_init`` → ``MPI_Start``, coll.h:545-620) because a
+serving/training hot loop calls the SAME collective millions of times
+and must not pay a decision tree per call. PR 8 froze the dispatch
+prologue (coll/hier/plan.py); this module extends that discipline to
+the ENTIRE lowering: at init time the (buffer identity, count, dtype,
+op, comm) tuple resolves ONCE into a frozen :class:`PersistPlan` —
+
+- the slot/provider and the tuned-style algorithm choice (ring vs
+  recursive doubling, bruck vs ring) are decided once, mirroring the
+  nonblocking path's decision rules exactly;
+- the full round schedule is PRE-BUILT: every :class:`~.sched.Round`
+  object exists before the first Start, its sends borrowing views
+  pre-pinned over the caller's buffers and its recvs landing either
+  straight in pre-pinned destination slices or in size-classed
+  ``mpool`` blocks acquired once and HELD for the request's lifetime;
+- the local compute between rounds (reductions, block placement) is
+  pre-bound into thunks that reproduce the ad-hoc algorithms'
+  arithmetic order exactly — so a frozen replay is BITWISE equal to the
+  ``coll_persist_enable=0`` re-issue path.
+
+Steady-state ``Start`` is therefore a schedule replay with zero
+per-call decisions: a fresh generator walks the frozen step list and
+yields the pre-built rounds.
+
+**Cross-phase chunk pipelining** (the software edition of the
+multi-stream overlap of arxiv 2508.13397, composed over the stage split
+of HiCCL 2408.05962): when ``coll_persist_chunk_bytes`` > 0 the frozen
+allreduce splits each ring block into sub-chunks and issues chunk k+1's
+reduce-scatter rounds as ``Round(ordered=False, wait=True)`` — the
+engine resumes on the round's OWN completion — while chunk k's
+allgather round (one ``ordered=False`` linear exchange) is still in
+flight, instead of barriering between the phases. Sub-chunking WITHIN
+ring blocks keeps every element's reduction chain identical to the
+un-chunked ring, so the pipelined schedule stays bitwise-equal too.
+
+**Wire compatibility**: every un-chunked frozen schedule emits the same
+rounds (sizes, peers, order) as the ad-hoc generator it mirrors, so a
+rank whose local buffer kind forces the re-issue fallback still
+interoperates with frozen peers. Eligibility gates are functions of the
+SYMMETRIC tuple (verb, count, dtype, op, comm size) only; rank-local
+layout quirks (non-contiguous buffers, derived datatypes) are absorbed
+by per-Start pack/unpack bounce thunks over a held scratch, never by a
+schedule change. The chunked allreduce changes the allgather wire
+pattern, so — like every ``coll_tuned`` algorithm knob —
+``coll_persist_enable`` / ``coll_persist_chunk_bytes`` must be set
+identically on every member, and buffers must be host buffers
+(ndarray/bytearray) on all ranks or none.
+
+**Invalidation** reuses the PR 8 machinery: relevant cvar writes bump
+this module's epoch via :func:`~ompi_tpu.mca.var.watch_var` AND the
+plan also pins the PR 8 global dispatch epoch plus a per-comm epoch
+bumped by ``coll/hier/plan.invalidate_comm`` (the decide.py re-score /
+Free seam), so a stale plan can never replay against a changed config —
+the next Start recompiles exactly once. A mid-Start peer death fails
+the activation through the PR 3 watchdog path; the completion hook then
+DISCARDS (never recycles) the plan's held pool blocks, the PR 9
+dying-conn lesson.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ompi_tpu.coll import sched as _sched
+from ompi_tpu.coll.sched import NbcRequest, Round
+from ompi_tpu.coll.basic import _np_reduce_typed, _typed_view
+from ompi_tpu.coll.hier import plan as _cplan
+from ompi_tpu.comm.communicator import parse_buffer
+from ompi_tpu.core.convertor import (
+    _as_byte_view as _as_bytes,
+    pack as cv_pack,
+    unpack as cv_unpack,
+)
+from ompi_tpu.mca.var import (
+    get_var,
+    register_pvar,
+    register_var,
+    watch_var,
+)
+from ompi_tpu.runtime import mpool
+
+_enable_var = register_var(
+    "coll_persist", "enable", 1,
+    help="1 = compile persistent collectives (X_init) into frozen "
+         "replayable plans: provider/algorithm choice, round schedule, "
+         "pinned buffer views, and pool blocks are resolved once at "
+         "init so steady-state Start is a schedule replay. 0 = the "
+         "pre-PR-11 re-issue path (rebuild the nonblocking schedule "
+         "per Start), kept verbatim as the measured A/B baseline. "
+         "Must match on every member of a communicator.", level=6)
+_chunk_var = register_var(
+    "coll_persist", "chunk_bytes", 262144,
+    help="Sub-chunk size for the pipelined persistent allreduce: each "
+         "ring block is split into ceil(block/chunk) chunks whose "
+         "reduce-scatter rounds overlap the previous chunk's allgather "
+         "(Round wait/unordered windowing across the phase boundary). "
+         "0 disables chunking (plain frozen ring, wire-identical to "
+         "the ad-hoc path). Must match on every member.", level=6)
+_donate_var = register_var(
+    "coll_persist", "donate", 0,
+    help="Mesh mode: 1 = X_init also compiles a donated-operand "
+         "executable, so Start(x) with a fresh operand lets XLA reuse "
+         "x's buffer for the output (x is CONSUMED — the MPI-4 "
+         "started-buffer ownership reading). The init-time operand "
+         "stays un-donated for operand-less restarts.", level=7)
+
+# replay counters (persist_* pvars). List slots so hot call sites
+# (PersistentCollRequest.Start, mesh _pcoll_init) bump them with one
+# attribute load + item add, no function call on the steady path.
+_plans = [0]       # frozen plans compiled (proc + mesh)
+_starts = [0]      # persistent Starts issued (both replay and re-issue)
+_replay_us = [0.0]  # accumulated Start-call latency, microseconds
+_overlap = [0]     # rounds issued across a chunk-phase boundary
+
+register_pvar("persist", "plans", lambda: _plans[0],
+              help="Persistent plans compiled (X_init freezes + "
+                   "invalidation rebuilds; mesh executable freezes "
+                   "count too)")
+register_pvar("persist", "starts", lambda: _starts[0],
+              help="Persistent Start activations issued (frozen replay "
+                   "AND coll_persist_enable=0 re-issue — the A/B "
+                   "denominator)")
+register_pvar("persist", "replay_us", lambda: _replay_us[0],
+              help="Accumulated Start-call latency in microseconds "
+                   "(schedule issue, first-round launch); divide by "
+                   "persist_starts deltas per mode for the A/B")
+register_pvar("persist", "overlap_rounds", lambda: _overlap[0],
+              help="Rounds the chunk-pipelined persistent allreduce "
+                   "issued across a chunk-phase boundary with no "
+                   "intervening barrier (> 0 proves cross-phase "
+                   "overlap; stays flat when coll_round_window<=1 "
+                   "forces lockstep)")
+
+
+def note_plan() -> None:
+    """One frozen plan compiled (hot call sites bump ``_plans[0]``
+    inline; this hook exists for tools and the mpilint contract)."""
+    _plans[0] += 1
+
+
+def note_start(us: float) -> None:
+    """One persistent Start, charging its issue latency (hot call sites
+    bump the slots inline; tools/lint hook)."""
+    _starts[0] += 1
+    _replay_us[0] += us
+
+
+def note_overlap(rounds: int) -> None:
+    """Cross-phase rounds issued by one pipelined replay."""
+    _overlap[0] += int(rounds)
+
+
+# ------------------------------------------------------------ invalidation
+# Module epoch: a plan is live only while every epoch it pinned at
+# compile time still matches. Config whose value is frozen into the
+# schedule invalidates through watch_var; everything the PR 8 dispatch
+# epoch already covers (metrics/sanitizer/trace enables, coll_hier
+# knobs) rides along because the plan pins that epoch too.
+_EPOCH = [1]
+
+
+def epoch() -> int:
+    return _EPOCH[0]
+
+
+def invalidate(_var=None) -> None:
+    """Bump the persist epoch: every frozen plan misses on its next
+    Start and recompiles exactly once (watch_var callback shape)."""
+    _EPOCH[0] += 1
+
+
+for _fw, _name in (("coll_persist", "enable"),
+                   ("coll_persist", "chunk_bytes"),
+                   ("coll_tuned", "allreduce_small_msg"),
+                   ("coll_tuned", "allgather_small_msg")):
+    watch_var(_fw, _name, invalidate)
+
+
+def enabled() -> bool:
+    return bool(_enable_var._value)
+
+
+# ---------------------------------------------------------------- pinning
+class _Pin:
+    """A pre-resolved flat uint8 view the schedule reads/writes, plus
+    per-Start bounce thunks when the caller's layout can't be aliased:
+    ``pre`` packs fresh send bytes into the held scratch, ``post``
+    unpacks received bytes back into the caller's buffer. Rank-local by
+    design — the wire schedule never depends on which side we took."""
+
+    __slots__ = ("view", "pre", "post")
+
+    def __init__(self, view, pre=None, post=None):
+        self.view = view
+        self.pre = pre
+        self.post = post
+
+
+def _pin(buf, writable: bool) -> Optional[Tuple[_Pin, int, object]]:
+    """(pin, count, datatype) for a host buffer, or None when the
+    buffer kind can't back a frozen plan (device arrays re-stage per
+    call — the re-issue path owns those)."""
+    obj = buf
+    if isinstance(buf, (list, tuple)):
+        if len(buf) not in (2, 3):
+            return None
+        obj = buf[0]
+    if not isinstance(obj, (np.ndarray, bytearray, memoryview)):
+        return None
+    if isinstance(obj, memoryview) and obj.readonly and writable:
+        return None
+    obj2, count, dt = parse_buffer(buf)
+    nbytes = count * dt.size
+    if isinstance(obj2, np.ndarray):
+        if not obj2.flags.c_contiguous:
+            # a strided ndarray can't be byte-viewed (the convertor has
+            # the same limit) — the re-issue path owns it, so frozen
+            # and fallback ranks fail or succeed identically
+            return None
+        if obj2.flags.writeable or not writable:
+            if dt.is_contiguous:
+                return _Pin(_as_bytes(obj2)[:nbytes]), count, dt
+        else:
+            return None
+    else:  # bytearray / memoryview: 1-D bytes, alias directly
+        view = np.frombuffer(obj2, np.uint8, nbytes)
+        if not (view.flags.writeable or not writable):
+            return None
+        if dt.is_contiguous:
+            return _Pin(view), count, dt
+        obj2 = view  # derived datatype over raw bytes: bounce below
+    # bounce: a non-contiguous DATATYPE over a contiguous buffer — a
+    # held scratch carries the wire bytes; pack/unpack run per Start
+    # (counted staging — the layout genuinely can't alias)
+    scratch = np.empty(nbytes, dtype=np.uint8)
+
+    def pre(_o=obj2, _c=count, _d=dt, _s=scratch, _n=nbytes):
+        data = cv_pack(_o, _c, _d)
+        if not data.flags.c_contiguous:
+            data = np.ascontiguousarray(data)
+        _s[:] = _as_bytes(data)[:_n]
+        _sched.note_copied(_n)
+
+    def post(_o=obj2, _c=count, _d=dt, _s=scratch, _n=nbytes):
+        cv_unpack(_s, _o, _c, _d)
+        _sched.note_copied(_n)
+
+    return _Pin(scratch, pre, post), count, dt
+
+
+# ------------------------------------------------------------------- plans
+class PersistPlan:
+    """One frozen lowering for (comm, verb, args). ``steps`` is the
+    replay program — ("r", Round) communication steps interleaved with
+    ("c", thunk) pre-bound compute — or None for the epoch-tagged
+    "re-issue" sentinel (ineligible shape: the Start path falls back
+    without re-testing eligibility every call)."""
+
+    __slots__ = ("verb", "steps", "held", "overlap_rounds", "epochs",
+                 "provider", "dead", "discarded", "active", "__weakref__")
+
+    def __init__(self, verb: str, steps, held, overlap_rounds: int,
+                 epochs, provider: str):
+        self.verb = verb
+        self.steps = steps
+        self.held = held                  # [(pool, block), ...]
+        self.overlap_rounds = overlap_rounds
+        self.epochs = epochs
+        self.provider = provider
+        self.dead = False
+        self.discarded = False
+        self.active = False
+
+    def __repr__(self) -> str:  # tools/info + debugging
+        kind = "replay" if self.steps is not None else "reissue"
+        return (f"<PersistPlan {self.verb} {kind} "
+                f"rounds={sum(1 for k, _ in (self.steps or ()) if k == 'r')} "
+                f"held={len(self.held)} dead={self.dead}>")
+
+    def retire(self) -> None:
+        """Release held pool blocks back to their free lists — only on
+        clean teardown (rebuild of an INACTIVE plan, comm Free). An
+        active plan discards instead: its views may still be a landing
+        zone for an in-flight drain."""
+        if self.dead:
+            return
+        self.dead = True
+        if self.active:
+            self._drop(recycle=False)
+        else:
+            self._drop(recycle=True)
+
+    def fail(self) -> None:
+        """A replay died mid-Start (peer death through the PR 3
+        watchdog, schedule error): DISCARD the held blocks — never
+        recycle — and kill the plan so the next Start recompiles."""
+        self.dead = True
+        self.discarded = True
+        self._drop(recycle=False)
+
+    def _drop(self, recycle: bool) -> None:
+        # drain IN PLACE: the GC finalizer holds this same list object,
+        # so a settled plan must leave it empty, never rebound
+        held = self.held
+        while held:
+            pool, block = held.pop()
+            if recycle:
+                pool.release(block)
+            else:
+                pool.discard(block)
+
+
+class _Builder:
+    """Accumulates the frozen step list + held-block ownership while a
+    verb builder lays out its schedule."""
+
+    __slots__ = ("steps", "held", "overlap")
+
+    def __init__(self):
+        self.steps: List[tuple] = []
+        self.held: List[tuple] = []
+        self.overlap = 0
+
+    def block(self, nbytes: int) -> np.ndarray:
+        """A staging view held for the plan's lifetime: size-classed
+        pool block when poolable, plain allocation otherwise."""
+        pool = mpool.class_pool(nbytes)
+        if pool is None:
+            return np.empty(max(nbytes, 1), dtype=np.uint8)[:nbytes]
+        blk, _ = pool.acquire_pair()
+        self.held.append((pool, blk))
+        return np.frombuffer(blk, np.uint8, nbytes)
+
+    def rnd(self, sends: Sequence = (), recvs: Sequence = (),
+            ordered: bool = True, wait: bool = False) -> None:
+        self.steps.append(("r", Round(sends=sends, recvs=recvs,
+                                      ordered=ordered, wait=wait)))
+
+    def do(self, fn: Callable[[], None]) -> None:
+        self.steps.append(("c", fn))
+
+
+def _replay(plan: PersistPlan):
+    """A fresh generator over the frozen steps — the whole per-Start
+    lowering. Rounds are the SAME pre-built objects every activation;
+    compute thunks read/write the pre-pinned views."""
+    for kind, item in plan.steps:
+        if kind == "c":
+            item()
+        else:
+            yield item
+
+
+# --------------------------------------------------------------- lifecycle
+# Pool blocks of plans the user dropped without Request_free/comm Free:
+# the GC finalizer must not take pool locks (it can fire inside ANY
+# allocation, including one a pool holds its lock around), so it parks
+# the blocks here and the next compile/release settles the accounting.
+_orphans: List[tuple] = []
+
+
+def _orphan_held(held: List[tuple]) -> None:
+    while held:
+        _orphans.append(held.pop())
+
+
+def _settle_orphans() -> None:
+    while _orphans:
+        pool, block = _orphans.pop()
+        # discard, never recycle: nothing proves the dropped plan had
+        # no activation still draining into its views
+        pool.discard(block)
+
+
+def compile_plan(comm, slot: str, args: tuple) -> PersistPlan:
+    """Resolve + freeze the entire lowering for one persistent request
+    (the X_init slow path). Returns a re-issue sentinel plan when the
+    shape is ineligible — cached under the same epochs so Start never
+    re-tests eligibility."""
+    _settle_orphans()
+    epochs = (_EPOCH[0], _cplan._EPOCH[0],
+              getattr(comm, "_persist_cepoch", 0))
+    builder = _BUILDERS.get(slot)
+    built = builder(comm, *args) if builder is not None else None
+    if built is None:
+        return PersistPlan(slot, None, [], 0, epochs, "reissue")
+    b, provider = built
+    plan = PersistPlan(slot, b.steps, b.held, b.overlap, epochs, provider)
+    if b.held:
+        # a plan GC'd while still holding blocks (request dropped with
+        # no Free) must not inflate pool accounting for process life
+        weakref.finalize(plan, _orphan_held, b.held)
+    _plans[0] += 1
+    live = getattr(comm, "_persist_live", None)
+    if live is None:
+        live = comm._persist_live = weakref.WeakSet()
+    live.add(plan)
+    return plan
+
+
+def valid(comm, plan: PersistPlan) -> bool:
+    """Live iff every pinned epoch still matches: the persist epoch
+    (coll_persist/tuned cvar writes), the PR 8 dispatch epoch
+    (metrics/sanitizer/trace/hier config), and the per-comm epoch
+    (decide.py re-scores, Free)."""
+    return (not plan.dead
+            and plan.epochs == (_EPOCH[0], _cplan._EPOCH[0],
+                                getattr(comm, "_persist_cepoch", 0)))
+
+
+def start(comm, plan: PersistPlan) -> NbcRequest:
+    """Replay the frozen schedule as one NbcRequest activation. A
+    failed activation (watchdog peer death, schedule error) discards
+    the plan's blocks through :meth:`PersistPlan.fail`."""
+    plan.active = True
+    if plan.overlap_rounds and _sched._window_var._value > 1:
+        # window<=1 forces every wait round into an ordered barrier —
+        # the schedule still replays bitwise-identically, but no round
+        # crosses a phase boundary, so the overlap claim must not grow
+        _overlap[0] += plan.overlap_rounds
+    req = NbcRequest(comm, _replay(plan))
+
+    def settle(r, _plan=plan):
+        _plan.active = False
+        if r._error:
+            _plan.fail()
+
+    req.add_completion_callback(settle)
+    return req
+
+
+def release_comm(comm) -> None:
+    """Comm Free: every live plan dies with its communicator — recycle
+    the blocks of inactive plans, discard those of active ones."""
+    _settle_orphans()
+    live = getattr(comm, "_persist_live", None)
+    if not live:
+        return
+    for plan in list(live):
+        plan.retire()
+
+
+# ================================================================ builders
+# Each builder returns (``_Builder``, provider-tag) for an eligible
+# SYMMETRIC shape, or None to fall back to the re-issue path. The wire
+# schedule (rounds, sizes, peers, order) of every un-chunked builder is
+# identical to the ad-hoc generator it mirrors, and reduction thunks
+# reproduce the ad-hoc accumulation order exactly — bitwise equality
+# across enable=0 / enable=1 / pipelined is by construction, and a
+# locally-fallen-back rank still interoperates.
+_Z = np.zeros(0, dtype=np.uint8)  # shared zero-byte token/landing view
+
+
+def _b_barrier(comm):
+    """Mirror alg.barrier_dissemination: ceil(log2 n) zero-byte rounds."""
+    n, r = comm.size, comm.rank
+    b = _Builder()
+    d = 1
+    while d < n:
+        b.rnd(sends=[(_Z, (r + d) % n)], recvs=[(0, (r - d) % n, _Z)])
+        d <<= 1
+    return b, "persist/dissemination"
+
+
+def _b_bcast(comm, buf, root):
+    """Mirror alg.bcast_binomial: one recv from the parent straight
+    into the (pinned) buffer, then one round fanning borrowed views of
+    it to the children."""
+    n, r = comm.size, comm.rank
+    p = _pin(buf, writable=True)
+    if p is None:
+        return None
+    pin, count, dt = p
+    nbytes = count * dt.size
+    b = _Builder()
+    vrank = (r - root) % n
+    if vrank == 0:
+        if pin.pre:
+            b.do(pin.pre)
+        mask = 1
+        while mask < n:
+            mask <<= 1
+        mask >>= 1
+    else:
+        mask = 1
+        while not (vrank & mask):
+            mask <<= 1
+        src = (vrank - mask + root) % n
+        b.rnd(recvs=[(nbytes, src, pin.view)])
+        mask >>= 1
+    sends = []
+    while mask > 0:
+        if vrank + mask < n and not (vrank & mask):
+            sends.append((pin.view, (vrank + mask + root) % n))
+        mask >>= 1
+    if sends:
+        b.rnd(sends=sends)
+    if vrank != 0 and pin.post:
+        b.do(pin.post)
+    return b, "persist/binomial"
+
+
+def _reduce_into(b, comm, spin, rview, op, root, count, dt):
+    """Append frozen reduce-to-root steps writing the packed result
+    into ``rview`` at the root (mirrors NbcColl.ireduce's choice:
+    binomial for commutative ops past 2 ranks, else rank-ordered
+    linear; accumulation order matches alg.reduce_* exactly)."""
+    n, r = comm.size, comm.rank
+    nbytes = count * dt.size
+    if spin.pre:
+        b.do(spin.pre)
+    if op.commutative and n > 2:
+        vrank = (r - root) % n
+        children = []
+        mask = 1
+        while mask < n:
+            if vrank & mask:
+                break
+            if vrank + mask < n:
+                children.append((vrank + mask + root) % n)
+            mask <<= 1
+        acc = b.block(nbytes)
+        b.do(lambda _a=acc, _s=spin.view: _a.__setitem__(slice(None), _s))
+        if children:
+            stages = [b.block(nbytes) for _ in children]
+            b.rnd(recvs=[(nbytes, c, st)
+                         for c, st in zip(children, stages)])
+
+            def fold(_a=acc, _st=stages, _op=op, _dt=dt):
+                t = _typed_view(_a, _dt)
+                for s in _st:
+                    t = _np_reduce_typed(_op, t, _typed_view(s, _dt))
+                _a[:] = _as_bytes(np.ascontiguousarray(t))
+
+            b.do(fold)
+        if vrank != 0:
+            parent = (vrank - mask + root) % n
+            b.rnd(sends=[(acc, parent)])
+        else:
+            b.do(lambda _r=rview, _a=acc: _r.__setitem__(slice(None), _a))
+        return
+    # rank-ordered linear fan-in (non-commutative ops / 2 ranks)
+    if r != root:
+        b.rnd(sends=[(spin.view, root)])
+        return
+    others = [i for i in range(n) if i != root]
+    stages = [b.block(nbytes) for _ in others]
+    b.rnd(recvs=[(nbytes, i, st) for i, st in zip(others, stages)])
+
+    def fold_linear(_o=others, _st=stages, _s=spin.view, _r=rview,
+                    _op=op, _dt=dt, _root=root, _n=n):
+        parts: List[np.ndarray] = [None] * _n  # type: ignore[list-item]
+        parts[_root] = _s
+        for i, st in zip(_o, _st):
+            parts[i] = st
+        acc = _typed_view(parts[0].copy(), _dt)
+        for i in range(1, _n):
+            acc = _np_reduce_typed(_op, acc, _typed_view(parts[i], _dt))
+        _r[:] = _as_bytes(np.ascontiguousarray(acc))
+
+    b.do(fold_linear)
+
+
+def _b_reduce(comm, sendbuf, recvbuf, op, root):
+    ps = _pin(recvbuf if sendbuf is None else sendbuf, writable=False)
+    if ps is None:
+        return None
+    spin, count, dt = ps
+    if dt.np_dtype is None:
+        return None
+    rview = None
+    post = None
+    if comm.rank == root:
+        pr = _pin(recvbuf, writable=True)
+        if pr is None:
+            return None
+        rpin, rcount, rdt = pr
+        rview, post = rpin.view, rpin.post
+    b = _Builder()
+    _reduce_into(b, comm, spin, rview, op, root, count, dt)
+    if post:
+        b.do(post)
+    return b, "persist/reduce"
+
+
+def _b_allreduce(comm, sendbuf, recvbuf, op):
+    """Mirror NbcColl.iallreduce: non-commutative → linear reduce +
+    binomial bcast; large commutative → ring (chunk-pipelined when
+    ``coll_persist_chunk_bytes`` is set); small commutative →
+    recursive doubling (power-of-two worlds; the fold-in pre/post
+    phase of non-pow2 worlds stays on the re-issue path)."""
+    n, r = comm.size, comm.rank
+    pr = _pin(recvbuf, writable=True)
+    if pr is None:
+        return None
+    rpin, count, dt = pr
+    if dt.np_dtype is None:
+        return None
+    if sendbuf is None:
+        spin = rpin
+    else:
+        ps = _pin(sendbuf, writable=False)
+        if ps is None:
+            return None
+        spin = ps[0]
+    nbytes = count * dt.size
+    if not op.commutative:
+        b = _Builder()
+        _reduce_into(b, comm, spin, rpin.view, op, 0, count, dt)
+        if r == 0 and rpin.post:
+            b.do(rpin.post)
+        # bcast of the reduced recvbuf, mirroring _allreduce_linear's
+        # second leg (by the time the bcast steps run, the fold — and
+        # on a bounce layout its unpack — has landed the result in the
+        # recvbuf the bcast re-reads)
+        sub = _b_bcast(comm, recvbuf, 0)
+        if sub is None:
+            return None
+        bb, _ = sub
+        b.steps.extend(bb.steps)
+        b.held.extend(bb.held)
+        return b, "persist/linear+bcast"
+    if n == 1:
+        return _ar_trivial(spin, rpin, nbytes)
+    if nbytes > get_var("coll_tuned", "allreduce_small_msg"):
+        if count % n != 0:
+            return None  # ad-hoc pads through scratch; re-issue owns it
+        return _ring_allreduce(comm, spin, rpin, op, count, dt)
+    if n & (n - 1):
+        return None  # non-pow2 small: the rd fold-in stays re-issue
+    return _rd_allreduce(comm, spin, rpin, op, count, dt)
+
+
+def _ar_trivial(spin, rpin, nbytes):
+    b = _Builder()
+    if spin.pre:
+        b.do(spin.pre)
+    if spin.view is not rpin.view:
+        b.do(lambda _r=rpin.view, _s=spin.view:
+             _r.__setitem__(slice(None), _s))
+    if rpin.post:
+        b.do(rpin.post)
+    return b, "persist/trivial"
+
+
+def _rd_allreduce(comm, spin, rpin, op, count, dt):
+    """Recursive doubling, power-of-two worlds: sendrecv with partner
+    2^t away, accumulating ``op(acc, got)`` in a held scratch — the
+    exact alg.allreduce_recursive_doubling order with rem == 0."""
+    n, r = comm.size, comm.rank
+    nbytes = count * dt.size
+    b = _Builder()
+    if spin.pre:
+        b.do(spin.pre)
+    acc = b.block(nbytes)
+    b.do(lambda _a=acc, _s=spin.view: _a.__setitem__(slice(None), _s))
+    stage = b.block(nbytes)
+    mask = 1
+    while mask < n:
+        partner = r ^ mask
+        b.rnd(sends=[(acc, partner)], recvs=[(nbytes, partner, stage)])
+
+        def fold(_a=acc, _g=stage, _op=op, _dt=dt):
+            out = _np_reduce_typed(_op, _typed_view(_a, _dt),
+                                   _typed_view(_g, _dt))
+            _a[:] = _as_bytes(np.ascontiguousarray(out))
+
+        b.do(fold)
+        mask <<= 1
+    b.do(lambda _r=rpin.view, _a=acc: _r.__setitem__(slice(None), _a))
+    if rpin.post:
+        b.do(rpin.post)
+    return b, "persist/recursive_doubling"
+
+
+def _ring_allreduce(comm, spin, rpin, op, count, dt):
+    """Ring reduce-scatter + allgather with pre-pinned block views.
+
+    Un-chunked: wire-identical to alg.allreduce_ring (nseg=1, alias
+    path) — same 2n-2 rounds, but the per-Start seed copy is gone: the
+    reduce-scatter thunks read the local contribution STRAIGHT from the
+    pinned send view (``recv[rb] = op(send[rb], got)`` — bitwise the
+    seeded ``arr[rb] = op(arr[rb], got)``).
+
+    Chunked (``coll_persist_chunk_bytes`` > 0): each ring block splits
+    into m sub-chunks; chunk c's reduce-scatter rounds are
+    ``Round(wait=True)`` so they resume on their own completion while
+    chunk c-1's one-round linear allgather (``ordered=False``) is still
+    in flight — the cross-phase overlap. Sub-chunking within blocks
+    keeps every element's reduction chain identical."""
+    n, r = comm.size, comm.rank
+    npdt = dt.np_dtype
+    isz = npdt.itemsize
+    k = count // n  # elements per ring block (count % n == 0 gated)
+    styped = spin.view.view(npdt)
+    rtyped = rpin.view.view(npdt)
+    left, right = (r - 1) % n, (r + 1) % n
+    cb = int(_chunk_var._value)
+    m = 1
+    if cb > 0 and k * isz > cb:
+        m = min(-(-(k * isz) // cb), k)
+    bounds = [k * c // m for c in range(m + 1)]
+    b = _Builder()
+    if spin.pre:
+        b.do(spin.pre)
+
+    def bslice(typed, blk, c0, c1):
+        return typed[blk * k + c0:blk * k + c1]
+
+    def fold(dst, src, got, _op=op):
+        dst[:] = _np_reduce_typed(_op, src, got)
+
+    for c in range(m):
+        c0, c1 = bounds[c], bounds[c + 1]
+        ke = c1 - c0
+        if ke == 0:
+            continue
+        stage = b.block(ke * isz)
+        gtyped = stage.view(npdt)
+        for t in range(n - 1):  # reduce-scatter phase
+            sb, rb = (r - t) % n, (r - t - 1) % n
+            # step 0 sends the local contribution straight from the
+            # pinned send view; later steps send the partial the
+            # previous fold wrote into the receive view — bitwise the
+            # seeded ad-hoc accumulator, without the per-Start seed copy
+            src = styped if t == 0 else rtyped
+            send = bslice(src, sb, c0, c1).view(np.uint8)
+            if m == 1:
+                b.rnd(sends=[(send, right)],
+                      recvs=[(ke * isz, left, stage)])
+            else:
+                if c > 0:
+                    b.overlap += 1
+                b.rnd(sends=[(send, right)],
+                      recvs=[(ke * isz, left, stage)],
+                      ordered=False, wait=True)
+            b.do(lambda _d=bslice(rtyped, rb, c0, c1),
+                 _s=bslice(styped, rb, c0, c1), _g=gtyped, _f=fold:
+                 _f(_d, _s, _g))
+        if m == 1:
+            # ring allgather, wire-identical to the ad-hoc schedule:
+            # forward the block received last round, land direct
+            for t in range(n - 1, 2 * n - 2):
+                ag = t - (n - 1)
+                sb, rb = (r + 1 - ag) % n, (r - ag) % n
+                b.rnd(sends=[(bslice(rtyped, sb, c0, c1).view(np.uint8),
+                              right)],
+                      recvs=[(ke * isz, left,
+                              bslice(rtyped, rb, c0, c1).view(np.uint8))])
+        else:
+            # linear allgather: my fully-reduced block to every peer,
+            # every other block straight into its final slice — all
+            # independent, one unordered round left in flight while the
+            # next chunk's reduce-scatter proceeds
+            own = (r + 1) % n
+            if c > 0:
+                b.overlap += 1
+            b.rnd(sends=[(bslice(rtyped, own, c0, c1).view(np.uint8), p)
+                         for p in range(n) if p != r],
+                  recvs=[(ke * isz, (blk - 1) % n,
+                          bslice(rtyped, blk, c0, c1).view(np.uint8))
+                         for blk in range(n) if blk != own],
+                  ordered=False)
+    if m > 1:
+        b.rnd()  # request-less ordered round: drain the window
+    if rpin.post:
+        b.do(rpin.post)
+    tag = "persist/ring" if m == 1 else f"persist/ring_pipelined[{m}]"
+    return b, tag
+
+
+def _b_allgather(comm, sendbuf, recvbuf):
+    """Mirror NbcColl.iallgather: bruck under allgather_small_msg,
+    ring above — both with frozen rounds."""
+    n, r = comm.size, comm.rank
+    ps = _pin(sendbuf, writable=False)
+    pr = _pin(recvbuf, writable=True)
+    if ps is None or pr is None:
+        return None
+    spin, scount, sdt = ps
+    rpin, rcount, rdt = pr
+    nb = scount * sdt.size
+    total = rcount * rdt.size
+    if total != n * nb:
+        return None
+    b = _Builder()
+    if spin.pre:
+        b.do(spin.pre)
+    if total <= get_var("coll_tuned", "allgather_small_msg") and n > 1:
+        acc = b.block(n * nb)
+        b.do(lambda _a=acc, _s=spin.view, _nb=nb:
+             (_a.__setitem__(slice(0, _nb), _s),
+              _sched.note_copied(_nb))[0])
+        dist = 1
+        while dist < n:
+            cnt = min(dist, n - dist)
+            b.rnd(sends=[(acc[:cnt * nb], (r - dist) % n)],
+                  recvs=[(cnt * nb, (r + dist) % n,
+                          acc[dist * nb:(dist + cnt) * nb])])
+            dist <<= 1
+
+        def rotate(_a=acc, _o=rpin.view, _nb=nb, _n=n, _r=r):
+            for i in range(_n):
+                src = (_r + i) % _n
+                _o[src * _nb:(src + 1) * _nb] = _a[i * _nb:(i + 1) * _nb]
+            _sched.note_copied(_n * _nb)
+
+        b.do(rotate)
+        prov = "persist/bruck"
+    else:
+        out = rpin.view
+        b.do(lambda _o=out, _s=spin.view, _r=r, _nb=nb:
+             (_o.__setitem__(slice(_r * _nb, (_r + 1) * _nb), _s),
+              _sched.note_copied(_nb))[0])
+        cur = out[r * nb:(r + 1) * nb]
+        for d in range(1, n):
+            src = (r - d) % n
+            slot = out[src * nb:(src + 1) * nb]
+            b.rnd(sends=[(cur, (r + 1) % n)], recvs=[(nb, (r - 1) % n,
+                                                      slot)])
+            cur = slot
+        prov = "persist/ring"
+    if rpin.post:
+        b.do(rpin.post)
+    return b, prov
+
+
+def _b_allgatherv(comm, sendbuf, recvbuf, counts, displs):
+    """Mirror alg.allgatherv_ring with frozen per-source slices."""
+    n, r = comm.size, comm.rank
+    ps = _pin(sendbuf, writable=False)
+    pr = _pin(recvbuf, writable=True)
+    if ps is None or pr is None:
+        return None
+    spin, scount, sdt = ps
+    rpin, rcount, rdt = pr
+    counts = [int(c) for c in counts]
+    if displs is None:
+        displs = np.cumsum([0] + counts[:-1]).tolist()
+    displs = [int(d) for d in displs]
+    esz = rdt.size
+    if scount * sdt.size != counts[r] * esz:
+        return None
+    out = rpin.view
+    if any(displs[i] * esz + counts[i] * esz > out.nbytes
+           for i in range(n)):
+        return None
+    b = _Builder()
+    if spin.pre:
+        b.do(spin.pre)
+    nb_own = counts[r] * esz
+    b.do(lambda _o=out, _s=spin.view, _d=displs[r] * esz, _nb=nb_own:
+         (_o.__setitem__(slice(_d, _d + _nb), _s),
+          _sched.note_copied(_nb))[0])
+    cur = out[displs[r] * esz:displs[r] * esz + nb_own]
+    for d in range(1, n):
+        src = (r - d) % n
+        nb_src = counts[src] * esz
+        slot = out[displs[src] * esz:displs[src] * esz + nb_src]
+        b.rnd(sends=[(cur, (r + 1) % n)],
+              recvs=[(nb_src, (r - 1) % n, slot)])
+        cur = slot
+    if rpin.post:
+        b.do(rpin.post)
+    return b, "persist/ring"
+
+
+def _b_alltoall(comm, sendbuf, recvbuf):
+    """Mirror alg.alltoall_pairwise: n-1 independent unordered rounds
+    over frozen slices."""
+    n, r = comm.size, comm.rank
+    ps = _pin(sendbuf, writable=False)
+    pr = _pin(recvbuf, writable=True)
+    if ps is None or pr is None:
+        return None
+    spin, scount, sdt = ps
+    rpin, rcount, rdt = pr
+    if scount * sdt.size != rcount * rdt.size or \
+            (scount * sdt.size) % n != 0:
+        return None
+    nb = scount * sdt.size // n
+    b = _Builder()
+    if spin.pre:
+        b.do(spin.pre)
+    b.do(lambda _o=rpin.view, _s=spin.view, _r=r, _nb=nb:
+         (_o.__setitem__(slice(_r * _nb, (_r + 1) * _nb),
+                         _s[_r * _nb:(_r + 1) * _nb]),
+          _sched.note_copied(_nb))[0])
+    for d in range(1, n):
+        dst, src = (r + d) % n, (r - d) % n
+        b.rnd(sends=[(spin.view[dst * nb:(dst + 1) * nb], dst)],
+              recvs=[(nb, src, rpin.view[src * nb:(src + 1) * nb])],
+              ordered=False)
+    if rpin.post:
+        b.do(rpin.post)
+    return b, "persist/pairwise"
+
+
+def _b_alltoallv(comm, sendbuf, recvbuf, sendcounts, sdispls,
+                 recvcounts, rdispls):
+    """Mirror alg.alltoallv_pairwise with frozen per-peer slices."""
+    n, r = comm.size, comm.rank
+    ps = _pin(sendbuf, writable=False)
+    pr = _pin(recvbuf, writable=True)
+    if ps is None or pr is None:
+        return None
+    spin, scount, sdt = ps
+    rpin, rcount, rdt = pr
+    sc = [int(c) for c in sendcounts]
+    sd = [int(d) for d in sdispls]
+    rc = [int(c) for c in recvcounts]
+    rd = [int(d) for d in rdispls]
+    se, re_ = sdt.size, rdt.size
+    if any((sd[i] + sc[i]) * se > spin.view.nbytes for i in range(n)) or \
+            any((rd[i] + rc[i]) * re_ > rpin.view.nbytes
+                for i in range(n)):
+        return None
+    b = _Builder()
+    if spin.pre:
+        b.do(spin.pre)
+    nb_own = sc[r] * se
+    if nb_own != rc[r] * re_:
+        return None
+    b.do(lambda _o=rpin.view, _s=spin.view, _so=sd[r] * se,
+         _do=rd[r] * re_, _nb=nb_own:
+         (_o.__setitem__(slice(_do, _do + _nb), _s[_so:_so + _nb]),
+          _sched.note_copied(_nb))[0])
+    for d in range(1, n):
+        dst, src = (r + d) % n, (r - d) % n
+        chunk = spin.view[sd[dst] * se:(sd[dst] + sc[dst]) * se]
+        nb_src = rc[src] * re_
+        b.rnd(sends=[(chunk, dst)],
+              recvs=[(nb_src, src,
+                      rpin.view[rd[src] * re_:rd[src] * re_ + nb_src])],
+              ordered=False)
+    if rpin.post:
+        b.do(rpin.post)
+    return b, "persist/pairwise"
+
+
+def _b_gather(comm, sendbuf, recvbuf, root):
+    return _gatherv_impl(comm, sendbuf, recvbuf, None, None, root)
+
+
+def _b_gatherv(comm, sendbuf, recvbuf, counts, displs, root):
+    return _gatherv_impl(comm, sendbuf, recvbuf, counts, displs, root)
+
+
+def _gatherv_impl(comm, sendbuf, recvbuf, counts, displs, root):
+    """Mirror alg.gather_linear / basic gatherv: non-roots send their
+    pinned block; the root fans n-1 direct recvs into frozen slices."""
+    n, r = comm.size, comm.rank
+    ps = _pin(sendbuf, writable=False)
+    if ps is None:
+        return None
+    spin, scount, sdt = ps
+    nb = scount * sdt.size
+    b = _Builder()
+    if r != root:
+        if spin.pre:
+            b.do(spin.pre)
+        b.rnd(sends=[(spin.view, root)])
+        return b, "persist/linear"
+    pr = _pin(recvbuf, writable=True)
+    if pr is None:
+        return None
+    rpin, rcount, rdt = pr
+    esz = rdt.size
+    if counts is None:
+        sizes = [nb] * n
+        offs = [i * nb for i in range(n)]
+    else:
+        counts = [int(c) for c in counts]
+        if displs is None:
+            displs = np.cumsum([0] + counts[:-1]).tolist()
+        sizes = [int(c) * esz for c in counts]
+        offs = [int(d) * esz for d in displs]
+    if any(offs[i] + sizes[i] > rpin.view.nbytes for i in range(n)) or \
+            sizes[root] != nb:
+        return None
+    if spin.pre:
+        b.do(spin.pre)
+    others = [i for i in range(n) if i != root]
+    b.rnd(recvs=[(sizes[i], i,
+                  rpin.view[offs[i]:offs[i] + sizes[i]])
+                 for i in others])
+    b.do(lambda _o=rpin.view, _s=spin.view, _off=offs[root], _nb=nb:
+         (_o.__setitem__(slice(_off, _off + _nb), _s),
+          _sched.note_copied(_nb))[0])
+    if rpin.post:
+        b.do(rpin.post)
+    return b, "persist/linear"
+
+
+def _b_scatter(comm, sendbuf, recvbuf, root):
+    return _scatterv_impl(comm, sendbuf, recvbuf, None, None, root)
+
+
+def _b_scatterv(comm, sendbuf, recvbuf, counts, displs, root):
+    return _scatterv_impl(comm, sendbuf, recvbuf, counts, displs, root)
+
+
+def _scatterv_impl(comm, sendbuf, recvbuf, counts, displs, root):
+    """Mirror alg.scatter_linear / basic scatterv: the root's one send
+    round of frozen slices; non-roots land direct."""
+    n, r = comm.size, comm.rank
+    pr = _pin(recvbuf, writable=True)
+    if pr is None:
+        return None
+    rpin, rcount, rdt = pr
+    nb = rcount * rdt.size
+    b = _Builder()
+    if r != root:
+        b.rnd(recvs=[(nb, root, rpin.view)])
+        if rpin.post:
+            b.do(rpin.post)
+        return b, "persist/linear"
+    ps = _pin(sendbuf, writable=False)
+    if ps is None:
+        return None
+    spin, scount, sdt = ps
+    esz = sdt.size
+    if counts is None:
+        sizes = [nb] * n
+        offs = [i * nb for i in range(n)]
+    else:
+        counts = [int(c) for c in counts]
+        if displs is None:
+            displs = np.cumsum([0] + counts[:-1]).tolist()
+        sizes = [int(c) * esz for c in counts]
+        offs = [int(d) * esz for d in displs]
+    if any(offs[i] + sizes[i] > spin.view.nbytes for i in range(n)) or \
+            sizes[root] != nb:
+        return None
+    if spin.pre:
+        b.do(spin.pre)
+    b.do(lambda _o=rpin.view, _s=spin.view, _off=offs[root], _nb=nb:
+         (_o.__setitem__(slice(None), _s[_off:_off + _nb]),
+          _sched.note_copied(_nb))[0])
+    sends = [(spin.view[offs[i]:offs[i] + sizes[i]], i)
+             for i in range(n) if i != root]
+    if sends:
+        b.rnd(sends=sends)
+    if rpin.post:
+        b.do(rpin.post)
+    return b, "persist/linear"
+
+
+def _b_reduce_scatter_block(comm, sendbuf, recvbuf, op):
+    """Mirror alg.reduce_scatter_block_sched: frozen reduce into a held
+    tmp at root 0 composed with a frozen scatter out of it."""
+    n, r = comm.size, comm.rank
+    pr = _pin(recvbuf, writable=True)
+    if pr is None:
+        return None
+    rpin, rcount, rdt = pr
+    if rdt.np_dtype is None:
+        return None
+    ps = _pin(recvbuf if sendbuf is None else sendbuf, writable=False)
+    if ps is None:
+        return None
+    spin, scount, sdt = ps
+    if scount != rcount * n:
+        return None
+    nb = rcount * rdt.size
+    b = _Builder()
+    # only the root folds into (and scatters out of) the staging
+    # buffer; a non-root holding an n*nb block would pin pool memory
+    # for the request's lifetime without ever touching it
+    tmp = b.block(n * nb) if r == 0 else None
+    _reduce_into(b, comm, spin, tmp, op, 0, scount, sdt)
+    if r == 0:
+        b.do(lambda _o=rpin.view, _t=tmp, _nb=nb:
+             (_o.__setitem__(slice(None), _t[:_nb]),
+              _sched.note_copied(_nb))[0])
+        sends = [(tmp[i * nb:(i + 1) * nb], i) for i in range(1, n)]
+        if sends:
+            b.rnd(sends=sends)
+    else:
+        b.rnd(recvs=[(nb, 0, rpin.view)])
+    if rpin.post:
+        b.do(rpin.post)
+    return b, "persist/reduce+scatter"
+
+
+def _b_scan(comm, sendbuf, recvbuf, op):
+    """Mirror alg.scan_linear: rank-ordered prefix chain."""
+    n, r = comm.size, comm.rank
+    pr = _pin(recvbuf, writable=True)
+    if pr is None:
+        return None
+    rpin, count, dt = pr
+    if dt.np_dtype is None:
+        return None
+    spin = rpin if sendbuf is None else (_pin(sendbuf, False) or
+                                         (None,))[0]
+    if spin is None:
+        return None
+    nbytes = count * dt.size
+    b = _Builder()
+    if spin.pre:
+        b.do(spin.pre)
+    acc = b.block(nbytes)
+    if r > 0:
+        stage = b.block(nbytes)
+        b.rnd(recvs=[(nbytes, r - 1, stage)])
+        b.do(lambda _a=acc, _g=stage, _s=spin.view, _op=op, _dt=dt:
+             _a.__setitem__(slice(None), _as_bytes(np.ascontiguousarray(
+                 _np_reduce_typed(_op, _typed_view(_g, _dt),
+                                  _typed_view(_s, _dt))))))
+    else:
+        b.do(lambda _a=acc, _s=spin.view:
+             _a.__setitem__(slice(None), _s))
+    if r < n - 1:
+        b.rnd(sends=[(acc, r + 1)])
+    b.do(lambda _r=rpin.view, _a=acc: _r.__setitem__(slice(None), _a))
+    if rpin.post:
+        b.do(rpin.post)
+    return b, "persist/linear"
+
+
+def _b_exscan(comm, sendbuf, recvbuf, op):
+    """Mirror alg.exscan_linear (recvbuf undefined at rank 0)."""
+    n, r = comm.size, comm.rank
+    pr = _pin(recvbuf, writable=True)
+    if pr is None:
+        return None
+    rpin, count, dt = pr
+    if dt.np_dtype is None:
+        return None
+    spin = rpin if sendbuf is None else (_pin(sendbuf, False) or
+                                         (None,))[0]
+    if spin is None:
+        return None
+    nbytes = count * dt.size
+    b = _Builder()
+    if spin.pre:
+        b.do(spin.pre)
+    stage = None
+    if r > 0:
+        stage = b.block(nbytes)
+        b.rnd(recvs=[(nbytes, r - 1, stage)])
+    if r < n - 1:
+        if r == 0:
+            b.rnd(sends=[(spin.view, r + 1)])
+        else:
+            nxt = b.block(nbytes)
+            b.do(lambda _x=nxt, _g=stage, _s=spin.view, _op=op, _dt=dt:
+                 _x.__setitem__(slice(None), _as_bytes(
+                     np.ascontiguousarray(_np_reduce_typed(
+                         _op, _typed_view(_g.copy(), _dt),
+                         _typed_view(_s, _dt))))))
+            b.rnd(sends=[(nxt, r + 1)])
+    if r > 0:
+        b.do(lambda _r=rpin.view, _g=stage:
+             _r.__setitem__(slice(None), _g))
+        if rpin.post:
+            b.do(rpin.post)
+    return b, "persist/linear"
+
+
+_BUILDERS = {
+    "ibarrier": _b_barrier,
+    "ibcast": _b_bcast,
+    "ireduce": _b_reduce,
+    "iallreduce": _b_allreduce,
+    "iallgather": _b_allgather,
+    "iallgatherv": _b_allgatherv,
+    "ialltoall": _b_alltoall,
+    "ialltoallv": _b_alltoallv,
+    "igather": _b_gather,
+    "igatherv": _b_gatherv,
+    "iscatter": _b_scatter,
+    "iscatterv": _b_scatterv,
+    "ireduce_scatter_block": _b_reduce_scatter_block,
+    "iscan": _b_scan,
+    "iexscan": _b_exscan,
+}

@@ -51,6 +51,7 @@ Datapath discipline (the PR 9 btl contract, extended up to this layer):
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import (Callable, Dict, Generator, List, Optional, Sequence,
                     Tuple)
@@ -115,6 +116,21 @@ register_pvar("coll_round", "windowed", lambda: _ctr["windowed"],
                    "inside the coll_round_window)")
 
 
+# coll/persist imports this module, so the replay-counter handle binds
+# lazily — a one-time memo, not a per-Start sys.modules lookup (Start
+# latency is the pvar the persistent A/B measures)
+_persist_mod = None
+
+
+def _persist():
+    global _persist_mod
+    if _persist_mod is None:
+        from ompi_tpu.coll import persist
+
+        _persist_mod = persist
+    return _persist_mod
+
+
 def copy_mode() -> bool:
     """True when the legacy (copying) round engine is armed — the
     algorithms branch to their verbatim pre-PR-10 staging on it."""
@@ -143,20 +159,30 @@ class Round:
     an ordered round's sends/recvs BEFORE draining the window (the
     recvs pre-post), so the ordered round's own payloads must not
     depend on any in-flight unordered result.
+    ``wait``   — (only meaningful with ``ordered=False``) the generator
+    resumes as soon as THIS round's own sends/recvs complete, WITHOUT
+    draining other in-flight unordered rounds: its own results are
+    guaranteed at resume, everything else keeps flying. This is the
+    cross-phase pipelining seam (coll/persist.py's chunked allreduce
+    issues chunk k+1's reduce-scatter rounds while chunk k's allgather
+    rounds are still in flight) — a full ``ordered`` barrier between
+    the phases would serialize exactly the overlap the chunking buys.
     ``free``   — previously-received pooled views the generator is done
     with: recycled immediately instead of at schedule end (the
     segmented-ring steady-state path)."""
 
-    __slots__ = ("sends", "recvs", "ordered", "free")
+    __slots__ = ("sends", "recvs", "ordered", "wait", "free")
 
     def __init__(self,
                  sends: Sequence[Tuple[np.ndarray, int]] = (),
                  recvs: Sequence[Tuple] = (),
                  ordered: bool = True,
+                 wait: bool = False,
                  free: Sequence[np.ndarray] = ()):
         self.sends = list(sends)
         self.recvs = list(recvs)
         self.ordered = ordered
+        self.wait = wait
         self.free = free
 
 
@@ -297,6 +323,13 @@ def run_blocking(comm, gen: Schedule, tag: int, cid: int) -> None:
                 while inflight:
                     retire(*inflight.popleft())
                 retire(reqs, post)
+            elif rnd.wait:
+                # self-wait: this round's own results gate the resume,
+                # earlier unordered rounds keep flying (the cross-phase
+                # pipelining contract)
+                if inflight:
+                    _bump("windowed")
+                retire(reqs, post)
             else:
                 _bump("windowed")
                 inflight.append((reqs, post))
@@ -348,6 +381,8 @@ class NbcRequest(Request):
         self._state = _RoundState()
         self._inflight = 0          # issued-but-unretired batches
         self._wait_batch = None     # ordered batch the generator awaits
+        self._wait_self = False     # Round.wait: resume on the batch's
+        #                             OWN retirement, not the window's
         self._park_bufs = None      # bufs pending a free window slot
         self._gen_done = False
         self._finishing = False
@@ -391,6 +426,7 @@ class NbcRequest(Request):
                                            self._cid, self._state)
             window = max(1, _window_var._value)
             ordered = rnd.ordered or window <= 1
+            wait_self = not ordered and rnd.wait
             if not reqs:
                 if ordered:
                     # a request-less ordered round is still a barrier
@@ -412,6 +448,7 @@ class NbcRequest(Request):
             for r in reqs:
                 r.add_completion_callback(
                     lambda r, b=batch: self._child_done(r, b))
+            overlapped = False
             with self._lock:
                 batch["n"] -= 1
                 done_now = batch["n"] == 0
@@ -430,11 +467,25 @@ class NbcRequest(Request):
                         self._wait_batch = batch
                         self._gen_running = False
                         return
+                elif wait_self:
+                    # Round.wait: this batch's own retirement gates the
+                    # resume; other in-flight batches keep flying (they
+                    # are the overlap the schedule asked for)
+                    overlapped = self._inflight > (0 if done_now else 1)
+                    if not done_now:
+                        self._wait_batch = batch
+                        self._wait_self = True
+                        self._gen_running = False
+                        if overlapped:
+                            # _ctr_lock is a leaf lock: safe under _lock
+                            _bump("windowed")
+                        return
                 elif not done_now and self._inflight >= window:
                     self._park_bufs = next_bufs
                     self._gen_running = False
                     return
-            if not ordered and not done_now:
+            if (not ordered and not wait_self and not done_now) or \
+                    (wait_self and overlapped):
                 _bump("windowed")
             bufs = next_bufs
 
@@ -460,9 +511,15 @@ class NbcRequest(Request):
                     self._finishing = True
                     finish = self._child_error
             elif self._wait_batch is not None:
-                if self._inflight == 0:
+                # ordered waits resume when the whole window drains; a
+                # Round.wait batch resumes on its OWN retirement (the
+                # just-retired batch is `batch`), leaving other rounds
+                # in flight
+                if self._inflight == 0 or \
+                        (self._wait_self and batch is self._wait_batch):
                     fire = self._wait_batch["bufs"]
                     self._wait_batch = None
+                    self._wait_self = False
                     self._gen_running = True
             elif self._park_bufs is not None and \
                     self._inflight < max(1, _window_var._value):
@@ -516,16 +573,21 @@ class PersistentCollRequest(Request):
     Reference: ompi/mca/coll/coll.h:545-620 declares the *_init third of the
     triple surface; libnbc builds the schedule at init and replays it per
     Start. Here ``issue`` is a thunk capturing the buffers/op/root that
-    builds and launches a fresh NbcRequest per Start — the generator *is*
-    the schedule, so replay == regenerate. Tag consistency across ranks
-    holds because MPI requires persistent starts (like every collective) to
-    be identically ordered on all members, so the per-comm NBC sequence
-    counter stays aligned."""
+    launches the activation: when the persistent-plan compiler
+    (coll/persist.py) froze the lowering at init, it replays the frozen
+    schedule; otherwise (``coll_persist_enable=0`` or an ineligible
+    shape) it rebuilds and launches a fresh NbcRequest per Start — the
+    pre-PR-11 re-issue path, kept verbatim as the A/B baseline. Tag
+    consistency across ranks holds because MPI requires persistent
+    starts (like every collective) to be identically ordered on all
+    members, so the per-comm NBC sequence counter stays aligned."""
 
-    def __init__(self, issue: Callable[[], Request]):
+    def __init__(self, issue: Callable[[], Request],
+                 name: str = "persistent collective"):
         super().__init__()
         self.persistent = True
         self._issue = issue
+        self._name = name
         # Active state is distinct from completion: the request stays
         # *active* from Start until Wait/Test collects it, even though the
         # inner schedule may have completed microseconds after Start (MPI
@@ -536,11 +598,15 @@ class PersistentCollRequest(Request):
 
     def Start(self) -> "PersistentCollRequest":
         if self._active:
-            raise MPIError(ERR_REQUEST,
-                           "persistent collective already active")
+            raise MPIError(
+                ERR_REQUEST,
+                f"Start on still-active {self._name}: the previous "
+                "activation must be completed by Wait/Test before a "
+                "restart (MPI 3.0 §3.9)")
         self._active = True
         self._complete.clear()
         self._error = 0
+        t0 = time.perf_counter()
         try:
             inner = self._issue()
         except BaseException:
@@ -550,6 +616,12 @@ class PersistentCollRequest(Request):
             self._active = False
             self._complete.set()
             raise
+        # the A/B denominator: Start-call latency (issue decisions +
+        # first-round launch) accumulated for BOTH the frozen-replay and
+        # re-issue paths, so the replay win is measured from pvars
+        p = _persist()
+        p._starts[0] += 1
+        p._replay_us[0] += (time.perf_counter() - t0) * 1e6
 
         def done(r):
             self.status = r.status
@@ -557,6 +629,17 @@ class PersistentCollRequest(Request):
 
         inner.add_completion_callback(done)
         return self
+
+    def Free(self) -> None:
+        """MPI_Request_free on an inactive persistent collective: retire
+        the frozen plan so its held pool blocks return to their free
+        lists (an active plan's are discarded — in-flight drains may
+        still land in its views). The comm's Free covers requests the
+        caller never frees."""
+        box = getattr(self, "_persist_box", None)
+        if box is not None and box[0] is not None:
+            box[0].retire()
+            box[0] = None
 
     def _finish(self, status) -> None:
         self._active = False
@@ -626,34 +709,60 @@ class MeshPersistentRequest(JaxRequest):
 
     The TPU-native reading of MPI-4 persistence: the setup that init
     amortizes is trace+compile — XlaComm's init methods run one warm-up
-    dispatch so every Start is a cached-executable dispatch only. jax
-    operands are immutable, so "re-reads the buffer at Start" becomes an
-    optional fresh operand argument (same shape/dtype/sharding triggers no
+    dispatch so every Start is a cached-executable dispatch only, and
+    (PR 11) pre-freeze the resolved fast-table executable into
+    ``dispatch`` so Start skips even the fast-dict lookup. jax operands
+    are immutable, so "re-reads the buffer at Start" becomes an optional
+    fresh operand argument (same shape/dtype/sharding triggers no
     retrace); omitted, the init-time operand is re-run. ``result`` holds
-    the latest Start's output once Wait/Test observes completion."""
+    the latest Start's output once Wait/Test observes completion.
 
-    def __init__(self, comm, dispatch, x):
+    ``donate`` (armed by ``coll_persist_donate``) is a second
+    executable compiled at init with the operand buffer DONATED to XLA:
+    a ``Start(x)`` with a fresh operand consumes ``x`` (its buffer is
+    reused for the output — the MPI-4 reading: the started buffer
+    belongs to the operation until completion). The init-time operand is
+    kept un-donated so operand-less restarts stay valid."""
+
+    def __init__(self, comm, dispatch, x, frozen: bool = False,
+                 donate=None):
         Request.__init__(self)
         self.persistent = True
         self._comm = comm
         self._dispatch = dispatch
         self._x = x
+        self._frozen = frozen
+        self._donate = donate
         self._active = False
         self.result = None
         self._complete.set()  # inactive == complete
 
     def Start(self, x=None):
         if self._active:
-            raise MPIError(ERR_REQUEST,
-                           "persistent collective already active")
+            raise MPIError(
+                ERR_REQUEST,
+                f"Start on still-active persistent mesh collective on "
+                f"{self._comm.name}: complete it with Wait/Test first")
         self._comm._check_usable()  # revoked comms must not dispatch
+        t0 = time.perf_counter()
         # dispatch before committing any state: a failed dispatch (bad
         # shape/sharding) must leave the request inactive with the
         # previous operand and result intact, not report stale data as
         # this Start's success
-        result = self._dispatch(self._x if x is None else x)
-        if x is not None:
-            self._x = x
+        if x is not None and self._donate is not None \
+                and x is not self._x:
+            # donated path: x is consumed; the init-time operand stays
+            # bound (and un-donated) for operand-less restarts — which
+            # is why passing the init operand itself routes to the
+            # un-donated dispatch below instead of deleting it
+            result = self._donate(x)
+        else:
+            result = self._dispatch(self._x if x is None else x)
+            if x is not None:
+                self._x = x
+        p = _persist()
+        p._starts[0] += 1
+        p._replay_us[0] += (time.perf_counter() - t0) * 1e6
         self._active = True
         self._complete.clear()
         self._error = 0

@@ -521,6 +521,23 @@ class ProcComm(Intracomm):
     def Ialltoall(self, sendbuf, recvbuf) -> Request:
         return self._coll("ialltoall")(self, sendbuf, recvbuf)
 
+    def Ialltoallv(self, sendbuf, recvbuf, sendcounts, sdispls,
+                   recvcounts, rdispls) -> Request:
+        return self._coll("ialltoallv")(self, sendbuf, recvbuf, sendcounts,
+                                        sdispls, recvcounts, rdispls)
+
+    def Igatherv(self, sendbuf, recvbuf, counts, displs=None,
+                 root: int = 0) -> Request:
+        self._check_root(root)
+        return self._coll("igatherv")(self, sendbuf, recvbuf, counts,
+                                      displs, root)
+
+    def Iscatterv(self, sendbuf, recvbuf, counts, displs=None,
+                  root: int = 0) -> Request:
+        self._check_root(root)
+        return self._coll("iscatterv")(self, sendbuf, recvbuf, counts,
+                                       displs, root)
+
     def Igather(self, sendbuf, recvbuf, root: int = 0) -> Request:
         self._check_root(root)
         return self._coll("igather")(self, sendbuf, recvbuf, root)
@@ -542,17 +559,27 @@ class ProcComm(Intracomm):
     # ------------------------------------------- persistent collectives
     # MPI-4's third of the coll triple surface (reference:
     # ompi/mca/coll/coll.h:545-620 *_init slots). Each init fixes the
-    # buffers/op/root and returns an inactive persistent request; every
-    # Start replays the schedule against the *current* buffer contents
-    # (the thunk rebuilds the round generator — see
-    # coll/sched.PersistentCollRequest).
+    # buffers/op/root, compiles the ENTIRE lowering into a frozen
+    # replayable plan (coll/persist.py: provider + algorithm decision,
+    # pre-built round schedule, pre-pinned views, pre-acquired pool
+    # blocks), and returns an inactive persistent request; every Start
+    # replays that schedule against the *current* buffer contents. With
+    # coll_persist_enable=0 — or for shapes the compiler declines —
+    # Start re-issues the nonblocking schedule per activation (the
+    # pre-PR-11 path, kept verbatim as the A/B baseline).
     def _pcoll(self, slot: str, *args) -> Request:
         from ompi_tpu.coll.sched import PersistentCollRequest
+        from ompi_tpu.coll import persist as _persist
 
         self._check_usable()
         issue = self.coll.get(slot)
+        box = [_persist.compile_plan(self, slot, args)
+               if _persist.enabled() else None]
 
         def start_issue():
+            if self.coll is None:  # freed comms must not replay
+                raise MPIError(ERR_COMM,
+                               "persistent Start on a freed communicator")
             self._check_usable()  # a revoked comm must fail at Start too
             spc.record(slot)      # each Start is one collective invocation
             if _metrics._enable_var._value:  # each Start enters the comm
@@ -560,9 +587,20 @@ class ProcComm(Intracomm):
             if _san._enable_var._value:  # every Start is one ordered call
                 _san.on_collective(self, slot,
                                    _san._signature(slot, args))
+            if _persist.enabled():
+                plan = box[0]
+                if plan is None or not _persist.valid(self, plan):
+                    if plan is not None:
+                        plan.retire()  # recycle an invalidated plan's blocks
+                    plan = box[0] = _persist.compile_plan(self, slot, args)
+                if plan.steps is not None:
+                    return _persist.start(self, plan)
             return issue(self, *args)
 
-        return PersistentCollRequest(start_issue)
+        req = PersistentCollRequest(
+            start_issue, name=f"persistent {slot[1:]} on {self.name}")
+        req._persist_box = box  # Request_free retires the frozen plan
+        return req
 
     def Barrier_init(self) -> Request:
         return self._pcoll("ibarrier")
@@ -590,13 +628,30 @@ class ProcComm(Intracomm):
     def Alltoall_init(self, sendbuf, recvbuf) -> Request:
         return self._pcoll("ialltoall", sendbuf, recvbuf)
 
+    def Alltoallv_init(self, sendbuf, recvbuf, sendcounts, sdispls,
+                       recvcounts, rdispls) -> Request:
+        return self._pcoll("ialltoallv", sendbuf, recvbuf, sendcounts,
+                           sdispls, recvcounts, rdispls)
+
     def Gather_init(self, sendbuf, recvbuf, root: int = 0) -> Request:
         self._check_root(root)
         return self._pcoll("igather", sendbuf, recvbuf, root)
 
+    def Gatherv_init(self, sendbuf, recvbuf, counts, displs=None,
+                     root: int = 0) -> Request:
+        self._check_root(root)
+        return self._pcoll("igatherv", sendbuf, recvbuf, counts, displs,
+                           root)
+
     def Scatter_init(self, sendbuf, recvbuf, root: int = 0) -> Request:
         self._check_root(root)
         return self._pcoll("iscatter", sendbuf, recvbuf, root)
+
+    def Scatterv_init(self, sendbuf, recvbuf, counts, displs=None,
+                      root: int = 0) -> Request:
+        self._check_root(root)
+        return self._pcoll("iscatterv", sendbuf, recvbuf, counts, displs,
+                           root)
 
     def Reduce_scatter_block_init(self, sendbuf, recvbuf,
                                   op: _op.Op = _op.SUM) -> Request:
@@ -665,6 +720,13 @@ class ProcComm(Intracomm):
         # decide-state reclaim rides it).
         _metrics._forget_cid(self.cid)
         self._plans.clear()  # frozen dispatch plans die with the comm
+        if getattr(self, "_persist_live", None):
+            # persistent plans pin pool blocks for the request lifetime;
+            # a freed comm returns them (or discards an active plan's —
+            # an in-flight drain may still land in its views)
+            from ompi_tpu.coll import persist as _persist
+
+            _persist.release_comm(self)
         self.coll = None
         self._freed = True
 

@@ -431,39 +431,96 @@ class XlaComm(Intracomm):
     # ------------------------------------ persistent collectives (X_init)
     # MPI-4's third of the triple surface, TPU-native: the setup that
     # persistence amortizes is trace+compile. init runs one warm-up
-    # dispatch (populating the per-comm jit cache), so every Start is a
-    # cached-executable dispatch; Wait blocks on device readiness.
-    # Reference: ompi/mca/coll/coll.h:545-620 *_init slots.
-    def _pcoll_init(self, verb: str, x, *args):
+    # dispatch (populating the per-comm jit cache) and PRE-FREEZES the
+    # resolved fast-table executable into the request (coll/persist's
+    # frozen-lowering discipline: Start skips even the fast-dict lookup
+    # and the dispatch decision tree — revocation stays checked). With
+    # coll_persist_donate=1, init also compiles a donated-operand
+    # executable so Start(x) lets XLA reuse x's buffer for the output
+    # (x is consumed). Reference: ompi/mca/coll/coll.h:545-620.
+    def _pcoll_init(self, verb: str, x, *args, fast_key=None):
         from ompi_tpu.coll.sched import MeshPersistentRequest
+        from ompi_tpu.coll import persist as _persist
 
         fn = getattr(self, verb)
         fn(x, *args)  # warm-up: trace+compile now, dispatch-only later
-        return MeshPersistentRequest(self, lambda op_x: fn(op_x, *args), x)
+        frozen = None
+        if fast_key is not None and _persist._enable_var._value:
+            # coll_persist_enable=0 keeps the pre-PR-11 per-Start verb
+            # dispatch verbatim — the same A/B contract as proc mode
+            frozen = self._fast.get(fast_key)
+        donate = None
+        if frozen is not None:
+            _persist._plans[0] += 1
+            # the frozen dispatch keeps the fast-path epilogue (_hot:
+            # SPC record + cache-hit count + comm.<verb> span) — a
+            # persistent Start is still one collective invocation
+            spc_name = ("reduce_scatter_block" if verb == "reduce_scatter"
+                        else verb)
+            dispatch = (lambda a, _f=frozen, _v=spc_name:
+                        self._hot(_v, _f, a))
+            if _persist._donate_var._value:
+                import jax
+                import jax.numpy as jnp
+
+                dexec = jax.jit(frozen, donate_argnums=0)
+                # warm the donated executable on a throwaway operand so
+                # the first Start(x) is dispatch-only (init owns the
+                # compile); the init-time x itself is never donated
+                dexec(jnp.zeros_like(x))
+                donate = (lambda a, _f=dexec, _v=spc_name:
+                          self._hot(_v, _f, a))
+        else:
+            dispatch = lambda op_x: fn(op_x, *args)  # noqa: E731
+        return MeshPersistentRequest(self, dispatch, x,
+                                     frozen=frozen is not None,
+                                     donate=donate)
+
+    @staticmethod
+    def _op_key(op: _op.Op):
+        # pair ops re-validate their layout per call on the fast path;
+        # a frozen executable would skip that check, so they keep the
+        # legacy per-Start dispatch
+        return None if op.is_pair else op.uid
 
     def allreduce_init(self, x, op: _op.Op = _op.SUM):
-        return self._pcoll_init("allreduce", x, op)
+        k = self._op_key(op)
+        return self._pcoll_init(
+            "allreduce", x, op,
+            fast_key=None if k is None else ("allreduce", k))
 
     def bcast_init(self, x, root: int = 0):
-        return self._pcoll_init("bcast", x, root)
+        return self._pcoll_init("bcast", x, root,
+                                fast_key=("bcast", root))
 
     def reduce_init(self, x, op: _op.Op = _op.SUM, root: int = 0):
-        return self._pcoll_init("reduce", x, op, root)
+        k = self._op_key(op)
+        return self._pcoll_init(
+            "reduce", x, op, root,
+            fast_key=None if k is None else ("reduce", k, root))
 
     def allgather_init(self, x):
-        return self._pcoll_init("allgather", x)
+        return self._pcoll_init("allgather", x, fast_key=("allgather",))
 
     def alltoall_init(self, x):
-        return self._pcoll_init("alltoall", x)
+        return self._pcoll_init("alltoall", x, fast_key=("alltoall",))
 
     def reduce_scatter_init(self, x, op: _op.Op = _op.SUM):
-        return self._pcoll_init("reduce_scatter", x, op)
+        k = self._op_key(op)
+        return self._pcoll_init(
+            "reduce_scatter", x, op,
+            fast_key=None if k is None else ("reduce_scatter", k))
 
     def scan_init(self, x, op: _op.Op = _op.SUM):
-        return self._pcoll_init("scan", x, op)
+        k = self._op_key(op)
+        return self._pcoll_init(
+            "scan", x, op, fast_key=None if k is None else ("scan", k))
 
     def exscan_init(self, x, op: _op.Op = _op.SUM):
-        return self._pcoll_init("exscan", x, op)
+        k = self._op_key(op)
+        return self._pcoll_init(
+            "exscan", x, op,
+            fast_key=None if k is None else ("exscan", k))
 
     Allreduce_init = allreduce_init
     Bcast_init = bcast_init

@@ -51,6 +51,7 @@ def _load_everything() -> None:
     import ompi_tpu.runtime.progress  # idle-block cvar + progress_idle_blocks pvar
     import ompi_tpu.runtime.mpool  # BufferPool mpool_pool_* pvars
     import ompi_tpu.coll.sched  # coll_round_* window/copy_mode cvars + datapath pvars
+    import ompi_tpu.coll.persist  # coll_persist_* cvars + persist_* replay pvars
 
 
 def print_header(out) -> None:

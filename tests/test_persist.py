@@ -1,0 +1,429 @@
+"""Persistent fused-collective pipelines (coll/persist.py, PR 11).
+
+Unit level: the fake loopback pml from the round-engine tests drives
+real frozen plans, the Round(wait=True) windowing mode, pin/eligibility
+rules, and the epoch invalidation seams. End-to-end bitwise A/B, the
+replay-overhead gate, chunk overlap, and the kill-mid-Start discard
+proof live in tests/procmode/check_persist.py; mesh-mode freezing is
+covered here on a virtual 8-device mesh.
+"""
+
+import threading
+from collections import deque
+
+import numpy as np
+import pytest
+
+import jax
+
+from ompi_tpu.coll import persist, sched
+from ompi_tpu.coll.hier import plan as _cplan
+from ompi_tpu.coll.sched import (
+    NbcRequest,
+    PersistentCollRequest,
+    Round,
+    run_blocking,
+)
+from ompi_tpu.core import op as mpi_op
+from ompi_tpu.core.datatype import FLOAT64
+from ompi_tpu.core.errors import MPIError
+from ompi_tpu.core.request import CompletedRequest, Request
+from ompi_tpu.mca.var import all_pvars, all_vars, set_var
+from ompi_tpu.parallel import mesh_world
+from tests.test_coll_round import _FakeComm, _Router
+from tests.test_process_mode import run_mpi
+
+TAG = -78
+CID = 9011
+
+FT = (("ft_enable", "1"),
+      ("ft_heartbeat_period", "0.25"),
+      ("ft_heartbeat_timeout", "4.0"),
+      ("ft_era_timeout", "60"),
+      ("coll_sm_enable", "0"))
+
+
+@pytest.fixture(scope="module")
+def world():
+    assert jax.device_count() >= 8
+    return mesh_world(jax.devices()[:8])
+
+
+@pytest.fixture(autouse=True)
+def _restore_cvars():
+    yield
+    set_var("coll_persist", "enable", 1)
+    set_var("coll_persist", "chunk_bytes", 262144)
+    set_var("coll_persist", "donate", 0)
+    set_var("coll_round", "window", 4)
+
+
+# ------------------------------------------------------------ procmode
+@pytest.mark.parametrize("np_", [2, 3])
+def test_persist_procmode(np_):
+    r = run_mpi(np_, "tests/procmode/check_persist.py", timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("PERSIST-OK") == np_
+    assert r.stdout.count("PERSIST-EQ") == np_
+    assert r.stdout.count("PERSIST-INVAL") == np_
+
+
+def test_persist_kill_discards_blocks():
+    """A peer death mid-Start fails the activation through the
+    watchdog path and discards (never recycles) the plan's blocks."""
+    r = run_mpi(3, "tests/procmode/check_persist.py", "kill",
+                timeout=150,
+                mca=FT + (("ft_inject_plan", "kill(2,after=60)"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("PERSIST-KILL-OK") == 2, r.stdout + r.stderr
+
+
+# ------------------------------------------------- Round.wait semantics
+def test_wait_round_resumes_without_draining_window():
+    """A Round(wait=True) resumes on its OWN completion while an
+    earlier unordered round is still in flight — the cross-phase
+    pipelining contract (run_blocking engine)."""
+    router = _Router()
+    c0 = _FakeComm(router, 0, 3)
+    c2 = _FakeComm(router, 2, 3)
+    seen = []
+    # pre-mail the wait round's payload (from rank 1) so its batch
+    # retires at issue time; rank 2 stays silent so round A pends
+    router.mail[(0, 1, TAG, CID)] = deque([bytes([7] * 32)])
+
+    def gen(comm):
+        yield Round(recvs=[(64, 2, np.zeros(64, np.uint8))],
+                    ordered=False)  # round A: pending
+        got = np.zeros(32, np.uint8)
+        yield Round(recvs=[(32, 1, got)], ordered=False, wait=True)
+        # resumed here with A's recv still posted
+        seen.append(("resumed", int(got[0]), router.posted(0)))
+        from ompi_tpu.core.datatype import BYTE
+
+        c2.pml.isend(np.full(64, 3, np.uint8), 64, BYTE, 0, TAG, CID)
+        yield Round()  # barrier: drains A (now satisfied)
+
+    run_blocking(c0, gen(c0), TAG, CID)
+    assert seen == [("resumed", 7, 1)], seen
+
+
+def test_wait_round_nbc_engine():
+    """Same contract through NbcRequest: the wait batch's own
+    retirement fires the resume even with another batch in flight."""
+    router = _Router()
+    c0 = _FakeComm(router, 0, 3)
+    c2 = _FakeComm(router, 2, 3)
+    order = []
+    nbcid = c0.cid | sched.NBC_CID_BIT
+    router.mail[(0, 1, 0, nbcid)] = deque([bytes([9] * 16)])
+
+    def gen(comm):
+        yield Round(recvs=[(16, 2, np.zeros(16, np.uint8))],
+                    ordered=False)  # pending: rank 2 is silent
+        got = np.zeros(16, np.uint8)
+        yield Round(recvs=[(16, 1, got)], ordered=False, wait=True)
+        order.append(int(got[0]))
+        yield Round()  # request-less barrier: drains the window
+
+    req = NbcRequest(c0, gen(c0))
+    assert order == [9]  # resumed synchronously off the mailed payload
+    assert not req.is_complete  # round 1 still in flight
+    from ompi_tpu.core.datatype import BYTE
+
+    c2.pml.isend(np.zeros(16, np.uint8), 16, BYTE, 0, 0, nbcid)
+    req.Wait()
+
+
+# ----------------------------------------------------- plan compilation
+def _self_comm():
+    return _FakeComm(_Router(), 0, 1)
+
+
+def test_frozen_plan_replays_on_single_rank():
+    comm = _self_comm()
+    x = np.arange(16, dtype=np.float64)
+    out = np.zeros(16)
+    plan = persist.compile_plan(comm, "iallreduce", (x, out, mpi_op.SUM))
+    assert plan.steps is not None
+    p0 = all_pvars()["persist_plans"].value
+    req = persist.start(comm, plan)
+    req.Wait()
+    np.testing.assert_array_equal(out, x)
+    # replay re-reads the (mutated) pinned buffer
+    x += 5
+    persist.start(comm, plan).Wait()
+    np.testing.assert_array_equal(out, x)
+    assert all_pvars()["persist_plans"].value == p0  # replay != rebuild
+
+
+def test_pin_rules():
+    comm = _self_comm()
+    # strided ndarray: unsupported repo-wide -> re-issue sentinel
+    base = np.zeros((8, 2))
+    plan = persist.compile_plan(
+        comm, "iallreduce", (base[:, 0], np.zeros(8), mpi_op.SUM))
+    assert plan.steps is None
+    # non-buffer object -> sentinel
+    plan = persist.compile_plan(
+        comm, "iallreduce", (object(), np.zeros(8), mpi_op.SUM))
+    assert plan.steps is None
+    # reductions on a derived datatype (np_dtype is None) stay on the
+    # re-issue path — symmetric: the dtype is the same on every rank
+    vec = FLOAT64.Create_vector(4, 1, 2).Commit()
+    src = np.zeros(8)
+    src[::2] = np.arange(4) + 1.0
+    out = np.zeros(8)
+    plan = persist.compile_plan(
+        comm, "iallreduce", ([src, 1, vec], [out, 1, vec], mpi_op.SUM))
+    assert plan.steps is None
+    # data movement over the same derived type takes the bounce pin:
+    # pack/unpack thunks per Start, schedule unchanged
+    plan = persist.compile_plan(
+        comm, "igather", ([src, 1, vec], [out, 1, vec], 0))
+    assert plan.steps is not None
+    persist.start(comm, plan).Wait()
+    np.testing.assert_array_equal(out[::2], src[::2])
+    assert out[1::2].sum() == 0  # gaps untouched
+
+
+def test_invalidation_epochs():
+    comm = _self_comm()
+    x = np.zeros(8)
+    plan = persist.compile_plan(comm, "iallreduce",
+                                (x, np.zeros(8), mpi_op.SUM))
+    assert persist.valid(comm, plan)
+    set_var("coll_persist", "chunk_bytes", 131072)
+    assert not persist.valid(comm, plan)  # watch_var bumped the epoch
+    plan2 = persist.compile_plan(comm, "iallreduce",
+                                 (x, np.zeros(8), mpi_op.SUM))
+    assert persist.valid(comm, plan2)
+    _cplan.invalidate_comm(comm)  # the decide.py re-score / Free seam
+    assert not persist.valid(comm, plan2)
+    # the PR 8 global dispatch epoch invalidates persist plans too
+    plan3 = persist.compile_plan(comm, "iallreduce",
+                                 (x, np.zeros(8), mpi_op.SUM))
+    _cplan.invalidate()
+    assert not persist.valid(comm, plan3)
+
+
+def test_retire_recycles_fail_discards():
+    comm = _FakeComm(_Router(), 0, 3)
+    n = 6144
+    x = np.arange(n, dtype=np.float64)
+    plan = persist.compile_plan(comm, "iallreduce",
+                                (x, np.zeros(n), mpi_op.SUM))
+    assert plan.steps is not None and plan.held
+    pool = plan.held[0][0]
+    with pool._plock:
+        free0 = len(pool._free)
+    plan.retire()
+    with pool._plock:
+        assert len(pool._free) >= free0 + 1  # recycled
+    plan2 = persist.compile_plan(comm, "iallreduce",
+                                 (x, np.zeros(n), mpi_op.SUM))
+    pool2 = plan2.held[0][0]
+    with pool2._plock:
+        free1 = len(pool2._free)
+    plan2.fail()
+    assert plan2.discarded and not plan2.held
+    with pool2._plock:
+        assert len(pool2._free) <= free1  # discarded, never recycled
+
+
+def test_gcd_plan_settles_pool_accounting():
+    """A request dropped without Free must not inflate the pool's
+    outstanding count forever: the GC finalizer parks the blocks and
+    the next compile/release settles them (discard, never recycle)."""
+    import gc
+
+    comm = _FakeComm(_Router(), 0, 3)
+    nelem = 6144
+    x = np.arange(nelem, dtype=np.float64)
+    plan = persist.compile_plan(comm, "iallreduce",
+                                (x, np.zeros(nelem), mpi_op.SUM))
+    pool = plan.held[0][0]
+    with pool._plock:
+        out_held = pool.outstanding
+        free0 = len(pool._free)
+    nblocks = len(plan.held)
+    del plan
+    gc.collect()
+    assert len(persist._orphans) >= nblocks  # parked, locks untouched
+    persist._settle_orphans()
+    with pool._plock:
+        assert pool.outstanding == out_held - nblocks
+        assert len(pool._free) == free0  # discarded, never recycled
+
+
+def test_request_free_retires_the_plan():
+    comm = _FakeComm(_Router(), 0, 3)
+    nelem = 6144
+    x = np.arange(nelem, dtype=np.float64)
+    plan = persist.compile_plan(comm, "iallreduce",
+                                (x, np.zeros(nelem), mpi_op.SUM))
+    assert plan.held
+    pool = plan.held[0][0]
+    with pool._plock:
+        free0 = len(pool._free)
+    req = PersistentCollRequest(lambda: CompletedRequest())
+    req._persist_box = [plan]
+    req.Free()
+    assert plan.dead
+    with pool._plock:
+        assert len(pool._free) >= free0 + 1  # inactive plan: recycled
+
+
+def test_double_start_names_the_request():
+    req = PersistentCollRequest(lambda: CompletedRequest(),
+                                name="persistent allreduce on world")
+    inner = [None]
+
+    def issue():
+        r = Request()
+        inner[0] = r
+        return r
+
+    req._issue = issue
+    req.Start()
+    with pytest.raises(MPIError, match="still-active.*allreduce"):
+        req.Start()
+    inner[0]._set_complete(0)
+    req.Wait()
+    req.Start()  # completed activation restarts cleanly
+    inner[0]._set_complete(0)
+    req.Wait()
+
+
+def test_chunked_plan_counts_overlap_statically():
+    comm = _FakeComm(_Router(), 0, 2)
+    n = 65536  # 512 KB f64
+    x = np.arange(n, dtype=np.float64)
+    set_var("coll_persist", "chunk_bytes", 65536)
+    plan = persist.compile_plan(comm, "iallreduce",
+                                (x, np.zeros(n), mpi_op.SUM))
+    assert plan.steps is not None
+    assert plan.overlap_rounds > 0
+    assert "pipelined" in plan.provider
+    set_var("coll_persist", "chunk_bytes", 0)
+    plan2 = persist.compile_plan(comm, "iallreduce",
+                                 (x, np.zeros(n), mpi_op.SUM))
+    assert plan2.overlap_rounds == 0 and plan2.provider == "persist/ring"
+
+
+def test_overlap_pvar_gated_on_effective_window():
+    """coll_round_window<=1 runs every wait round as a barrier — the
+    overlap pvar must stay flat for those activations."""
+    comm = _FakeComm(_Router(), 0, 2)
+    nelem = 65536
+    x = np.arange(nelem, dtype=np.float64)
+    set_var("coll_persist", "chunk_bytes", 65536)
+    plan = persist.compile_plan(comm, "iallreduce",
+                                (x, np.zeros(nelem), mpi_op.SUM))
+    assert plan.overlap_rounds > 0
+    set_var("coll_round", "window", 1)
+    o0 = all_pvars()["persist_overlap_rounds"].value
+    persist.start(comm, plan)  # parks on the first wait round (no peer)
+    assert all_pvars()["persist_overlap_rounds"].value == o0
+
+
+def test_reduce_scatter_block_stages_only_at_root():
+    """Non-root ranks must not pin the n*nb staging block for the
+    request's lifetime — only the root folds into it."""
+    # counts chosen so n*nb (16800 B) lands in the 32 KiB size class —
+    # test_coll_round's exact-accounting tests own the 4 KiB class
+    counts = 700
+    n = 3
+    for rank, expect in ((0, 4), (1, 1)):
+        # root: tmp + binomial acc + 2 child stages; leaf rank 1 (no
+        # children): its own acc only — no n*nb staging block
+        comm = _FakeComm(_Router(), rank, n)
+        plan = persist.compile_plan(
+            comm, "ireduce_scatter_block",
+            (np.zeros(n * counts), np.zeros(counts), mpi_op.SUM))
+        assert plan.steps is not None
+        assert len(plan.held) == expect, (rank, plan.held)
+        plan.retire()
+
+
+# --------------------------------------------------------- registration
+def test_cvars_pvars_registered():
+    v = all_vars()
+    for name in ("coll_persist_enable", "coll_persist_chunk_bytes",
+                 "coll_persist_donate"):
+        assert name in v, name
+    pv = all_pvars()
+    for name in ("persist_plans", "persist_starts", "persist_replay_us",
+                 "persist_overlap_rounds"):
+        assert name in pv, name
+
+
+def test_info_cli_loads_persist_vars(capsys):
+    from ompi_tpu.tools.info import main as info_main
+
+    assert info_main(["--level", "9", "--param", "coll_persist"]) == 0
+    out = capsys.readouterr().out
+    assert "coll_persist_enable" in out
+    assert "coll_persist_chunk_bytes" in out
+
+
+def test_mpilint_covers_persist_hooks():
+    from ompi_tpu.analysis import lint
+
+    assert "coll/persist.py" in lint.INSTR_IMPL
+    assert "_persist" in lint.PERSIST_ALIASES
+    got = lint.lint_source(
+        "from ompi_tpu.coll import persist as _persist\n"
+        "def isend(self, dst):\n"
+        "    _persist.note_start(1.0)\n"
+        "    return self._isend(dst)\n",
+        "ompi_tpu/pml/ob1.py")
+    assert any(f.rule == "hot-guard" for f in got)
+
+
+# ----------------------------------------------------------- mesh mode
+def _ranked(k=0):
+    base = np.arange(4, dtype=np.float32) + k
+    return np.stack([base + r for r in range(8)])
+
+
+def test_mesh_init_freezes_executable(world):
+    req = world.allreduce_init(world.shard(_ranked()))
+    assert req.persistent and req._frozen
+    for k in (0, 5):
+        req.Start(world.shard(_ranked(k)))
+        req.Wait()
+        np.testing.assert_allclose(np.asarray(req.result),
+                                   np.stack([_ranked(k).sum(0)] * 8))
+
+
+def test_mesh_init_respects_enable_0(world):
+    set_var("coll_persist", "enable", 0)
+    req = world.allgather_init(world.shard(_ranked(3)))
+    assert not req._frozen  # the pre-PR-11 per-Start dispatch, verbatim
+    req.Start()
+    req.Wait()
+    np.testing.assert_allclose(np.asarray(req.result)[0], _ranked(3))
+
+
+def test_mesh_donated_start_consumes_operand(world):
+    set_var("coll_persist", "donate", 1)
+    x0 = world.shard(_ranked(1))
+    req = world.allreduce_init(x0)
+    assert req._donate is not None
+    fresh = world.shard(_ranked(4))
+    req.Start(fresh)
+    req.Wait()
+    np.testing.assert_allclose(np.asarray(req.result),
+                               np.stack([_ranked(4).sum(0)] * 8))
+    assert fresh.is_deleted()  # donated: XLA reused the buffer
+    req.Start()  # operand-less restart re-runs the UN-donated init x
+    req.Wait()
+    np.testing.assert_allclose(np.asarray(req.result),
+                               np.stack([_ranked(1).sum(0)] * 8))
+    req.Start(x0)  # passing the init operand itself must NOT donate it
+    req.Wait()
+    assert not x0.is_deleted()
+    req.Start()
+    req.Wait()
+    np.testing.assert_allclose(np.asarray(req.result),
+                               np.stack([_ranked(1).sum(0)] * 8))
