@@ -921,6 +921,53 @@ def bench_persistent():
     return out
 
 
+def bench_qos():
+    """Priority-aware traffic shaping A/B: foreground 4KB-allreduce
+    p99 under a 64MB background replication storm, legacy FIFO
+    (btl_tcp_shape_enable=0, verbatim) vs the class-based
+    weighted-deficit scheduler — measured by
+    tests/procmode/check_qos.py from the metrics-plane histogram, with
+    bitwise equality and bulk completion gated inside the check (the
+    ratio itself is retried stripe-style there, MIN-allreduced across
+    ranks). Gauges mirror into the metrics registry so the BENCH json
+    and the Prometheus export agree."""
+    import os
+    import re
+    import subprocess
+
+    from ompi_tpu.runtime import metrics
+
+    env = _procmode_env()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "3",
+             "--mca", "metrics_enable", "1", "--mca", "btl_btl", "^sm",
+             "--mca", "btl_tcp_sndbuf", str(256 << 10),
+             "--mca", "btl_tcp_rcvbuf", str(256 << 10),
+             "tests/procmode/check_qos.py"],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except Exception as e:  # pragma: no cover
+        return {"error": str(e)[:300]}
+    m = re.search(r"QOS-P99 rank 0 off=([0-9.]+)us on=([0-9.]+)us "
+                  r"ratio=([0-9.]+)", r.stdout)
+    if not m or "QOS-OK" not in r.stdout:
+        return {"error": r.stdout[-300:] + r.stderr[-300:]}
+    out = {
+        "fg_p99_us": {"fifo": float(m.group(1)),
+                      "shaped": float(m.group(2)),
+                      "ratio": float(m.group(3))},
+        "bulk_completed_ranks": r.stdout.count("QOS-BULK"),
+        "bitwise_equal_ranks": r.stdout.count("QOS-EQ"),
+        "persist_chaos_equal_ranks": r.stdout.count("QOS-PERSIST-EQ"),
+    }
+    for mode in ("fifo", "shaped"):
+        metrics.gauge_set("bench_qos_fg_p99_us", out["fg_p99_us"][mode],
+                          mode=mode)
+    metrics.gauge_set("bench_qos_p99_ratio", out["fg_p99_us"]["ratio"])
+    return out
+
+
 def bench_host_paths():
     """Process-mode fast paths vs their frame-based fallbacks: coll/sm
     segment collectives (xhc analog) and the zero-copy shared-segment
@@ -1016,6 +1063,7 @@ def main() -> int:
     detail["p2p"] = bench_p2p()
     detail["coll_datapath"] = bench_coll_datapath()
     detail["persistent"] = bench_persistent()
+    detail["qos"] = bench_qos()
     detail["host_paths"] = bench_host_paths()
     detail["model_step"] = bench_mfu()
 

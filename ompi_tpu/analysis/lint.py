@@ -15,8 +15,9 @@ hot-guard                 in hot modules (parallel/mesh.py, pml/ob1.py,
                           ft/inject.py chaos hook, ft/diskless.py
                           replication hook, reshard/ accounting
                           hook, quant/ codec-accounting hook,
-                          coll/hier note_* observability hook, and
-                          coll/persist replay-accounting hook
+                          coll/hier note_* observability hook,
+                          coll/persist replay-accounting hook, and
+                          qos.py traffic-classification hook
                           (framework code allowed on
                           the wire path) — sits behind a live-Var
                           guard: ``X.enabled()`` / ``X._enable_var._value`` (or
@@ -133,7 +134,13 @@ INSTR_IMPL = ("runtime/trace.py", "runtime/sanitizer.py", "runtime/spc.py",
               "coll/sched.py",
               # the persistent-plan compiler owns the persist note_*
               # hooks and the replay counters (PR 11)
-              "coll/persist.py")
+              "coll/persist.py",
+              # the QoS module owns the classification hooks and the
+              # stamped-by-class counters; the shaped tcp send path is
+              # instrumentation-bearing framework code (per-class
+              # deferral observations, preemption counters) riding the
+              # same guard discipline
+              "qos.py", "btl/tcp.py")
 
 TRACE_ALIASES = {"trace", "_trace", "_tr"}
 SAN_ALIASES = {"sanitizer", "_san", "_sanitizer"}
@@ -160,6 +167,10 @@ HIER_ALIASES = {"hier", "_hier"}
 # Start/replay-latency notes, overlap-round counts): same contract in
 # hot modules — the steady-state replay path bumps list slots inline
 PERSIST_ALIASES = {"persist", "_persist"}
+# qos.py traffic-classification hooks: the per-send class decision and
+# the segmentation/reassembly counters run on the pml send path and
+# must sit behind the btl_tcp_shape_enable live Var
+QOS_ALIASES = {"qos", "_qos"}
 INSTR_TRACE_ATTRS = {"span", "record_span", "instant", "counter",
                      "wrap_span"}
 INSTR_SAN_ATTRS = {"wrap_coll", "on_collective", "check_p2p",
@@ -173,6 +184,7 @@ INSTR_QUANT_ATTRS = {"note_coll", "note_wire"}
 INSTR_HIER_ATTRS = {"note_stage", "note_plan_hit", "note_plan_miss",
                     "note_retune"}
 INSTR_PERSIST_ATTRS = {"note_plan", "note_start", "note_overlap"}
+INSTR_QOS_ATTRS = {"classify", "note_segments", "note_reassembled"}
 
 _SUPPRESS_RE = re.compile(r"#\s*mpilint:\s*disable=([A-Za-z0-9_,\- ]+)")
 
@@ -291,6 +303,9 @@ def _instr_call(node: ast.AST) -> Optional[str]:
             if v.id in PERSIST_ALIASES and \
                     node.func.attr in INSTR_PERSIST_ATTRS:
                 return "persist"
+            if v.id in QOS_ALIASES and \
+                    node.func.attr in INSTR_QOS_ATTRS:
+                return "qos"
     return None
 
 
@@ -759,6 +774,7 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
 # --self-test` lints each and verifies its rule fires.
 SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
     "hot-guard": ("ompi_tpu/pml/ob1.py", """
+from ompi_tpu import qos as _qos
 from ompi_tpu import quant as _quant
 from ompi_tpu.coll import hier as _hier
 from ompi_tpu.coll import persist as _persist
@@ -776,6 +792,7 @@ def isend(self, dst):
     _quant.note_wire(4096, 512)
     _hier.note_stage("allreduce", "cross", 1.0)
     _persist.note_start(1.0)
+    _qos.classify(0, 0)
     with _trace.span("pml.send", cat="pml"):
         return self._isend(dst)
 """),

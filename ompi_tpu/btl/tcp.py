@@ -27,6 +27,21 @@ copies are measured, not estimated: ``btl_tcp_bytes_copied`` /
 ``btl_tcp_copy_mode=1`` re-materializes the legacy copies so bench can
 A/B the tax in one process.
 
+Priority-aware traffic shaping (``btl_tcp_shape_enable``): each
+connection's send backlog becomes three QoS-class sub-queues
+(LATENCY / NORMAL / BULK, read from bits 6-7 of the pml kind byte —
+see ompi_tpu/qos.py) drained by a weighted-deficit scheduler with a
+starvation bound (``btl_tcp_shape_max_defer_bytes``), so a background
+checkpoint blob can no longer head-of-line-block a 4KB allreduce for
+its full serialization time. FIFO still holds WITHIN a class (the
+pml's per-(peer, class) sequence planes depend on it); preemption
+happens between frames — the pml segments oversized blobs into
+sub-frames so the yield granularity is ``btl_tcp_shape_segment_bytes``.
+The legacy single-FIFO drain stays verbatim behind shape_enable=0 (the
+A/B baseline), and the win is measured from the ``btl_tcp_shape_*``
+pvars (queued-bytes-by-class gauges, preemption counts) plus the
+metrics-plane per-class deferral histogram.
+
 On-wire compression (``btl_tcp_compress`` = zlib level 1-9, 0 = off):
 large rendezvous payloads (>= ``btl_tcp_compress_min_bytes``) go out
 zlib-deflated with the top bit of the length word flagging the frame;
@@ -59,11 +74,14 @@ import zlib
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ompi_tpu import qos as _qos
 from ompi_tpu.btl.base import Btl, btl_framework
 from ompi_tpu.ft import inject as _inject
 from ompi_tpu.mca.component import Component
-from ompi_tpu.mca.var import register_var, register_pvar, get_var
-from ompi_tpu.pml.base import HDR_SIZE
+from ompi_tpu.mca.var import (register_var, register_pvar, get_var,
+                              watch_var)
+from ompi_tpu.pml.base import HDR_SIZE, QOS_SHIFT
+from ompi_tpu.runtime import metrics as _metrics
 from ompi_tpu.runtime import mpool as _mpool
 from ompi_tpu.utils.output import get_logger
 
@@ -116,6 +134,131 @@ _copy_mode_var = register_var(
          "section — the copies feed btl_tcp_bytes_copied either way, "
          "so copies-per-wire-byte is measured, not estimated", level=9)
 
+# ------------------------------------------------- priority traffic shaping
+# btl_tcp_shape_enable / shape_segment_bytes live in ompi_tpu/qos.py
+# (the pml shares them: it stamps the class and segments system blobs);
+# the scheduler knobs below are this transport's own.
+_quantum_var = register_var(
+    "btl_tcp", "shape_quantum_bytes", 1 << 16,
+    help="Base quantum of the weighted-deficit drain: each scheduling "
+         "round grants every backlogged class quantum * weight bytes "
+         "of deficit; a class sends while its deficit covers its head "
+         "frame. Smaller = tighter interleave, more scheduling work "
+         "per byte", level=6)
+_weights_var = register_var(
+    "btl_tcp", "shape_weights", "8,4,1", typ=str,
+    help="Deficit weights 'latency,normal,bulk' for the shaped drain "
+         "(floor 1 each): the steady-state wire-byte ratio between "
+         "backlogged classes", level=6)
+_max_defer_var = register_var(
+    "btl_tcp", "shape_max_defer_bytes", 4 << 20,
+    help="Starvation bound: once other classes have sent this many "
+         "bytes past a backlogged class's head frame, that class is "
+         "served next regardless of deficit — BULK always progresses. "
+         "0 disables the bound (pure weighted-deficit)", level=6)
+_sndbuf_var = register_var(
+    "btl_tcp", "sndbuf", 0,
+    help="SO_SNDBUF for every tcp connection (reference: "
+         "btl_tcp_sndbuf); 0 (default) = kernel default/autotuning. "
+         "Bytes the kernel has accepted are beyond any send "
+         "scheduler's reach, so with traffic shaping a bounded send "
+         "buffer keeps scheduling authority at the btl's per-class "
+         "queues instead of a deep autotuned kernel backlog", level=5)
+_rcvbuf_var = register_var(
+    "btl_tcp", "rcvbuf", 0,
+    help="SO_RCVBUF for every tcp connection, applied before "
+         "connect/listen so the TCP window scale reflects it "
+         "(reference: btl_tcp_rcvbuf); 0 (default) = kernel default. "
+         "Together with btl_tcp_sndbuf this bounds per-connection "
+         "in-flight bytes — the A/B harness uses it to pin a "
+         "deterministic wire bandwidth on loopback", level=5)
+
+# shaped-path counters + live queued-bytes-by-class gauges (plain int
+# bumps like _ctr; the by-class gauges take _qlock because different
+# conns bump them under different wlocks)
+_shape_ctr = {"preempt": 0, "enqueued": 0}
+_qbytes = [0, 0, 0]   # queued bytes by class (qos.NORMAL/LATENCY/BULK)
+_qpeak = [0, 0, 0]
+_qlock = threading.Lock()
+
+register_pvar("btl_tcp", "shape_queued_normal",
+              lambda: _qbytes[_qos.NORMAL],
+              help="Bytes currently queued in NORMAL-class send "
+                   "sub-queues across all shaped connections")
+register_pvar("btl_tcp", "shape_queued_latency",
+              lambda: _qbytes[_qos.LATENCY],
+              help="Bytes currently queued in LATENCY-class send "
+                   "sub-queues across all shaped connections")
+register_pvar("btl_tcp", "shape_queued_bulk",
+              lambda: _qbytes[_qos.BULK],
+              help="Bytes currently queued in BULK-class send "
+                   "sub-queues across all shaped connections")
+register_pvar("btl_tcp", "shape_peak_queued_normal",
+              lambda: _qpeak[_qos.NORMAL],
+              help="High-water mark of NORMAL-class queued bytes")
+register_pvar("btl_tcp", "shape_peak_queued_latency",
+              lambda: _qpeak[_qos.LATENCY],
+              help="High-water mark of LATENCY-class queued bytes")
+register_pvar("btl_tcp", "shape_peak_queued_bulk",
+              lambda: _qpeak[_qos.BULK],
+              help="High-water mark of BULK-class queued bytes")
+register_pvar("btl_tcp", "shape_preemptions",
+              lambda: _shape_ctr["preempt"],
+              help="Frames the shaped drain served ahead of an "
+                   "earlier-enqueued frame of another class (the "
+                   "out-of-FIFO services the per-class scheduler "
+                   "exists to make)")
+register_pvar("btl_tcp", "shape_enqueued",
+              lambda: _shape_ctr["enqueued"],
+              help="Frames that took the shaped (backlogged) queue "
+                   "path instead of the zero-copy direct send")
+
+# mpitop/promexport read the by-class queue gauges as one sampler row
+def register_shape_sampler() -> None:
+    """(Re)bind the by-class queue sampler into the metrics registry —
+    called at import; tests that reset the registry re-call it."""
+    _metrics.register_sampler(
+        "btl_tcp_shape_queued_bytes_by_class",
+        lambda: {"latency": _qbytes[_qos.LATENCY],
+                 "normal": _qbytes[_qos.NORMAL],
+                 "bulk": _qbytes[_qos.BULK],
+                 "peak_latency": _qpeak[_qos.LATENCY],
+                 "peak_normal": _qpeak[_qos.NORMAL],
+                 "peak_bulk": _qpeak[_qos.BULK]})
+
+
+register_shape_sampler()
+
+# strict-priority service preference inside one deficit round
+_SERVICE_ORDER = (_qos.LATENCY, _qos.NORMAL, _qos.BULK)
+
+_weights_memo: Optional[List[int]] = None
+
+
+def _parse_weights(_var=None) -> None:
+    global _weights_memo
+    _weights_memo = None
+
+
+watch_var("btl_tcp", "shape_weights", _parse_weights)
+
+
+def _weights() -> List[int]:
+    """[w_by_class_int]: cvar order is latency,normal,bulk; class ints
+    are NORMAL=0/LATENCY=1/BULK=2. Floor 1 so every class drains."""
+    global _weights_memo
+    w = _weights_memo
+    if w is None:
+        parts = str(_weights_var._value).split(",")
+        try:
+            lat, norm, bulk = (max(int(p), 1) for p in parts[:3])
+        except (ValueError, TypeError):
+            lat, norm, bulk = 8, 4, 1
+        w = [1, 1, 1]
+        w[_qos.LATENCY], w[_qos.NORMAL], w[_qos.BULK] = lat, norm, bulk
+        _weights_memo = w
+    return w
+
 # datapath counters (plain int bumps — no instrumentation framework on
 # the per-frame path), exported as pvars below
 _ctr = {"copied": 0, "writev": 0, "wire": 0}
@@ -142,14 +285,25 @@ _LEN = struct.Struct("<I")
 _RX_BLOCK = (1 << 20) + (1 << 12)
 _rx_pool = _mpool.BufferPool(_RX_BLOCK)
 
-# rank-handshake capability bit + frame compression flag: both ride the
-# top bit of their u32 word (ranks and frame lengths stay < 2^31)
+# rank-handshake capability bits + frame compression flag: compression
+# rides the top bit of its u32 word (ranks and frame lengths stay
+# < 2^30); the QoS bit advertises "my pml masks class bits from the
+# kind byte and keys its sequence planes per (peer, class)" — every
+# build with this code does, so like the compress bit it is advertised
+# unconditionally and acked unconditionally. Shaping toward a peer
+# that never acks (an older build) is documented-unsupported: its pml
+# would reject class-stamped kind bytes, exactly like dialing a
+# pre-compress acceptor.
 _CAP_COMPRESS = 1 << 31
+_CAP_QOS = 1 << 30
 _ZFLAG = 1 << 31
 _LEN_MASK = _ZFLAG - 1
 # acceptor's handshake ack: magic in the high byte + capability bits
 _ZACK_MAGIC = 0x5A << 24
 _ZACK_ACCEPT = 1
+_ZACK_QOS = 2
+_ZACK_WORDS = frozenset(
+    _ZACK_MAGIC | a | q for a in (0, _ZACK_ACCEPT) for q in (0, _ZACK_QOS))
 
 
 def _compress_counters():
@@ -171,9 +325,26 @@ register_pvar("btl_tcp", "compress_saved_bytes",
               help="Payload bytes kept off the wire by tcp compression")
 
 
+def _apply_bufs(sock: socket.socket) -> None:
+    """SO_SNDBUF/SO_RCVBUF bounds (btl_tcp_sndbuf/rcvbuf, 0 = kernel
+    default) — called before connect/listen so TCP window scaling
+    honors them."""
+    snd = int(_sndbuf_var._value)
+    rcv = int(_rcvbuf_var._value)
+    try:
+        if snd > 0:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, snd)
+        if rcv > 0:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcv)
+    except OSError:
+        pass
+
+
 class _Conn:
     __slots__ = ("sock", "rxb", "rstart", "rend", "wq", "wbuf", "rbuf",
-                 "wlock", "peer", "dead", "peer_z", "await_ack")
+                 "wlock", "peer", "dead", "peer_z", "await_ack",
+                 "wqs", "cur", "cur_cls", "deficit", "defer", "peer_q",
+                 "eseq")
 
     def __init__(self, sock: socket.socket, peer: Optional[int] = None):
         self.sock = sock
@@ -207,6 +378,22 @@ class _Conn:
         # deadlock two polling-only ranks dialing each other — each
         # stuck in its own handshake, neither accepting)
         self.await_ack = False
+        # traffic shaping (btl_tcp_shape_enable): per-class send
+        # sub-queues of (enqueue seq, nbytes, owned vec list, enq ts),
+        # allocated lazily so unshaped conns pay one None slot; `cur`
+        # is the partially-written frame that must finish before the
+        # scheduler may switch class (TCP frames are contiguous on the
+        # wire — preemption happens BETWEEN frames, which is why
+        # oversized blobs are segmented upstream)
+        self.wqs: Optional[tuple] = None
+        self.cur: Optional[list] = None
+        self.cur_cls = 0
+        self.deficit = [0, 0, 0]
+        self.defer = [0, 0, 0]
+        # negotiated at handshake: peer masks QoS class bits and keys
+        # its seq planes per class (every build with this code)
+        self.peer_q = False
+        self.eseq = 0
 
 
 class TcpBtl(Btl):
@@ -239,6 +426,9 @@ class TcpBtl(Btl):
             host = best_local_addr() or "127.0.0.1"
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # buffer bounds inherit to accepted sockets; RCVBUF must be
+        # set before listen so the window scale factor reflects it
+        _apply_bufs(self.listener)
         self.listener.bind((bind, 0))
         self.listener.listen(64)
         self.listener.setblocking(False)
@@ -288,9 +478,20 @@ class TcpBtl(Btl):
         while True:
             left = deadline - time.monotonic()
             try:
-                s = socket.create_connection(
-                    (host, int(port)), timeout=max(min(10.0, left), 1.0),
-                    source_address=(src, 0) if src else None)
+                # manual socket (vs create_connection) so the
+                # btl_tcp_sndbuf/rcvbuf bounds are applied BEFORE the
+                # handshake — the window scale is negotiated at SYN
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                try:
+                    _apply_bufs(s)
+                    s.settimeout(max(min(10.0, left), 1.0))
+                    if src:
+                        s.bind((src, 0))
+                    s.connect((host, int(port)))
+                except BaseException:
+                    s.close()  # a failed attempt must not leak the fd
+                    raise
+                s.settimeout(None)
                 break
             except OSError as e:
                 left = deadline - time.monotonic()
@@ -322,8 +523,10 @@ class TcpBtl(Btl):
         # ack word, consumed asynchronously by _drain — sends stay
         # uncompressed on this link until it lands, so a peer that never
         # acks (a build without this framing) simply keeps the link at
-        # plain framing.
-        s.sendall(_LEN.pack(self.my_rank | _CAP_COMPRESS))
+        # plain framing. The QoS capability bit rides along identically
+        # (shaped per-class scheduling engages only after the peer acks
+        # it — frames sent before the ack drain FIFO).
+        s.sendall(_LEN.pack(self.my_rank | _CAP_COMPRESS | _CAP_QOS))
         conn.await_ack = True
         s.setblocking(False)
         with self._sel_lock:
@@ -426,29 +629,40 @@ class TcpBtl(Btl):
             if _copy_mode_var._value:
                 self._send_legacy(conn, lenw, header, mv, dup)
                 return
-            if conn.wbuf:
-                # legacy residue after a copy_mode flip: older frames
-                # must hit the wire first
-                conn.wq.append(bytes(conn.wbuf))
-                conn.wbuf.clear()
-            backlog = bool(conn.wq)
-            if not backlog:
-                # fast path: push straight from the caller's buffer
-                vecs = self._try_send(conn, vecs)
-                if not vecs:
-                    return  # fully on the wire (or conn failed): 0 copies
-            # backpressure: own the unsent remainder — the ONE copy the
-            # zero-copy path ever pays, and only for bytes the kernel
-            # would not take now
-            for v in vecs:
-                if isinstance(v, memoryview):
-                    _ctr["copied"] += len(v)
-                    v = bytes(v)
-                conn.wq.append(v)
-            if backlog:
-                self._flush_locked(conn)
+            if _qos._enable_var._value and conn.peer_q:
+                # shaped path: per-class sub-queues drained by the
+                # weighted-deficit scheduler (poke below still runs —
+                # a backlog may have been queued)
+                self._send_shaped(conn, vecs, header[0] >> QOS_SHIFT)
             else:
-                self._want_write(conn, True)
+                if conn.cur is not None or \
+                        (conn.wqs is not None and any(conn.wqs)):
+                    # shaped residue after a shape_enable flip: older
+                    # frames must hit the wire first
+                    self._fold_shaped_residue(conn)
+                if conn.wbuf:
+                    # legacy residue after a copy_mode flip: older
+                    # frames must hit the wire first
+                    conn.wq.append(bytes(conn.wbuf))
+                    conn.wbuf.clear()
+                backlog = bool(conn.wq)
+                if not backlog:
+                    # fast path: push straight from the caller's buffer
+                    vecs = self._try_send(conn, vecs)
+                    if not vecs:
+                        return  # fully on the wire (or conn failed): 0 copies
+                # backpressure: own the unsent remainder — the ONE copy
+                # the zero-copy path ever pays, and only for bytes the
+                # kernel would not take now
+                for v in vecs:
+                    if isinstance(v, memoryview):
+                        _ctr["copied"] += len(v)
+                        v = bytes(v)
+                    conn.wq.append(v)
+                if backlog:
+                    self._flush_locked(conn)
+                else:
+                    self._want_write(conn, True)
         # a backlog was (or may still be) queued: wake a progress loop
         # parked in the idle select so the flush doesn't wait out the
         # park interval — the park's write-fd list was computed before
@@ -471,6 +685,13 @@ class TcpBtl(Btl):
         copies feed btl_tcp_bytes_copied so copies-per-wire-byte is
         MEASURED on the real legacy code, not modeled. Caller holds
         conn.wlock and has done the dead-check."""
+        if conn.cur is not None or \
+                (conn.wqs is not None and any(conn.wqs)):
+            # shaped residue after a copy_mode flip: a partially-written
+            # shaped frame MUST finish (and older shaped frames must
+            # drain) before legacy bytes hit the wire, or the stream
+            # desyncs / same-class frames overtake their seqs
+            self._fold_shaped_residue(conn)
         payload = bytes(mv)  # the old eager copy (pre-PR tcp.py:277)  # mpilint: disable=hot-copy — legacy A/B path reproduces the old copies on purpose
         frame = lenw + header + payload
         _ctr["copied"] += len(payload) + len(frame)
@@ -486,6 +707,10 @@ class TcpBtl(Btl):
         """The pre-vectored flush: byte-wise send + O(n) front-trim of
         the concat queue (O(n^2) across a backlog — the measured tax).
         Caller holds conn.wlock."""
+        if conn.cur is not None or \
+                (conn.wqs is not None and any(conn.wqs)):
+            # shaped residue after a copy_mode flip: ordered first
+            self._fold_shaped_residue(conn)
         self._fold_wq_legacy(conn)
         while conn.wbuf:
             try:
@@ -536,9 +761,245 @@ class TcpBtl(Btl):
                     sent = 0
         return vecs
 
+    # ------------------------------------------------- shaped send path
+    # btl_tcp_shape_enable=1: every connection drains three class
+    # sub-queues (LATENCY/NORMAL/BULK, read from bits 6-7 of the pml
+    # kind byte) with a weighted-deficit scheduler instead of one FIFO.
+    # FIFO holds WITHIN a class (the pml's per-(peer, class) seq planes
+    # depend on it); across classes the scheduler reorders on purpose —
+    # that is the whole point. A partially-written frame always
+    # finishes first (TCP frames are contiguous on the wire), so the
+    # preemption granularity is one frame — which is why the pml
+    # segments oversized blobs before they get here.
+    def _send_shaped(self, conn: _Conn, vecs: List, cls: int) -> None:
+        """Shaped enqueue/send of one frame. Caller holds conn.wlock
+        and has done the dead-check."""
+        if conn.wqs is None:
+            conn.wqs = (deque(), deque(), deque())
+        if conn.wbuf:
+            # legacy residue after a copy_mode flip: ordered first
+            conn.wq.append(bytes(conn.wbuf))
+            conn.wbuf.clear()
+        if conn.wq:
+            # pre-shaping FIFO residue (mode flip, or frames queued
+            # before the peer's QoS ack landed): it must hit the wire
+            # before any shaped frame. If a partial shaped frame is
+            # already mid-write it is older still — append after it.
+            if conn.cur is None:
+                conn.cur = list(conn.wq)
+                conn.cur_cls = _qos.NORMAL
+            else:
+                conn.cur.extend(conn.wq)
+            conn.wq.clear()
+        if conn.cur is None and not any(conn.wqs):
+            # fast path: push straight from the caller's buffer
+            total = sum(len(v) for v in vecs)
+            vecs = self._try_send(conn, vecs)
+            if not vecs:
+                return  # fully on the wire (or conn failed): 0 copies
+            # backpressure: own the unsent remainder. A frame with
+            # bytes already on the wire is the unpreemptible
+            # in-progress frame; one the kernel took NOTHING of is
+            # still schedulable — queue it so a LATENCY arrival can
+            # jump ahead of an untouched bulk frame.
+            cur = []
+            left = 0
+            for v in vecs:
+                left += len(v)
+                if isinstance(v, memoryview):
+                    _ctr["copied"] += len(v)
+                    v = bytes(v)
+                cur.append(v)
+            if left < total:
+                conn.cur = cur
+                conn.cur_cls = cls
+            else:
+                conn.eseq += 1
+                conn.wqs[cls].append(
+                    (conn.eseq, left, cur, time.monotonic()))
+                _shape_ctr["enqueued"] += 1
+                with _qlock:
+                    _qbytes[cls] += left
+                    if _qbytes[cls] > _qpeak[cls]:
+                        _qpeak[cls] = _qbytes[cls]
+            self._want_write(conn, True)
+            return
+        # backlog: own the frame into its class sub-queue, then give
+        # the scheduler a drain pass (a LATENCY arrival may preempt
+        # the queued bulk right now instead of at the next progress)
+        nb = 0
+        owned = []
+        for v in vecs:
+            if isinstance(v, memoryview):
+                _ctr["copied"] += len(v)
+                v = bytes(v)
+            owned.append(v)
+            nb += len(v)
+        conn.eseq += 1
+        conn.wqs[cls].append((conn.eseq, nb, owned, time.monotonic()))
+        _shape_ctr["enqueued"] += 1
+        with _qlock:
+            _qbytes[cls] += nb
+            if _qbytes[cls] > _qpeak[cls]:
+                _qpeak[cls] = _qbytes[cls]
+        if cls == _qos.BULK:
+            # background enqueue: do NOT drain synchronously — a bulk
+            # producer in a tight ship loop would otherwise spend its
+            # own timeslice pushing the whole backlog through sendmsg,
+            # starving the latency-critical threads the shaper exists
+            # to protect. The progress engine drains it (the trailing
+            # poke in send() wakes a parked loop).
+            self._want_write(conn, True)
+        else:
+            self._flush_shaped(conn)
+
+    def _flush_shaped(self, conn: _Conn) -> None:
+        """Drain the shaped sub-queues: finish the in-progress frame,
+        then repeatedly let the deficit scheduler pick the next class.
+        Caller holds conn.wlock.
+
+        The drain is BUDGETED per call: a fast kernel (loopback) would
+        otherwise accept an entire multi-blob backlog in one loop while
+        this thread holds conn.wlock — and a LATENCY frame born on the
+        app thread mid-drain would block on the lock for the whole
+        serialization, re-creating exactly the head-of-line blocking
+        the scheduler exists to remove. Stopping every ~16 quanta
+        releases the lock (the yield point between sendmsg calls); the
+        selector's write interest re-enters the drain immediately."""
+        budget = 16 * max(int(_quantum_var._value), 1)
+        sent = 0
+        while True:
+            if conn.cur is not None:
+                before = sum(len(v) for v in conn.cur)
+                rem = self._try_send(conn, conn.cur)
+                if conn.dead is not None:
+                    return
+                if rem:
+                    conn.cur = rem  # socket full mid-frame: resume later
+                    self._want_write(conn, True)
+                    return
+                sent += before
+                conn.cur = None
+            if sent >= budget:
+                # yield point: backlog remains, the lock must breathe
+                self._want_write(conn, True)
+                return
+            cls = self._pick_class(conn)
+            if cls is None:
+                self._want_write(conn, False)
+                return
+            wqs = conn.wqs
+            # peek-try-commit: a frame the kernel takes NOTHING of
+            # stays at its queue head, still schedulable — committing
+            # it to `cur` would let an untouched frame block a later
+            # preemption for no wire progress
+            eseq, nb, owned, ts = wqs[cls][0]
+            rem = self._try_send(conn, list(owned))
+            if conn.dead is not None:
+                return
+            if rem and sum(len(v) for v in rem) == nb:
+                self._want_write(conn, True)
+                return
+            wqs[cls].popleft()
+            # preemption = serving ahead of an earlier-enqueued frame
+            # of another class (the out-of-FIFO service the per-class
+            # scheduler exists to make)
+            older = [wqs[c][0][0] for c in _SERVICE_ORDER
+                     if c != cls and wqs[c]]
+            if older and min(older) < eseq:
+                _shape_ctr["preempt"] += 1
+            with _qlock:
+                _qbytes[cls] -= nb
+            if conn.deficit[cls] >= nb:
+                # only deficit-granted serves spend credit: a grant
+                # that bypassed the deficit check (sole backlogged
+                # class, starvation bound) must not drive the counter
+                # negative, or a class that ran alone for a while
+                # starts a later contention epoch in deep debt and
+                # starves against its own weight (classic DRR never
+                # goes negative)
+                conn.deficit[cls] -= nb
+            if not wqs[cls]:
+                conn.deficit[cls] = 0  # classic DRR: empty resets
+            conn.defer[cls] = 0
+            for c in _SERVICE_ORDER:
+                if c != cls and wqs[c]:
+                    conn.defer[c] += nb
+            if _metrics._enable_var._value:
+                # per-frame deferral histogram (time queued by class)
+                _metrics.observe("btl_tcp_shape_defer_us",
+                                 (time.monotonic() - ts) * 1e6,
+                                 cls=_qos.NAMES[cls])
+            if rem:
+                conn.cur = rem  # frame started: must finish first
+                conn.cur_cls = cls
+                self._want_write(conn, True)
+                return
+            sent += nb
+
+    def _pick_class(self, conn: _Conn) -> Optional[int]:
+        """Next class to serve: the starvation bound first (a class
+        past btl_tcp_shape_max_defer_bytes of deferral wins outright —
+        BULK always progresses), then weighted-deficit round-robin in
+        LATENCY > NORMAL > BULK preference order. Caller holds wlock."""
+        wqs = conn.wqs
+        nonempty = [c for c in _SERVICE_ORDER if wqs[c]]
+        if not nonempty:
+            return None
+        if len(nonempty) == 1:
+            return nonempty[0]
+        md = int(_max_defer_var._value)
+        if md > 0:
+            starved = [c for c in nonempty if conn.defer[c] >= md]
+            if starved:
+                return max(starved, key=lambda c: conn.defer[c])
+        q = max(int(_quantum_var._value), 1)
+        w = _weights()
+        while True:
+            for c in nonempty:
+                if conn.deficit[c] >= wqs[c][0][1]:
+                    return c
+            for c in nonempty:
+                conn.deficit[c] += q * w[c]
+
+    def _fold_shaped_residue(self, conn: _Conn) -> None:
+        """Shaped residue after a shape_enable flip: fold the partial
+        frame and every class sub-queue into the legacy FIFO, oldest
+        class-order (cross-class order is arbitrary by construction —
+        the shaper had already unordered them). Caller holds wlock."""
+        frames: List = []
+        if conn.cur is not None:
+            frames.extend(conn.cur)
+            conn.cur = None
+        if conn.wqs is not None:
+            for c in _SERVICE_ORDER:
+                dq = conn.wqs[c]
+                while dq:
+                    _eseq, nb, owned, _ts = dq.popleft()
+                    frames.extend(owned)
+                    with _qlock:
+                        _qbytes[c] -= nb
+        conn.wq.extendleft(reversed(frames))
+
+    def _drop_shaped(self, conn: _Conn) -> None:
+        """Dead conn: release the shaped queues and settle the by-class
+        gauges. Caller holds conn.wlock."""
+        conn.cur = None
+        if conn.wqs is not None:
+            for c in _SERVICE_ORDER:
+                dq = conn.wqs[c]
+                while dq:
+                    _eseq, nb, _owned, _ts = dq.popleft()
+                    with _qlock:
+                        _qbytes[c] -= nb
+
     def _flush_locked(self, conn: _Conn) -> None:
         """Drain the owned write queue with vectored sends; caller
         holds conn.wlock."""
+        if conn.cur is not None or \
+                (conn.wqs is not None and any(conn.wqs)):
+            # shaped residue after a shape_enable flip: ordered first
+            self._fold_shaped_residue(conn)
         if conn.wbuf:
             # legacy residue after a copy_mode flip: ordered first
             conn.wq.appendleft(bytes(conn.wbuf))
@@ -582,6 +1043,7 @@ class TcpBtl(Btl):
             conn.dead = err
             conn.wq.clear()
             conn.wbuf.clear()
+            self._drop_shaped(conn)
         self.log.error("i/o with rank %s failed: %s", conn.peer, err)
         self._unregister(conn)
         # The dead conn stays in self.conns: bytes already queued (and
@@ -652,6 +1114,12 @@ class TcpBtl(Btl):
                     with conn.wlock:
                         if _copy_mode_var._value:
                             self._flush_legacy(conn)
+                        elif conn.cur is not None or \
+                                (conn.wqs is not None and any(conn.wqs)):
+                            # shaped backlog pending (regardless of the
+                            # cvar's CURRENT value: a flip mid-backlog
+                            # must still drain what the shaper queued)
+                            self._flush_shaped(conn)
                         else:
                             self._flush_locked(conn)
                 if mask & selectors.EVENT_READ:
@@ -675,15 +1143,22 @@ class TcpBtl(Btl):
                 return 0
             raw += chunk
         word = _LEN.unpack(raw)[0]
-        peer = word & ~_CAP_COMPRESS
+        peer = word & ~(_CAP_COMPRESS | _CAP_QOS)
         conn = _Conn(s, peer)
-        if word & _CAP_COMPRESS:
-            # the connector understands zlib-flagged frames; answer with
-            # our ack so it knows we do too (decoding is always
-            # available in this build — acceptance is unconditional)
-            conn.peer_z = True
+        if word & (_CAP_COMPRESS | _CAP_QOS):
+            # the connector understands zlib-flagged frames / QoS class
+            # bits; answer with our ack so it knows we do too (decoding
+            # is always available in this build — acceptance is
+            # unconditional, per advertised capability)
+            ack = _ZACK_MAGIC
+            if word & _CAP_COMPRESS:
+                conn.peer_z = True
+                ack |= _ZACK_ACCEPT
+            if word & _CAP_QOS:
+                conn.peer_q = True
+                ack |= _ZACK_QOS
             try:
-                s.sendall(_LEN.pack(_ZACK_MAGIC | _ZACK_ACCEPT))
+                s.sendall(_LEN.pack(ack))
             except OSError:
                 # the dialer died mid-handshake; under PR 3's connect
                 # retry it will redial — close or each attempt leaks a fd
@@ -775,8 +1250,9 @@ class TcpBtl(Btl):
             # length word and desync the whole stream
             word = _LEN.unpack_from(buf, off)[0]
             conn.await_ack = False
-            if word in (_ZACK_MAGIC, _ZACK_MAGIC | _ZACK_ACCEPT):
+            if word in _ZACK_WORDS:
                 conn.peer_z = bool(word & _ZACK_ACCEPT)
+                conn.peer_q = bool(word & _ZACK_QOS)
                 off += 4
         while end - off >= 4:
             word = _LEN.unpack_from(buf, off)[0]
@@ -893,8 +1369,9 @@ class TcpBtl(Btl):
         if conn.await_ack and len(buf) >= 4:
             word = _LEN.unpack_from(buf, 0)[0]
             conn.await_ack = False
-            if word in (_ZACK_MAGIC, _ZACK_MAGIC | _ZACK_ACCEPT):
+            if word in _ZACK_WORDS:
                 conn.peer_z = bool(word & _ZACK_ACCEPT)
+                conn.peer_q = bool(word & _ZACK_QOS)
                 off = 4
         while len(buf) - off >= 4:
             word = _LEN.unpack_from(buf, off)[0]

@@ -35,6 +35,12 @@ allgather round (one ``ordered=False`` linear exchange) is still in
 flight, instead of barriering between the phases. Sub-chunking WITHIN
 ring blocks keeps every element's reduction chain identical to the
 un-chunked ring, so the pipelined schedule stays bitwise-equal too.
+With traffic shaping on (``btl_tcp_shape_enable``), the allgather
+phase additionally rides QoS class BULK on tag sub-plane 1: the
+overlapped phases then INTERLEAVE at the wire (the shaped btl serves
+the next chunk's reduce-scatter — the critical path — ahead of queued
+completion traffic) instead of self-contending FIFO on the shared
+connection, which was the seam PR 11 left open.
 
 **Wire compatibility**: every un-chunked frozen schedule emits the same
 rounds (sizes, peers, order) as the ad-hoc generator it mirrors, so a
@@ -67,6 +73,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ompi_tpu import qos as _qos_mod
 from ompi_tpu.coll import sched as _sched
 from ompi_tpu.coll.sched import NbcRequest, Round
 from ompi_tpu.coll.basic import _np_reduce_typed, _typed_view
@@ -338,9 +345,11 @@ class _Builder:
         return np.frombuffer(blk, np.uint8, nbytes)
 
     def rnd(self, sends: Sequence = (), recvs: Sequence = (),
-            ordered: bool = True, wait: bool = False) -> None:
+            ordered: bool = True, wait: bool = False,
+            qos=None, plane: int = 0) -> None:
         self.steps.append(("r", Round(sends=sends, recvs=recvs,
-                                      ordered=ordered, wait=wait)))
+                                      ordered=ordered, wait=wait,
+                                      qos=qos, plane=plane)))
 
     def do(self, fn: Callable[[], None]) -> None:
         self.steps.append(("c", fn))
@@ -757,7 +766,16 @@ def _ring_allreduce(comm, spin, rpin, op, count, dt):
             # linear allgather: my fully-reduced block to every peer,
             # every other block straight into its final slice — all
             # independent, one unordered round left in flight while the
-            # next chunk's reduce-scatter proceeds
+            # next chunk's reduce-scatter proceeds. The phase rides
+            # QoS class BULK on tag sub-plane 1: the shaped tcp btl
+            # may then serve the NEXT chunk's reduce-scatter frames
+            # (the critical path) ahead of this completion traffic
+            # instead of serializing the phases FIFO on the wire — and
+            # the distinct tag plane keeps the cross-class reorder
+            # away from the reduce-scatter matching (same peer, same
+            # schedule, equal sizes). Unshaped jobs ignore the class;
+            # the plane split is symmetric either way, so results stay
+            # bitwise-equal across btl_tcp_shape_enable=0/1.
             own = (r + 1) % n
             if c > 0:
                 b.overlap += 1
@@ -766,7 +784,7 @@ def _ring_allreduce(comm, spin, rpin, op, count, dt):
                   recvs=[(ke * isz, (blk - 1) % n,
                           bslice(rtyped, blk, c0, c1).view(np.uint8))
                          for blk in range(n) if blk != own],
-                  ordered=False)
+                  ordered=False, qos=_qos_mod.BULK, plane=1)
     if m > 1:
         b.rnd()  # request-less ordered round: drain the window
     if rpin.post:

@@ -169,21 +169,35 @@ class Round:
     the phases would serialize exactly the overlap the chunking buys.
     ``free``   — previously-received pooled views the generator is done
     with: recycled immediately instead of at schedule end (the
-    segmented-ring steady-state path)."""
+    segmented-ring steady-state path).
+    ``qos``    — QoS class for this round's sends (ompi_tpu/qos.py;
+    None = the pml's own classification). A schedule phase that tags
+    its rounds BULK lets the shaped tcp btl interleave another phase's
+    frames ahead of it instead of serializing them FIFO.
+    ``plane``  — tag sub-plane (0-3): rounds on different planes match
+    on distinct tags. REQUIRED whenever two phases of one schedule
+    carry different QoS classes to the same peer: the shaped btl
+    reorders across classes, and same-(cid, src, tag) frames arriving
+    out of send order would bind to the wrong posted receives."""
 
-    __slots__ = ("sends", "recvs", "ordered", "wait", "free")
+    __slots__ = ("sends", "recvs", "ordered", "wait", "free", "qos",
+                 "plane")
 
     def __init__(self,
                  sends: Sequence[Tuple[np.ndarray, int]] = (),
                  recvs: Sequence[Tuple] = (),
                  ordered: bool = True,
                  wait: bool = False,
-                 free: Sequence[np.ndarray] = ()):
+                 free: Sequence[np.ndarray] = (),
+                 qos: Optional[int] = None,
+                 plane: int = 0):
         self.sends = list(sends)
         self.recvs = list(recvs)
         self.ordered = ordered
         self.wait = wait
         self.free = free
+        self.qos = qos
+        self.plane = plane
 
 
 Schedule = Generator[Round, List[np.ndarray], None]
@@ -241,6 +255,10 @@ def _issue(comm, rnd: Round, tag: int, cid: int, state: _RoundState):
     post: List[tuple] = []
     legacy = _copy_mode_var._value
     moved = 0
+    if rnd.plane:
+        # tag sub-plane: far above the per-comm NBC sequence counters,
+        # symmetric across ranks (both sides build the same rounds)
+        tag = tag | (rnd.plane << 56)
     for rec in rnd.recvs:
         nbytes, src = rec[0], rec[1]
         dest = rec[2] if len(rec) > 2 else None
@@ -270,7 +288,8 @@ def _issue(comm, rnd: Round, tag: int, cid: int, state: _RoundState):
             _bump("copied", data.nbytes)
         moved += data.nbytes
         reqs.append(comm.pml.isend(data, data.nbytes, BYTE,
-                                   comm.group.world_rank(dst), tag, cid))
+                                   comm.group.world_rank(dst), tag, cid,
+                                   qos=rnd.qos))
     _bump("moved", moved)
     return reqs, bufs, post
 

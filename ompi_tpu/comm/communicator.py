@@ -152,6 +152,26 @@ class Communicator:
     def Set_errhandler(self, eh: Errhandler) -> None:
         self.errhandler = eh
 
+    # ------------------------------------------------------ QoS override
+    # Multi-tenant traffic shaping (ompi_tpu/qos.py): the override
+    # rides a comm-attr keyval (so Dup inherits it and Free's attribute
+    # sweep releases it) and applies to every frame of this
+    # communicator and its derived cid planes while
+    # btl_tcp_shape_enable is on.
+    def Set_qos_class(self, cls) -> None:
+        """Pin this communicator's traffic to QoS class ``cls``
+        ('latency' / 'normal' / 'bulk'): a latency-critical serving
+        comm is promoted past background planes, a replication comm is
+        demoted below foreground collectives."""
+        from ompi_tpu import qos as _qos
+
+        _qos.set_comm_class(self, cls)
+
+    def Get_qos_class(self) -> str:
+        from ompi_tpu import qos as _qos
+
+        return _qos.NAMES[_qos.get_comm_class(self)]
+
     def Set_attr(self, keyval: int, value: Any) -> None:
         # replacing a value fires the delete callback on the old one
         # (MPI_Comm_set_attr contract — the callback releases resources)

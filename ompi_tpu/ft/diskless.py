@@ -246,15 +246,39 @@ _comm_ref = None  # weakref to the last attached communicator
 def _ship(pml, dst_urank: int, kind: str, epoch: int, owner: int,
           blob: bytes) -> None:
     """One framed blob on the replication plane: u32 meta length + JSON
-    meta + raw npz bytes, a single system-plane frame (system tags skip
-    the eager limit). Fire-and-forget: a dead destination surfaces as a
-    missing receipt and the commit agreement aborts the epoch."""
+    meta + raw npz bytes in a single logical system-plane message
+    (system tags skip the eager limit). With traffic shaping on
+    (``btl_tcp_shape_enable``), the pml classifies tag -4600 as BULK
+    (``qos_tag_map``) and segments the blob into
+    ``btl_tcp_shape_segment_bytes`` sub-frames reassembled at the
+    receiver, so a 64MB epoch ship is preemptible by latency traffic
+    instead of holding the wire for its full serialization time (and
+    blobs past the 2 GiB tcp framing limit become shippable at all).
+    Fire-and-forget: a dead destination surfaces as a missing receipt
+    and the commit agreement aborts the epoch — a transfer severed
+    mid-blob leaves a partial the pml purges on peer failure, and this
+    rank's wait below times out into an abort vote."""
     from ompi_tpu.core.datatype import BYTE
     from ompi_tpu.runtime import spc
 
     meta = json.dumps({"kind": kind, "epoch": int(epoch),
                        "owner": int(owner), "len": len(blob)}).encode()
-    frame = struct.pack("<I", len(meta)) + meta + bytes(blob)
+    # chunked frame build: one monolithic `header + bytes(blob)` concat
+    # holds the GIL for the whole blob (~13ms per 64MB) and a burst of
+    # epoch ships starves every other thread in the process — the
+    # foreground collectives this plane must stay out of the way of.
+    # Slice-assigning in 1MB steps keeps every GIL hold sub-millisecond.
+    frame = bytearray(4 + len(meta) + len(blob))
+    struct.pack_into("<I", frame, 0, len(meta))
+    frame[4:4 + len(meta)] = meta
+    dst = memoryview(frame)
+    src = memoryview(blob).cast("B") if not isinstance(blob, bytes) \
+        else memoryview(blob)
+    base = 4 + len(meta)
+    step = 1 << 20
+    for off in range(0, len(blob), step):
+        dst[base + off:base + off + min(step, len(blob) - off)] = \
+            src[off:off + step]
     arr = np.frombuffer(frame, np.uint8)
     try:
         with spc.suppressed():
@@ -268,10 +292,15 @@ def _on_system(hdr, payload) -> None:
     """Replication-plane dispatch (runs on the transport's delivery
     thread — store and return, never raise)."""
     try:
-        data = bytes(payload)
-        (mlen,) = struct.unpack_from("<I", data, 0)
-        meta = json.loads(data[4:4 + mlen].decode())
-        blob = data[4 + mlen:]
+        # the pml's system-plane delivery hands OWNED bytes/bytearrays
+        # (`_owned` copies borrowed transport views; segmented blobs
+        # arrive as the reassembly accumulator itself), so the blob can
+        # be kept as a zero-copy memoryview slice — materializing
+        # `bytes(payload)` + a tail slice was two GIL-held full-blob
+        # copies per epoch received
+        (mlen,) = struct.unpack_from("<I", payload, 0)
+        meta = json.loads(bytes(payload[4:4 + mlen]).decode())
+        blob = memoryview(payload)[4 + mlen:]
         kind = meta["kind"]
         epoch = int(meta["epoch"])
         owner = int(meta["owner"])

@@ -50,7 +50,11 @@ def send_system(pml, dst: int, obj: dict, tag: int) -> None:
     matching; suppressed from SPC so counters stay user-only). Shared
     by every diagnostic subsystem with its own tag (sanitizer -4400,
     metrics -4500, diskless checkpoint replication -4600) — the
-    diagnostic plane must never take the application down."""
+    diagnostic plane must never take the application down. With
+    traffic shaping on (``btl_tcp_shape_enable``) the pml classifies
+    the frame by its tag (``qos_tag_map``) and segments oversized
+    payloads into preemptible BULK sub-frames, so a background blob
+    shipped through here cannot head-of-line-block latency traffic."""
     import json
 
     from ompi_tpu.core.datatype import BYTE
@@ -117,18 +121,32 @@ RNDV_ACK = 6   # receiver flow-control credit: hdr.nbytes = bytes landed
 _HDR = struct.Struct("<BiiqQQQQ")  # kind, src, cid, tag, seq, nbytes, offset, msgid
 HDR_SIZE = _HDR.size
 
+# QoS class (ompi_tpu/qos.py) rides bits 6-7 of the kind byte — the
+# header's one spare bit-field (kinds stop at 6). NORMAL encodes as 0,
+# so an unshaped job's frames are bit-identical to the pre-QoS format;
+# the receive side reads the class back to key its per-(peer, class)
+# sequence planes (the mirror of the sender's per-class wire order)
+# and the tcp btl reads header[0] >> 6 to pick a send sub-queue.
+QOS_SHIFT = 6
+KIND_MASK = (1 << QOS_SHIFT) - 1
+
 
 def pack_header(kind: int, src: int, cid: int, tag: int, seq: int,
-                nbytes: int, offset: int, msgid: int) -> bytes:
-    return _HDR.pack(kind, src, cid, tag, seq, nbytes, offset, msgid)
+                nbytes: int, offset: int, msgid: int,
+                qos: int = 0) -> bytes:
+    return _HDR.pack(kind | (qos << QOS_SHIFT), src, cid, tag, seq,
+                     nbytes, offset, msgid)
 
 
 class Header:
-    __slots__ = ("kind", "src", "cid", "tag", "seq", "nbytes", "offset", "msgid")
+    __slots__ = ("kind", "src", "cid", "tag", "seq", "nbytes", "offset",
+                 "msgid", "qos")
 
     def __init__(self, raw: bytes):
-        (self.kind, self.src, self.cid, self.tag, self.seq,
+        (kind_byte, self.src, self.cid, self.tag, self.seq,
          self.nbytes, self.offset, self.msgid) = _HDR.unpack(raw)
+        self.kind = kind_byte & KIND_MASK
+        self.qos = kind_byte >> QOS_SHIFT
 
 
 class SendRequest(Request):

@@ -70,7 +70,7 @@ class MonitoringPml:
             c[1] += nbytes
 
     # ------------------------------------------------- monitored verbs
-    def isend(self, buf, count, datatype, dst, tag, cid):
+    def isend(self, buf, count, datatype, dst, tag, cid, qos=None):
         if user_traffic(tag, cid):
             self._bump(dst, "tx", count * datatype.size)
             if _metrics._enable_var._value:
@@ -78,13 +78,14 @@ class MonitoringPml:
                 # (one attribute load when the metrics plane is off)
                 t0 = time.monotonic_ns()
                 req = self._inner.isend(buf, count, datatype, dst, tag,
-                                        cid)
+                                        cid, qos=qos)
                 req.add_completion_callback(
                     lambda r, t0=t0, dst=dst: _metrics.observe(
                         "pml_send_latency_us",
                         (time.monotonic_ns() - t0) / 1000.0, peer=dst))
                 return req
-        return self._inner.isend(buf, count, datatype, dst, tag, cid)
+        return self._inner.isend(buf, count, datatype, dst, tag, cid,
+                                 qos=qos)
 
     def irecv(self, buf, count, datatype, src, tag, cid):
         req = self._inner.irecv(buf, count, datatype, src, tag, cid)

@@ -37,6 +37,7 @@ from ompi_tpu.core.errors import (
 )
 from ompi_tpu.core.status import Status
 from ompi_tpu.ft import inject as _inject
+from ompi_tpu import qos as _qos
 from ompi_tpu.mca.var import register_var, register_pvar, get_var
 from ompi_tpu.pml.base import (
     ANY_SOURCE,
@@ -125,24 +126,37 @@ class Ob1Pml:
         self.engine = MatchingEngine()
         self.endpoints: Dict[int, "Btl"] = {}  # world rank -> btl module
         self.log = get_logger("pml.ob1")
-        # Per-PEER sequence numbers on the MATCH plane (reference:
-        # pml_ob1_isend.c:288 per-proc send_sequence + the recvfrag
-        # ordering check). Sender stamps EAGER/RTS frames from a per-dst
-        # counter; the receiver enforces continuity per source — a
-        # duplicate redelivered by failover is DROPPED (at-least-once
-        # becomes exactly-once) and a gap (a frame lost by a dying
-        # transport) raises instead of silently reordering the stream.
-        self._seq_to: Dict[int, int] = {}
-        self._expect_seq: Dict[int, int] = {}
+        # Per-(PEER, QoS class) sequence numbers on the MATCH plane
+        # (reference: pml_ob1_isend.c:288 per-proc send_sequence + the
+        # recvfrag ordering check). Sender stamps EAGER/RTS frames from
+        # a per-(dst, class) counter; the receiver enforces continuity
+        # per (source, class) — a duplicate redelivered by failover is
+        # DROPPED (at-least-once becomes exactly-once) and a gap (a
+        # frame lost by a dying transport) raises instead of silently
+        # reordering the stream. One sequence space PER CLASS because
+        # the shaped tcp btl keeps FIFO within a class but reorders
+        # across classes on purpose — a single space would park every
+        # preempting LATENCY frame in the reorder buffer until the
+        # BULK backlog it just overtook drained, re-creating at the
+        # pml exactly the head-of-line blocking the shaper removed.
+        # Unshaped jobs stamp class 0 everywhere, collapsing to the
+        # old one-space-per-peer behavior.
+        self._seq_to: Dict[tuple, int] = {}        # (dst, cls) -> seq
+        self._expect_seq: Dict[tuple, int] = {}    # (src, cls) -> seq
         # reorder buffer for MATCH frames that legitimately arrive ahead
         # of sequence (concurrent rails during failover re-drive):
-        # src -> {seq: (hdr, payload)}
-        self._ahead: Dict[int, Dict[int, tuple]] = {}
-        # per-dst send-order locks: seq assignment and handoff to the
-        # transport must be ATOMIC, or two app/progress threads sending
-        # to the same peer can hit the wire out of seq order and the
-        # receiver's gap check would drop a live frame
-        self._order_locks: Dict[int, threading.RLock] = {}
+        # (src, cls) -> {seq: (hdr, payload)}
+        self._ahead: Dict[tuple, Dict[int, tuple]] = {}
+        # per-(dst, cls) send-order locks: seq assignment and handoff to
+        # the transport must be ATOMIC, or two app/progress threads
+        # sending to the same peer can hit the wire out of seq order
+        # and the receiver's gap check would drop a live frame
+        self._order_locks: Dict[tuple, threading.RLock] = {}
+        # segmented system-blob reassembly: (src, msgid) -> [buf, got]
+        # (shaping splits oversized system frames so BULK blobs are
+        # preemptible; offset/msgid recombine them here before the
+        # system handler runs). Purged for a peer when it fails.
+        self._sys_reasm: Dict[tuple, list] = {}
         self._msgid = itertools.count(1)
         self._pending_sends: Dict[int, SendRequest] = {}  # msgid -> req
         self._active_recvs: Dict[int, RecvRequest] = {}  # msgid -> req
@@ -260,6 +274,14 @@ class Ob1Pml:
         completes with ERR_PROC_FAILED so blocked waits return.
         Wildcard (ANY_SOURCE) receives stay posted: a live sender may
         still match them (MPI_ERR_PROC_FAILED_PENDING semantics)."""
+        with self.engine.lock:
+            # a severed mid-blob segmented transfer leaves a partial
+            # reassembly that can never complete — drop it even in
+            # non-FT jobs (the owning diagnostic plane converts the
+            # missing delivery itself: diskless epoch receipts time
+            # out into an abort vote)
+            for key in [k for k in self._sys_reasm if k[0] == rank]:
+                del self._sys_reasm[key]
         if not get_var("ft", "enable"):
             # without the ULFM detector armed, mark_failed is only a
             # log/flood/exit-fence signal — a tcp rail error reaches it
@@ -409,43 +431,60 @@ class Ob1Pml:
         return btl
 
     # -------------------------------------------------------------- verbs
-    def _order_lock(self, dst: int) -> threading.RLock:
-        lock = self._order_locks.get(dst)
+    def _order_lock(self, key: tuple) -> threading.RLock:
+        lock = self._order_locks.get(key)
         if lock is None:
             with self.engine.lock:
-                lock = self._order_locks.setdefault(dst, threading.RLock())
+                lock = self._order_locks.setdefault(key, threading.RLock())
         return lock
 
     def isend(self, buf, count: int, datatype: Datatype, dst: int,
-              tag: int, cid: int) -> SendRequest:
+              tag: int, cid: int, qos: Optional[int] = None) -> SendRequest:
         if _trace.enabled():
             with _trace.span("pml.send", cat="pml", dst=dst, tag=tag,
                              nbytes=count * datatype.size):
-                return self._isend(buf, count, datatype, dst, tag, cid)
-        return self._isend(buf, count, datatype, dst, tag, cid)
+                return self._isend(buf, count, datatype, dst, tag, cid,
+                                   qos)
+        return self._isend(buf, count, datatype, dst, tag, cid, qos)
 
     def _isend(self, buf, count: int, datatype: Datatype, dst: int,
-               tag: int, cid: int) -> SendRequest:
+               tag: int, cid: int,
+               qos: Optional[int] = None) -> SendRequest:
         if _inject._enable_var._value:  # chaos op counter (ft/inject.py)
             _inject.on_op(self.my_rank, tag)
+        cls = 0
+        if _qos._enable_var._value:  # shaping: stamp the frame class
+            cls = _qos.classify(tag, cid) if qos is None else int(qos)
         btl = self._btl_for(dst)
         conv = Convertor(buf, count, datatype, for_send=True)
         req = SendRequest(dst, tag, cid, conv.packed_size)
         req.convertor = conv
+        req._qos_cls = cls
         eager_limit = btl.eager_limit
         # system-plane messages (osc active messages, ft notices) bypass
         # matching, so they can never run the RTS/CTS handshake — always
-        # ship them in one frame (transports queue arbitrary frame sizes)
+        # ship them in one frame (transports queue arbitrary frame
+        # sizes)... unless shaping is on and the blob is oversized, in
+        # which case it goes out as resumable sub-frames so the shaped
+        # btl can preempt it between sendmsg calls (a monolithic 64MB
+        # ckpt blob would otherwise hold the wire for its full
+        # serialization time regardless of queue priorities)
         if tag <= self.SYSTEM_TAG_BASE:
             eager_limit = None
-        # seq assignment + transport handoff under one per-dst lock:
-        # MATCH-plane wire order must equal seq order (reference: the
-        # per-proc send_sequence is taken under ob1's send lock). RLock
-        # because a self-btl delivery can re-enter isend for a reply.
+            if cls:
+                seg = _qos.segment_bytes()
+                if 0 < seg < conv.packed_size:
+                    return self._isend_system_segmented(
+                        req, conv, dst, tag, cid, cls, seg)
+        # seq assignment + transport handoff under one per-(dst, class)
+        # lock: MATCH-plane wire order must equal seq order per class
+        # (reference: the per-proc send_sequence is taken under ob1's
+        # send lock). RLock because a self-btl delivery can re-enter
+        # isend for a reply.
         if eager_limit is None or conv.packed_size <= eager_limit:
             payload = conv.pack_frag(conv.packed_size)
             self._send_match_frame(dst, EAGER, cid, tag,
-                                   conv.packed_size, 0, payload)
+                                   conv.packed_size, 0, payload, cls=cls)
             req.status._nbytes = conv.packed_size
             req._set_complete(0)
         else:
@@ -461,23 +500,55 @@ class Ob1Pml:
                 req._wd_last = _time.monotonic()  # RTS->CTS stall clock
             self._pending_sends[req.msgid] = req
             self._send_match_frame(dst, RNDV_RTS, cid, tag,
-                                   conv.packed_size, req.msgid, b"")
+                                   conv.packed_size, req.msgid, b"",
+                                   cls=cls)
+        return req
+
+    def _isend_system_segmented(self, req: SendRequest, conv: Convertor,
+                                dst: int, tag: int, cid: int, cls: int,
+                                seg: int) -> SendRequest:
+        """Ship one oversized system-plane blob as EAGER sub-frames of
+        at most ``seg`` payload bytes, each stamped with the blob total
+        in ``nbytes``, its position in ``offset``, and a shared nonzero
+        ``msgid`` — the receive side recombines them in
+        ``_dispatch_system`` before the handler runs (the same
+        offset/msgid discipline the rendezvous DATA stream uses). The
+        sub-frames ride the per-class seq plane in order, so the shaped
+        btl may interleave OTHER classes between them (the yield
+        points) while the blob's own stream stays FIFO."""
+        total = conv.packed_size
+        msgid = next(self._msgid)
+        nseg = 0
+        off = 0
+        while off < total:
+            frag = conv.pack_frag(min(seg, total - off))
+            self._send_match_frame(dst, EAGER, cid, tag, total, msgid,
+                                   frag, cls=cls, offset=off)
+            off += frag.nbytes
+            nseg += 1
+        if _qos._enable_var._value:  # reached only with shaping on
+            _qos.note_segments(nseg)
+        req.status._nbytes = total
+        req._set_complete(0)
         return req
 
     def _send_match_frame(self, dst: int, kind: int, cid: int, tag: int,
-                          nbytes: int, msgid: int, payload) -> None:
+                          nbytes: int, msgid: int, payload,
+                          cls: int = 0, offset: int = 0) -> None:
         """Stamp + transmit one MATCH-plane frame. The seq is committed
         BEFORE the send (a self-btl delivery can re-enter isend from the
         handler — reading an uncommitted counter would stamp a duplicate
         and the receiver would drop the reply as a redelivery), and
         rolled back if the transport rejected the frame with no nested
         send in between — a burned seq would otherwise poison the peer
-        stream with a permanent gap."""
-        with self._order_lock(dst):
-            seq = self._seq_to.get(dst, 0) + 1
-            self._seq_to[dst] = seq
+        stream with a permanent gap. Seq spaces are per (dst, class):
+        the shaped btl guarantees FIFO only within a class."""
+        key = (dst, cls)
+        with self._order_lock(key):
+            seq = self._seq_to.get(key, 0) + 1
+            self._seq_to[key] = seq
             hdr = pack_header(kind, self.my_rank, cid, tag, seq,
-                              nbytes, 0, msgid)
+                              nbytes, offset, msgid, qos=cls)
             try:
                 self._send_frame(dst, hdr, payload)
             except BaseException:
@@ -491,8 +562,8 @@ class Ob1Pml:
                 delivered_inline = getattr(self.endpoints.get(dst),
                                            "NAME", "") == "self"
                 if not delivered_inline and \
-                        self._seq_to.get(dst) == seq:
-                    self._seq_to[dst] = seq - 1
+                        self._seq_to.get(key) == seq:
+                    self._seq_to[key] = seq - 1
                 raise
 
     def irecv(self, buf, count: int, datatype: Datatype, src: int,
@@ -595,9 +666,7 @@ class Ob1Pml:
         if hdr.kind in (EAGER, RNDV_RTS) and hdr.seq:
             return self._incoming_match_plane(hdr, payload)
         if hdr.tag <= self.SYSTEM_TAG_BASE:
-            fn = self.system_handlers.get(hdr.tag)
-            if fn is not None:
-                fn(hdr, _owned(payload))
+            self._dispatch_system(hdr, payload)
             return
         if hdr.kind == EAGER:
             self._incoming_eager(hdr, payload)
@@ -621,17 +690,18 @@ class Ob1Pml:
         from ompi_tpu.runtime import spc
 
         deliveries = []
+        key = (hdr.src, hdr.qos)  # one continuity gate per (peer, class)
         with self.engine.lock:
-            expect = self._expect_seq.get(hdr.src, 1)
+            expect = self._expect_seq.get(key, 1)
             if hdr.seq < expect:
                 spc.record("pml_dup_frame")
                 self.log.warning(
-                    "dropping duplicate frame from rank %d (seq %d < "
-                    "expected %d; failover redelivery)",
-                    hdr.src, hdr.seq, expect)
+                    "dropping duplicate frame from rank %d class %d "
+                    "(seq %d < expected %d; failover redelivery)",
+                    hdr.src, hdr.qos, hdr.seq, expect)
                 return
             if hdr.seq > expect:
-                pend = self._ahead.setdefault(hdr.src, {})
+                pend = self._ahead.setdefault(key, {})
                 if hdr.seq in pend:
                     spc.record("pml_dup_frame")
                     return
@@ -647,29 +717,29 @@ class Ob1Pml:
                     spc.record("pml_seq_gap")
                     raise MPIError(
                         ERR_INTERN,
-                        f"sequence gap from rank {hdr.src}: stuck at "
-                        f"expected {expect} with {len(pend)} frames "
-                        f"parked ahead — a MATCH frame was lost in "
-                        f"transport failover")
+                        f"sequence gap from rank {hdr.src} class "
+                        f"{hdr.qos}: stuck at expected {expect} with "
+                        f"{len(pend)} frames parked ahead — a MATCH "
+                        f"frame was lost in transport failover")
                 spc.record("pml_ooo_frame")
                 if not pend:
                     self.log.warning(
-                        "frame from rank %d arrived ahead of sequence "
-                        "(got %d, expected %d); parking for reorder",
-                        hdr.src, hdr.seq, expect)
+                        "frame from rank %d class %d arrived ahead of "
+                        "sequence (got %d, expected %d); parking for "
+                        "reorder", hdr.src, hdr.qos, hdr.seq, expect)
                 pend[hdr.seq] = (hdr,
                                  bytes(payload) if payload else b"", now)
                 return
             ready = [(hdr, payload)]
-            self._expect_seq[hdr.src] = hdr.seq + 1
-            pend = self._ahead.get(hdr.src)
+            self._expect_seq[key] = hdr.seq + 1
+            pend = self._ahead.get(key)
             while pend:
-                nxt = self._expect_seq[hdr.src]
+                nxt = self._expect_seq[key]
                 if nxt not in pend:
                     break
                 ph, ppl, _t = pend.pop(nxt)
                 ready.append((ph, ppl))
-                self._expect_seq[hdr.src] = nxt + 1
+                self._expect_seq[key] = nxt + 1
             for h, pl in ready:
                 if h.tag <= self.SYSTEM_TAG_BASE:
                     deliveries.append((None, h, pl))
@@ -689,11 +759,56 @@ class Ob1Pml:
                         deliveries.append((req, h, None))
         for req, h, pl in deliveries:
             if req is None:
-                fn = self.system_handlers.get(h.tag)
-                if fn is not None:
-                    fn(h, _owned(pl))
+                self._dispatch_system(h, pl)
             else:
                 self._deliver_matched(req, h, pl)
+
+    def _dispatch_system(self, hdr: Header, payload) -> None:
+        """System-plane delivery: recombine segmented blobs (a nonzero
+        msgid marks a sub-frame; offset places it, nbytes is the blob
+        total), then run the registered handler. Sub-frames of one blob
+        arrive in order on their class's seq plane, but recombination
+        is offset-addressed anyway so a future out-of-order transport
+        stays correct. A partial whose peer dies is purged by
+        ``_on_peer_failed``."""
+        if hdr.msgid:
+            key = (hdr.src, hdr.msgid)
+            # the heavy work — the full-blob accumulator allocation and
+            # the per-segment copy — runs OUTSIDE engine.lock: holding
+            # the global match lock for a 64MB zero-fill would block a
+            # concurrent foreground match for milliseconds, re-adding
+            # on the receive side the head-of-line latency the shaper
+            # removed. Disjoint-offset copies are safe unlocked (the
+            # seq gate already dropped duplicates; a hypothetical
+            # re-copy writes identical bytes), and the byte counter +
+            # completion decision stay under the lock.
+            with self.engine.lock:
+                ent = self._sys_reasm.get(key)
+            if ent is None:
+                buf = bytearray(hdr.nbytes)
+                with self.engine.lock:
+                    ent = self._sys_reasm.setdefault(key, [buf, 0])
+            pl = payload if isinstance(
+                payload, (bytes, bytearray, memoryview)) \
+                else memoryview(payload).cast("B")
+            n = len(pl)
+            ent[0][hdr.offset:hdr.offset + n] = pl
+            with self.engine.lock:
+                if self._sys_reasm.get(key) is not ent:
+                    return  # purged mid-copy (peer failed): drop
+                ent[1] += n
+                if ent[1] < hdr.nbytes:
+                    return
+                del self._sys_reasm[key]
+            # hand the accumulator itself through: ownership is
+            # exclusively ours once the entry leaves the dict, and
+            # _owned passes bytearrays unchanged (zero-copy)
+            payload = ent[0]
+            if _qos._enable_var._value:
+                _qos.note_reassembled()
+        fn = self.system_handlers.get(hdr.tag)
+        if fn is not None:
+            fn(hdr, _owned(payload))
 
     def _incoming_eager(self, hdr: Header, payload: bytes) -> None:
         with self.engine.lock:
@@ -735,8 +850,11 @@ class Ob1Pml:
                 req._wd_last = _time.monotonic()  # DATA stall clock
             recv_id = next(self._msgid)
             self._active_recvs[recv_id] = req
+            # protocol control frames ride LATENCY when shaping: a CTS
+            # parked behind a bulk backlog stalls the whole rendezvous
+            ctl = _qos.LATENCY if _qos._enable_var._value else 0
             cts = pack_header(RNDV_CTS, self.my_rank, hdr.cid, hdr.tag, 0,
-                              hdr.nbytes, hdr.msgid, recv_id)
+                              hdr.nbytes, hdr.msgid, recv_id, qos=ctl)
             # single-copy offer (smsc/cma analog): when this receive
             # lands in plain contiguous memory and the peer shares the
             # node (it's behind the sm btl), tell the sender where to
@@ -845,7 +963,10 @@ class Ob1Pml:
                         spc.record_bytes("pml_cma_bytes", sreq.nbytes)
                         fin = pack_header(RNDV_FIN, self.my_rank, sreq.cid,
                                           sreq.tag, 0, sreq.nbytes, 0,
-                                          hdr.msgid)
+                                          hdr.msgid,
+                                          qos=_qos.LATENCY
+                                          if _qos._enable_var._value
+                                          else 0)
                         try:
                             self._send_frame(hdr.src, fin, b"")
                         except MPIError as e:
@@ -870,6 +991,13 @@ class Ob1Pml:
             depth = max(depth, 2 * frag_size)  # window >= ack cadence
         sreq._depth = depth
         sreq._frag_size = frag_size
+        if _qos._enable_var._value and \
+                getattr(sreq, "_qos_cls", 0) == _qos.BULK:
+            # BULK rendezvous DATA rides the segment granularity so a
+            # LATENCY frame can preempt the stream between fragments
+            seg = _qos.segment_bytes()
+            if seg > 0:
+                sreq._frag_size = min(frag_size, seg)
         # Close the pop->insert TOCTOU against _on_peer_failed: a
         # detector callback landing after the lock-free _pending_sends
         # pop above but before the _flowing insert below finds the
@@ -909,10 +1037,13 @@ class Ob1Pml:
                     # seq slot carries MY window size so the receiver
                     # paces ACKs to the sender's actual depth — config
                     # skew (different pipeline_depth per process) must
-                    # not stall the pipeline
+                    # not stall the pipeline. DATA frames carry the
+                    # message's QoS class (offset/msgid reassembly is
+                    # order-free, so the shaped btl may interleave)
                     dhdr = pack_header(RNDV_DATA, self.my_rank, sreq.cid,
                                        sreq.tag, sreq._depth, sreq.nbytes,
-                                       sreq._offset, sreq._rmsgid)
+                                       sreq._offset, sreq._rmsgid,
+                                       qos=getattr(sreq, "_qos_cls", 0))
                     btls = sreq._btls
                     if len(btls) == 1:
                         self._send_frame(sreq._peer, dhdr, frag)
@@ -1040,7 +1171,9 @@ class Ob1Pml:
                 req._last_ack = req._recv_bytes
                 ack = pack_header(RNDV_ACK, self.my_rank, hdr.cid, hdr.tag,
                                   0, req._recv_bytes, 0,
-                                  getattr(req, "_sender_msgid", 0))
+                                  getattr(req, "_sender_msgid", 0),
+                                  qos=_qos.LATENCY
+                                  if _qos._enable_var._value else 0)
                 try:
                     self._send_frame(hdr.src, ack, b"")
                 except MPIError:

@@ -203,12 +203,13 @@ class VprotocolPml:
     # Only user pt2pt is logged/replayed: library-internal traffic
     # (plane-bit cids, system tags) regenerates naturally on replay —
     # classification shared with pml/monitoring (pml/base.user_traffic).
-    def isend(self, buf, count, datatype, dst, tag, cid):
+    def isend(self, buf, count, datatype, dst, tag, cid, qos=None):
         from ompi_tpu.core.convertor import pack
         from ompi_tpu.pml.base import user_traffic
 
         if not user_traffic(tag, cid):
-            return self._inner.isend(buf, count, datatype, dst, tag, cid)
+            return self._inner.isend(buf, count, datatype, dst, tag, cid,
+                                     qos=qos)
         # one extra pack vs the inner pml's own convertor — accepted cost
         # of the payload log; the memoryview write avoids a bytes copy
         packed = pack(buf, count, datatype)
@@ -221,7 +222,8 @@ class VprotocolPml:
             _append(self._sb, dst, tag, cid, packed.nbytes,
                     memoryview(packed))
             self.logged_send_bytes += packed.nbytes
-            return self._inner.isend(buf, count, datatype, dst, tag, cid)
+            return self._inner.isend(buf, count, datatype, dst, tag, cid,
+                                     qos=qos)
 
     def irecv(self, buf, count, datatype, src, tag, cid):
         from ompi_tpu.pml.base import user_traffic

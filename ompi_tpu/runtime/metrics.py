@@ -678,6 +678,22 @@ def render_prometheus(snaps: Optional[List[Dict[str, Any]]] = None) -> str:
             f.lines.append(f"{f.name}_count{_prom_labels(lbl)} "
                            f"{_prom_num(h['count'])}")
         for mname, rows in sorted(snap.get("samplers", {}).items()):
+            if mname.endswith("_by_class") and isinstance(rows, dict):
+                # generic by-class sampler (the btl_tcp shape queue
+                # gauges): one gauge family, each key a class label —
+                # promexport --check validates it like any family
+                f = fam("ompi_metrics_" + _prom_name(mname), "gauge",
+                        f"per-class sampler {mname}")
+                for cls_name in sorted(rows):
+                    v = rows[cls_name]
+                    if not isinstance(v, (int, float)) or \
+                            isinstance(v, bool):
+                        continue
+                    lbl = dict(base)
+                    lbl["class"] = cls_name
+                    f.lines.append(
+                        f"{f.name}{_prom_labels(lbl)} {_prom_num(v)}")
+                continue
             if mname != "pml_comm_matrix" or not isinstance(rows, list):
                 continue
             msgs = fam("ompi_pml_peer_messages", "counter",

@@ -52,6 +52,8 @@ def _load_everything() -> None:
     import ompi_tpu.runtime.mpool  # BufferPool mpool_pool_* pvars
     import ompi_tpu.coll.sched  # coll_round_* window/copy_mode cvars + datapath pvars
     import ompi_tpu.coll.persist  # coll_persist_* cvars + persist_* replay pvars
+    import ompi_tpu.qos  # QoS classes: btl_tcp_shape_enable/segment + qos_* cvars/pvars
+    # (btl/tcp.py above also carries the btl_tcp_shape_* scheduler knobs)
 
 
 def print_header(out) -> None:

@@ -58,7 +58,7 @@ class _FakePml:
         self.rank = rank
         self.fail_recv_from = set(fail_recv_from)
 
-    def isend(self, data, nbytes, dt, dst, tag, cid):
+    def isend(self, data, nbytes, dt, dst, tag, cid, qos=None):
         req = Request()
         payload = np.ascontiguousarray(data).tobytes()
         key = (dst, self.rank, tag, cid)

@@ -256,7 +256,7 @@ class _FakeReq:
 class _FakePml:
     my_rank = 0
 
-    def isend(self, buf, count, datatype, dst, tag, cid):
+    def isend(self, buf, count, datatype, dst, tag, cid, qos=None):
         return _FakeReq()
 
     def irecv(self, buf, count, datatype, src, tag, cid):

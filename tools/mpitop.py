@@ -7,7 +7,9 @@ views — optionally aligning snapshot ages with the mpisync clock
 offsets that ``tools/trace_merge.py`` already parses — and renders one
 row per rank: collective counts and rates, traffic totals, the
 straggler skew EWMA the comm root computed for that rank, trip counts,
-and the p50/p99 of the pml send-latency histogram.
+the p50/p99 of the pml send-latency histogram, and the per-rank
+queued-bytes-by-class cell (QKB-L/N/B, KB latency/normal/bulk) from
+the traffic-shaping gauges when ``btl_tcp_shape_enable`` is on.
 
 Usage::
 
@@ -91,6 +93,27 @@ def _hist_quantile(snap: dict, name: str, q: float) -> Optional[float]:
     return math.inf
 
 
+def qos_queued(snap: dict) -> str:
+    """Queued-bytes-by-class cell, 'lat/norm/bulk' in KB, from the
+    btl_tcp shape gauges (the *_by_class sampler; pvar fallback for
+    snapshots written before the sampler existed). Empty when the rank
+    never shaped traffic."""
+    rows = snap.get("samplers", {}).get(
+        "btl_tcp_shape_queued_bytes_by_class")
+    if not isinstance(rows, dict):
+        pv = snap.get("pvars", {})
+        rows = {c: pv.get(f"btl_tcp_shape_queued_{c}") for c in
+                ("latency", "normal", "bulk")}
+        if all(v is None for v in rows.values()):
+            return ""
+    vals = [int(rows.get(c) or 0) for c in ("latency", "normal", "bulk")]
+    peaks = [int(rows.get(f"peak_{c}") or 0)
+             for c in ("latency", "normal", "bulk")]
+    if not any(vals) and not any(peaks):
+        return ""
+    return "/".join(str(v // 1024) for v in vals)
+
+
 def skew_by_rank(snaps: Dict[int, dict]) -> Dict[int, float]:
     """Worst coll_entry_skew_us EWMA per rank, pulled from every
     snapshot (comm roots hold the values for their members)."""
@@ -115,7 +138,7 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
     skews = skew_by_rank(snaps)
     lines = [f"{'RANK':>4} {'AGE-S':>6} {'COLLS':>8} {'COLL/S':>7} "
              f"{'TX-MB':>9} {'RX-MB':>9} {'SKEW-US':>8} {'TRIPS':>5} "
-             f"{'P50-US':>7} {'P99-US':>8}"]
+             f"{'P50-US':>7} {'P99-US':>8} {'QKB-L/N/B':>10}"]
     for rank in sorted(snaps):
         snap = snaps[rank]
         pv = snap.get("pvars", {})
@@ -138,7 +161,8 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
             f"{'' if skew is None else format(skew, '.0f'):>8} "
             f"{pv.get('metrics_straggler_trips', 0):>5} "
             f"{'' if p50 is None else format(p50, '.0f'):>7} "
-            f"{'' if p99 is None else format(p99, '.0f'):>8}")
+            f"{'' if p99 is None else format(p99, '.0f'):>8} "
+            f"{qos_queued(snap):>10}")
     trips = sum(int(s.get("pvars", {}).get("metrics_straggler_trips", 0))
                 for s in snaps.values())
     lines.append(f"-- {len(snaps)} rank(s), {trips} straggler trip(s), "
