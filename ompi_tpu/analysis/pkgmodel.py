@@ -1,0 +1,217 @@
+"""Shared whole-package AST model for the mpiracer passes.
+
+``ompi_tpu/analysis/threads.py`` (lock discipline / cross-thread races)
+and ``ompi_tpu/analysis/protocol.py`` (wire-protocol registry) both need
+the same substrate: every module of the package parsed once, with its
+mpiracer suppressions, import aliases, and statically-evaluable
+module-level integer constants resolved. This module holds that
+substrate and nothing rule-specific.
+
+Suppression syntax (mirrors mpilint, separate namespace)::
+
+    self._acked = n  # mpiracer: disable=lock-discipline — GIL-atomic,
+                     # TOCTOU closed by the re-check under engine.lock
+
+A suppression line MUST carry a justification after the rule list
+(anything with a word character). A bare ``disable=`` silences its
+rules but raises the unsuppressable ``bare-suppression`` finding, so
+the zero-findings tier-1 gate enforces the justification discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mpiracer:\s*disable=([A-Za-z0-9_,\- ]+?)(?:\s*(?:—|--|:)\s*(.*))?$")
+
+
+class Suppressions:
+    """Per-line rule suppressions plus the justification contract."""
+
+    def __init__(self, src: str):
+        self.by_line: Dict[int, Set[str]] = {}
+        self.bare: List[int] = []  # lines with disable= but no reason
+        for i, line in enumerate(src.splitlines(), 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            self.by_line[i] = rules
+            reason = m.group(2) or ""
+            if not re.search(r"\w", reason):
+                self.bare.append(i)
+
+    def active(self, line: int, rule: str) -> bool:
+        sup = self.by_line.get(line, ())
+        return rule in sup or "all" in sup
+
+
+def rel_path(path: str) -> str:
+    """Path relative to the ompi_tpu package root, forward slashes
+    (mirrors analysis/lint.rel_path so fake self-test paths scope the
+    same way)."""
+    parts = os.path.normpath(path).split(os.sep)
+    if "ompi_tpu" in parts:
+        i = len(parts) - 1 - parts[::-1].index("ompi_tpu")
+        return "/".join(parts[i + 1:])
+    return parts[-1]
+
+
+def _const_int(node: ast.AST, env: Dict[str, int]) -> Optional[int]:
+    """Evaluate a module-level constant expression over ints: literals,
+    previously-bound names, unary minus, and the shift/or/and arithmetic
+    the tag/cid-bit definitions use (``1 << 31``, ``BASE - 5``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand, env)
+        return None if v is None else -v
+    if isinstance(node, ast.BinOp):
+        a = _const_int(node.left, env)
+        b = _const_int(node.right, env)
+        if a is None or b is None:
+            return None
+        op = node.op
+        if isinstance(op, ast.LShift):
+            return a << b
+        if isinstance(op, ast.RShift):
+            return a >> b
+        if isinstance(op, ast.BitOr):
+            return a | b
+        if isinstance(op, ast.BitAnd):
+            return a & b
+        if isinstance(op, ast.Add):
+            return a + b
+        if isinstance(op, ast.Sub):
+            return a - b
+        if isinstance(op, ast.Mult):
+            return a * b
+    return None
+
+
+class ModuleInfo:
+    """One parsed module: tree + suppressions + imports + constants."""
+
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.relp = rel_path(path)
+        # dotted name inside the package ("ompi_tpu.pml.ob1")
+        dotted = self.relp[:-3] if self.relp.endswith(".py") else self.relp
+        if dotted.endswith("/__init__"):
+            dotted = dotted[: -len("/__init__")]
+        self.dotted = "ompi_tpu." + dotted.replace("/", ".") \
+            if dotted else "ompi_tpu"
+        self.src = src
+        self.suppress = Suppressions(src)
+        self.parse_error: Optional[Tuple[int, str]] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = (e.lineno or 0, e.msg or "syntax error")
+            return
+        # alias -> dotted module ("_trace" -> "ompi_tpu.runtime.trace");
+        # from-name -> (dotted module, attr) for `from m import f`
+        self.mod_aliases: Dict[str, str] = {}
+        self.from_names: Dict[str, Tuple[str, str]] = {}
+        # module-level int constants (tags, cid bits, bases)
+        self.constants: Dict[str, int] = {}
+        self.const_lines: Dict[str, int] = {}
+        # every top-level binding name (for module-global detection)
+        self.globals: Set[str] = set()
+        self._index()
+
+    def _index(self) -> None:
+        env = self.constants
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.mod_aliases[a.asname or
+                                     a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.module is None:
+                    continue
+                base = node.module
+                if node.level:  # relative import: anchor at our package
+                    prefix = self.dotted.split(".")
+                    # level 1 = current package dir
+                    anchor = prefix[: max(len(prefix) - (node.level - 1)
+                                          - (0 if self.relp.endswith(
+                                              "__init__.py") else 1), 1)]
+                    base = ".".join(anchor + ([base] if base else []))
+                for a in node.names:
+                    name = a.asname or a.name
+                    # `from ompi_tpu.runtime import trace as _trace`
+                    # imports a MODULE; record it as a module alias too
+                    self.mod_aliases.setdefault(name, f"{base}.{a.name}")
+                    self.from_names[name] = (base, a.name)
+        for stmt in self.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = [t for t in stmt.targets
+                           if isinstance(t, ast.Name)]
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                    and isinstance(stmt.target, ast.Name):
+                targets = [stmt.target]
+                value = stmt.value
+            else:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    self.globals.add(stmt.name)
+                continue
+            for t in targets:
+                self.globals.add(t.id)
+                v = _const_int(value, env)
+                if v is not None:
+                    env[t.id] = v
+                    self.const_lines[t.id] = stmt.lineno
+
+    def resolve_module(self, alias: str) -> Optional[str]:
+        return self.mod_aliases.get(alias)
+
+
+class Package:
+    """All parsed modules of one tree, keyed by rel path."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = {m.relp: m for m in modules}
+        self.by_dotted = {m.dotted: m for m in modules}
+
+    def module_for_dotted(self, dotted: str) -> Optional[ModuleInfo]:
+        m = self.by_dotted.get(dotted)
+        if m is not None:
+            return m
+        # `import ompi_tpu.runtime.trace` resolving through a package
+        # __init__: fall back to the longest matching prefix module
+        return self.by_dotted.get(dotted.rsplit(".", 1)[0])
+
+
+def load_package(paths: List[str]) -> Package:
+    """Parse files and/or directory trees into a Package."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        files.append(os.path.join(root, n))
+        else:
+            files.append(p)
+    mods = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            mods.append(ModuleInfo(f, fh.read()))
+    return Package(mods)
+
+
+def load_source(src: str, path: str) -> Package:
+    """Single-source package (self-test and unit tests)."""
+    return Package([ModuleInfo(path, src)])

@@ -1,0 +1,430 @@
+"""mpiracer wire-protocol registry pass.
+
+The system-tag / plane space grew one subsystem at a time — revoke
+-4242, heartbeat -4243, era -4244, failure flood -4245, osc -4300,
+sanitizer -4400, metrics -4500, diskless -4600, hier -4700, the quant
+collective tag -35 inside the collective CID plane, and the CKPT_CID_BIT
+payload channel — and its invariants lived only in scattered comments.
+This pass extracts ONE registry from the tree and machine-checks:
+
+``tag-collision``
+    No two named tag constants (or CID plane bits) resolve to the same
+    value from different definition sites. A collision silently routes
+    one subsystem's frames into another's handler.
+
+``orphan-tag``
+    Every system tag (<= SYSTEM_TAG_BASE) that is ever *sent*
+    (``send_system(..., TAG)``, a ``SystemPlane(TAG, ...)`` binding's
+    send side, or an ``isend`` naming the tag) has a registered handler
+    somewhere in the tree. System frames have no unexpected queue — an
+    unbound tag drops the frame on the floor.
+
+``handler-fence``
+    Every handler binding is reachable from
+    ``runtime/wireup.init_process_mode`` BEFORE the pre-activation
+    fence (the LAST ``modex.fence()`` in that function). A fast peer's
+    first frame can arrive the moment the fence releases it, and a
+    handler bound later loses that frame — the PR 5 diskless flake,
+    encoded. Intentionally-lazy planes carry an inline suppression
+    with the argument why the lost-first-frame window is benign.
+
+Registry extraction is static: module-level integer constants whose
+name matches ``*TAG*`` (negative value) or ``*_CID_BIT``, plus raw
+negative literals at send sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ompi_tpu.analysis.report import Finding
+from ompi_tpu.analysis.pkgmodel import (
+    ModuleInfo,
+    Package,
+    load_package,
+    load_source,
+)
+from ompi_tpu.analysis import threads as _threads
+
+RULES: Dict[str, str] = {
+    "tag-collision": "system tags / cid plane bits are defined once per "
+                     "value across the tree",
+    "orphan-tag": "every sent system tag has a registered handler",
+    "handler-fence": "system handlers bind before the wireup "
+                     "pre-activation fence",
+}
+
+SYSTEM_TAG_BASE = -4000
+_TAG_NAME_RE = re.compile(r"(^|_)TAG(_|$)")
+_CID_BIT_RE = re.compile(r"CID_BIT$")
+_EXCLUDE_RE = re.compile(r"BASE$")  # SYSTEM_TAG_BASE and friends
+
+WIREUP = "runtime/wireup.py"
+
+
+class TagDef:
+    __slots__ = ("name", "value", "mod", "line", "kind")
+
+    def __init__(self, name: str, value: int, mod: ModuleInfo,
+                 line: int, kind: str):
+        self.name = name
+        self.value = value
+        self.mod = mod
+        self.line = line
+        self.kind = kind  # "tag" | "cidbit"
+
+
+class Registry:
+    """The extracted protocol registry (also what ``--json`` dumps)."""
+
+    def __init__(self):
+        self.defs: List[TagDef] = []
+        # value -> [(mod, line, context)] for system-plane sends
+        self.sent: Dict[int, List[Tuple[ModuleInfo, int, str]]] = {}
+        # value -> [(mod, line, fn_qual)] handler-binding sites
+        self.handled: Dict[int, List[Tuple[ModuleInfo, int, str]]] = {}
+        # plane-owning module relp -> tag value (SystemPlane ctors)
+        self.planes: Dict[str, int] = {}
+        # functions containing an `<plane>.ensure(...)` call:
+        # [(owner module relp, fn_qual, mod, line)]
+        self.ensures: List[Tuple[str, str, ModuleInfo, int]] = []
+
+    def names_for(self, value: int) -> List[str]:
+        return [d.name for d in self.defs if d.value == value]
+
+
+def _resolve_tag(node: ast.AST, mod: ModuleInfo,
+                 pkg: Package) -> Optional[int]:
+    """Resolve a tag operand: int literal, module constant, imported
+    name, or `alias.NAME` attribute."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub) \
+            and isinstance(node.operand, ast.Constant):
+        v = node.operand.value
+        return -v if isinstance(v, int) else None
+    if isinstance(node, ast.Name):
+        if node.id in mod.constants:
+            return mod.constants[node.id]
+        src = mod.from_names.get(node.id)
+        if src is not None:
+            m = pkg.module_for_dotted(src[0])
+            if m is not None:
+                return m.constants.get(src[1])
+        return None
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        dotted = mod.resolve_module(node.value.id)
+        if dotted is not None:
+            m = pkg.module_for_dotted(dotted)
+            if m is not None:
+                return m.constants.get(node.attr)
+    return None
+
+
+def _fn_qual(stack: List[str], mod: ModuleInfo) -> str:
+    return f"{mod.relp}::{'.'.join(stack) if stack else '<module>'}"
+
+
+class _Collector(ast.NodeVisitor):
+    """Per-module walk collecting sends / handler bindings / ensures."""
+
+    def __init__(self, mod: ModuleInfo, pkg: Package, reg: Registry):
+        self.mod = mod
+        self.pkg = pkg
+        self.reg = reg
+        self.stack: List[str] = []
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):  # noqa: N802
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_Call(self, node):  # noqa: N802
+        mod, pkg, reg = self.mod, self.pkg, self.reg
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else "")
+        qual = _fn_qual(self.stack, mod)
+        if name == "send_system":
+            tag = None
+            if len(node.args) >= 4:
+                tag = _resolve_tag(node.args[3], mod, pkg)
+            for kw in node.keywords:
+                if kw.arg == "tag":
+                    tag = _resolve_tag(kw.value, mod, pkg)
+            if tag is not None:
+                reg.sent.setdefault(tag, []).append(
+                    (mod, node.lineno, "send_system"))
+        elif name == "register_system_handler" and node.args:
+            tag = _resolve_tag(node.args[0], mod, pkg)
+            if tag is not None:
+                reg.handled.setdefault(tag, []).append(
+                    (mod, node.lineno, qual))
+        elif name in ("SystemPlane", "_SystemPlane") and node.args:
+            tag = _resolve_tag(node.args[0], mod, pkg)
+            if tag is not None:
+                reg.handled.setdefault(tag, []).append(
+                    (mod, node.lineno, qual))
+                # the plane's send side counts as a sender of this tag
+                reg.sent.setdefault(tag, []).append(
+                    (mod, node.lineno, "SystemPlane"))
+                reg.planes[mod.relp] = tag
+        elif name == "ensure":
+            # `<something>._plane.ensure(pml)` / `_plane.ensure(pml)`:
+            # attribute the ensure to the module owning the plane —
+            # local call, or through a module alias
+            owner: Optional[str] = None
+            recv = func.value if isinstance(func, ast.Attribute) else None
+            chain: List[str] = []
+            while isinstance(recv, ast.Attribute):
+                chain.append(recv.attr)
+                recv = recv.value
+            if isinstance(recv, ast.Name):
+                chain.append(recv.id)
+                dotted = mod.resolve_module(recv.id)
+                if dotted is not None:
+                    m = pkg.module_for_dotted(dotted)
+                    if m is not None:
+                        owner = m.relp
+            if owner is None and any("plane" in c for c in chain):
+                owner = mod.relp
+            if owner is not None:
+                reg.ensures.append((owner, qual, mod, node.lineno))
+        elif name == "isend":
+            for a in list(node.args) + [kw.value for kw in node.keywords
+                                        if kw.arg == "tag"]:
+                tag = _resolve_tag(a, mod, pkg)
+                if tag is not None and (
+                        tag <= SYSTEM_TAG_BASE
+                        or any(d.value == tag for d in reg.defs)):
+                    reg.sent.setdefault(tag, []).append(
+                        (mod, node.lineno, "isend"))
+        self.generic_visit(node)
+
+
+def build_registry(pkg: Package) -> Registry:
+    reg = Registry()
+    for mod in pkg.modules.values():
+        if mod.tree is None:
+            continue
+        for name, value in mod.constants.items():
+            if _EXCLUDE_RE.search(name):
+                continue
+            line = mod.const_lines.get(name, 0)
+            if _CID_BIT_RE.search(name):
+                reg.defs.append(TagDef(name, value, mod, line, "cidbit"))
+            elif _TAG_NAME_RE.search(name) and value < 0:
+                reg.defs.append(TagDef(name, value, mod, line, "tag"))
+    for mod in pkg.modules.values():
+        if mod.tree is not None:
+            _Collector(mod, pkg, reg).visit(mod.tree)
+    return reg
+
+
+# ----------------------------------------------------------- fence closure
+def _prefence_closure(pkg: Package) -> Optional[Set[str]]:
+    """Qualnames of functions reachable from init_process_mode's
+    statements BEFORE the pre-activation fence (the last .fence() call).
+    None when the tree has no wireup (single-file runs: the fence rule
+    then treats every binding as unreachable)."""
+    wmod = pkg.modules.get(WIREUP)
+    if wmod is None or wmod.tree is None:
+        return None
+    init = None
+    for node in wmod.tree.body:
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "init_process_mode":
+            init = node
+            break
+    if init is None:
+        return None
+    fence_line = None
+    for n in ast.walk(init):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "fence":
+            fence_line = n.lineno  # last one wins: pre-activation fence
+    model = _threads.build_model(pkg)
+    root = _threads.FnInfo(f"{WIREUP}::<prefence>", "<prefence>", None,
+                           wmod, init)
+    for stmt in init.body:
+        if fence_line is not None and stmt.lineno >= fence_line:
+            break
+        for n in ast.walk(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if isinstance(f, ast.Name):
+                root.calls.append(("name", f.id))
+            elif isinstance(f, ast.Attribute):
+                v = f.value
+                if isinstance(v, ast.Name) and \
+                        wmod.resolve_module(v.id):
+                    root.calls.append(
+                        ("mod:" + wmod.resolve_module(v.id), f.attr))
+                else:
+                    root.calls.append(("attr", f.attr))
+    closure: Set[str] = {f"{WIREUP}::init_process_mode",
+                         f"{WIREUP}::<prefence>"}
+    work = [root]
+    while work:
+        fi = work.pop()
+        for nxt in _threads._resolve_calls(model, fi):
+            if nxt.qual not in closure:
+                closure.add(nxt.qual)
+                work.append(nxt)
+    # nested defs of init_process_mode before the fence (handlers are
+    # defined inline and registered inline)
+    for q in list(model.fns):
+        if q.startswith(f"{WIREUP}::init_process_mode."):
+            closure.add(q)
+    return closure
+
+
+# ------------------------------------------------------------------ rules
+def check_registry(pkg: Package, reg: Registry) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def add(mod: ModuleInfo, rule: str, line: int, msg: str,
+            hint: str = "") -> None:
+        if mod.suppress.active(line, rule):
+            return
+        findings.append(Finding(rule, mod.path, line, msg, hint=hint))
+
+    # ---- tag-collision: one value, one definition site (per kind)
+    for kind in ("tag", "cidbit"):
+        by_value: Dict[int, List[TagDef]] = {}
+        for d in reg.defs:
+            if d.kind == kind:
+                by_value.setdefault(d.value, []).append(d)
+        for value, defs in sorted(by_value.items()):
+            if len(defs) <= 1:
+                continue
+            first = defs[0]
+            for d in defs[1:]:
+                if d.name == first.name:
+                    # the same logical constant re-exported under its
+                    # own name (ANY_TAG in the package __init__) is one
+                    # definition, not two subsystems
+                    continue
+                add(d.mod, "tag-collision", d.line,
+                    f"{d.name} = {value} collides with {first.name} "
+                    f"({first.mod.relp}:{first.line}) — two subsystems "
+                    "sharing one value route frames into each other's "
+                    "handler",
+                    hint="pick an unused value; the registry in this "
+                         "pass's --json output lists the taken ones")
+
+    # ---- orphan-tag: sent system tags without any handler
+    for value, sites in sorted(reg.sent.items()):
+        if value > SYSTEM_TAG_BASE:
+            continue  # collective-plane tags are matched, not dispatched
+        if value in reg.handled:
+            continue
+        names = reg.names_for(value) or [str(value)]
+        for mod, line, ctx in sites:
+            add(mod, "orphan-tag", line,
+                f"system tag {names[0]} ({value}) is sent here ({ctx}) "
+                "but no register_system_handler/SystemPlane binds it "
+                "anywhere — system frames have no unexpected queue, the "
+                "frame is dropped on the floor",
+                hint="bind a handler (and bind it before the wireup "
+                     "pre-activation fence)")
+
+    # ---- handler-fence
+    closure = _prefence_closure(pkg)
+    for value, sites in sorted(reg.handled.items()):
+        ok = False
+        if closure is not None:
+            for mod, _line, qual in sites:
+                if qual in closure:
+                    ok = True
+            # a module-level SystemPlane ctor binds lazily through
+            # .ensure(pml): reachable when any pre-fence function calls
+            # the owning module's ensure
+            for owner, qual, _m, _l in reg.ensures:
+                if reg.planes.get(owner) == value and qual in closure:
+                    ok = True
+        if ok:
+            continue
+        for mod, line, qual in sites:
+            names = reg.names_for(value) or [str(value)]
+            add(mod, "handler-fence", line,
+                f"handler for system tag {names[0]} ({value}) is bound "
+                f"in {qual.split('::')[-1]}, which is not reachable "
+                "from wireup before the pre-activation fence — a fast "
+                "peer's first frame on this tag beats the binding and "
+                "is silently dropped (the PR 5 diskless flake class)",
+                hint="bind from init_process_mode before the second "
+                     "modex.fence() (the diskless _plane.ensure idiom), "
+                     "or suppress with the argument why a lost first "
+                     "frame is benign")
+    return findings
+
+
+# ------------------------------------------------------------- public API
+def analyze_package(pkg: Package) -> List[Finding]:
+    return check_registry(pkg, build_registry(pkg))
+
+
+def analyze_paths(paths: List[str]) -> List[Finding]:
+    return analyze_package(load_package(paths))
+
+
+def analyze_source(src: str, path: str) -> List[Finding]:
+    return analyze_package(load_source(src, path))
+
+
+def registry_json(pkg: Package) -> Dict:
+    """The extracted registry, for --json scripting."""
+    return registry_dict(build_registry(pkg))
+
+
+def registry_dict(reg: Registry) -> Dict:
+    return {
+        "tags": [
+            {"name": d.name, "value": d.value, "module": d.mod.relp,
+             "line": d.line, "kind": d.kind,
+             "handled": d.value in reg.handled,
+             "sent": d.value in reg.sent}
+            for d in sorted(reg.defs, key=lambda d: (d.kind, d.value))
+        ],
+    }
+
+
+# -------------------------------------------------------------- self-test
+SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
+    "tag-collision": ("ompi_tpu/ft/newplane.py", """
+HEARTBEAT_TAG = -4243
+SHADOW_TAG = -4243  # same value, different subsystem: must fire
+"""),
+    "orphan-tag": ("ompi_tpu/runtime/telemetry.py", """
+from ompi_tpu.pml.base import send_system
+
+TELEMETRY_TAG = -4800
+
+def ship(pml, dst, obj):
+    send_system(pml, dst, obj, TELEMETRY_TAG)
+"""),
+    "handler-fence": ("ompi_tpu/runtime/telemetry.py", """
+from ompi_tpu.pml.base import send_system
+
+TELEMETRY_TAG = -4800
+
+def bind_late(pml):
+    pml.register_system_handler(TELEMETRY_TAG, lambda hdr, payload: None)
+
+def ship(pml, dst, obj):
+    send_system(pml, dst, obj, TELEMETRY_TAG)
+"""),
+}

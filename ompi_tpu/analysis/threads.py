@@ -1,0 +1,907 @@
+"""mpiracer lock-discipline / cross-thread-race pass.
+
+The most expensive recurring bug class in this tree is the app-thread /
+ProgressThread data race: ``progress._call_count`` (PR 9),
+``NbcRequest._child_error`` and the sched ``_ctr`` (PR 10), ob1's
+``_acked`` (PR 3) were each found only by human review after landing.
+This pass machine-checks the two contracts those reviews kept
+re-deriving:
+
+``lock-discipline``
+    Per class, an attribute is *lock-owned* when an attribute-defining
+    assignment carries a ``# locked-by: self._lock`` annotation, or by
+    inference: any write to ``self.X`` inside a ``with <lock>:`` block
+    (outside ``__init__``) marks ``X`` as owned by that lock. Every
+    other write to a lock-owned attribute must hold one of its owning
+    locks; a ``# locked-by: <lock>`` comment on a ``def`` line asserts
+    the caller holds that lock for the whole body (the MatchingEngine
+    "called with lock held" contract, made machine-readable).
+
+``cross-thread-race``
+    An intra-package call graph is seeded with app-thread entries
+    (public communicator/mesh/request/checkpoint verbs, ``isend`` /
+    ``irecv``, ``Start``) and progress-thread entries
+    (``ProgressThread`` bodies, ``register_progress`` callbacks, btl
+    ``progress``/deliver paths, system-plane handlers, watchdog sweeps,
+    ``weakref.finalize`` finalizers, ``threading.Thread`` targets).
+    State reachable from BOTH domains that is mutated read-modify-write
+    (``+=``, ``.append()``, ``.pop()`` ...) with no lock held and no
+    lock ownership anywhere is exactly the ``_call_count`` bug class —
+    flagged at each unlocked mutation site.
+
+Plain loads are not flagged (monotonic-latch reads are the house idiom
+everywhere); a read that matters is by definition part of a
+read-modify-write, and those are. GIL-atomic single-op dict/deque
+idioms that are *intentionally* lock-free carry a per-line
+``# mpiracer: disable=<rule> — justification`` suppression
+(pkgmodel.Suppressions enforces the justification).
+
+Statistical counters (the spc.record relaxed-atomic trade: a racing
+``+=`` can at worst lose a count, and the hot path must stay one
+bytecode) are annotated ONCE at their definition instead of at every
+bump site::
+
+    _ctr = {"copied": 0}  # mpiracer: relaxed-counter — single-op GIL
+                          # adds; loss tolerated, hot path stays lock-free
+
+which exempts that name from both rules. The justification is required
+— a bare ``relaxed-counter`` marker is ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ompi_tpu.analysis.report import Finding
+from ompi_tpu.analysis.pkgmodel import (
+    ModuleInfo,
+    Package,
+    load_package,
+    load_source,
+)
+
+RULES: Dict[str, str] = {
+    "lock-discipline": "lock-owned attributes are written only under an "
+                       "owning lock (annotated or inferred)",
+    "cross-thread-race": "no unlocked read-modify-write on state "
+                         "reachable from both the app thread and the "
+                         "progress thread",
+}
+
+# thread-domain labels
+APP = 1
+PROG = 2
+
+# Modules whose public surface is an app-thread entry (user verbs,
+# request waits, checkpoint/restore, persistent Start). The progress
+# side is seeded structurally (thread targets, callback registrations),
+# so only this list is curated.
+APP_ENTRY_MODULES = (
+    "comm/communicator.py",
+    "comm/intercomm.py",
+    "parallel/mesh.py",
+    "parallel/multislice.py",
+    "parallel/partitioned.py",
+    "core/request.py",
+    "pml/ob1.py",
+    "pml/base.py",
+    "pml/partitioned.py",
+    "runtime/checkpoint.py",
+    "runtime/progress.py",  # Wait loops drive progress()/idle_block()
+    "ft/diskless.py",
+    "ft/recovery.py",
+    "coll/persist.py",
+    "reshard/exec.py",
+    "reshard/elastic.py",
+    "osc/window.py",
+    "io/file.py",
+)
+
+# Registration calls whose fn argument becomes a progress-thread root.
+_PROG_REGISTRARS = {
+    "register_progress",       # runtime/progress.py callbacks
+    "register_system_handler",  # pml system plane (delivered on progress)
+    "on_failure",              # ft detector callbacks
+    "set_propagator",          # ft failure flood
+    "finalize",                # weakref.finalize(obj, fn, ...)
+    "register_forget_hook",    # metrics reclaim hooks (comm Free path)
+}
+# Constructions binding (tag, handler): handler runs on delivery.
+_PLANE_CTORS = ("SystemPlane", "_SystemPlane")
+# Method names that ARE progress-domain entries wherever they exist:
+# every btl's progress() drain/accept loop, and the pml deliver entry a
+# btl invokes through its stored `deliver` callback (also re-entered
+# inline by the self btl — the call graph adds the app label there).
+_PROG_METHOD_SEEDS = {"progress", "handle_incoming"}
+
+# Mutating container/method calls counted as writes on their receiver.
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "rotate", "sort", "reverse",
+}
+
+# Generic method names never resolved package-wide (dict/list/socket/
+# Event/logging surface — resolving `.get()` to ModexClient.get would
+# wire every dict read into the modex).
+_GENERIC_ATTRS = _MUTATORS | {
+    "get", "put", "keys", "values", "items", "copy", "join", "close",
+    "open", "read", "write", "index", "count", "encode", "decode",
+    "split", "strip", "format", "cast", "tobytes", "fileno", "acquire",
+    "release", "wait", "set", "is_set", "notify", "notify_all", "recv",
+    "recv_into", "sendall", "sendmsg", "connect", "bind", "listen",
+    "accept", "settimeout", "setblocking", "shutdown", "flush", "seek",
+    "tell", "match", "search", "sub", "group", "info", "debug",
+    "warning", "error", "exception", "log", "pack", "unpack",
+    "pack_into", "unpack_from", "item", "sum", "min", "max", "all",
+    "any", "view", "astype", "reshape", "start", "stop", "kill",
+    "exists", "isdir", "dirname", "basename", "abspath", "normpath",
+}
+
+_LOCKED_BY_RE = re.compile(r"#\s*locked-by:\s*([A-Za-z_][\w.()]*)")
+# a Condition's context manager acquires its lock, so `with self._cond:`
+# counts; mutex covers ports of that idiom
+_LOCKY_RE = re.compile(r"lock|cond|mutex", re.IGNORECASE)
+_RELAXED_RE = re.compile(
+    r"#\s*mpiracer:\s*relaxed-counter\s*(?:—|--|:)\s*(\S.*)")
+
+# constructor-ish methods excluded from inference AND checking: they run
+# before the object is visible to a second thread
+_CTOR_METHODS = {"__init__", "__new__", "__post_init__", "__init_subclass__"}
+
+
+# ------------------------------------------------------------ lock tokens
+class LockToken(tuple):
+    """(root, path) — root is 'self', '<module>' for module globals, or
+    a local variable name (foreign object); path is the dotted lock
+    attribute path ('engine.lock', '_pump_lock', '_lock')."""
+
+    __slots__ = ()
+
+    def __new__(cls, root: str, path: str):
+        return super().__new__(cls, (root, path))
+
+    @property
+    def root(self) -> str:
+        return self[0]
+
+    @property
+    def path(self) -> str:
+        return self[1]
+
+
+def _attr_chain(node: ast.AST) -> Optional[Tuple[str, List[str]]]:
+    """Attribute chain root name + path components, or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, list(reversed(parts))
+    return None
+
+
+def _lock_token(expr: ast.AST,
+                aliases: Dict[str, LockToken]) -> Optional[LockToken]:
+    """LockToken for a with-item expression when it looks like a lock:
+    ``self._lock``, ``self.engine.lock``, ``conn.wlock``, ``_lock``,
+    ``self._order_lock(key)`` (call through a lock factory), or a local
+    name previously assigned from one."""
+    if isinstance(expr, ast.Call):
+        chain = _attr_chain(expr.func)
+        if chain and chain[1] and _LOCKY_RE.search(chain[1][-1]):
+            root, parts = chain
+            return LockToken(root, ".".join(parts) + "()")
+        if isinstance(expr.func, ast.Name) and \
+                _LOCKY_RE.search(expr.func.id):
+            return LockToken("<module>", expr.func.id + "()")
+        return None
+    chain = _attr_chain(expr)
+    if chain and chain[1] and _LOCKY_RE.search(chain[1][-1]):
+        return LockToken(chain[0], ".".join(chain[1]))
+    if isinstance(expr, ast.Name):
+        if expr.id in aliases:
+            return aliases[expr.id]
+        if _LOCKY_RE.search(expr.id):
+            return LockToken("<module>", expr.id)
+    return None
+
+
+def _parse_locked_by(text: str) -> Optional[LockToken]:
+    """'self.engine.lock' -> (self, engine.lock); '_wake_lock' ->
+    (<module>, _wake_lock)."""
+    text = text.strip()
+    if not text:
+        return None
+    if text.startswith("self."):
+        return LockToken("self", text[len("self."):])
+    if "." not in text and "(" not in text:
+        return LockToken("<module>", text)
+    root, _, rest = text.partition(".")
+    return LockToken(root, rest)
+
+
+# --------------------------------------------------------------- accesses
+READ, ASSIGN, STORE, RMW, MUTCALL = "read", "assign", "store", "rmw", "mutcall"
+_WRITE_KINDS = (ASSIGN, STORE, RMW, MUTCALL)
+
+
+class Access:
+    __slots__ = ("root", "attr", "kind", "line", "held", "fn")
+
+    def __init__(self, root: str, attr: str, kind: str, line: int,
+                 held: frozenset, fn: "FnInfo"):
+        self.root = root      # 'self', '<module>', or a local var name
+        self.attr = attr      # attribute name, or global name for module
+        self.kind = kind
+        self.line = line
+        self.held = held      # frozenset[LockToken]
+        self.fn = fn
+
+
+class FnInfo:
+    __slots__ = ("qual", "name", "cls", "mod", "node", "calls",
+                 "accesses", "annot_locks", "is_ctor", "label")
+
+    def __init__(self, qual: str, name: str, cls: Optional[str],
+                 mod: ModuleInfo, node: ast.AST):
+        self.qual = qual
+        self.name = name
+        self.cls = cls          # enclosing class name or None
+        self.mod = mod
+        self.node = node
+        self.calls: List[Tuple[str, str]] = []  # (kind, name)
+        self.accesses: List[Access] = []
+        self.annot_locks: frozenset = frozenset()
+        self.is_ctor = name in _CTOR_METHODS
+        self.label = 0
+
+
+class ClassInfo:
+    __slots__ = ("name", "mod", "methods", "bases", "lock_map",
+                 "evidence", "annotated")
+
+    def __init__(self, name: str, mod: ModuleInfo, bases: List[str]):
+        self.name = name
+        self.mod = mod
+        self.bases = bases
+        self.methods: Dict[str, FnInfo] = {}
+        # attr -> set of owning lock paths (LockToken.path strings)
+        self.lock_map: Dict[str, Set[str]] = {}
+        self.evidence: Dict[str, Tuple[str, int]] = {}  # attr -> site
+        self.annotated: Set[str] = set()
+
+
+class Model:
+    """Extraction result over one Package."""
+
+    def __init__(self, pkg: Package):
+        self.pkg = pkg
+        self.fns: Dict[str, FnInfo] = {}
+        self.classes: Dict[Tuple[str, str], ClassInfo] = {}  # (relp, name)
+        self.class_by_name: Dict[str, List[ClassInfo]] = {}
+        self.methods_by_name: Dict[str, List[FnInfo]] = {}
+        self.mod_fns: Dict[Tuple[str, str], FnInfo] = {}  # (relp, name)
+        self.prog_seeds: Set[str] = set()
+        # module-global lock map: (relp, name) -> owning lock paths
+        self.global_lock_map: Dict[Tuple[str, str], Set[str]] = {}
+        self.global_evidence: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        # relaxed-counter annotations: (relp, name) globals and
+        # (relp, class, attr) attributes exempt from both rules
+        self.relaxed_globals: Set[Tuple[str, str]] = set()
+        self.relaxed_attrs: Set[Tuple[str, str, str]] = set()
+
+
+# ------------------------------------------------------------- extraction
+class _Extractor:
+    def __init__(self, mod: ModuleInfo, model: Model):
+        self.mod = mod
+        self.model = model
+        self.lines = mod.src.splitlines()
+        # line -> locked-by expr text
+        self.locked_by: Dict[int, str] = {}
+        # lines carrying a justified relaxed-counter marker
+        self.relaxed_lines: Set[int] = set()
+        for i, line in enumerate(self.lines, 1):
+            m = _LOCKED_BY_RE.search(line)
+            if m:
+                self.locked_by[i] = m.group(1)
+            if _RELAXED_RE.search(line):
+                self.relaxed_lines.add(i)
+
+    def run(self) -> None:
+        for node in self.mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._function(node, cls=None, prefix="")
+            elif isinstance(node, ast.ClassDef):
+                self._class(node)
+        # module-level statements may register callbacks too
+        pseudo = FnInfo(f"{self.mod.relp}::<module>", "<module>", None,
+                        self.mod, self.mod.tree)
+        self._walk_fn(pseudo,
+                      [s for s in self.mod.tree.body
+                       if not isinstance(s, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef,
+                                             ast.ClassDef))])
+
+    def _class(self, node: ast.ClassDef) -> None:
+        bases = []
+        for b in node.bases:
+            chain = _attr_chain(b)
+            if chain:
+                bases.append((chain[1] or [chain[0]])[-1])
+            elif isinstance(b, ast.Name):
+                bases.append(b.id)
+        ci = ClassInfo(node.name, self.mod, bases)
+        self.model.classes[(self.mod.relp, node.name)] = ci
+        self.model.class_by_name.setdefault(node.name, []).append(ci)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = self._function(item, cls=node.name,
+                                    prefix=node.name + ".")
+                ci.methods[item.name] = fi
+
+    def _function(self, node, cls: Optional[str], prefix: str) -> FnInfo:
+        qual = f"{self.mod.relp}::{prefix}{node.name}"
+        fi = FnInfo(qual, node.name, cls, self.mod, node)
+        # a locked-by comment on the def line asserts the caller's lock
+        annot = self.locked_by.get(node.lineno)
+        if annot:
+            tok = _parse_locked_by(annot)
+            if tok is not None:
+                fi.annot_locks = frozenset({tok})
+        self.model.fns[qual] = fi
+        self.model.methods_by_name.setdefault(node.name, []).append(fi)
+        if cls is None:
+            self.model.mod_fns[(self.mod.relp, node.name)] = fi
+        self._walk_fn(fi, node.body)
+        return fi
+
+    # -------------------------------------------------- statement walking
+    def _walk_fn(self, fi: FnInfo, body: List[ast.stmt]) -> None:
+        aliases: Dict[str, LockToken] = {}
+        self._walk(fi, body, frozenset(fi.annot_locks), aliases)
+
+    def _walk(self, fi: FnInfo, body: List[ast.stmt], held: frozenset,
+              aliases: Dict[str, LockToken]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: fresh lock context (it runs later, from
+                # whoever calls it — reachability comes from callback
+                # registration or a local by-name call)
+                self._function(stmt, cls=fi.cls,
+                               prefix=(fi.qual.split("::", 1)[1]
+                                       + ".<locals>."))
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                self._class(stmt)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                new = set(held)
+                for item in stmt.items:
+                    tok = _lock_token(item.context_expr, aliases)
+                    if tok is not None:
+                        new.add(tok)
+                    else:
+                        self._expr(fi, item.context_expr, held, aliases)
+                    if item.optional_vars is not None and tok is not None \
+                            and isinstance(item.optional_vars, ast.Name):
+                        aliases[item.optional_vars.id] = tok
+                self._walk(fi, stmt.body, frozenset(new), aliases)
+                continue
+            if isinstance(stmt, ast.If):
+                self._expr(fi, stmt.test, held, aliases)
+                self._walk(fi, stmt.body, held, aliases)
+                self._walk(fi, stmt.orelse, held, aliases)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._expr(fi, stmt.iter, held, aliases)
+                self._store_target(fi, stmt.target, held, noflag=True)
+                self._walk(fi, stmt.body, held, aliases)
+                self._walk(fi, stmt.orelse, held, aliases)
+                continue
+            if isinstance(stmt, ast.While):
+                self._expr(fi, stmt.test, held, aliases)
+                self._walk(fi, stmt.body, held, aliases)
+                self._walk(fi, stmt.orelse, held, aliases)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._walk(fi, stmt.body, held, aliases)
+                for h in stmt.handlers:
+                    self._walk(fi, h.body, held, aliases)
+                self._walk(fi, stmt.orelse, held, aliases)
+                self._walk(fi, stmt.finalbody, held, aliases)
+                continue
+            if isinstance(stmt, ast.Assign):
+                tok = _lock_token(stmt.value, aliases)
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and tok is not None:
+                        aliases[t.id] = tok
+                    self._store_target(fi, t, held)
+                    if stmt.lineno in self.relaxed_lines:
+                        self._mark_relaxed(fi, t)
+                self._expr(fi, stmt.value, held, aliases)
+                # attribute-defining annotation: self.X = ... # locked-by:
+                annot = self.locked_by.get(stmt.lineno)
+                if annot:
+                    owner = _parse_locked_by(annot)
+                    for t in stmt.targets:
+                        chain = _attr_chain(t)
+                        if owner is not None and chain and \
+                                chain[0] == "self" and len(chain[1]) == 1 \
+                                and fi.cls is not None:
+                            ci = self.model.classes.get(
+                                (self.mod.relp, fi.cls))
+                            if ci is not None:
+                                ci.lock_map.setdefault(
+                                    chain[1][0], set()).add(owner.path)
+                                ci.annotated.add(chain[1][0])
+                                ci.evidence.setdefault(
+                                    chain[1][0],
+                                    (self.mod.relp, stmt.lineno))
+                continue
+            if isinstance(stmt, ast.AugAssign):
+                self._store_target(fi, stmt.target, held, rmw=True)
+                self._expr(fi, stmt.value, held, aliases)
+                continue
+            if isinstance(stmt, ast.AnnAssign):
+                if stmt.value is not None:
+                    self._store_target(fi, stmt.target, held)
+                    if stmt.lineno in self.relaxed_lines:
+                        self._mark_relaxed(fi, stmt.target)
+                    self._expr(fi, stmt.value, held, aliases)
+                continue
+            if isinstance(stmt, (ast.Expr, ast.Return)):
+                if getattr(stmt, "value", None) is not None:
+                    self._expr(fi, stmt.value, held, aliases)
+                continue
+            if isinstance(stmt, (ast.Delete,)):
+                for t in stmt.targets:
+                    self._store_target(fi, t, held)
+                continue
+            if isinstance(stmt, ast.Raise):
+                if stmt.exc is not None:
+                    self._expr(fi, stmt.exc, held, aliases)
+                continue
+            if isinstance(stmt, ast.Assert):
+                self._expr(fi, stmt.test, held, aliases)
+                continue
+            # Import / Pass / Global / Nonlocal / Break / Continue: no-op
+
+    def _mark_relaxed(self, fi: FnInfo, t: ast.AST) -> None:
+        chain = _attr_chain(t)
+        if chain is not None and chain[0] == "self" and chain[1] and \
+                fi.cls is not None:
+            self.model.relaxed_attrs.add(
+                (self.mod.relp, fi.cls, chain[1][0]))
+        elif isinstance(t, ast.Name) and t.id in self.mod.globals:
+            self.model.relaxed_globals.add((self.mod.relp, t.id))
+
+    def _store_target(self, fi: FnInfo, t: ast.AST, held: frozenset,
+                      rmw: bool = False, noflag: bool = False) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._store_target(fi, e, held, rmw=rmw, noflag=noflag)
+            return
+        kind = RMW if rmw else ASSIGN
+        if isinstance(t, ast.Subscript):
+            kind = RMW if rmw else STORE
+            t = t.value
+        chain = _attr_chain(t)
+        if chain is not None and chain[1]:
+            root, parts = chain
+            if noflag:
+                return
+            fi.accesses.append(Access(root, parts[0], kind,
+                                      t.lineno, held, fi))
+        elif isinstance(t, ast.Name) and not noflag:
+            if t.id in self.mod.globals:
+                fi.accesses.append(Access("<module>", t.id, kind,
+                                          t.lineno, held, fi))
+
+    def _expr(self, fi: FnInfo, node: ast.AST, held: frozenset,
+              aliases: Dict[str, LockToken]) -> None:
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            self._call(fi, n, held, aliases)
+
+    def _call(self, fi: FnInfo, n: ast.Call, held: frozenset,
+              aliases: Dict[str, LockToken]) -> None:
+        func = n.func
+        # ---- call-graph edge
+        if isinstance(func, ast.Name):
+            fi.calls.append(("name", func.id))
+            if func.id in _PLANE_CTORS and len(n.args) >= 2:
+                self._seed_callback(fi, n.args[1])
+            if func.id == "Thread":
+                for kw in n.keywords:
+                    if kw.arg == "target":
+                        self._seed_callback(fi, kw.value)
+            if func.id in _PROG_REGISTRARS and n.args:
+                self._seed_callback(
+                    fi, n.args[1] if func.id in ("register_system_handler",
+                                                 "finalize")
+                    and len(n.args) > 1 else n.args[0])
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+            recv = func.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                fi.calls.append(("self", name))
+            elif isinstance(recv, ast.Name) and \
+                    self.mod.resolve_module(recv.id):
+                fi.calls.append(
+                    ("mod:" + self.mod.resolve_module(recv.id), name))
+            else:
+                fi.calls.append(("attr", name))
+            if name in _PROG_REGISTRARS:
+                # weakref.finalize(obj, fn) / pml.register_system_handler
+                idx = 1 if name in ("register_system_handler",
+                                    "finalize") else 0
+                if len(n.args) > idx:
+                    self._seed_callback(fi, n.args[idx])
+            if name == "Thread":
+                for kw in n.keywords:
+                    if kw.arg == "target":
+                        self._seed_callback(fi, kw.value)
+            if name in _PLANE_CTORS and len(n.args) >= 2:
+                self._seed_callback(fi, n.args[1])
+            # ---- mutating method call on an attribute / global
+            if name in _MUTATORS:
+                chain = _attr_chain(recv)
+                if chain is not None and chain[1]:
+                    fi.accesses.append(Access(chain[0], chain[1][0],
+                                              MUTCALL, n.lineno, held, fi))
+                elif isinstance(recv, ast.Name) and \
+                        recv.id in self.mod.globals:
+                    fi.accesses.append(Access("<module>", recv.id,
+                                              MUTCALL, n.lineno, held, fi))
+
+    def _seed_callback(self, fi: FnInfo, arg: ast.AST) -> None:
+        """Mark a registered callback as a progress-thread root."""
+        model = self.model
+        if isinstance(arg, ast.Lambda):
+            qual = f"{fi.qual}.<lambda@{arg.lineno}>"
+            lfi = FnInfo(qual, "<lambda>", fi.cls, self.mod, arg)
+            model.fns[qual] = lfi
+            self._expr(lfi, arg.body, frozenset(), {})
+            model.prog_seeds.add(qual)
+            return
+        if isinstance(arg, ast.Name):
+            model.prog_seeds.add(f"{self.mod.relp}::name:{arg.id}")
+            return
+        chain = _attr_chain(arg)
+        if chain is not None and chain[1]:
+            root, parts = chain
+            if root == "self" and fi.cls is not None:
+                model.prog_seeds.add(
+                    f"{self.mod.relp}::{fi.cls}.{parts[-1]}")
+            else:
+                model.prog_seeds.add(f"*::{parts[-1]}")
+
+
+# ---------------------------------------------------------- lock inference
+def _infer_lock_maps(model: Model) -> None:
+    for fi in model.fns.values():
+        if fi.is_ctor:
+            continue
+        for acc in fi.accesses:
+            if acc.kind not in _WRITE_KINDS or not acc.held:
+                continue
+            if acc.root == "self" and fi.cls is not None:
+                ci = model.classes.get((fi.mod.relp, fi.cls))
+                if ci is None:
+                    continue
+                for tok in acc.held:
+                    if tok.root in ("self", "<module>"):
+                        ci.lock_map.setdefault(acc.attr, set()).add(
+                            tok.path)
+                        ci.evidence.setdefault(acc.attr,
+                                               (fi.mod.relp, acc.line))
+            elif acc.root == "<module>":
+                for tok in acc.held:
+                    if tok.root == "<module>":
+                        key = (fi.mod.relp, acc.attr)
+                        model.global_lock_map.setdefault(
+                            key, set()).add(tok.path)
+                        model.global_evidence.setdefault(
+                            key, (fi.mod.relp, acc.line))
+
+
+# ------------------------------------------------------------ reachability
+def _resolve_calls(model: Model, fi: FnInfo) -> List[FnInfo]:
+    out: List[FnInfo] = []
+    relp = fi.mod.relp
+    for kind, name in fi.calls:
+        if kind == "name":
+            # local nested def of the same lexical chain first
+            prefix = fi.qual.split("::", 1)[1]
+            nested = model.fns.get(f"{relp}::{prefix}.<locals>.{name}")
+            if nested is not None:
+                out.append(nested)
+                continue
+            target = model.mod_fns.get((relp, name))
+            if target is not None:
+                out.append(target)
+                continue
+            src = fi.mod.from_names.get(name)
+            if src is not None:
+                m = model.pkg.module_for_dotted(src[0])
+                if m is not None:
+                    target = model.mod_fns.get((m.relp, src[1]))
+                    if target is not None:
+                        out.append(target)
+                        continue
+            # constructor call -> __init__ of a class of that name
+            for ci in model.class_by_name.get(name, ()):
+                init = ci.methods.get("__init__")
+                if init is not None:
+                    out.append(init)
+        elif kind == "self" and fi.cls is not None:
+            ci = model.classes.get((relp, fi.cls))
+            found = False
+            seen: Set[str] = set()
+            stack = [ci] if ci is not None else []
+            while stack:
+                c = stack.pop()
+                if c is None or c.name in seen:
+                    continue
+                seen.add(c.name)
+                m = c.methods.get(name)
+                if m is not None:
+                    out.append(m)
+                    found = True
+                for b in c.bases:
+                    stack.extend(model.class_by_name.get(b, ()))
+            if not found and name not in _GENERIC_ATTRS:
+                out.extend(model.methods_by_name.get(name, ()))
+        elif kind.startswith("mod:"):
+            m = model.pkg.module_for_dotted(kind[4:])
+            if m is not None:
+                target = model.mod_fns.get((m.relp, name))
+                if target is not None:
+                    out.append(target)
+        else:  # generic attribute call
+            if name not in _GENERIC_ATTRS:
+                out.extend(model.methods_by_name.get(name, ()))
+    return out
+
+
+def _seed_and_propagate(model: Model) -> None:
+    # app seeds: public surface of the curated verb/entry modules
+    for fi in model.fns.values():
+        if fi.mod.relp in APP_ENTRY_MODULES and \
+                not fi.name.startswith("_") and "<locals>" not in fi.qual:
+            fi.label |= APP
+    # progress seeds
+    prog: List[FnInfo] = []
+    for fi in model.fns.values():
+        if fi.cls is not None and fi.name in _PROG_METHOD_SEEDS:
+            prog.append(fi)
+    for seed in model.prog_seeds:
+        if seed.startswith("*::"):
+            prog.extend(model.methods_by_name.get(seed[3:], ()))
+            continue
+        fi = model.fns.get(seed)
+        if fi is not None:
+            prog.append(fi)
+            continue
+        if "::name:" in seed:
+            relp, name = seed.split("::name:", 1)
+            # a by-name registered callback: module fn or any nested def
+            target = model.mod_fns.get((relp, name))
+            if target is not None:
+                prog.append(target)
+            for q, f in model.fns.items():
+                if q.startswith(relp + "::") and \
+                        q.endswith(".<locals>." + name):
+                    prog.append(f)
+    for fi in prog:
+        fi.label |= PROG
+
+    # BFS per label
+    edges: Dict[str, List[FnInfo]] = {}
+
+    def succ(fi: FnInfo) -> List[FnInfo]:
+        got = edges.get(fi.qual)
+        if got is None:
+            got = edges[fi.qual] = _resolve_calls(model, fi)
+        return got
+
+    for label in (APP, PROG):
+        work = [f for f in model.fns.values() if f.label & label]
+        while work:
+            fi = work.pop()
+            for nxt in succ(fi):
+                if not nxt.label & label:
+                    nxt.label |= label
+                    work.append(nxt)
+
+
+# ------------------------------------------------------------------ rules
+def _held_satisfies(acc: Access, owners: Set[str]) -> bool:
+    for tok in acc.held:
+        if tok.path in owners:
+            return True
+    return False
+
+
+def _check(model: Model) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def add(mod: ModuleInfo, rule: str, line: int, msg: str,
+            hint: str = "") -> None:
+        if mod.suppress.active(line, rule):
+            return
+        findings.append(Finding(rule, mod.path, line, msg, hint=hint))
+
+    # ---- lock-discipline: class attributes
+    for ci in model.classes.values():
+        if not ci.lock_map:
+            continue
+        for m in ci.methods.values():
+            if m.is_ctor:
+                continue
+            for acc in m.accesses:
+                if acc.root != "self" or acc.kind not in _WRITE_KINDS:
+                    continue
+                if (ci.mod.relp, ci.name, acc.attr) in \
+                        model.relaxed_attrs:
+                    continue
+                owners = ci.lock_map.get(acc.attr)
+                if not owners or _held_satisfies(acc, owners):
+                    continue
+                ev = ci.evidence.get(acc.attr, ("?", 0))
+                add(ci.mod, "lock-discipline", acc.line,
+                    f"{ci.name}.{m.name} writes self.{acc.attr} without "
+                    f"holding its owning lock "
+                    f"({' / '.join(sorted(owners))}; ownership "
+                    f"established at {ev[0]}:{ev[1]})",
+                    hint="hold the lock, annotate the def with "
+                         "`# locked-by: <lock>` if the caller holds it, "
+                         "or suppress with a justification")
+
+    # ---- lock-discipline: module globals
+    for fi in model.fns.values():
+        if fi.is_ctor:
+            continue
+        for acc in fi.accesses:
+            if acc.root != "<module>" or acc.kind not in _WRITE_KINDS:
+                continue
+            key = (fi.mod.relp, acc.attr)
+            if key in model.relaxed_globals:
+                continue
+            owners = model.global_lock_map.get(key)
+            if not owners or _held_satisfies(acc, owners):
+                continue
+            if fi.name == "<module>":
+                continue  # import-time init: single-threaded
+            ev = model.global_evidence.get(key, ("?", 0))
+            add(fi.mod, "lock-discipline", acc.line,
+                f"{fi.name}() writes module global {acc.attr} without "
+                f"holding its owning lock ({' / '.join(sorted(owners))}; "
+                f"ownership established at {ev[0]}:{ev[1]})",
+                hint="hold the lock or suppress with a justification")
+
+    # ---- cross-thread-race: unlocked RMW on dual-domain state
+    # group accesses by (class attr) and (module global)
+    attr_accs: Dict[Tuple[str, str, str], List[Access]] = {}
+    for fi in model.fns.values():
+        for acc in fi.accesses:
+            if acc.root == "self" and fi.cls is not None:
+                attr_accs.setdefault(
+                    ("C", fi.mod.relp + "::" + fi.cls, acc.attr),
+                    []).append(acc)
+            elif acc.root == "<module>":
+                attr_accs.setdefault(
+                    ("G", fi.mod.relp, acc.attr), []).append(acc)
+    for (kind, where, attr), accs in attr_accs.items():
+        if kind == "C":
+            relp, cls = where.split("::", 1)
+            ci = model.classes.get((relp, cls))
+            if ci is None or ci.lock_map.get(attr) or \
+                    (relp, cls, attr) in model.relaxed_attrs:
+                continue  # lock-owned: the discipline rule covers it
+            mod = ci.mod
+        else:
+            if model.global_lock_map.get((where, attr)) or \
+                    (where, attr) in model.relaxed_globals:
+                continue
+            mod = model.fns[accs[0].fn.qual].mod
+        labels = 0
+        for acc in accs:
+            if not acc.fn.is_ctor and acc.fn.name != "<module>":
+                labels |= acc.fn.label
+        if labels != (APP | PROG):
+            continue
+        for acc in accs:
+            if acc.kind not in (RMW, MUTCALL) or acc.held or \
+                    acc.fn.is_ctor or acc.fn.name == "<module>" or \
+                    not acc.fn.label:
+                continue
+            what = f"{where.split('::')[-1]}.{attr}" if kind == "C" \
+                else f"module global {attr}"
+            add(mod, "cross-thread-race", acc.line,
+                f"unlocked read-modify-write of {what} in "
+                f"{acc.fn.name}(), which is reachable from "
+                f"{_label_str(acc.fn.label)} while the attribute is "
+                "touched from both thread domains with no owning lock "
+                "anywhere (the progress._call_count bug class)",
+                hint="guard every mutation with one lock, use an atomic "
+                     "idiom (itertools.count), or suppress with a "
+                     "justification")
+    return findings
+
+
+def _label_str(label: int) -> str:
+    return {APP: "the app thread", PROG: "the progress thread",
+            APP | PROG: "both thread domains"}.get(label, "no entry")
+
+
+# ------------------------------------------------------------- public API
+def build_model(pkg: Package) -> Model:
+    model = Model(pkg)
+    for mod in pkg.modules.values():
+        if mod.tree is None:
+            continue
+        if mod.relp.startswith("analysis/"):
+            # offline CLI tooling: no runtime threads exist there, and
+            # its embedded bad-code snippets must not pollute the
+            # name-resolved call graph
+            continue
+        _Extractor(mod, model).run()
+    _infer_lock_maps(model)
+    _seed_and_propagate(model)
+    return model
+
+
+def analyze_package(pkg: Package) -> List[Finding]:
+    model = build_model(pkg)
+    return _check(model)
+
+
+def analyze_paths(paths: List[str]) -> List[Finding]:
+    return analyze_package(load_package(paths))
+
+
+def analyze_source(src: str, path: str) -> List[Finding]:
+    return analyze_package(load_source(src, path))
+
+
+# -------------------------------------------------------------- self-test
+SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
+    "lock-discipline": ("ompi_tpu/pml/ob1.py", """
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._depth = 0
+
+    def deposit(self, n):
+        with self._lock:
+            self._depth += n
+
+    def leak(self, n):
+        self._depth = n  # write outside self._lock: must fire
+"""),
+    "cross-thread-race": ("ompi_tpu/comm/communicator.py", """
+from ompi_tpu.runtime.progress import register_progress
+
+class Comm:
+    def __init__(self):
+        self._ops = 0
+
+    def Send(self, buf):
+        self._ops += 1          # app thread
+
+    def _drain_cb(self):
+        self._ops += 1          # progress thread
+        return 0
+
+def install(comm):
+    register_progress(comm._drain_cb)
+"""),
+}

@@ -1,0 +1,202 @@
+"""mpiracer CLI — static lock-discipline, cross-thread-race, and
+wire-protocol registry analysis.
+
+Thin wrapper over ``ompi_tpu.analysis.threads`` (lock map inference +
+call-graph thread-reachability) and ``ompi_tpu.analysis.protocol``
+(system tag/plane registry: collisions, orphan tags, handler-fence).
+Shares the Finding/reporter/exit-code format with mpilint::
+
+    python -m tools.mpiracer [PATH ...]     # default: ompi_tpu/
+    python -m tools.mpiracer --self-test    # every rule vs a bad snippet
+    python -m tools.mpiracer --list-rules
+    python -m tools.mpiracer --json         # findings + tag registry
+
+Suppression: ``# mpiracer: disable=<rule>[,<rule>...] — justification``
+on the offending line. The justification is REQUIRED: a bare
+``disable=`` raises the unsuppressable ``bare-suppression`` finding.
+
+Exit status: 0 = clean, 1 = findings (including the expected seeded
+violations under --self-test), 2 = usage error or a rule that failed
+to fire in --self-test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ompi_tpu.analysis.report import Finding, format_finding, report  # noqa: E402
+from ompi_tpu.analysis import pkgmodel as _pkgmodel  # noqa: E402
+from ompi_tpu.analysis import protocol as _protocol  # noqa: E402
+from ompi_tpu.analysis import threads as _threads  # noqa: E402
+
+# rules owned by the shared scan layer (emitted here so each fires once
+# per file even though both passes share the parse)
+COMMON_RULES: Dict[str, str] = {
+    "bare-suppression": "every mpiracer suppression carries a "
+                        "justification after the rule list",
+    "parse-error": "every analyzed file must parse (a broken file "
+                   "would silently escape every other rule)",
+}
+
+RULES: Dict[str, str] = {**_threads.RULES, **_protocol.RULES,
+                         **COMMON_RULES}
+
+COMMON_SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
+    "bare-suppression": ("ompi_tpu/coll/basic.py", """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def locked(self):
+        with self._lock:
+            self._n = 1
+
+    def unlocked(self):
+        self._n = 2  # mpiracer: disable=lock-discipline
+"""),
+    "parse-error": ("ompi_tpu/coll/basic.py", """
+def broken(:
+    return
+"""),
+}
+
+SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
+    **_threads.SELF_TEST_SNIPPETS,
+    **_protocol.SELF_TEST_SNIPPETS,
+    **COMMON_SELF_TEST_SNIPPETS,
+}
+
+
+def _common_findings(pkg: _pkgmodel.Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in pkg.modules.values():
+        if mod.parse_error is not None:
+            line, msg = mod.parse_error
+            findings.append(Finding("parse-error", mod.path, line,
+                                    f"unparseable file: {msg}"))
+            continue
+        for line in mod.suppress.bare:
+            findings.append(Finding(
+                "bare-suppression", mod.path, line,
+                "mpiracer suppression without a justification — the "
+                "rule list must be followed by the reason the "
+                "violation is intentional",
+                hint="append `— <why this is safe>` after the rules"))
+    return findings
+
+
+def analyze_package(pkg: _pkgmodel.Package,
+                    registry=None) -> List[Finding]:
+    """Both passes + the shared-scan rules. Pass a pre-built protocol
+    Registry to reuse it (the --json path dumps the same registry it
+    checked, without a second whole-package walk)."""
+    findings = _common_findings(pkg)
+    findings += _threads.analyze_package(pkg)
+    if registry is None:
+        registry = _protocol.build_registry(pkg)
+    findings += _protocol.check_registry(pkg, registry)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def analyze_paths(paths: List[str]) -> List[Finding]:
+    return analyze_package(_pkgmodel.load_package(paths))
+
+
+def analyze_source(src: str, path: str) -> List[Finding]:
+    return analyze_package(_pkgmodel.load_source(src, path))
+
+
+def self_test() -> Tuple[List[Finding], List[str]]:
+    """Analyze every embedded bad snippet. Returns (all findings, rule
+    ids that FAILED to fire on their snippet)."""
+    findings: List[Finding] = []
+    missed: List[str] = []
+    for rule, (fake_path, src) in SELF_TEST_SNIPPETS.items():
+        got = analyze_source(src, fake_path)
+        findings.extend(got)
+        if not any(f.rule == rule for f in got):
+            missed.append(rule)
+    return findings, missed
+
+
+def _to_json(findings: List[Finding], registry) -> str:
+    return json.dumps({
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "severity": f.severity, "message": f.message,
+             "hint": f.hint}
+            for f in findings
+        ],
+        "registry": _protocol.registry_dict(registry),
+        "clean": not findings,
+    }, indent=2)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mpiracer",
+        description="static lock-discipline / cross-thread-race / "
+                    "wire-protocol analysis for ompi_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the ompi_tpu "
+                         "package next to this tool; note the protocol "
+                         "fence rule needs the whole tree in view)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="analyze the embedded bad snippet for every "
+                         "rule; exits 1 when all rules correctly fire "
+                         "on the seeded violations, 2 when any rule "
+                         "is silent")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule ids and contracts")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings + the extracted tag registry "
+                         "as JSON on stdout (promexport-style "
+                         "scripting); exit codes unchanged")
+    opts = ap.parse_args(argv)
+
+    if opts.list_rules:
+        width = max(len(r) for r in RULES)
+        for rule, desc in RULES.items():
+            print(f"{rule:<{width}}  {desc}")
+        return 0
+
+    if opts.self_test:
+        findings, missed = self_test()
+        for f in findings:
+            print(format_finding(f), file=sys.stderr)
+        for rule in missed:
+            print(f"SELF-TEST FAIL: rule '{rule}' did not fire on its "
+                  "seeded violation", file=sys.stderr)
+        if missed:
+            return 2
+        print(f"self-test: all {len(SELF_TEST_SNIPPETS)} rules "
+              f"fired ({len(findings)} seeded findings)")
+        return 1 if findings else 2
+
+    paths = opts.paths or [os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ompi_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"mpiracer: no such path: {p}", file=sys.stderr)
+            return 2
+    pkg = _pkgmodel.load_package(paths)
+    registry = _protocol.build_registry(pkg)
+    findings = analyze_package(pkg, registry=registry)
+    if opts.json:
+        print(_to_json(findings, registry))
+        return 1 if any(f.severity == "error" for f in findings) else 0
+    return report(findings, clean_paths=None if findings else paths)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
