@@ -314,6 +314,17 @@ from ompi_tpu.pml.base import SystemPlane as _SystemPlane  # noqa: E402
 _plane = _SystemPlane(HIER_TAG, _on_system)
 
 
+def bind_plane(pml) -> None:
+    """Wireup hook: bind the -4700 handler before the pre-activation
+    fence. The lazy ensure in report() runs when THIS rank finishes a
+    composed call — a peer that finished the same collective earlier
+    has already shipped its stage report, and an unbound tag drops it
+    (re-scoring would then see only a subset of samples). Unconditional:
+    an unused handler is one dict slot, and hier selection is a
+    per-communicator decision this plane must not depend on."""
+    _plane.ensure(pml)
+
+
 # ------------------------------------------------------------- plan sync
 def sync(comm, st: VerbState, idx: int) -> None:
     """The agreed-index plan agreement: the root publishes its active

@@ -479,6 +479,18 @@ from ompi_tpu.pml.base import SystemPlane as _SystemPlane  # noqa: E402
 _plane = _SystemPlane(METRICS_TAG, _on_system)
 
 
+def bind_plane(pml) -> None:
+    """Wireup hook: bind the -4500 handler on the not-yet-published pml
+    BEFORE the pre-activation fence. The init_bottom hook
+    (_bind_world_handler) reads world_pml(), which is still None at
+    that point in wireup — and a fast peer's first collective entry
+    stamp can arrive the moment the fence releases it, before this
+    rank's init_bottom runs (the PR 5 diskless flake class; mpiracer
+    handler-fence)."""
+    if _enable_var._value:
+        _plane.ensure(pml)
+
+
 def _trip_local(cid: int, skew_us: float, ewma_us: float,
                 detail: str) -> None:
     """The laggard-side trip: pvar + spc + MPI_T event + show_help + a

@@ -374,6 +374,16 @@ from ompi_tpu.pml.base import SystemPlane as _SystemPlane  # noqa: E402
 _plane = _SystemPlane(SAN_TAG, _on_system)
 
 
+def bind_plane(pml) -> None:
+    """Wireup hook: bind the -4400 handler before the pre-activation
+    fence (world_pml() is still None inside wireup, so the init_bottom
+    hook can't cover this window — a fast peer's first shipped coll
+    entry would be dropped and every later call index would be off by
+    one, reported as phantom divergence)."""
+    if _enable_var._value:
+        _plane.ensure(pml)
+
+
 def _deadlock_detected(pml, cycle: List[int]) -> None:
     """Report a cycle once per episode, tell the other members, and
     (level >= 2) fail the locally-blocked requests whose wait-for edge

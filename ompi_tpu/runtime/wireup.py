@@ -268,6 +268,21 @@ def init_process_mode():
     if ft_diskless.enabled():
         ft_diskless._plane.ensure(pml)
 
+    # same fence discipline for the other diagnostic planes (mpiracer
+    # handler-fence): the sanitizer/metrics init_bottom hooks read
+    # world_pml(), which is None until init_process_mode RETURNS — so a
+    # fast peer racing through init_bottom into its first collective
+    # could ship a stamp/probe this rank's unbound tag would drop. The
+    # hier retune plane has no init_bottom hook at all (its lazy ensure
+    # ran only when this rank's own composed call finished).
+    from ompi_tpu.coll.hier import decide as hier_decide
+    from ompi_tpu.runtime import metrics as rt_metrics
+    from ompi_tpu.runtime import sanitizer as rt_sanitizer
+
+    rt_sanitizer.bind_plane(pml)
+    rt_metrics.bind_plane(pml)
+    hier_decide.bind_plane(pml)
+
     hb = None
     if get_var("ft", "enable") and job == 0:
         # the heartbeat ring runs over job-0 world ranks; spawned jobs
