@@ -106,7 +106,7 @@ _tag_map_var = register_var(
 
 # classification counters (plain int bumps, the btl _ctr discipline) —
 # stamped-by-class totals prove the demotion map engages
-_ctr: Dict[str, int] = {"normal": 0, "latency": 0, "bulk": 0,
+_ctr: Dict[str, int] = {"normal": 0, "latency": 0, "bulk": 0,  # mpiracer: relaxed-counter — classify() rides the per-send hot path; single-op GIL adds, a racing bump may lose a count
                         "seg_frames": 0, "reassembled": 0}
 
 register_pvar("qos", "stamped_normal", lambda: _ctr["normal"],
@@ -198,7 +198,16 @@ _cls_cache: Dict[int, int] = {}
 
 
 def _clear_cache(*_a) -> None:
-    _cls_cache.clear()
+    # rebind, don't .clear(): the pml's classify() reads this dict from
+    # both the app thread and the progress thread with no lock (one
+    # dict hit per send is the whole point). clear() racing a concurrent
+    # _comm_class insert could resurrect a stale class after a comm-attr
+    # rewrite; swapping in a fresh dict is one atomic store, and an
+    # in-flight reader of the old dict at worst finishes its current
+    # lookup against the pre-invalidation view (found by mpiracer
+    # cross-thread-race).
+    global _cls_cache
+    _cls_cache = {}
 
 
 def comm_keyval() -> int:
@@ -232,7 +241,13 @@ def get_comm_class(comm) -> int:
 
 
 def _comm_class(cid: int) -> int:
-    cls = _cls_cache.get(cid)
+    # bind the dict ONCE: a _clear_cache() rebind racing this lookup
+    # must see our (possibly stale) insert land in the DISCARDED dict,
+    # not the fresh one — re-reading the global at the store would let
+    # a pre-invalidation class resurrect into the new cache (and stick
+    # to a recycled cid)
+    cache = _cls_cache
+    cls = cache.get(cid)
     if cls is not None:
         return cls
     from ompi_tpu.comm.communicator import lookup_comm
@@ -243,7 +258,7 @@ def _comm_class(cid: int) -> int:
         v = comm.attributes.get(_keyval)
         if v is not None:
             cls = int(v)
-    _cls_cache[cid] = cls
+    cache[cid] = cls
     return cls
 
 

@@ -184,7 +184,13 @@ def idle_block(max_wait: float, base: float,
     finally:
         with _wake_lock:
             _parked[0] -= 1
-    _idle_blocks[0] += 1
+    # pvar bump under the wake lock: the app thread (progress_until)
+    # and the ProgressThread both park here, and the unlocked += was
+    # the same lost-update read-modify-write _call_count had before
+    # PR 9 (found by mpiracer cross-thread-race). Once per completed
+    # park — nowhere near the hot path, so the lock is free.
+    with _wake_lock:
+        _idle_blocks[0] += 1
     if any(fd == wake_r for fd, _ev in ready):
         try:
             _os.read(wake_r, 4096)  # drain coalesced pokes
