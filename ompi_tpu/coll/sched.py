@@ -229,7 +229,7 @@ class _RoundState:
 
     def free(self, views) -> None:
         for v in views:
-            ent = self._held.pop(id(v), None)
+            ent = self._held.pop(id(v), None)  # mpiracer: disable=cross-thread-race — a _RoundState belongs to ONE schedule; the single-driver _gen_running token (NbcRequest) serializes every resume that can reach free()
             if ent is not None:
                 ent[0].release(ent[1])
 

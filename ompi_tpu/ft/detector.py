@@ -73,7 +73,7 @@ def mark_failed(rank: int) -> None:
 def on_failure(cb: Callable[[int], None]) -> None:
     """Register a failure observer (reference: the PMIx event handlers
     registered at instance.c init)."""
-    _callbacks.append(cb)
+    _callbacks.append(cb)  # mpiracer: disable=cross-thread-race — GIL-atomic append at registration time; mark_failed iterates a list() snapshot
 
 
 class HeartbeatDetector:

@@ -107,7 +107,7 @@ _file_params: Optional[Dict[str, str]] = None
 _watchers: Dict[str, list] = {}
 
 
-def _load_param_file() -> Dict[str, str]:
+def _load_param_file() -> Dict[str, str]:  # locked-by: _lock
     """Parse the param file once (reference: mca_base_parse_paramfile)."""
     global _file_params
     if _file_params is not None:

@@ -177,7 +177,7 @@ class PvarSession:
 
     def free(self) -> None:
         self._freed = True
-        self._handles.clear()
+        self._handles.clear()  # mpiracer: disable=cross-thread-race — MPI_T sessions are tool-thread objects; the standard leaves concurrent session use undefined
 
 
 class PvarHandle:

@@ -125,7 +125,7 @@ def _on_message(hdr, payload: bytes) -> None:
         _HDR.unpack(payload[: _HDR.size])
     body = payload[_HDR.size:]
     if verb == _ACK:
-        p = _pending.pop(req_id, None)
+        p = _pending.pop(req_id, None)  # mpiracer: disable=cross-thread-race — GIL-atomic handoff keyed by a unique req_id: origin stores, target ACK pops exactly once
         if p is not None:
             p.data = body
             p.error = opcode  # target-side error rides the opcode field

@@ -241,7 +241,7 @@ class MatchingEngine:
             _trace.counter(name, value, cat="pml")
 
     # Called with lock held -----------------------------------------------
-    def post(self, req: RecvRequest) -> None:
+    def post(self, req: RecvRequest) -> None:  # locked-by: self.lock
         req._pseq = self._pseq
         self._pseq += 1
         self._n_posted += 1
@@ -252,7 +252,7 @@ class MatchingEngine:
             self._posted_exact.setdefault(
                 (req.cid, req.src, req.tag), deque()).append(req)
 
-    def cancel_posted(self, req: RecvRequest) -> bool:
+    def cancel_posted(self, req: RecvRequest) -> bool:  # locked-by: self.lock
         """Remove a still-pending posted receive; False if already
         matched/absent."""
         if req.matched:
@@ -273,7 +273,7 @@ class MatchingEngine:
         self._depth("pml.posted_queue", self._n_posted)
         return True
 
-    def match_posted(self, hdr: Header) -> Optional[RecvRequest]:
+    def match_posted(self, hdr: Header) -> Optional[RecvRequest]:  # locked-by: self.lock
         q = self._posted_exact.get((hdr.cid, hdr.src, hdr.tag))
         exact = q[0] if q else None
         wild = None
@@ -299,7 +299,7 @@ class MatchingEngine:
         req.status.tag = hdr.tag
         return req
 
-    def add_unexpected(self, frag: UnexpectedFrag) -> None:
+    def add_unexpected(self, frag: UnexpectedFrag) -> None:  # locked-by: self.lock
         frag._aseq = self._aseq
         self._aseq += 1
         self._n_unexpected += 1
@@ -308,7 +308,7 @@ class MatchingEngine:
         self._unexpected.setdefault((h.cid, h.src, h.tag),
                                     deque()).append(frag)
 
-    def match_unexpected(self, req: RecvRequest,
+    def match_unexpected(self, req: RecvRequest,  # locked-by: self.lock
                          remove: bool = True) -> Optional[UnexpectedFrag]:
         """Earliest-arrived fragment matching ``req`` (which may carry
         wildcards — fragments never do)."""
@@ -342,7 +342,7 @@ class MatchingEngine:
             self._depth("pml.unexpected_queue", self._n_unexpected)
         return best
 
-    def drain_posted_for_src(self, src: int) -> List[RecvRequest]:
+    def drain_posted_for_src(self, src: int) -> List[RecvRequest]:  # locked-by: self.lock
         """Remove every posted receive NAMING ``src`` (the ULFM
         peer-death drain: the pml completes them with ERR_PROC_FAILED) —
         both the fully-specified bucket entries and named-source ANY_TAG

@@ -113,7 +113,7 @@ def _owned(payload):
 
 
 # watchdog-failed requests, all pml instances (pvar + spc mirror)
-_wd_trips = [0]
+_wd_trips = [0]  # mpiracer: relaxed-counter — spc.record's relaxed-atomic trade: a racing += may lose a count; trips are rare and the pvar is a diagnostic floor
 register_pvar("pml", "watchdog_trips", lambda: _wd_trips[0],
               help="Requests failed with MPIX_ERR_PROC_FAILED by the "
                    "peer-death watchdog (detector callbacks + "
@@ -352,7 +352,7 @@ class Ob1Pml:
         matching engine is transport-agnostic, so a message stream may
         switch transports mid-protocol."""
         btl = self._btl_for(dst)
-        stashed = self.dead_letter.pop(dst, None)
+        stashed = self.dead_letter.pop(dst, None)  # mpiracer: disable=cross-thread-race — GIL-atomic claim of the whole backlog list; per-class wire order is held by the callers' order/pump locks, and cross-class interleave is allowed by design (QoS planes)
         last = None
         if stashed is None:
             # fast path: no backlog for this peer
@@ -498,7 +498,7 @@ class Ob1Pml:
             req._pump_lock = threading.RLock()
             if self._peer_timeout:
                 req._wd_last = _time.monotonic()  # RTS->CTS stall clock
-            self._pending_sends[req.msgid] = req
+            self._pending_sends[req.msgid] = req  # mpiracer: disable=lock-discipline — GIL-atomic insert under a fresh msgid; the watchdog/failure sweeps iterate a list() snapshot under engine.lock and _incoming_cts's pop is the only other writer of this key
             self._send_match_frame(dst, RNDV_RTS, cid, tag,
                                    conv.packed_size, req.msgid, b"",
                                    cls=cls)
@@ -849,7 +849,7 @@ class Ob1Pml:
             if self._peer_timeout:
                 req._wd_last = _time.monotonic()  # DATA stall clock
             recv_id = next(self._msgid)
-            self._active_recvs[recv_id] = req
+            self._active_recvs[recv_id] = req  # mpiracer: disable=lock-discipline — GIL-atomic insert under a fresh recv_id; the detector-sweep TOCTOU this opens is re-checked under known_failed() right after the CTS send below
             # protocol control frames ride LATENCY when shaping: a CTS
             # parked behind a bulk backlog stalls the whole rendezvous
             ctl = _qos.LATENCY if _qos._enable_var._value else 0
@@ -876,7 +876,7 @@ class Ob1Pml:
             except MPIError as e:
                 # dead transport: fail the receive instead of leaving it
                 # matched-but-incomplete (Wait would spin forever)
-                self._active_recvs.pop(recv_id, None)
+                self._active_recvs.pop(recv_id, None)  # mpiracer: disable=lock-discipline — GIL-atomic pop of a key only this thread inserted; a racing watchdog pop just wins the completion
                 req.status._nbytes = 0
                 req._set_complete(e.code)
                 return
@@ -889,7 +889,7 @@ class Ob1Pml:
                 from ompi_tpu.ft.detector import known_failed
 
                 if hdr.src in known_failed() and \
-                        self._active_recvs.pop(recv_id, None) is not None:
+                        self._active_recvs.pop(recv_id, None) is not None:  # mpiracer: disable=lock-discipline — the pop IS the race closer: whoever pops (this re-check or the detector sweep) owns the failure completion
                     self._fail_requests(
                         [req], f"rank {hdr.src} is failed (match race)")
 
@@ -939,7 +939,7 @@ class Ob1Pml:
 
     def _incoming_cts(self, hdr: Header, payload: bytes = b"") -> None:
         # hdr.offset carries the sender msgid; hdr.msgid the receiver reqid.
-        sreq = self._pending_sends.pop(int(hdr.offset), None)
+        sreq = self._pending_sends.pop(int(hdr.offset), None)  # mpiracer: disable=lock-discipline — GIL-atomic claim: whoever pops (CTS or failure sweep) owns the request; the dead-peer TOCTOU is re-checked below before _pump
         if sreq is None:
             return
         conv = sreq.convertor
@@ -1019,7 +1019,7 @@ class Ob1Pml:
         # _pump_lock was created in _isend, before the request became
         # watchdog-visible
         if depth and sreq.nbytes > depth:
-            self._flowing[sreq.msgid] = sreq
+            self._flowing[sreq.msgid] = sreq  # mpiracer: disable=lock-discipline — GIL-atomic insert; the detector-sweep window between the _pending_sends pop and this insert is closed by the known_failed() re-check above
         self._pump(sreq)
 
     def _pump(self, sreq: SendRequest) -> None:
@@ -1071,7 +1071,7 @@ class Ob1Pml:
             except MPIError as e:
                 # transport died mid-rendezvous: fail the send request so
                 # the sender's Wait surfaces the loss instead of spinning
-                self._flowing.pop(sreq.msgid, None)
+                self._flowing.pop(sreq.msgid, None)  # mpiracer: disable=lock-discipline — GIL-atomic pop under sreq._pump_lock; the failure sweep serializes its verdict through the same _pump_lock
                 sreq.status._nbytes = sreq._offset
                 sreq._set_complete(e.code)
                 return
@@ -1079,7 +1079,7 @@ class Ob1Pml:
                 # all bytes queued: local completion (buffered-send
                 # semantics, matching the reference's send-side FIN-free
                 # completion for non-RDMA pipelines)
-                self._flowing.pop(sreq.msgid, None)
+                self._flowing.pop(sreq.msgid, None)  # mpiracer: disable=lock-discipline — GIL-atomic pop under sreq._pump_lock (same serialization as the failure path)
                 sreq.status._nbytes = sreq.nbytes
                 sreq._set_complete(0)
 
@@ -1103,7 +1103,7 @@ class Ob1Pml:
     def _incoming_fin(self, hdr: Header) -> None:
         """Sender confirms a single-copy (cma) delivery: the whole
         message is already in our posted buffer."""
-        req = self._active_recvs.pop(hdr.msgid, None)
+        req = self._active_recvs.pop(hdr.msgid, None)  # mpiracer: disable=lock-discipline — GIL-atomic claim: FIN vs watchdog, whoever pops owns the completion
         if req is None:
             return
         from ompi_tpu.runtime import spc

@@ -232,7 +232,7 @@ def for_proc_comm(comm) -> QuantState:
             any(c.get("enable") for c in cards):
         key = (st.reason,)
         if key not in _warned:
-            _warned.add(key)
+            _warned.add(key)  # mpiracer: disable=cross-thread-race — GIL-atomic dedup for show_help; a racing add at worst prints the fallback banner twice
             show_help("quant", "negotiate-fallback",
                       comm=getattr(comm, "name", "?"), reason=st.reason)
     return st

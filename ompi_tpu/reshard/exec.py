@@ -98,7 +98,7 @@ class _Staging:
     __slots__ = ("cur", "peak")
 
     def __init__(self):
-        self.cur = 0
+        self.cur = 0  # mpiracer: relaxed-counter — per-exec staging watermark mutated only by the plan's driving thread
         self.peak = 0
 
     def alloc(self, n: int) -> None:

@@ -145,5 +145,5 @@ class Session:
         created FROM a tracked comm (Dup/Split/Create_group) register
         here too via ProcComm's propagation — tracking is transitive, or
         Finalize's liveness check would miss grandchildren."""
-        self._derived.add(comm)
+        self._derived.add(comm)  # mpiracer: disable=cross-thread-race — GIL-atomic set add; removal happens only in app-thread Finalize after traffic quiesces
         comm._session = weakref.ref(self)

@@ -32,7 +32,7 @@ _enable_var = register_var("spc", "enable", True,
                                 "(reference: mpi_spc_attach)", level=4)
 
 _lock = threading.Lock()
-_counters: Dict[str, int] = defaultdict(int)
+_counters: Dict[str, int] = defaultdict(int)  # mpiracer: relaxed-counter — record() is documented LOCK-FREE (relaxed-atomic adds, ompi_spc.c trade); the multi-field recorders below take _lock on their own
 _suppress = threading.local()
 
 

@@ -73,7 +73,7 @@ import ompi_tpu.runtime.sanitizer  # noqa: F401,E402  (cvars + hooks)
 import ompi_tpu.ft.diskless  # noqa: F401,E402  (ckpt cvars + init hook)
 
 
-def _instance_up() -> None:
+def _instance_up() -> None:  # locked-by: _lock
     """Idempotent instance bring-up (the body of the reference's
     ompi_mpi_instance_init: RTE init, framework opens, PML select,
     modex, add_procs)."""

@@ -1,0 +1,450 @@
+"""mpiracer: the static lock-discipline / cross-thread-race /
+wire-protocol gate.
+
+Tier-1 runs both passes over the whole ``ompi_tpu`` package and demands
+zero findings — every cross-thread contract violation in the tree has
+either been fixed, annotated (``# locked-by:`` / ``relaxed-counter``),
+or carries an inline ``# mpiracer: disable=<rule> — justification``.
+The self-test (one seeded-bad snippet per rule) proves every rule can
+actually fire.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ompi_tpu")
+sys.path.insert(0, REPO)
+
+from ompi_tpu.analysis import pkgmodel, protocol, threads  # noqa: E402
+from ompi_tpu.analysis.report import format_finding  # noqa: E402
+from tools import mpiracer  # noqa: E402
+
+
+# ------------------------------------------------------------ tier-1 gate
+def test_tree_clean():
+    """The CI gate: zero findings from BOTH passes over the package."""
+    findings = mpiracer.analyze_paths([PKG])
+    assert findings == [], "\n" + "\n".join(
+        format_finding(f) for f in findings)
+
+
+def test_every_rule_fires_on_its_seeded_snippet():
+    _findings, missed = mpiracer.self_test()
+    assert missed == []
+
+
+def test_rule_tables_cover_both_passes_and_common():
+    assert set(mpiracer.SELF_TEST_SNIPPETS) == set(mpiracer.RULES)
+    assert set(threads.RULES) <= set(mpiracer.RULES)
+    assert set(protocol.RULES) <= set(mpiracer.RULES)
+
+
+# ----------------------------------------------------------------- the CLI
+def test_self_test_cli_exits_nonzero_on_seeded_violations():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mpiracer", "--self-test"],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr
+    for rule in mpiracer.RULES:
+        assert f"[{rule}]" in r.stderr, f"rule {rule} missing from output"
+
+
+def test_cli_clean_tree_exits_zero():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mpiracer", "ompi_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_cli_json_output_is_scriptable():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mpiracer", "--json", "ompi_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["clean"] is True
+    assert doc["findings"] == []
+    tags = {t["name"]: t for t in doc["registry"]["tags"]}
+    # the registry sees the whole plane space
+    for name in ("REVOKE_TAG", "HEARTBEAT_TAG", "ERA_TAG", "SAN_TAG",
+                 "METRICS_TAG", "FT_CKPT_TAG", "HIER_TAG", "OSC_TAG"):
+        assert name in tags, sorted(tags)
+        assert tags[name]["handled"], name
+    assert tags["CKPT_CID_BIT"]["kind"] == "cidbit"
+    # values are unique per kind once same-name re-exports (the
+    # ANY_TAG package-__init__ idiom) collapse to one logical constant
+    pairs = {(t["name"], t["value"])
+             for t in doc["registry"]["tags"] if t["kind"] == "tag"}
+    vals = [v for _n, v in pairs]
+    assert len(vals) == len(set(vals))
+
+
+# ------------------------------------------------------------ suppressions
+def test_justified_suppression_silences_only_that_rule():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def lk(self):\n"
+        "        with self._lock:\n"
+        "            self._n = 1\n"
+        "    def unl(self):\n"
+        "        self._n = 2"
+        "  # mpiracer: disable=lock-discipline — test fixture\n"
+    )
+    assert mpiracer.analyze_source(src, "ompi_tpu/coll/basic.py") == []
+
+
+def test_bare_suppression_is_itself_a_finding():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def lk(self):\n"
+        "        with self._lock:\n"
+        "            self._n = 1\n"
+        "    def unl(self):\n"
+        "        self._n = 2  # mpiracer: disable=lock-discipline\n"
+    )
+    got = mpiracer.analyze_source(src, "ompi_tpu/coll/basic.py")
+    assert [f.rule for f in got] == ["bare-suppression"]
+
+
+def test_wrong_rule_suppression_does_not_silence():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def lk(self):\n"
+        "        with self._lock:\n"
+        "            self._n = 1\n"
+        "    def unl(self):\n"
+        "        self._n = 2"
+        "  # mpiracer: disable=cross-thread-race — wrong rule\n"
+    )
+    got = mpiracer.analyze_source(src, "ompi_tpu/coll/basic.py")
+    assert [f.rule for f in got] == ["lock-discipline"]
+
+
+# -------------------------------------------------------- lock map / locks
+def test_lock_map_inference_from_with_blocks():
+    src = (
+        "class C:\n"
+        "    def a(self):\n"
+        "        with self.engine.lock:\n"
+        "            self._q[1] = 2\n"
+        "    def b(self):\n"
+        "        self._q.pop(1, None)\n"
+    )
+    got = threads.analyze_source(src, "ompi_tpu/pml/ob1.py")
+    assert [f.rule for f in got] == ["lock-discipline"]
+    assert "engine.lock" in got[0].message
+
+
+def test_init_writes_neither_infer_nor_flag():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n"       # ctor write: no inference
+        "    def a(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n"
+    )
+    assert threads.analyze_source(src, "ompi_tpu/pml/ob1.py") == []
+
+
+def test_locked_by_annotation_on_attribute_definition():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0  # locked-by: self._lock\n"
+        "    def a(self):\n"
+        "        self._n = 5\n"
+    )
+    got = threads.analyze_source(src, "ompi_tpu/pml/ob1.py")
+    assert [f.rule for f in got] == ["lock-discipline"]
+
+
+def test_locked_by_annotation_on_def_asserts_caller_holds():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def a(self):\n"
+        "        with self._lock:\n"
+        "            self._n = 1\n"
+        "    def b(self):  # locked-by: self._lock\n"
+        "        self._n = 2\n"
+    )
+    assert threads.analyze_source(src, "ompi_tpu/pml/ob1.py") == []
+
+
+def test_condition_context_counts_as_lock():
+    src = (
+        "class C:\n"
+        "    def a(self):\n"
+        "        with self._cond:\n"
+        "            self._n = 1\n"
+        "    def b(self):\n"
+        "        with self._cond:\n"
+        "            self._n = 2\n"
+    )
+    assert threads.analyze_source(src, "ompi_tpu/pml/ob1.py") == []
+
+
+def test_relaxed_counter_marker_exempts_with_justification():
+    base = (
+        "from ompi_tpu.runtime.progress import register_progress\n"
+        "_ctr = [0]{marker}\n"
+        "def Send(x):\n"
+        "    _ctr[0] += 1\n"
+        "def _cb():\n"
+        "    _ctr[0] += 1\n"
+        "    return 0\n"
+        "register_progress(_cb)\n"
+    )
+    ok = base.format(
+        marker="  # mpiracer: relaxed-counter — loss tolerated")
+    assert threads.analyze_source(
+        ok, "ompi_tpu/comm/communicator.py") == []
+    # without a justification the marker is ignored and the race fires
+    bare = base.format(marker="  # mpiracer: relaxed-counter")
+    got = threads.analyze_source(bare, "ompi_tpu/comm/communicator.py")
+    assert {f.rule for f in got} == {"cross-thread-race"}
+
+
+# ------------------------------------------------- thread reachability
+def test_call_graph_labels_app_progress_and_dual():
+    src = (
+        "from ompi_tpu.runtime.progress import register_progress\n"
+        "class Comm:\n"
+        "    def Send(self, x):\n"
+        "        self._shared()\n"
+        "    def _app_only(self):\n"
+        "        pass\n"
+        "    def _shared(self):\n"
+        "        pass\n"
+        "    def _drain(self):\n"
+        "        self._shared()\n"
+        "        return 0\n"
+        "def install(c):\n"
+        "    register_progress(c._drain)\n"
+    )
+    model = threads.build_model(
+        pkgmodel.load_source(src, "ompi_tpu/comm/communicator.py"))
+    labels = {f.name: f.label for f in model.fns.values()}
+    assert labels["Send"] == threads.APP
+    assert labels["_drain"] & threads.PROG
+    assert labels["_shared"] == threads.APP | threads.PROG
+    assert labels["_app_only"] == 0  # defined, never reached
+
+
+def test_thread_target_and_system_handler_seed_progress():
+    src = (
+        "import threading\n"
+        "class HB:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "    def _run(self):\n"
+        "        pass\n"
+        "def bind(pml):\n"
+        "    pml.register_system_handler(-4999, _on_msg)\n"
+        "def _on_msg(hdr, payload):\n"
+        "    pass\n"
+    )
+    model = threads.build_model(
+        pkgmodel.load_source(src, "ompi_tpu/ft/detector.py"))
+    labels = {f.name: f.label for f in model.fns.values()}
+    assert labels["_run"] & threads.PROG
+    assert labels["_on_msg"] & threads.PROG
+
+
+# -------------------------------------------------------- protocol pass
+def test_tag_collision_unit_and_same_name_reexport_exempt():
+    src = "A_TAG = -4650\nB_TAG = -4650\n"
+    got = protocol.analyze_source(src, "ompi_tpu/ft/x.py")
+    assert any(f.rule == "tag-collision" for f in got)
+    # the same name re-declared (package __init__ re-export idiom)
+    reexport = "ANY_TAG = -1\n"
+    pkg_src = {"ompi_tpu/pml/base.py": reexport,
+               "ompi_tpu/__init__.py": reexport}
+    mods = [pkgmodel.ModuleInfo(p, s) for p, s in pkg_src.items()]
+    got = protocol.check_registry(
+        pkgmodel.Package(mods), protocol.build_registry(
+            pkgmodel.Package(mods)))
+    assert not any(f.rule == "tag-collision" for f in got)
+
+
+def test_orphan_tag_fires_only_below_system_base():
+    sent_sys = (
+        "from ompi_tpu.pml.base import send_system\n"
+        "X_TAG = -4650\n"
+        "def ship(pml):\n"
+        "    send_system(pml, 0, {}, X_TAG)\n"
+    )
+    got = protocol.analyze_source(sent_sys, "ompi_tpu/ft/x.py")
+    assert any(f.rule == "orphan-tag" for f in got)
+    # a collective-plane tag (> -4000) is matched, not dispatched
+    sent_coll = (
+        "TAG_X = -35\n"
+        "def go(pml):\n"
+        "    pml.isend(b'', 0, None, 1, TAG_X, 0)\n"
+    )
+    got = protocol.analyze_source(sent_coll, "ompi_tpu/coll/x.py")
+    assert not any(f.rule == "orphan-tag" for f in got)
+
+
+def _fence_pkg(tmp_path, bind_before_fence: bool):
+    root = tmp_path / "ompi_tpu"
+    (root / "runtime").mkdir(parents=True)
+    (root / "ft").mkdir()
+    bind = "    ftx.bind_plane(pml)\n"
+    wireup = (
+        "def init_process_mode():\n"
+        "    from ompi_tpu.ft import x as ftx\n"
+        "    pml = make_pml()\n"
+        "    modex.fence()\n"
+        + (bind if bind_before_fence else "")
+        + "    modex.fence()\n"
+        + ("" if bind_before_fence else bind)
+        + "    return pml\n"
+    )
+    plane = (
+        "from ompi_tpu.pml.base import SystemPlane\n"
+        "X_TAG = -4650\n"
+        "def _on(hdr, payload):\n"
+        "    pass\n"
+        "_plane = SystemPlane(X_TAG, _on)\n"
+        "def bind_plane(pml):\n"
+        "    _plane.ensure(pml)\n"
+    )
+    (root / "runtime" / "wireup.py").write_text(wireup)
+    (root / "ft" / "x.py").write_text(plane)
+    return pkgmodel.load_package([str(root)])
+
+
+def test_handler_fence_passes_prefence_binding(tmp_path):
+    pkg = _fence_pkg(tmp_path, bind_before_fence=True)
+    got = protocol.analyze_package(pkg)
+    assert not any(f.rule == "handler-fence" for f in got), got
+
+
+def test_handler_fence_fires_on_postfence_binding(tmp_path):
+    pkg = _fence_pkg(tmp_path, bind_before_fence=False)
+    got = protocol.analyze_package(pkg)
+    assert any(f.rule == "handler-fence" for f in got)
+
+
+# --------------------------------------- regressions for the real fixes
+def test_diag_planes_bound_prefence_in_real_tree():
+    """PR 13 fix: the sanitizer (-4400), metrics (-4500), and hier
+    retune (-4700) planes were bound only by init_bottom hooks /
+    first-use lazily — AFTER the wireup pre-activation fence, so a fast
+    peer's first frame could be dropped (the PR 5 diskless flake
+    class). They now bind from wireup like diskless; the fence pass
+    over the real tree must stay clean for them."""
+    got = protocol.analyze_paths([PKG])
+    fence = [f for f in got if f.rule == "handler-fence"]
+    assert fence == [], "\n".join(format_finding(f) for f in fence)
+    src = open(os.path.join(PKG, "runtime", "wireup.py")).read()
+    pre = src.split("connect_parent_if_spawned")[0]
+    for call in ("rt_sanitizer.bind_plane(pml)",
+                 "rt_metrics.bind_plane(pml)",
+                 "hier_decide.bind_plane(pml)"):
+        assert call in pre, call
+
+
+def test_metrics_bind_plane_binds_when_enabled():
+    from ompi_tpu.mca.var import set_var
+    from ompi_tpu.runtime import metrics
+
+    class FakePml:
+        def __init__(self):
+            self.handlers = {}
+
+        def register_system_handler(self, tag, fn):
+            self.handlers[tag] = fn
+
+    old = metrics._enable_var._value
+    try:
+        p = FakePml()
+        set_var("metrics", "enable", False)
+        metrics.bind_plane(p)
+        assert metrics.METRICS_TAG not in p.handlers
+        set_var("metrics", "enable", True)
+        metrics.bind_plane(p)
+        assert metrics.METRICS_TAG in p.handlers
+    finally:
+        set_var("metrics", "enable", old)
+        metrics._plane.reset()
+
+
+def test_hier_bind_plane_is_unconditional():
+    from ompi_tpu.coll.hier import decide
+
+    class FakePml:
+        def __init__(self):
+            self.handlers = {}
+
+        def register_system_handler(self, tag, fn):
+            self.handlers[tag] = fn
+
+    p = FakePml()
+    try:
+        decide.bind_plane(p)
+        assert decide.HIER_TAG in p.handlers
+    finally:
+        decide._plane.reset()
+
+
+def test_idle_blocks_pvar_bump_is_locked_and_counts():
+    """PR 13 fix: the progress_idle_blocks bump was an unlocked += on a
+    module global hit by both the app thread (progress_until) and the
+    ProgressThread — the _call_count bug class. It now runs under
+    _wake_lock; a completed park must still count exactly once."""
+    from ompi_tpu.runtime import progress
+
+    old_sources = list(progress._idle_sources)
+    progress.set_idle_sources([])  # fd-complete (empty): parking allowed
+    try:
+        before = progress._idle_blocks[0]
+        parked = progress.idle_block(0.01, 0.001)
+        assert parked is True
+        assert progress._idle_blocks[0] == before + 1
+    finally:
+        progress.set_idle_sources(old_sources)
+    # and the tree gate agrees: no cross-thread finding in progress.py
+    got = threads.analyze_paths(
+        [os.path.join(PKG, "runtime", "progress.py")])
+    assert not any(f.rule == "cross-thread-race" for f in got), got
+
+
+def test_qos_cache_invalidation_rebinds_atomically():
+    """PR 13 fix: _clear_cache() used dict.clear(), which racing a
+    concurrent classify() insert could resurrect a stale class after a
+    comm-attr rewrite. It now swaps in a fresh dict (one atomic
+    store)."""
+    from ompi_tpu import qos
+
+    qos._cls_cache[123] = qos.BULK
+    old = qos._cls_cache
+    qos._clear_cache()
+    assert qos._cls_cache is not old          # rebound, not cleared
+    assert qos._cls_cache == {}
+    assert old[123] == qos.BULK               # in-flight readers intact
+    # and the lookup binds the dict ONCE: a stale insert racing the
+    # rebind must land in the DISCARDED dict, never the fresh one —
+    # else a pre-invalidation class resurrects onto a recycled cid
+    import ast
+    import inspect
+
+    tree = ast.parse(inspect.getsource(qos._comm_class))
+    global_reads = [n.lineno for n in ast.walk(tree)
+                    if isinstance(n, ast.Name) and n.id == "_cls_cache"]
+    assert len(global_reads) == 1, (
+        "_comm_class must read the module global exactly once "
+        f"(got lines {global_reads})")
