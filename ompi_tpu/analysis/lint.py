@@ -186,6 +186,150 @@ INSTR_HIER_ATTRS = {"note_stage", "note_plan_hit", "note_plan_miss",
 INSTR_PERSIST_ATTRS = {"note_plan", "note_start", "note_overlap"}
 INSTR_QOS_ATTRS = {"classify", "note_segments", "note_reassembled"}
 
+# ---------------------------------------------------------- auto-derive
+# The lists above were hand-extended by every PR that added an
+# instrumentation plane — the recurring tax ISSUE 13 kills. They are now
+# an override/allowlist: the EFFECTIVE sets are the union of the hand
+# lists and what a package scan derives from the house conventions:
+#
+# - an instrumentation-impl module defines a top-level ``_enable_var``
+#   assignment, a top-level ``def enabled()``, a top-level ``note_*``
+#   hook, or carries an explicit ``MPILINT_INSTR_IMPL = True`` marker
+#   (for plane members with no hooks of their own, e.g. the shaped tcp
+#   send path);
+# - its aliases are every name the package imports it under
+#   (``from ompi_tpu.runtime import trace as _tr`` covers mesh.py);
+# - its guarded hook-attr set is its top-level ``note_*`` functions
+#   (the one naming convention every plane shares; the irregular hook
+#   names — observe, classify, wire_send ... — stay hand-kept).
+#
+# A new plane that follows the conventions is covered by hot-guard with
+# ZERO linter edits; ``python -m tools.mpilint --self-test`` proves the
+# derivation still reproduces the hand-kept lists (parity).
+def _pkg_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+_derived_memo: Optional[Tuple[Set[str], Dict[str, Set[str]],
+                              Dict[str, Set[str]]]] = None
+
+
+def derive_instr(root: Optional[str] = None):
+    """Scan the package once: returns (impl module rel-paths,
+    alias -> {rel modules}, rel module -> {note_* hook names})."""
+    global _derived_memo
+    if root is None and _derived_memo is not None:
+        return _derived_memo
+    from ompi_tpu.analysis import pkgmodel
+
+    pkg = pkgmodel.load_package([root or _pkg_root()])
+    impl: Set[str] = set()
+    attr_map: Dict[str, Set[str]] = {}
+    for mod in pkg.modules.values():
+        if mod.tree is None or mod.relp.startswith("analysis/"):
+            continue
+        notes: Set[str] = set()
+        is_impl = "MPILINT_INSTR_IMPL" in mod.globals
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "_enable_var"
+                    for t in stmt.targets):
+                is_impl = True
+            elif isinstance(stmt, ast.FunctionDef):
+                if stmt.name == "enabled":
+                    is_impl = True
+                elif stmt.name.startswith("note_"):
+                    is_impl = True
+                    notes.add(stmt.name)
+        if is_impl:
+            impl.add(mod.relp)
+            attr_map[mod.relp] = notes
+    alias_map: Dict[str, Set[str]] = {}
+    dotted_impl = {m.dotted: m.relp for m in pkg.modules.values()
+                   if m.relp in impl}
+    for mod in pkg.modules.values():
+        if mod.tree is None:
+            continue
+        for alias, dotted in mod.mod_aliases.items():
+            relp = dotted_impl.get(dotted)
+            if relp is not None:
+                alias_map.setdefault(alias, set()).add(relp)
+    for dotted, relp in dotted_impl.items():
+        alias_map.setdefault(dotted.rsplit(".", 1)[-1],
+                             set()).add(relp)
+    if root is None:
+        _derived_memo = (impl, alias_map, attr_map)
+        _dotted_impl_memo.update(dotted_impl)
+    return impl, alias_map, attr_map
+
+
+_dotted_impl_memo: Dict[str, str] = {}
+
+
+def _file_instr_aliases(tree: ast.Module) -> Dict[str, str]:
+    """The linted file's OWN import aliases that resolve to derived
+    instrumentation-impl modules (alias -> rel path). A file that does
+    ``from ompi_tpu.ft import diskless as _d`` gets hook coverage for
+    ``_d.note_*`` no matter what the rest of the package calls it."""
+    derive_instr()  # populate _dotted_impl_memo
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                relp = _dotted_impl_memo.get(a.name)
+                if relp is not None:
+                    out[a.asname or a.name.split(".")[0]] = relp
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                relp = _dotted_impl_memo.get(f"{node.module}.{a.name}")
+                if relp is not None:
+                    out[a.asname or a.name] = relp
+    return out
+
+
+def effective_instr_impl() -> Set[str]:
+    impl, _aliases, _attrs = derive_instr()
+    return impl | set(INSTR_IMPL)
+
+
+def _derived_hook(alias: str, attr: str,
+                  local: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """Plane label when alias.attr is a derived note_* hook — resolved
+    through the linted file's own imports first, then the package-wide
+    alias scan."""
+    if not attr.startswith("note_"):
+        return None
+    _impl, alias_map, attr_map = derive_instr()
+    relps = set(alias_map.get(alias, ()))
+    if local and alias in local:
+        relps.add(local[alias])
+    for relp in relps:
+        if attr in attr_map.get(relp, ()):
+            return os.path.basename(relp)[:-3]
+    return None
+
+
+def derive_parity():
+    """Parity of the derivation vs the hand-kept lists: returns
+    (hand impl modules the scan FAILED to derive,
+     derived-only impl modules the hand list doesn't carry,
+     hand aliases the package never imports — dead allowlist entries).
+    The first set must stay empty (the --self-test gate): a refactor
+    that breaks a convention would silently shrink hot-guard coverage
+    back to the hand lists."""
+    impl, alias_map, _attrs = derive_instr()
+    missing_impl = set(INSTR_IMPL) - impl
+    extra_impl = impl - set(INSTR_IMPL)
+    hand_aliases: Set[str] = set()
+    for s in (TRACE_ALIASES, SAN_ALIASES, INJECT_ALIASES,
+              METRICS_ALIASES, DISKLESS_ALIASES, RESHARD_ALIASES,
+              QUANT_ALIASES, HIER_ALIASES, PERSIST_ALIASES,
+              QOS_ALIASES):
+        hand_aliases |= s
+    dead_aliases = hand_aliases - set(alias_map)
+    return missing_impl, extra_impl, dead_aliases
+
+
 _SUPPRESS_RE = re.compile(r"#\s*mpilint:\s*disable=([A-Za-z0-9_,\- ]+)")
 
 
@@ -271,7 +415,8 @@ def _is_guard_expr(node: ast.AST, guard_names: Set[str]) -> bool:
     return False
 
 
-def _instr_call(node: ast.AST) -> Optional[str]:
+def _instr_call(node: ast.AST,
+                local: Optional[Dict[str, str]] = None) -> Optional[str]:
     """'trace' / 'sanitizer' / 'inject' when node is an
     instrumentation (or fault-injection hook) call."""
     if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
@@ -306,6 +451,9 @@ def _instr_call(node: ast.AST) -> Optional[str]:
             if v.id in QOS_ALIASES and \
                     node.func.attr in INSTR_QOS_ATTRS:
                 return "qos"
+            # auto-derived planes: any note_* hook of a scanned impl
+            # module, through any alias the package imports it under
+            return _derived_hook(v.id, node.func.attr, local)
     return None
 
 
@@ -318,12 +466,13 @@ def _span_call(node: ast.AST) -> bool:
 
 
 # ------------------------------------------------------------- hot-guard
-def _check_hot_guard(tree: ast.Module, scan: FileScan) -> None:
+def _check_hot_guard(tree: ast.Module, scan: FileScan,
+                     local: Optional[Dict[str, str]] = None) -> None:
     def leaf_scan(stmt: ast.stmt, guarded: bool) -> None:
         if guarded:
             return
         for n in ast.walk(stmt):
-            kind = _instr_call(n)
+            kind = _instr_call(n, local)
             if kind is not None:
                 scan.add(
                     "hot-guard", n.lineno,
@@ -686,10 +835,10 @@ def scan_source(src: str, path: str) -> FileScan:
     _check_mutable_default(tree, scan)
     _check_swallowed_mpierror(tree, scan)
     _check_hot_copy(tree, scan)
-    if relp not in INSTR_IMPL:
+    if relp not in effective_instr_impl():
         _check_span_ctx(tree, scan)
     if relp in HOT_MODULES:
-        _check_hot_guard(tree, scan)
+        _check_hot_guard(tree, scan, _file_instr_aliases(tree))
     return scan
 
 

@@ -30,6 +30,10 @@ and the active plan ("hier"/"flat") is applied on agreed call indices.
 
 from __future__ import annotations
 
+# plane member (hier/__init__ owns the note_* hooks): mpilint
+# module-scan marker for the derived INSTR_IMPL set
+MPILINT_INSTR_IMPL = True
+
 import time
 from typing import Any, Dict, Optional
 

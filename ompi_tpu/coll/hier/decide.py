@@ -30,6 +30,10 @@ the same index.
 
 from __future__ import annotations
 
+# plane member (hier/__init__ owns the note_* hooks): mpilint
+# module-scan marker for the derived INSTR_IMPL set
+MPILINT_INSTR_IMPL = True
+
 import json
 import threading
 from typing import Dict, List, Optional, Tuple

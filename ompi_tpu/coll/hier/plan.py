@@ -36,6 +36,10 @@ key components.
 
 from __future__ import annotations
 
+# plane member (hier/__init__ owns the note_* hooks): mpilint
+# module-scan marker for the derived INSTR_IMPL set
+MPILINT_INSTR_IMPL = True
+
 from typing import Optional
 
 from ompi_tpu.coll import hier as _hier

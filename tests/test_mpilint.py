@@ -244,3 +244,61 @@ def test_reshard_modules_are_in_the_instrumented_impl_set():
     for mod in ("reshard/plan.py", "reshard/exec.py",
                 "reshard/elastic.py"):
         assert mod in lint.INSTR_IMPL
+
+
+# ------------------------------------------------------- auto-derivation
+def test_derived_impl_reproduces_hand_list():
+    """PR 13 satellite: INSTR_IMPL and the alias sets are now DERIVED
+    from a module scan (_enable_var / enabled() / note_* /
+    MPILINT_INSTR_IMPL conventions) with the hand lists kept as an
+    allowlist. The scan must reproduce the hand list exactly — parity
+    is what kills the every-PR hand-extension tax safely."""
+    missing, _extra, _dead = lint.derive_parity()
+    assert missing == set()
+
+
+def test_derived_aliases_cover_every_import_alias_in_use():
+    _impl, alias_map, _attrs = lint.derive_instr()
+    # the aliases the tree actually imports instrumentation under —
+    # including mesh.py's `trace as _tr`, which predates every hand list
+    for alias in ("_trace", "_tr", "trace", "_san", "_metrics",
+                  "_inject", "_hier", "_persist", "_qos", "_quant",
+                  "_spc", "_exec"):
+        assert alias in alias_map, alias
+
+
+def test_derived_note_hook_is_hot_guard_covered_without_hand_entry():
+    """A note_* hook that is NOT in any hand-kept INSTR_*_ATTRS set
+    (diskless.note_replica_restore) must still trip hot-guard through
+    the derived tables — the zero-linter-edits contract for new
+    planes."""
+    assert "note_replica_restore" not in lint.INSTR_DISKLESS_ATTRS
+    bare = (
+        "from ompi_tpu.ft import diskless as _diskless\n"
+        "def isend(self, dst):\n"
+        "    _diskless.note_replica_restore()\n"
+    )
+    hot = lint.lint_source(bare, "ompi_tpu/pml/ob1.py")
+    assert any(f.rule == "hot-guard" for f in hot)
+    guarded = (
+        "from ompi_tpu.ft import diskless as _diskless\n"
+        "def isend(self, dst):\n"
+        "    if _diskless._enable_var._value:\n"
+        "        _diskless.note_replica_restore()\n"
+    )
+    assert lint.lint_source(guarded, "ompi_tpu/pml/ob1.py") == []
+
+
+def test_marker_modules_join_the_effective_impl_set():
+    impl = lint.effective_instr_impl()
+    for mod in ("btl/tcp.py", "reshard/elastic.py", "coll/hier/plan.py",
+                "coll/hier/decide.py", "coll/hier/compose.py"):
+        assert mod in impl
+
+
+def test_self_test_cli_reports_derivation_parity():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.mpilint", "--self-test"],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "derive parity: impl scan == hand list" in r.stdout

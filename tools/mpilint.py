@@ -54,6 +54,22 @@ def main(argv=None) -> int:
                   "seeded violation", file=sys.stderr)
         if missed:
             return 2
+        # derivation parity: the module scan must reproduce the
+        # hand-kept INSTR_IMPL list (the hand lists are an allowlist,
+        # not the coverage source of truth anymore)
+        missing_impl, extra_impl, dead_aliases = _lint.derive_parity()
+        if missing_impl:
+            print("SELF-TEST FAIL: instr-impl derivation lost "
+                  f"{sorted(missing_impl)} — a convention "
+                  "(_enable_var / enabled() / note_* / "
+                  "MPILINT_INSTR_IMPL) was refactored away",
+                  file=sys.stderr)
+            return 2
+        print(f"derive parity: impl scan == hand list"
+              + (f" (+{len(extra_impl)} convention-only modules)"
+                 if extra_impl else "")
+              + (f"; hand-only aliases kept for snippets: "
+                 f"{sorted(dead_aliases)}" if dead_aliases else ""))
         print(f"self-test: all {len(_lint.SELF_TEST_SNIPPETS)} rules "
               f"fired ({len(findings)} seeded findings)")
         return 1 if findings else 2
