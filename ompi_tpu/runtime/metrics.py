@@ -87,8 +87,13 @@ _buckets_var = register_var(
     help="Log2 histogram sizing: finite bucket upper edges 1us, 2us, "
          "4us ... 2^(N-1)us, plus the +Inf overflow bucket", level=5)
 _dir_var = register_var(
-    "metrics", "dir", ".", typ=str,
-    help="Directory for the per-rank metrics-rank<N>.json snapshot",
+    "metrics", "dir", "", typ=str,
+    help="Directory for the per-rank metrics-rank<N>.json snapshot. "
+         "Empty (default) = a per-job subdir of the system temp dir "
+         "(ompi-tpu-metrics-<launcher pid>) — NOT the CWD, which "
+         "littered repo checkouts with per-rank snapshots every "
+         "procmode run. tools/mpitop.py finds the newest such dir by "
+         "default; point this somewhere durable to keep snapshots",
     level=5)
 _http_var = register_var(
     "metrics", "http_port", 0,
@@ -565,12 +570,29 @@ def snapshot() -> Dict[str, Any]:
     return out
 
 
+def default_snapshot_dir() -> str:
+    """Where snapshots land when ``metrics_dir`` is unset: a per-JOB
+    subdir of the system temp dir. Keyed by the launcher pid (every
+    rank of one mpirun shares it, so tools/mpitop.py can merge the rank
+    files) — NOT flat tempdir, where two concurrent jobs on one host
+    would overwrite each other's metrics-rank0.json; singletons key by
+    their own pid."""
+    import tempfile
+
+    job = os.environ.get("OMPI_TPU_LAUNCHER_PID") or str(os.getpid())  # mpilint: disable=raw-environ — launcher/job identity (the wireup pdeathsig key), not config
+    return os.path.join(tempfile.gettempdir(), f"ompi-tpu-metrics-{job}")
+
+
 def export_json(path: Optional[str] = None) -> str:
     """Write the snapshot as metrics-rank<N>.json; returns the path.
     Atomic rename so tools/mpitop.py never reads a torn file."""
     if path is None:
-        path = os.path.join(_dir_var._value or ".",
-                            f"metrics-rank{_rank()}.json")
+        base = _dir_var._value or default_snapshot_dir()
+        try:
+            os.makedirs(base, exist_ok=True)
+        except OSError:
+            base = "."  # unwritable temp dir: last-resort CWD
+        path = os.path.join(base, f"metrics-rank{_rank()}.json")
     # unique tmp per writer: the periodic writer thread and the
     # finalize/atexit export may race, and a shared tmp name would let
     # one writer's fd interleave into the other's renamed final file

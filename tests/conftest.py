@@ -25,6 +25,17 @@ import jax
 
 jax.config.update("jax_platforms", _platform)
 
+# Metrics snapshots go to a throwaway dir, never the repo checkout:
+# procmode subprocesses inherit this env var, so a test that enables
+# the metrics plane can't litter metrics-rank<N>.json into the CWD.
+# Tests that care about the location still win — they set the env key
+# (or the cvar) explicitly on their own child env / registry.
+import tempfile
+
+os.environ.setdefault(
+    "OMPI_TPU_MCA_metrics_dir",
+    tempfile.mkdtemp(prefix="ompi-tpu-test-metrics-"))
+
 # Persistent compile cache: the suite's wall time is dominated by XLA
 # CPU compiles of the big shard_map programs (train step, multislice);
 # repeat runs (CI retries, the judge's second pass, local dev) hit the

@@ -498,3 +498,54 @@ def test_metrics_cvars_registered():
     assert vars_["metrics_enable"].default is False
     assert vars_["metrics_straggler_threshold_us"].typ is float
     assert vars_["metrics_http_port"].default == 0  # endpoint off by default
+
+
+# ------------------------------------------------- snapshot dir (PR 13)
+def test_default_snapshot_dir_is_per_job_under_tempdir(monkeypatch):
+    """With metrics_dir unset, snapshots land in a per-JOB temp subdir
+    (keyed by the launcher pid every rank shares; own pid for
+    singletons) — never the CWD, which littered repo checkouts, and
+    never the flat temp dir, where two concurrent jobs would overwrite
+    each other's metrics-rank0.json."""
+    import tempfile
+
+    from ompi_tpu.runtime import metrics
+
+    monkeypatch.setenv("OMPI_TPU_LAUNCHER_PID", "12345")
+    d = metrics.default_snapshot_dir()
+    assert d == os.path.join(tempfile.gettempdir(),
+                             "ompi-tpu-metrics-12345")
+    monkeypatch.delenv("OMPI_TPU_LAUNCHER_PID")
+    assert metrics.default_snapshot_dir().endswith(
+        f"ompi-tpu-metrics-{os.getpid()}")
+
+
+def test_export_json_defaults_off_the_cwd(monkeypatch):
+    from ompi_tpu.mca.var import get_var, set_var
+    from ompi_tpu.runtime import metrics
+
+    monkeypatch.setenv("OMPI_TPU_LAUNCHER_PID", str(os.getpid()))
+    old = get_var("metrics", "dir")
+    set_var("metrics", "dir", "")
+    try:
+        path = metrics.export_json()
+        assert os.path.dirname(path) == metrics.default_snapshot_dir()
+        assert os.path.exists(path)
+        os.remove(path)
+    finally:
+        set_var("metrics", "dir", old)
+
+
+def test_mpitop_default_dir_finds_newest_job_dir(monkeypatch, tmp_path):
+    import tempfile as _tf
+
+    from tools import mpitop
+
+    monkeypatch.setattr(_tf, "gettempdir", lambda: str(tmp_path))
+    assert mpitop._default_dir() == "."  # no candidates: old behavior
+    a = tmp_path / "ompi-tpu-metrics-100"
+    b = tmp_path / "ompi-tpu-metrics-200"
+    a.mkdir()
+    b.mkdir()
+    os.utime(a, (1, 1))
+    assert mpitop._default_dir() == str(b)

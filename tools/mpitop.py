@@ -170,13 +170,34 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
     return "\n".join(lines)
 
 
+def _default_dir() -> str:
+    """Mirror the writer's default (metrics.default_snapshot_dir): with
+    metrics_dir unset, ranks write to a per-job
+    ompi-tpu-metrics-<launcher pid> subdir of the temp dir. mpitop
+    can't know the pid, so it watches the most recently modified such
+    dir; no candidates (metrics never enabled, or metrics_dir pointed
+    elsewhere) falls back to the CWD like the old default."""
+    import glob
+    import tempfile
+
+    cands = glob.glob(os.path.join(tempfile.gettempdir(),
+                                   "ompi-tpu-metrics-*"))
+    cands = [d for d in cands if os.path.isdir(d)]
+    if not cands:
+        return "."
+    return max(cands, key=lambda d: os.path.getmtime(d))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="mpitop",
         description="top-like live viewer over per-rank "
                     "metrics-rank<N>.json snapshots")
-    ap.add_argument("--dir", default=".",
-                    help="snapshot directory (default .)")
+    ap.add_argument("--dir", default=None,
+                    help="snapshot directory (default: the newest "
+                         "ompi-tpu-metrics-<job> dir under the system "
+                         "temp dir — where an unset metrics_dir "
+                         "writes — falling back to the CWD)")
     ap.add_argument("--offsets", default=None,
                     help="mpisync offsets (JSON map or mpisync stdout) "
                          "for cross-host snapshot-age alignment")
@@ -185,6 +206,8 @@ def main(argv=None) -> int:
     ap.add_argument("--once", action="store_true",
                     help="render one frame and exit (no screen clear)")
     opts = ap.parse_args(argv)
+    if opts.dir is None:
+        opts.dir = _default_dir()
     offsets = load_offsets(opts.offsets) if opts.offsets else {}
 
     prev: Dict[int, dict] = {}
@@ -194,7 +217,10 @@ def main(argv=None) -> int:
         if not snaps:
             print(f"mpitop: no metrics-rank*.json under {opts.dir} "
                   "(enable with --mca metrics_enable 1; live refresh "
-                  "needs --mca metrics_snapshot_period N)",
+                  "needs --mca metrics_snapshot_period N; snapshots "
+                  "land under metrics_dir, or a per-job "
+                  "ompi-tpu-metrics-<pid> temp dir when unset — pass "
+                  "--dir to watch a specific one)",
                   file=sys.stderr)
             if opts.once:
                 return 1
