@@ -54,6 +54,8 @@ def _load_everything() -> None:
     import ompi_tpu.coll.persist  # coll_persist_* cvars + persist_* replay pvars
     import ompi_tpu.qos  # QoS classes: btl_tcp_shape_enable/segment + qos_* cvars/pvars
     # (btl/tcp.py above also carries the btl_tcp_shape_* scheduler knobs)
+    # mpilint/mpiracer (ompi_tpu/analysis/) are build-time gates by
+    # design: they register no cvars/pvars, so there is nothing to load
 
 
 def print_header(out) -> None:
