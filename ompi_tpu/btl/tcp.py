@@ -83,6 +83,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ompi_tpu import qos as _qos
 from ompi_tpu.btl.base import Btl, btl_framework
 from ompi_tpu.ft import inject as _inject
+from ompi_tpu.runtime import forensics as _forensics
 from ompi_tpu.mca.component import Component
 from ompi_tpu.mca.var import (register_var, register_pvar, get_var,
                               watch_var)
@@ -350,7 +351,7 @@ class _Conn:
     __slots__ = ("sock", "rxb", "rstart", "rend", "wq", "wbuf", "rbuf",
                  "wlock", "peer", "dead", "peer_z", "await_ack",
                  "wqs", "cur", "cur_cls", "deficit", "defer", "peer_q",
-                 "eseq")
+                 "eseq", "last_rx", "last_tx")
 
     def __init__(self, sock: socket.socket, peer: Optional[int] = None):
         self.sock = sock
@@ -400,6 +401,11 @@ class _Conn:
         # its seq planes per class (every build with this code)
         self.peer_q = False
         self.eseq = 0
+        # last wire activity (monotonic), stamped only while the
+        # forensics plane is armed — dump evidence for "is this link
+        # moving at all", not a live gauge
+        self.last_rx: Optional[float] = None
+        self.last_tx: Optional[float] = None
 
 
 class TcpBtl(Btl):
@@ -452,6 +458,76 @@ class TcpBtl(Btl):
         # progress(); concurrent drains would interleave frame parsing)
         self._progress_lock = threading.Lock()
         self._closed = False
+        # stall-forensics provider (rebind-by-name: the live transport
+        # wins; weakly bound so test-built instances don't pin)
+        _forensics.register_weak_provider(
+            "btl.tcp", self, alive=lambda btl: not btl._closed)
+
+    # -------------------------------------------------- stall forensics
+    def debug_state(self) -> dict:
+        """Forensics provider: per-connection dial/established/dead
+        state, per-class shaped queue depths with the oldest frame's
+        age, the partially-written frame, partial-frame reassembly
+        residue, and the last wire rx/tx stamps (populated while the
+        forensics plane is armed). Each conn is snapshotted under its
+        own wlock — the same lock every WRITE-queue mutation holds; the
+        rx parser's span fields belong to the progress thread and are
+        read lock-free and clamped."""
+        now = time.monotonic()
+        with self._conn_lock:
+            conns = dict(self.conns)
+        out = []
+        for peer, conn in sorted(conns.items())[:_forensics.CAP]:
+            # single reads + clamp: the rx parser advances these on the
+            # progress thread outside wlock, and a torn pair (rend read
+            # before a compaction, rstart after) must not record a
+            # negative partial-frame size as evidence
+            r0, r1 = conn.rstart, conn.rend  # mpiracer: disable=cross-thread-race — lock-free diagnostic snapshot, clamped below; taking the progress side's lock here could block a dump behind the wedged loop it is diagnosing
+            with conn.wlock:
+                ent: dict = {
+                    "peer": peer,
+                    "state": ("dead" if conn.dead is not None else
+                              "dialing" if conn.await_ack else
+                              "established"),
+                    "dead_reason": str(conn.dead) if conn.dead else None,
+                    "wq_frames": len(conn.wq),
+                    "wq_bytes": sum(len(b) for b in conn.wq),
+                    "legacy_wbuf_bytes": len(conn.wbuf),
+                    "rx_partial_bytes": max(0, r1 - r0),
+                    "last_rx_age_s": None if conn.last_rx is None
+                    else round(now - conn.last_rx, 3),
+                    "last_tx_age_s": None if conn.last_tx is None
+                    else round(now - conn.last_tx, 3),
+                }
+                if conn.cur is not None:
+                    ent["in_progress_frame"] = {
+                        "cls": _qos.NAMES.get(conn.cur_cls,
+                                              conn.cur_cls),
+                        "bytes_left": sum(len(v) for v in conn.cur)}
+                if conn.wqs is not None:
+                    shaped = {}
+                    for c in _SERVICE_ORDER:
+                        dq = conn.wqs[c]
+                        if not dq:
+                            continue
+                        shaped[_qos.NAMES[c]] = {
+                            "frames": len(dq),
+                            "bytes": sum(e[1] for e in dq),
+                            "oldest_age_s": round(now - dq[0][3], 3),
+                            "deferred_bytes": conn.defer[c]}
+                    if shaped:
+                        ent["shaped_queues"] = shaped
+            out.append(ent)
+        return {
+            "rank": self.my_rank,
+            "listen": f"{self.host}:{self.port}",
+            "closed": self._closed,
+            "conns": out,
+            "conns_omitted": max(0, len(conns) - len(out)),
+            "queued_by_class": {"latency": _qbytes[_qos.LATENCY],
+                                "normal": _qbytes[_qos.NORMAL],
+                                "bulk": _qbytes[_qos.BULK]},
+        }
 
     # ------------------------------------------------------------- wiring
     def set_peers(self, peers: Dict[int, str]) -> None:
@@ -731,6 +807,8 @@ class TcpBtl(Btl):
                 self._want_write(conn, True)
                 return
             _ctr["wire"] += sent
+            if _forensics._enable_var._value:  # last-tx dump evidence
+                conn.last_tx = time.monotonic()
             del conn.wbuf[:sent]
         self._want_write(conn, False)
 
@@ -756,6 +834,8 @@ class TcpBtl(Btl):
                 return vecs
             _ctr["writev"] += 1
             _ctr["wire"] += sent
+            if _forensics._enable_var._value:  # last-tx dump evidence
+                conn.last_tx = time.monotonic()
             while sent:
                 l0 = len(vecs[0])
                 if sent >= l0:
@@ -1027,6 +1107,8 @@ class TcpBtl(Btl):
                 return
             _ctr["writev"] += 1
             _ctr["wire"] += sent
+            if _forensics._enable_var._value:  # last-tx dump evidence
+                conn.last_tx = time.monotonic()
             while sent:
                 l0 = len(wq[0])
                 if sent >= l0:
@@ -1242,6 +1324,8 @@ class TcpBtl(Btl):
             self._unregister(conn)
             return 0
         _ctr["wire"] += n_in
+        if _forensics._enable_var._value:  # last-rx dump evidence
+            conn.last_rx = time.monotonic()
         conn.rend += n_in
         n = 0
         mv = memoryview(buf)
@@ -1367,6 +1451,8 @@ class TcpBtl(Btl):
             self._unregister(conn)
             return 0
         _ctr["wire"] += len(data)
+        if _forensics._enable_var._value:  # last-rx dump evidence
+            conn.last_rx = time.monotonic()
         conn.rbuf += data  # mpilint: disable=hot-copy — legacy A/B path reproduces the old rbuf concat on purpose
         _ctr["copied"] += len(data)
         n = 0

@@ -68,6 +68,7 @@ dying-conn lesson.
 
 from __future__ import annotations
 
+import threading
 import weakref
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -161,6 +162,38 @@ def note_start(us: float) -> None:
 def note_overlap(rounds: int) -> None:
     """Cross-phase rounds issued by one pipelined replay."""
     _overlap[0] += int(rounds)
+
+
+# ------------------------------------------------------- stall forensics
+# Live-plan registry for the forensics provider (runtime/forensics):
+# WeakSet so GC'd plans drop out; populated on the cold compile path.
+_fx_lock = threading.Lock()
+_live_plans: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _fx_debug_state() -> dict:
+    """Forensics provider: frozen-plan census — how many plans are
+    live, how many pool blocks they pin, and which persistent Starts
+    are active right now (an active Start mid-stall is an in-flight
+    round batch in coll.sched's section; this names the plan)."""
+    with _fx_lock:
+        plans = list(_live_plans)
+    active = _forensics.clip(
+        [{"verb": p.verb, "provider": p.provider,
+          "held_blocks": len(p.held),
+          "overlap_rounds": p.overlap_rounds}
+         for p in plans if p.active])
+    return {"plans_compiled": _plans[0],
+            "starts": _starts[0],
+            "live_plans": len(plans),
+            "held_blocks": sum(len(p.held) for p in plans),
+            "active_starts": active,
+            "orphaned_blocks": len(_orphans)}
+
+
+from ompi_tpu.runtime import forensics as _forensics  # noqa: E402
+
+_forensics.register_provider("coll.persist", _fx_debug_state)
 
 
 # ------------------------------------------------------------ invalidation
@@ -410,6 +443,8 @@ def compile_plan(comm, slot: str, args: tuple) -> PersistPlan:
     if live is None:
         live = comm._persist_live = weakref.WeakSet()
     live.add(plan)
+    with _fx_lock:  # forensics plan census (cold compile path)
+        _live_plans.add(plan)
     return plan
 
 

@@ -62,6 +62,7 @@ from ompi_tpu.core.datatype import BYTE
 from ompi_tpu.core.errors import MPIError, ERR_REQUEST
 from ompi_tpu.core.request import Request
 from ompi_tpu.mca.var import register_var, register_pvar
+from ompi_tpu.runtime import forensics as _forensics
 from ompi_tpu.runtime import mpool
 
 # Distinct CID plane per traffic class: COLL_CID_BIT = 1<<30 (coll/basic),
@@ -135,6 +136,62 @@ def copy_mode() -> bool:
     """True when the legacy (copying) round engine is armed — the
     algorithms branch to their verbatim pre-PR-10 staging on it."""
     return bool(_copy_mode_var._value)
+
+
+# ------------------------------------------------------- stall forensics
+# Live-schedule registry for the forensics provider: populated only
+# while the plane is armed (one live-Var load per schedule otherwise).
+# NbcRequests ride a WeakSet (they die with their requests); blocking
+# schedules check in/out explicitly around the drive loop.
+import weakref as _weakref  # noqa: E402
+
+_fx_lock = threading.Lock()
+_live_nbc: "_weakref.WeakSet" = _weakref.WeakSet()
+_live_blocking: Dict[int, dict] = {}
+
+
+def _fx_debug_state() -> dict:
+    """Forensics provider: every in-flight schedule's round batches and
+    window occupancy (what the schedule is waiting FOR), plus the
+    datapath counters. NbcRequest fields are read under each request's
+    own lock — the same lock its batch retirement holds."""
+    now = time.monotonic()
+    with _fx_lock:
+        nbc = [r for r in _live_nbc]
+        blocking = [dict(v) for v in _live_blocking.values()]
+    reqs = []
+    nbc_live = 0
+    for r in nbc:
+        if r._complete.is_set():
+            continue
+        nbc_live += 1
+        if len(reqs) >= _forensics.CAP:
+            continue
+        with r._lock:
+            waiting = ("round-self" if r._wait_self
+                       else "ordered-barrier" if r._wait_batch is not None
+                       else "window-full" if r._park_bufs is not None
+                       else "schedule-done" if r._gen_done
+                       else "advancing")
+            reqs.append({"tag": r._tag, "cid": r._cid,
+                         "inflight_batches": r._inflight,
+                         "waiting": waiting,
+                         "child_error": r._child_error,
+                         "age_s": round(
+                             now - getattr(r, "_fx_born", now), 3)})
+    for b in blocking:
+        b["age_s"] = round(now - b.pop("born"), 3)
+    with _ctr_lock:
+        counters = dict(_ctr)
+    return {"window": int(_window_var._value),
+            "nbc_inflight": reqs,
+            "nbc_inflight_omitted": max(0, nbc_live - len(reqs)),
+            "blocking": _forensics.clip(blocking),
+            "blocking_omitted": max(0, len(blocking) - _forensics.CAP),
+            "counters": counters}
+
+
+_forensics.register_provider("coll.sched", _fx_debug_state)
 
 
 def note_copied(nbytes: int) -> None:
@@ -313,6 +370,13 @@ def run_blocking(comm, gen: Schedule, tag: int, cid: int) -> None:
     state = _RoundState()
     inflight: deque = deque()  # (reqs, postcopies) of unordered rounds
     first_error: Optional[MPIError] = None
+    fx_key = None
+    if _forensics._enable_var._value:  # forensics check-in
+        fx_key = id(state)
+        with _fx_lock:
+            _live_blocking[fx_key] = {"tag": tag, "cid": cid,
+                                      "round": 0,
+                                      "born": time.monotonic()}
 
     def retire(reqs, post) -> None:
         nonlocal first_error
@@ -334,6 +398,11 @@ def run_blocking(comm, gen: Schedule, tag: int, cid: int) -> None:
             except StopIteration:
                 break
             first = False
+            if fx_key is not None:
+                with _fx_lock:
+                    ent = _live_blocking.get(fx_key)
+                    if ent is not None:
+                        ent["round"] += 1
             if rnd.free:
                 state.free(rnd.free)
             reqs, bufs, post = _issue(comm, rnd, tag, cid, state)
@@ -365,6 +434,10 @@ def run_blocking(comm, gen: Schedule, tag: int, cid: int) -> None:
             retire(*inflight.popleft())
         state.discard_all()
         raise
+    finally:
+        if fx_key is not None:  # forensics check-out, every exit path
+            with _fx_lock:
+                _live_blocking.pop(fx_key, None)
     state.release_all()
 
 
@@ -406,6 +479,10 @@ class NbcRequest(Request):
         self._gen_done = False
         self._finishing = False
         self._gen_running = True
+        if _forensics._enable_var._value:  # forensics registry
+            self._fx_born = time.monotonic()
+            with _fx_lock:
+                _live_nbc.add(self)
         self._advance(None, first=True)
 
     # ------------------------------------------------------------ engine

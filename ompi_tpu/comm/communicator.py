@@ -172,6 +172,23 @@ class Communicator:
 
         return _qos.NAMES[_qos.get_comm_class(self)]
 
+    # ------------------------------------------------- stall forensics
+    def Dump_state(self, reason: str = "Dump_state") -> Optional[str]:
+        """Debug verb: write this rank's full per-subsystem forensics
+        dump (``stall-rank<N>.json`` under metrics_dir) and — in
+        process mode — request the same from every member of this
+        communicator over the forensics system plane. Works with the
+        stall sentinel disabled (``forensics_enable`` gates only the
+        automatic machinery); returns the local dump path, or None if
+        the dump could not be written."""
+        from ompi_tpu.runtime import forensics as _fx
+
+        path = _fx.dump(reason=reason)
+        pml = getattr(self, "pml", None)
+        if pml is not None and self.size > 1:
+            _fx.request_peer_dumps(pml, list(self.group.ranks), reason)
+        return path
+
     def Set_attr(self, keyval: int, value: Any) -> None:
         # replacing a value fires the delete callback on the old one
         # (MPI_Comm_set_attr contract — the callback releases resources)
@@ -824,6 +841,44 @@ class ProcComm(Intracomm):
 
     def Is_inter(self) -> bool:
         return False
+
+    def Abort(self, errorcode: int = 1) -> None:
+        """MPI_Abort: terminate the whole job now (reference:
+        ompi_mpi_abort). ``os._exit`` never runs atexit, so everything
+        the clean-exit hooks would have exported — the trace flight
+        recorder, the metrics snapshot, a forensics dump when the
+        plane is armed — is flushed HERE first, through the same
+        atomic-rename writers; an MPIError escaping to Abort no longer
+        loses the entire ring. This function does not return."""
+        import os as _os
+
+        from ompi_tpu.utils.output import get_logger
+
+        get_logger("comm").error("MPI_Abort(%s) on %s", errorcode,
+                                 self.name)
+        _trace.export_on_fatal()
+        try:
+            if _metrics._enable_var._value:
+                _metrics.export_json()
+        except Exception:
+            pass
+        try:
+            from ompi_tpu.runtime import forensics as _fx
+
+            if _fx._enable_var._value:
+                _fx.dump(reason=f"MPI_Abort({errorcode})")
+        except Exception:
+            pass
+        try:
+            from ompi_tpu.runtime import wireup as _wireup
+
+            ctx = _wireup._ctx
+            if ctx is not None:
+                ctx["modex"].abort(
+                    f"MPI_Abort({errorcode}) on {self.name}")
+        except Exception:
+            pass
+        _os._exit(errorcode if errorcode else 1)
 
     # ULFM surface (reference: ompi/mpiext/ftmpi MPIX_Comm_*)
     def Revoke(self) -> None:

@@ -85,6 +85,8 @@ class Request:
         self.status.error = error
         if _san_done is not None:
             _san_done(self)
+        if _fx_note is not None:  # forensics stall-sentinel tick
+            _fx_note(self)
         # Flip the flag and snapshot callbacks under the registration lock:
         # a registration racing on another thread either lands in the
         # snapshot or observes the flag and self-fires — never lost
@@ -297,6 +299,11 @@ _completion_cond = threading.Condition()
 _san_new: Optional[Callable[["Request"], None]] = None
 _san_done: Optional[Callable[["Request"], None]] = None
 _san_wait = None  # Request -> watch object with poll()/close(), or None
+
+# Stall-sentinel completion tick, bound by runtime/forensics.py only
+# while forensics_enable is set (rebound live on cvar writes) — the
+# disabled path is this one global load per completion.
+_fx_note: Optional[Callable[["Request"], None]] = None
 
 
 def _bind_sanitizer(new, done, wait) -> None:

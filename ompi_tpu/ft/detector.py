@@ -34,6 +34,28 @@ _failed_lock = threading.Lock()
 _callbacks: List[Callable[[int], None]] = []
 _propagator: Optional[Callable[[int], None]] = None
 _log = get_logger("ft.detector")
+_live_hb = [None]  # weakref to the running HeartbeatDetector, if any
+
+
+def _fx_debug_state() -> dict:
+    """Stall-forensics provider (runtime/forensics contract): the
+    confirmed-failure set plus the ring observer's suspicion state —
+    who this rank watches, how stale that edge is vs the timeout."""
+    out: dict = {"known_failed": sorted(known_failed())}
+    ref = _live_hb[0]
+    det = ref() if ref is not None else None
+    if det is not None:
+        age = time.monotonic() - det.last_seen
+        timeout = float(get_var("ft", "heartbeat_timeout"))
+        out["heartbeat"] = {
+            "rank": det.rank, "observed": det.observed,
+            "target": det.target,
+            "last_seen_age_s": round(age, 3),
+            "timeout_s": timeout,
+            "suspect": bool(det.observed != det.rank
+                            and age > timeout / 2.0),
+        }
+    return out
 
 
 def known_failed() -> Set[int]:
@@ -93,6 +115,9 @@ class HeartbeatDetector:
     def start(self) -> None:
         if self.size < 2:
             return
+        import weakref
+
+        _live_hb[0] = weakref.ref(self)  # forensics suspicion view
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ompi-tpu-ft-detector")
         self._thread.start()
@@ -144,3 +169,8 @@ def _reset_for_testing() -> None:
     with _failed_lock:
         _failed.clear()
     _callbacks.clear()
+
+
+from ompi_tpu.runtime import forensics as _forensics  # noqa: E402
+
+_forensics.register_provider("ft.detector", _fx_debug_state)

@@ -38,11 +38,25 @@ usability — agreement must work on revoked comms; that is its job).
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+import time as _time
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.runtime import forensics as _forensics
+from ompi_tpu.utils.show_help import register_topic, show_help
+
+register_topic(
+    "ft", "era-timeout",
+    "An era agreement TIMED OUT (ERR_PENDING after ft_era_timeout):\n"
+    "{detail}\n"
+    "The round/participant/votes-outstanding snapshot above is the\n"
+    "soak-triage evidence: a missing contribution names the stalled\n"
+    "member, a missing query answer names the stalled survivor. With\n"
+    "forensics_enable set, per-rank stall-rank<N>.json dumps were also\n"
+    "requested — merge them with tools/mpidiag.py to name the blocking\n"
+    "edge under the agreement.")
 
 ERA_TAG = -4244  # system plane (REVOKE=-4242, HEARTBEAT=-4243)
 
@@ -55,6 +69,19 @@ K_PULL = 6      # member asking a (possibly returned) peer for a cached
                 # decision; answered with DECIDE iff one exists — no fence
 
 ERA_GC_KEEP = 16  # sequences of per-comm agreement state kept for serving
+
+
+def _participant_bitmask(members: Optional[List[int]],
+                         have: List[int]) -> int:
+    """Bit i set = the i-th member (ascending member-list order) has
+    contributed to the round — positional over the member list so the
+    mask stays compact for sparse world-rank sets; falls back to raw
+    world-rank bits when the member list is unknown (a state created
+    by the background handler before the local agree() entered)."""
+    if members is None:
+        return sum(1 << r for r in have if 0 <= r < 1024)
+    pos = {m: i for i, m in enumerate(sorted(members))}
+    return sum(1 << pos[r] for r in have if r in pos)
 
 register_var("ft", "era_timeout", 60.0,
              help="Seconds before an undetected agreement stall fails "
@@ -69,7 +96,7 @@ register_var("ft", "era_inject", "",
 
 class _AgreeState:
     __slots__ = ("flag", "contribs", "decision", "qans", "min_decider",
-                 "lock")
+                 "lock", "members", "entered", "done")
 
     def __init__(self):
         self.flag: Optional[int] = None          # my contribution
@@ -78,6 +105,15 @@ class _AgreeState:
         self.qans: Dict[int, Tuple[bool, int]] = {}  # rank -> (have, val)
         self.min_decider = -1
         self.lock = threading.Lock()
+        # introspection only (set by the local agree() entry; states
+        # created by the background handler have None/0 until then):
+        # the member list this rank agreed over, its entry stamp, and
+        # whether the local call has exited (return OR raise) — an
+        # in-progress agreement (members set, not done) is pending work
+        # for the stall sentinel, which posts no pml requests of its own
+        self.members: Optional[List[int]] = None
+        self.entered = 0.0
+        self.done = False
 
 
 class EraEngine:
@@ -91,6 +127,26 @@ class EraEngine:
         self._seqs: Dict[int, int] = {}  # cid -> next sequence
         self._lock = threading.Lock()
         pml.register_system_handler(ERA_TAG, self._on_message)
+        # stall-forensics provider (rebind-by-name: the newest engine —
+        # one per pml — reports; weakly bound so test engines don't pin)
+        import weakref
+
+        ref = weakref.ref(self)
+        _forensics.register_weak_provider("ft.era", self)
+
+        def _fx_pending(_ref=ref) -> int:
+            # agreements this rank is INSIDE (members recorded, call
+            # not exited): they post no pml requests, so without this
+            # probe an era stall reads as "idle" to the stall sentinel
+            eng = _ref()
+            if eng is None:
+                return 0
+            with eng._lock:
+                states = list(eng._states.values())
+            return sum(1 for st in states
+                       if st.members is not None and not st.done)
+
+        _forensics.register_pending_probe("ft.era", _fx_pending)
 
     # ------------------------------------------------------------ plumbing
     def _state(self, cid: int, seq: int) -> _AgreeState:
@@ -154,6 +210,76 @@ class EraEngine:
             if dec is not None:
                 self._send(src, K_DECIDE, cid, seq, dec)
 
+    # ------------------------------------------------- stall forensics
+    def debug_state(self) -> dict:
+        """Forensics provider: every kept agreement round's state —
+        contributions held (the participant bitmask over the member
+        list), cached decision, query answers, stale-decision fence —
+        newest rounds first, clipped to forensics.CAP."""
+        now = _time.monotonic()
+        with self._lock:
+            n_states = len(self._states)
+            keys = sorted(self._states, reverse=True)[:_forensics.CAP]
+            states = [(k, self._states[k]) for k in keys]
+            seqs = dict(self._seqs)
+        rounds = []
+        for (cid, seq), st in states:
+            with st.lock:
+                members = st.members
+                have = sorted(st.contribs)
+                rounds.append({
+                    "cid": cid, "round": seq,
+                    "members": members,
+                    "contribs": have,
+                    "participant_bitmask": _participant_bitmask(
+                        members, have),
+                    "votes_outstanding": (
+                        None if members is None
+                        else [m for m in members if m not in st.contribs]),
+                    "decision": st.decision is not None,
+                    "in_progress": st.members is not None
+                    and not st.done,
+                    "query_answers": sorted(st.qans),
+                    "min_decider": st.min_decider,
+                    "age_s": round(now - st.entered, 3)
+                    if st.entered else None,
+                })
+        return {"rounds": rounds,
+                "rounds_omitted": max(0, n_states - len(rounds)),
+                "next_seq_by_cid": {
+                    str(c): s for c, s in seqs.items()}}
+
+    def _timeout(self, st: _AgreeState, cid: int, seq: int,
+                 phase: str, waiting: str):
+        """Build (and show_help) the agreement-timeout verdict carrying
+        the round, participant bitmask, and votes-outstanding — the
+        evidence soak triage needs even with forensics disabled — and
+        return the MPIError to raise."""
+        from ompi_tpu.core.errors import MPIError, ERR_PENDING
+        from ompi_tpu.ft.detector import known_failed
+
+        with st.lock:
+            members = st.members
+            have = sorted(st.contribs)
+            decision = st.decision
+            qans = sorted(st.qans)
+        failed = sorted(known_failed()
+                        & set(members or have))
+        outstanding = [] if members is None else \
+            [m for m in members if m not in have and m not in failed]
+        detail = (f"{phase}: agreement round {seq} on cid {cid} "
+                  f"stalled waiting on {waiting}; members {members}, "
+                  f"contributions held {have} (participant bitmask "
+                  f"0x{_participant_bitmask(members, have):x}), votes "
+                  f"outstanding {outstanding}, query answers {qans}, "
+                  f"known failed {failed}, decision "
+                  f"{'cached' if decision is not None else 'none'}")
+        show_help("ft", "era-timeout", once=False, detail=detail)
+        if _forensics._enable_var._value:
+            _forensics.trigger(f"era-timeout: round {seq} cid {cid} "
+                               f"waiting on {waiting}")
+        return MPIError(ERR_PENDING, detail)
+
     # ----------------------------------------------------------- the driver
     def agree(self, comm, flag: int, abort_on_revoke: bool = False) -> int:
         """Uniform AND-consensus over ``comm``'s live members.
@@ -166,11 +292,6 @@ class EraEngine:
         DEFAULT stays False: MPIX_Comm_agree and the recovery's own
         survivor agreement must complete on revoked comms (that is the
         ULFM contract and the entire point of ERA)."""
-        from ompi_tpu.core.errors import MPIError, ERR_PENDING, ERR_REVOKED
-        from ompi_tpu.ft.detector import known_failed
-        from ompi_tpu.runtime.progress import progress_until
-        import time
-
         cid = comm.cid
         with self._lock:
             seq = self._seqs.get(cid, 0)
@@ -183,6 +304,24 @@ class EraEngine:
         with st.lock:
             st.flag = flag
             st.contribs[me] = flag
+            st.members = list(members)   # introspection/timeout detail
+            st.entered = _time.monotonic()
+        try:
+            return self._agree_drive(comm, st, cid, seq, me, members,
+                                     flag, abort_on_revoke)
+        finally:
+            # every exit — decision, timeout, revoke-abort — retires
+            # the round from the stall sentinel's pending-work view
+            st.done = True
+
+    def _agree_drive(self, comm, st: _AgreeState, cid: int, seq: int,
+                     me: int, members, flag: int,
+                     abort_on_revoke: bool) -> int:
+        from ompi_tpu.core.errors import MPIError, ERR_PENDING, ERR_REVOKED
+        from ompi_tpu.ft.detector import known_failed
+        from ompi_tpu.runtime.progress import progress_until
+        import time
+
         # eager replication: every potential coordinator gets my flag now
         for m in members:
             if m < me and m not in known_failed():
@@ -221,8 +360,9 @@ class EraEngine:
             if st.decision is not None:
                 return st.decision
             if time.monotonic() >= deadline:
-                raise MPIError(ERR_PENDING,
-                               f"agreement stalled on coordinator {coord}")
+                raise self._timeout(
+                    st, cid, seq, "member wait",
+                    f"coordinator {coord} (no decision broadcast)")
             if done and coord in known_failed():
                 recovering = True
             # the loop recomputes the coordinator; my entry-time CONTRIB
@@ -254,8 +394,9 @@ class EraEngine:
         if not progress_until(contribs_complete, timeout=remaining()):
             missing = [m for m in members if m not in st.contribs
                        and m not in known_failed()]
-            raise MPIError(ERR_PENDING,
-                           f"agreement: no contribution from {missing}")
+            raise self._timeout(
+                st, cid, seq, "coordinator contribution collection",
+                f"contribution from {missing}")
         if aborted():
             raise MPIError(ERR_REVOKED,
                            "agreement aborted: communicator revoked "
@@ -283,8 +424,9 @@ class EraEngine:
             if not progress_until(queries_complete, timeout=remaining()):
                 missing = [m for m in queried if m not in st.qans
                            and m not in known_failed()]
-                raise MPIError(ERR_PENDING,
-                               f"agreement: no query answer from {missing}")
+                raise self._timeout(
+                    st, cid, seq, "coordinator query phase",
+                    f"query answer from {missing}")
             if aborted():
                 raise MPIError(ERR_REVOKED,
                                "agreement aborted: communicator revoked "

@@ -368,3 +368,44 @@ class MatchingEngine:
     def find_unexpected(self, src: int, tag: int, cid: int) -> Optional[UnexpectedFrag]:
         probe = RecvRequest(None, 0, None, src, tag, cid)  # matcher only
         return self.match_unexpected(probe, remove=False)
+
+    def debug_state(self, now: float, cap: int = 64) -> dict:  # locked-by: self.lock
+        """Forensics snapshot of the matching queues (runtime/forensics
+        introspection contract): per-key posted/unexpected depths with
+        the oldest entry's posting/arrival order and age, clipped to
+        ``cap`` keys. Call with the engine lock held — the pml's
+        provider wraps this so the queues and the protocol dicts are
+        one consistent cut."""
+
+        def born(req) -> Optional[float]:
+            t = getattr(req, "_fx_born", None)
+            return None if t is None else round(now - t, 3)
+
+        posted = []
+        for (cid, src, tag), q in self._posted_exact.items():
+            if len(posted) >= cap:
+                break
+            posted.append({"cid": cid, "src": src, "tag": tag,
+                           "n": len(q), "oldest_pseq": q[0]._pseq,
+                           "oldest_age_s": born(q[0])})
+        wild = [{"cid": r.cid, "src": r.src, "tag": r.tag,
+                 "pseq": r._pseq, "age_s": born(r)}
+                for r in self._posted_wild[:cap]]
+        unexpected = []
+        for (cid, src, tag), q in self._unexpected.items():
+            if len(unexpected) >= cap:
+                break
+            unexpected.append({"cid": cid, "src": src, "tag": tag,
+                               "n": len(q), "oldest_aseq": q[0]._aseq,
+                               "nbytes": q[0].hdr.nbytes})
+        return {
+            "n_posted": self._n_posted,
+            "n_unexpected": self._n_unexpected,
+            "posted": posted,
+            "posted_omitted": max(0, len(self._posted_exact)
+                                  - len(posted)),
+            "posted_wild": wild,
+            "unexpected": unexpected,
+            "unexpected_omitted": max(0, len(self._unexpected)
+                                      - len(unexpected)),
+        }

@@ -55,6 +55,7 @@ from ompi_tpu.pml.base import (
     UnexpectedFrag,
     pack_header,
 )
+from ompi_tpu.runtime import forensics as _forensics
 from ompi_tpu.runtime import sanitizer as _san
 from ompi_tpu.runtime import trace as _trace
 from ompi_tpu.utils.output import get_logger
@@ -223,6 +224,21 @@ class Ob1Pml:
             register_progress(_watchdog_cb, low_priority=True)
         if _inject._enable_var._value:
             _inject.note_rank(my_rank)  # chaos recv-side rank identity
+        # Stall-forensics introspection contract (runtime/forensics):
+        # the provider runs only at dump time, the pending probe is a
+        # few len() loads per sentinel poll. Weakly bound like the
+        # detector callback above — the registry is rebind-by-name, so
+        # the newest pml instance (tests build several) reports.
+
+        def _fx_pending(_ref=ref):
+            pml = _ref()
+            if pml is None:
+                return 0
+            return (pml.engine.n_posted + len(pml._pending_sends)
+                    + len(pml._active_recvs) + len(pml._flowing))
+
+        _forensics.register_weak_provider("pml", self)
+        _forensics.register_pending_probe("pml", _fx_pending)
 
     # ------------------------------------------------------------- wiring
     def add_endpoint(self, rank: int, btl) -> None:
@@ -234,6 +250,101 @@ class Ob1Pml:
         transport fails (reference: bml_r2's btl_send array — the next
         eligible BTL takes over when one is ejected)."""
         self.fallbacks[rank] = list(btls)
+
+    # -------------------------------------------------- stall forensics
+    def debug_state(self) -> dict:
+        """Forensics provider (runtime/forensics contract): matching
+        queues, in-flight protocol state per stage (pending = RTS
+        unanswered, flowing = DATA window, active = matched receives),
+        per-(peer, class) seq-plane positions with gap detection (the
+        reorder buffer's parked frames ARE the gap witnesses), and the
+        watchdog arm. One consistent cut under engine.lock; every list
+        clipped to forensics.CAP."""
+        now = _time.monotonic()
+        cap = _forensics.CAP
+
+        def born(req) -> float:
+            t = getattr(req, "_fx_born", None)
+            if t is None:
+                t = getattr(req, "_wd_last", None)
+            return float("inf") if t is None else t
+
+        def age(req) -> Optional[float]:
+            t = born(req)
+            return None if t == float("inf") else round(now - t, 3)
+
+        def oldest(d: dict) -> list:
+            # oldest-first before the clip: the blame walk keys on the
+            # OLDEST blocked entry, which dict insertion order would
+            # silently drop past CAP
+            return sorted(d.items(), key=lambda kv: born(kv[1]))[:cap]
+
+        with self.engine.lock:
+            pending = [
+                {"msgid": m, "dst": r.dst, "tag": r.tag, "cid": r.cid,
+                 "nbytes": r.nbytes, "stage": "rts-unanswered",
+                 "age_s": age(r)}
+                for m, r in oldest(self._pending_sends)]
+            flowing = [
+                {"msgid": m, "dst": getattr(r, "_peer", None),
+                 "tag": r.tag, "cid": r.cid, "nbytes": r.nbytes,
+                 "stage": "data-window", "offset": r._offset,
+                 "acked": r._acked, "depth": r._depth, "age_s": age(r)}
+                for m, r in oldest(self._flowing)]
+            active = [
+                {"msgid": m, "src": r.status.source, "tag": r.tag,
+                 "cid": r.cid, "nbytes": r.status._nbytes,
+                 "stage": "recv-data",
+                 "got": getattr(r, "_recv_bytes", 0), "age_s": age(r)}
+                for m, r in oldest(self._active_recvs)]
+            seq_to = {f"{d}:{c}": s
+                      for (d, c), s in self._seq_to.items()}
+            expect = {f"{s}:{c}": e
+                      for (s, c), e in self._expect_seq.items()}
+            gaps = []
+            for (src, cls), pend in self._ahead.items():
+                if not pend:
+                    continue
+                oldest_ts = min(t for _h, _p, t in pend.values())
+                gaps.append({"src": src, "cls": cls,
+                             "expect": self._expect_seq.get(
+                                 (src, cls), 1),
+                             "parked": len(pend),
+                             "parked_seqs": sorted(pend)[:8],
+                             "oldest_age_s": round(now - oldest_ts, 3)})
+            reasm = [
+                {"src": k[0], "msgid": k[1], "got": v[1],
+                 "total": len(v[0])}
+                for k, v in list(self._sys_reasm.items())[:cap]]
+            queues = self.engine.debug_state(now, cap)
+        return {
+            "rank": self.my_rank,
+            "matching": queues,
+            "pending_sends": pending,
+            "pending_sends_omitted": max(0, len(self._pending_sends)
+                                         - len(pending)),
+            "flowing_sends": flowing,
+            "flowing_sends_omitted": max(0, len(self._flowing)
+                                         - len(flowing)),
+            "active_recvs": active,
+            "active_recvs_omitted": max(0, len(self._active_recvs)
+                                        - len(active)),
+            "seq_to": seq_to,
+            "expect_seq": expect,
+            "seq_gaps": gaps,
+            "sys_reassembly": reasm,
+            "sys_reassembly_omitted": max(0, len(self._sys_reasm)
+                                          - len(reasm)),
+            "watchdog": {"peer_timeout_s": self._peer_timeout,
+                         "armed": self._peer_timeout > 0,
+                         "trips": _wd_trips[0]},
+            "endpoints": {str(r): getattr(b, "NAME", "?")
+                          for r, b in list(self.endpoints.items())[:cap]},
+            # list() snapshot: a send hitting a newly-dead conn inserts
+            # here concurrently — exactly the moment dumps are FOR
+            "dead_letter": {str(r): len(f)
+                            for r, f in list(self.dead_letter.items())},
+        }
 
     # ------------------------------------------------ peer-death watchdog
     def _fail_requests(self, victims, why: str) -> None:
@@ -322,7 +433,12 @@ class Ob1Pml:
             return 0
         self._wd_next = now + min(self._peer_timeout / 4.0, 1.0)
         cutoff = now - self._peer_timeout
-        stale = []  # (req, peer)
+        # ONE locked scan collects the stale candidates without popping
+        # (the healthy path used to walk all three stores twice under
+        # engine.lock whenever forensics was on); the pop pass below
+        # re-checks per entry, so a candidate that completes or whose
+        # peer wakes up during the dump is left alone
+        candidates = []  # (store, msgid, peer)
         with self.engine.lock:
             for store, peer_of in (
                     (self._pending_sends, lambda r: r.dst),
@@ -330,10 +446,28 @@ class Ob1Pml:
                     (self._active_recvs, lambda r: r.status.source)):
                 for msgid, req in list(store.items()):
                     t0 = getattr(req, "_wd_last", None)
-                    if t0 is not None and t0 < cutoff and \
-                            store.pop(msgid, None) is not None:
-                        # stale only if WE popped it (see _on_peer_failed)
-                        stale.append((req, peer_of(req)))
+                    if t0 is not None and t0 < cutoff:
+                        candidates.append((store, msgid, peer_of(req)))
+        if not candidates:
+            return 0
+        if _forensics._enable_var._value:
+            # dump BEFORE the conversion pops the stale entries: the
+            # evidence (which msgid/tag/cid, what protocol stage, how
+            # many bytes landed) is exactly what _fail_requests is
+            # about to destroy
+            _forensics.trigger(
+                f"pml-watchdog: peer silent > "
+                f"{self._peer_timeout}s (pre-conversion evidence)")
+        stale = []  # (req, peer)
+        with self.engine.lock:
+            for store, msgid, peer in candidates:
+                req = store.get(msgid)
+                t0 = getattr(req, "_wd_last", None) \
+                    if req is not None else None
+                if t0 is not None and t0 < cutoff and \
+                        store.pop(msgid, None) is not None:
+                    # stale only if WE popped it (see _on_peer_failed)
+                    stale.append((req, peer))
         if not stale:
             return 0
         self._fail_requests(
@@ -341,7 +475,8 @@ class Ob1Pml:
             f"peer silent > pml_peer_timeout={self._peer_timeout}s")
         from ompi_tpu.ft.detector import mark_failed
 
-        for peer in {p for _, p in stale if p is not None and p >= 0}:
+        peers = {p for _, p in stale if p is not None and p >= 0}
+        for peer in peers:
             mark_failed(peer)
         return len(stale)
 
@@ -498,6 +633,8 @@ class Ob1Pml:
             req._pump_lock = threading.RLock()
             if self._peer_timeout:
                 req._wd_last = _time.monotonic()  # RTS->CTS stall clock
+            if _forensics._enable_var._value:  # dump age evidence
+                req._fx_born = _time.monotonic()
             self._pending_sends[req.msgid] = req  # mpiracer: disable=lock-discipline — GIL-atomic insert under a fresh msgid; the watchdog/failure sweeps iterate a list() snapshot under engine.lock and _incoming_cts's pop is the only other writer of this key
             self._send_match_frame(dst, RNDV_RTS, cid, tag,
                                    conv.packed_size, req.msgid, b"",
@@ -580,6 +717,8 @@ class Ob1Pml:
         if _inject._enable_var._value:  # chaos op counter (ft/inject.py)
             _inject.on_op(self.my_rank, tag)
         req = RecvRequest(buf, count, datatype, src, tag, cid)
+        if _forensics._enable_var._value:  # dump age evidence
+            req._fx_born = _time.monotonic()
         with self.engine.lock:
             frag = self.engine.match_unexpected(req)
             if frag is None:

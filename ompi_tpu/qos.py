@@ -95,13 +95,16 @@ _segment_var = register_var(
          "bulk blob mid-transfer", level=5)
 _tag_map_var = register_var(
     "qos", "tag_map", "-4600:bulk,-4500:bulk,-4242:latency,"
-                      "-4243:latency,-4244:latency,-4245:latency",
+                      "-4243:latency,-4244:latency,-4245:latency,"
+                      "-4800:latency",
     typ=str,
     help="Default QoS class per system tag plane: 'tag:class' pairs, "
          "comma-separated. The default demotes the known background "
          "planes (diskless ckpt replication -4600, metrics shipping "
          "-4500) to bulk and promotes the ft control plane (revoke "
-         "-4242, heartbeat -4243, era -4244, failure flood -4245) to "
+         "-4242, heartbeat -4243, era -4244, failure flood -4245) and "
+         "the stall-forensics dump requests (-4800 — a dump request "
+         "diagnosing a bulk backlog must not queue behind it) to "
          "latency; unlisted system tags ride normal", level=5)
 
 # classification counters (plain int bumps, the btl _ctr discipline) —

@@ -593,14 +593,13 @@ def export_json(path: Optional[str] = None) -> str:
         except OSError:
             base = "."  # unwritable temp dir: last-resort CWD
         path = os.path.join(base, f"metrics-rank{_rank()}.json")
-    # unique tmp per writer: the periodic writer thread and the
-    # finalize/atexit export may race, and a shared tmp name would let
-    # one writer's fd interleave into the other's renamed final file
-    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(snapshot(), f, default=str)
-    os.replace(tmp, path)
-    return path
+    # unique tmp per writer (utils/fsio): the periodic writer thread
+    # and the finalize/atexit export may race, and a shared tmp name
+    # would let one writer's fd interleave into the other's renamed
+    # final file
+    from ompi_tpu.utils.fsio import atomic_write_json
+
+    return atomic_write_json(path, snapshot(), default=str)
 
 
 # ------------------------------------------------------- prometheus render

@@ -286,6 +286,22 @@ class ProgressThread:
         self._thread.start()
 
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        except BaseException:
+            # an unhandled exception killing the progress thread ends
+            # async progress for the whole job — and if the job is then
+            # aborted/killed, atexit never runs and the flight-recorder
+            # ring dies with it. Export the evidence before the thread
+            # goes down (re-entrancy-guarded, never raises).
+            from ompi_tpu.utils.output import get_logger
+
+            get_logger("runtime.progress").exception(
+                "progress thread died")
+            _trace.export_on_fatal()
+            raise
+
+    def _run_loop(self) -> None:
         import time
 
         idle = 0
@@ -328,3 +344,33 @@ class ProgressThread:
         if self._thread is not None:
             self._thread.join(timeout=1.0)
             self._thread = None
+
+
+# ---------------------------------------------------------- stall forensics
+def _fx_debug_state() -> dict:
+    """Forensics provider (runtime/forensics contract): park state and
+    wake sources — is anyone still driving progress, and can a frame
+    wake it. Iterations-since-last-completion lives in the sentinel's
+    own section of the dump (it polls on the low-priority cadence)."""
+    with _wake_lock:
+        parked = _parked[0]
+        blocks = _idle_blocks[0]
+    with _lock:
+        ncb = len(_callbacks)
+        nlow = len(_low_priority)
+    srcs = list(_idle_sources)
+    return {
+        "parked_threads": parked,
+        "idle_blocks": blocks,
+        "callbacks": ncb,
+        "low_priority_callbacks": nlow,
+        "idle_sources": len(srcs),
+        "poll_only_transport": any(fn is None for fn in srcs),
+        "idle_block_us": int(_idle_var._value),
+        "wakeup_pipe_armed": _wakeup[0] is not None,
+    }
+
+
+from ompi_tpu.runtime import forensics as _forensics  # noqa: E402
+
+_forensics.register_provider("runtime.progress", _fx_debug_state)

@@ -410,6 +410,15 @@ def _deadlock_detected(pml, cycle: List[int]) -> None:
     _violation("deadlock",
                " -> ".join(str(r) for r in cycle),
                fatal=False, cycle=list(cycle))
+    # stall forensics: a confirmed wait-for cycle is exactly the moment
+    # the per-subsystem queue state is evidence — dump before level-2
+    # breaks the cycle and the blocked requests vanish
+    from ompi_tpu.runtime import forensics as _forensics
+
+    if _forensics._enable_var._value:
+        _forensics.trigger(
+            "sanitizer-deadlock: cycle "
+            + " -> ".join(str(r) for r in cycle))
     if _level() >= 2:
         for w in watches:
             if w.peer in members and not w.req._complete.is_set():

@@ -280,12 +280,15 @@ def export(path: Optional[str] = None) -> str:
         "otherData": {"rank": rank, "dropped_events": dropped,
                       "clock": "monotonic_ns"},
     }
-    with open(path, "w") as f:
-        # span args are arbitrary caller values (numpy ints ride in from
-        # user tags/counts) — stringify anything JSON can't take rather
-        # than lose the rank's whole trace to a TypeError
-        json.dump(doc, f, default=str)
-    return path
+    # atomic rename (shared writer discipline, utils/fsio): the
+    # abort/fatal path (export_on_fatal) and the finalize export may
+    # both write this file, and a merge tool must never read a torn
+    # one. default=str: span args are arbitrary caller values (numpy
+    # ints ride in from user tags/counts) — stringify anything JSON
+    # can't take rather than lose the rank's whole trace to a TypeError
+    from ompi_tpu.utils.fsio import atomic_write_json
+
+    return atomic_write_json(path, doc, default=str)
 
 
 def snapshot() -> List[Tuple[int, tuple]]:
@@ -332,6 +335,37 @@ register_pvar("trace", "buffered_events", buffered_events,
               help="Events currently held in the trace ring buffers")
 
 _exported = False
+_fatal_exporting = [False]
+
+
+def export_on_fatal() -> None:
+    """Abort/fatal-path export: flush the flight-recorder rings NOW.
+
+    A clean exit reaches :func:`_maybe_export` through finalize/atexit,
+    but an ``os._exit`` after MPI_Abort — or an unhandled exception
+    killing the progress thread just before the job is torn down —
+    never runs atexit, and the entire ring was lost. Re-entrancy
+    guarded (an export failure aborting again must not recurse), never
+    raises, and does NOT mark the finalize export done: a later clean
+    export holds strictly more events and atomically replaces this
+    file."""
+    with _reg_lock:
+        if _fatal_exporting[0]:
+            return
+        _fatal_exporting[0] = True
+    try:
+        if not buffered_events():
+            return
+        try:
+            _warn_overflow()
+        except Exception:
+            pass
+        export()
+    except Exception:
+        pass  # evidence is best-effort on the way down
+    finally:
+        with _reg_lock:
+            _fatal_exporting[0] = False
 
 
 def _maybe_export() -> None:

@@ -276,12 +276,17 @@ def init_process_mode():
     # hier retune plane has no init_bottom hook at all (its lazy ensure
     # ran only when this rank's own composed call finished).
     from ompi_tpu.coll.hier import decide as hier_decide
+    from ompi_tpu.runtime import forensics as rt_forensics
     from ompi_tpu.runtime import metrics as rt_metrics
     from ompi_tpu.runtime import sanitizer as rt_sanitizer
 
     rt_sanitizer.bind_plane(pml)
     rt_metrics.bind_plane(pml)
     hier_decide.bind_plane(pml)
+    # stall-forensics dump-request plane (-4800): a fast peer's stall
+    # sentinel can latch and request this rank's dump the moment the
+    # fence releases it — same pre-fence discipline as the planes above
+    rt_forensics.bind_plane(pml)
 
     hb = None
     if get_var("ft", "enable") and job == 0:

@@ -53,6 +53,7 @@ def _load_everything() -> None:
     import ompi_tpu.coll.sched  # coll_round_* window/copy_mode cvars + datapath pvars
     import ompi_tpu.coll.persist  # coll_persist_* cvars + persist_* replay pvars
     import ompi_tpu.qos  # QoS classes: btl_tcp_shape_enable/segment + qos_* cvars/pvars
+    import ompi_tpu.runtime.forensics  # stall-forensics cvars + forensics_* pvars
     # (btl/tcp.py above also carries the btl_tcp_shape_* scheduler knobs)
     # mpilint/mpiracer (ompi_tpu/analysis/) are build-time gates by
     # design: they register no cvars/pvars, so there is nothing to load

@@ -1,0 +1,31 @@
+"""Shared filesystem helpers for the observability exporters."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+
+def atomic_write_json(path: str, doc: Any, **dump_kwargs) -> str:
+    """Write ``doc`` as JSON via a uniquely-named tmp + atomic rename,
+    so a concurrent reader (mpitop, mpidiag, trace_merge) never sees a
+    torn file and two writers (periodic vs finalize, fatal vs clean)
+    never interleave. The ONE writer discipline for the metrics
+    snapshot, the trace export, and the forensics dumps — a failed
+    write (disk full: exactly the condition the abort-path exporters
+    run under) unlinks its partial tmp instead of stranding one per
+    attempt. Returns ``path``."""
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, **dump_kwargs)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path

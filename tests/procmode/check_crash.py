@@ -1,0 +1,44 @@
+"""Abort-path flight-recorder proof: an MPIError escaping to MPI_Abort
+must NOT lose the trace rings.
+
+``os._exit`` (the tail of Abort) never runs atexit, so before this PR
+an aborted rank's entire flight recorder vanished — the one run you
+most want a timeline for. ``Comm.Abort`` now routes through
+``trace.export_on_fatal()`` (re-entrancy-guarded, atomic rename)
+before the exit.
+
+Run: mpirun -np 2 --mca trace_enable 1 check_crash.py
+(with OMPI_TPU_MCA_trace_dir pointing somewhere inspectable). Rank 1
+records real spans, hits a seeded MPIError, and Aborts with code 3;
+the launcher tears down rank 0. The parent test asserts
+``trace-rank1.json`` exists and holds rank 1's spans.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.core.errors import MPIError, ERR_INTERN
+
+
+def main() -> int:
+    rank = COMM_WORLD.Get_rank()
+    x = np.ones(64, np.float32)
+    out = np.zeros(64, np.float32)
+    for _ in range(3):  # real traffic: the ring must hold real spans
+        COMM_WORLD.Sendrecv(x, 1 - rank, 7, out, 1 - rank, 7)
+    if rank == 1:
+        try:
+            raise MPIError(ERR_INTERN, "seeded fatal (check_crash)")
+        except MPIError:
+            COMM_WORLD.Abort(3)  # does not return
+        raise AssertionError("Abort returned")
+    # rank 0 idles until the launcher tears it down on rank 1's abort
+    time.sleep(60)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,212 @@
+"""Stall-forensics proof: a seeded drop-all stall on one edge produces
+per-rank dumps and a merged mpidiag report naming the TRUE blocking
+edge, deterministically, episode after episode.
+
+``stall`` mode (3 ranks, tcp only so the wire evidence is real)::
+
+    mpirun -np 3 --mca btl_btl ^sm
+           --mca forensics_enable 1
+           --mca forensics_stall_threshold_ms 400
+           --mca ft_inject_plan "drop(0,1,side=recv)"
+           check_forensics.py stall [episodes]
+
+Every episode: rank 1 posts a receive from rank 0, rank 0 sends — and
+the chaos harness drops every frame on the 0 -> 1 edge at rank 1's
+deliver funnel. Rank 1 has pending work and sees no completion, so its
+stall sentinel latches within the threshold, dumps
+``stall-rank1.json``, and requests peer dumps (the 1 -> 0 and 1 -> 2
+edges are healthy, so ranks 0/2 dump too — and had they not been, the
+local dump already existed: the local-only fallback). Rank 1 then runs
+the mpidiag blame walk over the merged dumps and asserts it names the
+true blocking edge — rank 1 blocked on MATCH, the episode's tag, cid
+0, from rank 0, with the seq-plane verdict proving rank 0 stamped
+frames rank 1 never received. 5/5 episodes must agree (the sentinel
+re-arms on the cancel completion between episodes).
+
+``ondemand`` mode (3 ranks, no chaos, forensics_enable UNSET)::
+
+    mpirun -np 3 check_forensics.py ondemand
+
+A healthy run: real traffic, then rank 0 calls ``comm.Dump_state()``.
+Every rank must produce a clean dump — valid JSON, every expected
+subsystem present, no provider errors, sentinel not latched — and the
+merged mpidiag report must blame nothing.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from ompi_tpu import COMM_WORLD  # noqa: E402
+from ompi_tpu.runtime import forensics as fx  # noqa: E402
+from ompi_tpu.runtime import metrics as _metrics  # noqa: E402
+
+import mpidiag  # noqa: E402
+
+GO_TAG = 31
+
+
+def dump_dir() -> str:
+    return _metrics._dir_var._value or _metrics.default_snapshot_dir()
+
+
+def read_dump(rank: int) -> dict:
+    path = os.path.join(dump_dir(), f"stall-rank{rank}.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+def wait_fresh_dumps(ranks, prev_seq, deadline_s=20.0) -> dict:
+    """Wait until every rank's dump exists with seq > its previous one
+    (each episode's evidence must be NEW, not a stale file)."""
+    deadline = time.monotonic() + deadline_s
+    out = {}
+    while time.monotonic() < deadline:
+        out = {}
+        for r in ranks:
+            try:
+                doc = read_dump(r)
+            except (OSError, ValueError):
+                break
+            if int(doc.get("seq", 0)) <= prev_seq.get(r, 0):
+                break
+            out[r] = doc
+        else:
+            return out
+        time.sleep(0.05)
+    raise AssertionError(
+        f"dumps never freshened: have "
+        f"{[(r, d.get('seq')) for r, d in out.items()]} vs {prev_seq}")
+
+
+def poll_go(src: int) -> None:
+    """Wait for the episode-advance token WITHOUT posting a receive —
+    a posted receive would be pending work and latch OUR sentinel."""
+    while not COMM_WORLD.Iprobe(src, GO_TAG):
+        time.sleep(0.02)
+    COMM_WORLD.Recv(np.zeros(1, np.int64), src, GO_TAG)
+
+
+def check_stall(episodes: int) -> int:
+    rank = COMM_WORLD.Get_rank()
+    size = COMM_WORLD.Get_size()
+    assert size == 3, f"this check wants exactly 3 ranks, got {size}"
+    prev_seq = {r: 0 for r in range(size)}
+    go = np.zeros(1, np.int64)
+    for ep in range(1, episodes + 1):
+        tag = 70 + ep
+        if rank == 0:
+            # dropped at rank 1's deliver funnel: completes eagerly
+            # here, never matches there
+            COMM_WORLD.Send(np.full(8, ep, np.int64), 1, tag)
+            poll_go(1)
+        elif rank == 2:
+            poll_go(1)
+        else:
+            stalled = COMM_WORLD.Irecv(np.zeros(8, np.int64), 0, tag)
+            dumps = wait_fresh_dumps(range(size), prev_seq)
+            prev_seq = {r: int(d["seq"]) for r, d in dumps.items()}
+            assert dumps[1]["stall"]["latched"], \
+                f"ep{ep}: rank 1's sentinel never latched"
+            assert "stall-sentinel" in dumps[1]["reason"], dumps[1]
+            for r in (0, 2):
+                assert "peer-request" in dumps[r]["reason"], \
+                    f"ep{ep}: rank {r} dump reason {dumps[r]['reason']!r}"
+            report = mpidiag.analyze(dumps)
+            blames = report["blames"]
+            assert len(blames) >= 1, report
+            want = f"rank 1 blocked on MATCH tag {tag} cid 0 from rank 0"
+            hit = [b for b in blames if want in b]
+            assert hit, f"ep{ep}: no blame names the true edge: {blames}"
+            # the seq-plane verdict must prove the frames left rank 0:
+            # ep frames stamped on the normal plane, rank 1 expects 1
+            assert f"stamped seq {ep} on the normal plane" in hit[0] \
+                and "expects 1" in hit[0], hit[0]
+            assert not report["cycles"], report["cycles"]
+            print(f"FORENSICS-EP{ep}-OK {hit[0]}", flush=True)
+            # break the stall: the cancel completion re-arms the
+            # sentinel for the next episode
+            assert COMM_WORLD.pml.cancel_recv(stalled)
+            stalled.Wait()
+            for peer in (0, 2):
+                COMM_WORLD.Send(go, peer, GO_TAG)
+    if rank == 1:
+        print(f"FORENSICS-STALL-OK episodes={episodes}", flush=True)
+    # the 0 -> 1 edge stays drop-poisoned (that is the seeded fault):
+    # a normal Finalize would hang its exit-fence Ibarrier on it, so
+    # the check exits directly once its own handshake is drained —
+    # rank 1 last, after its final GO frames had time to flush
+    sys.stdout.flush()
+    time.sleep(0.6 if rank == 1 else 0.2)
+    os._exit(0)
+
+
+def _no_errors(node, path="") -> None:
+    if isinstance(node, dict):
+        assert "error" not in node, f"provider error at {path}: {node}"
+        for k, v in node.items():
+            _no_errors(v, f"{path}.{k}")
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            _no_errors(v, f"{path}[{i}]")
+
+
+def check_ondemand() -> int:
+    rank = COMM_WORLD.Get_rank()
+    size = COMM_WORLD.Get_size()
+    assert not fx.enabled(), "ondemand mode proves the DISABLED path"
+    # real traffic so the dump reflects an active (then quiesced) run
+    x = np.ones(1024, np.float32)
+    out = np.zeros(1024, np.float32)
+    for _ in range(5):
+        COMM_WORLD.Sendrecv(x, (rank + 1) % size, 7,
+                            out, (rank - 1) % size, 7)
+        COMM_WORLD.Allreduce(x, out)
+    assert out[0] == size
+    if rank == 0:
+        path = COMM_WORLD.Dump_state(reason="healthy-check")
+        assert path and os.path.exists(path), path
+    deadline = time.monotonic() + 15.0
+    dumps = {}
+    while time.monotonic() < deadline and len(dumps) < size:
+        dumps = mpidiag.read_dumps(dump_dir())
+        time.sleep(0.05)
+    assert len(dumps) == size, f"only {sorted(dumps)} dumped"
+    mine = dumps[rank]
+    subs = mine["subsystems"]
+    for want in ("pml", "btl.tcp", "coll.sched", "ft.detector",
+                 "ft.era", "runtime.progress"):
+        assert want in subs, f"rank {rank}: no {want} provider: " \
+                             f"{sorted(subs)}"
+    _no_errors(subs)
+    assert not mine["stall"]["latched"]
+    json.dumps(mine)  # round-trips
+    if rank == 0:
+        report = mpidiag.analyze(dumps)
+        assert not report["blames"], report["blames"]
+        assert not report["cycles"], report["cycles"]
+        assert "no stalled rank" in mpidiag.render(report)
+    print(f"FORENSICS-ONDEMAND-OK rank={rank}", flush=True)
+    return 0
+
+
+def main() -> int:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "stall"
+    if mode == "stall":
+        episodes = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+        return check_stall(episodes)
+    if mode == "ondemand":
+        return check_ondemand()
+    print(f"unknown mode {mode!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,549 @@
+"""mpidiag — merge per-rank stall-forensics dumps, name the blocking edge.
+
+Each rank's stall sentinel (``ompi_tpu/runtime/forensics.py``) writes
+``stall-rank<N>.json`` — a lock-consistent snapshot of every stateful
+subsystem (pml queues and seq planes, btl per-class send queues, coll
+round batches, ft suspicion/agreement state, progress park state) —
+when pending work stops completing, on demand (SIGUSR1 /
+``comm.Dump_state()``), or from the auto triggers (sanitizer deadlock,
+watchdog conversion, era timeout). mpidiag merges those dumps
+(mpisync clock offsets align cross-host ages, same parser as
+tools/trace_merge.py) and walks the **waiting-on edges**: each rank's
+oldest blocked receive is matched against the peer's send-side state —
+a pending RTS, a stalled DATA window, a frame parked in a shaped tcp
+sub-queue, or a sequence-plane position proving the frame was stamped
+but never arrived — to name the blocking edge in one line, e.g.::
+
+    BLAME: rank 1 blocked on MATCH tag 7 cid 0 from rank 0 (12.3s):
+      rank 0 stamped seq 3 on the normal plane but rank 1 expects 1 —
+      2 frame(s) lost/dropped on the wire (rank 0's send queue to 1 is
+      empty)
+
+or the cycle when edges loop (``BLAME-CYCLE: 0 -> 1 -> 0``).
+
+Usage::
+
+    python tools/mpidiag.py [--dir DIR] [--offsets mpisync.json] [--json]
+
+``--dir`` defaults to the newest ``ompi-tpu-metrics-<job>`` temp dir
+(where an unset ``metrics_dir`` writes), falling back to the CWD.
+
+``--offsets`` is the operator's assertion that the dumps' monotonic
+clocks are comparable: ages are shifted onto one reference instant via
+``ts0 = ts_r - offset_r`` (the trace_merge convention). On a single
+host the clock is shared — pass an all-zero map to correct pure
+dump-instant skew. Without ``--offsets`` ages are reported exactly as
+each dump recorded them (cross-host monotonic epochs are unrelated, so
+aligning by default would fabricate skew).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _TOOLS)
+sys.path.insert(0, os.path.dirname(_TOOLS))
+
+from trace_merge import load_offsets  # noqa: E402  (mpisync offsets)
+
+_CLS_NAMES = {0: "normal", 1: "latency", 2: "bulk"}
+
+
+# ------------------------------------------------------------------ load
+def read_dumps(directory: str) -> Dict[int, dict]:
+    """rank -> dump for every readable stall-rank*.json."""
+    out: Dict[int, dict] = {}
+    for path in sorted(glob.glob(
+            os.path.join(directory, "stall-rank*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue  # mid-rewrite or gone
+        out[int(doc.get("rank", 0))] = doc
+    return out
+
+
+def _pml(dump: dict) -> dict:
+    return dump.get("subsystems", {}).get("pml", {})
+
+
+def _tcp(dump: dict) -> dict:
+    return dump.get("subsystems", {}).get("btl.tcp", {})
+
+
+# ----------------------------------------------------------------- edges
+class Edge:
+    """One waiting-on edge: ``rank`` is blocked on ``peer``."""
+
+    __slots__ = ("rank", "peer", "kind", "cid", "tag", "age_s",
+                 "detail")
+
+    def __init__(self, rank: int, peer: int, kind: str, cid: int,
+                 tag: int, age_s: Optional[float], detail: str):
+        self.rank = rank
+        self.peer = peer
+        self.kind = kind
+        self.cid = cid
+        self.tag = tag
+        self.age_s = age_s
+        self.detail = detail
+
+    def describe(self) -> str:
+        age = "" if self.age_s is None else f" ({self.age_s:.1f}s)"
+        if self.kind.startswith("ERA"):
+            what = ("vote" if self.kind == "ERA-VOTE"
+                    else "decision broadcast")
+            return (f"rank {self.rank} blocked in era agreement round "
+                    f"{self.tag} on cid {self.cid}, waiting on rank "
+                    f"{self.peer}'s {what}{age}")
+        return (f"rank {self.rank} blocked on {self.kind} tag "
+                f"{self.tag} cid {self.cid} from rank {self.peer}"
+                f"{age}")
+
+
+def blocked_edges(rank: int, dump: dict) -> List[Edge]:
+    """Every waiting-on edge a rank's pml section shows, receive side
+    first (a blocked receive is the thing a stall is usually ABOUT; a
+    blocked send names the back edge of a cycle)."""
+    pml = _pml(dump)
+    edges: List[Edge] = []
+    for p in pml.get("matching", {}).get("posted", []):
+        if p.get("src", -1) < 0:
+            continue
+        edges.append(Edge(rank, int(p["src"]), "MATCH",
+                          int(p.get("cid", 0)), int(p.get("tag", 0)),
+                          p.get("oldest_age_s"),
+                          f"{p.get('n', 1)} posted receive(s)"))
+    for r in pml.get("active_recvs", []):
+        if r.get("src", -1) is None or r.get("src", -1) < 0:
+            continue
+        edges.append(Edge(rank, int(r["src"]), "DATA",
+                          int(r.get("cid", 0)), int(r.get("tag", 0)),
+                          r.get("age_s"),
+                          f"rendezvous {r.get('got', 0)}/"
+                          f"{r.get('nbytes', '?')} bytes landed"))
+    for s in pml.get("pending_sends", []):
+        edges.append(Edge(rank, int(s["dst"]), "RTS",
+                          int(s.get("cid", 0)), int(s.get("tag", 0)),
+                          s.get("age_s"),
+                          f"{s.get('nbytes', '?')}B rendezvous, CTS "
+                          "unanswered"))
+    for s in pml.get("flowing_sends", []):
+        dst = s.get("dst")
+        if dst is None:
+            continue
+        edges.append(Edge(rank, int(dst), "DATA-WINDOW",
+                          int(s.get("cid", 0)), int(s.get("tag", 0)),
+                          s.get("age_s"),
+                          f"{s.get('acked', 0)}/{s.get('offset', 0)} "
+                          f"bytes acked of {s.get('nbytes', '?')}"))
+    edges.extend(_era_edges(rank, dump))
+    return edges
+
+
+def _era_edges(rank: int, dump: dict) -> List[Edge]:
+    """Waiting-on edges from in-progress era agreement rounds — these
+    ride system-plane handlers, post NO pml requests, and are the shape
+    of the era-stall class: a coordinator waits on the outstanding
+    votes, a member waits on the coordinator's decision broadcast."""
+    subs = dump.get("subsystems", {})
+    failed = set(subs.get("ft.detector", {}).get("known_failed", []))
+    edges: List[Edge] = []
+    for rnd in subs.get("ft.era", {}).get("rounds", []):
+        if not rnd.get("in_progress"):
+            continue
+        cid = int(rnd.get("cid", 0))
+        seq = int(rnd.get("round", 0))
+        members = rnd.get("members") or []
+        live = [m for m in members if m not in failed]
+        coord = min(live) if live else None
+        if coord == rank:
+            for peer in (rnd.get("votes_outstanding") or []):
+                # era's phase-1 predicate is contribution-OR-death: a
+                # known-failed voter is satisfied, not blocking — an
+                # edge toward it would out-tiebreak the live stalled
+                # voter and blame a dead rank
+                if int(peer) in failed:
+                    continue
+                edges.append(Edge(
+                    rank, int(peer), "ERA-VOTE", cid, seq,
+                    rnd.get("age_s"),
+                    f"coordinating round {seq}, vote outstanding"))
+        elif coord is not None:
+            edges.append(Edge(
+                rank, int(coord), "ERA-DECISION", cid, seq,
+                rnd.get("age_s"),
+                f"member of round {seq}, no decision received"))
+    return edges
+
+
+def oldest_blocked_edge(rank: int, dump: dict) -> Optional[Edge]:
+    """The rank's oldest blocked RECEIVE edge, falling back to its
+    oldest blocked send — the edge the blame walk follows."""
+    edges = blocked_edges(rank, dump)
+    if not edges:
+        return None
+
+    def key(e: Edge) -> Tuple[int, float]:
+        rank_of_kind = (0 if e.kind in ("MATCH", "DATA")
+                        else 1 if e.kind.startswith("ERA") else 2)
+        return (rank_of_kind,
+                -(e.age_s if e.age_s is not None else -math.inf))
+
+    return sorted(edges, key=key)[0]
+
+
+# ----------------------------------------------------------------- blame
+def _queue_position(peer_dump: dict, to_rank: int) -> Optional[str]:
+    """The peer's tcp send-queue state toward ``to_rank``: which class
+    sub-queues hold frames and how many bytes stand ahead."""
+    for conn in _tcp(peer_dump).get("conns", []):
+        if int(conn.get("peer", -1)) != to_rank:
+            continue
+        parts = []
+        shaped = conn.get("shaped_queues", {})
+        for cls, q in shaped.items():
+            parts.append(f"{q.get('frames', '?')} frame(s) / "
+                         f"{q.get('bytes', 0) / 1e6:.1f}MB queued in "
+                         f"its {cls.upper()} queue "
+                         f"(oldest {q.get('oldest_age_s', '?')}s)")
+        if conn.get("wq_frames"):
+            parts.append(f"{conn['wq_frames']} frame(s) / "
+                         f"{conn.get('wq_bytes', 0) / 1e6:.1f}MB in "
+                         "its FIFO backlog")
+        cur = conn.get("in_progress_frame")
+        if cur:
+            parts.append(f"a {cur.get('cls', '?')} frame mid-write "
+                         f"({cur.get('bytes_left', '?')}B left)")
+        if conn.get("state") == "dead":
+            parts.append(f"the link is DEAD: {conn.get('dead_reason')}")
+        if not parts:
+            st = conn.get("state", "?")
+            rx = conn.get("last_rx_age_s")
+            tx = conn.get("last_tx_age_s")
+            wire = "" if tx is None else (
+                f"; last tx {tx}s ago, last rx "
+                + ("never" if rx is None else f"{rx}s ago"))
+            return f"its send queue to {to_rank} is empty ({st}{wire})"
+        return "; ".join(parts)
+    return None
+
+
+def _seq_verdict(edge: Edge, dumps: Dict[int, dict]) -> Optional[str]:
+    """Compare the peer's send-side seq-plane position with the blocked
+    rank's expected position: stamped > expected-1 proves frames left
+    the pml but never crossed the matching gate — lost, dropped, or
+    still queued below."""
+    me = dumps.get(edge.rank)
+    peer = dumps.get(edge.peer)
+    if me is None or peer is None:
+        return None
+    sent_map = _pml(peer).get("seq_to", {})
+    expect_map = _pml(me).get("expect_seq", {})
+    for cls in (0, 1, 2):
+        sent = sent_map.get(f"{edge.rank}:{cls}")
+        if sent is None:
+            continue
+        expect = expect_map.get(f"{edge.peer}:{cls}", 1)
+        if int(sent) >= int(expect):
+            missing = int(sent) - int(expect) + 1
+            plane = _CLS_NAMES.get(cls, cls)
+            return (f"rank {edge.peer} stamped seq {sent} on the "
+                    f"{plane} plane but rank {edge.rank} expects "
+                    f"{expect} — {missing} frame(s) in flight or "
+                    f"lost/dropped on the wire")
+    # a parked reorder gap on the blocked rank is the other witness
+    for gap in _pml(me).get("seq_gaps", []):
+        if int(gap.get("src", -1)) == edge.peer:
+            return (f"rank {edge.rank} is stuck at expected seq "
+                    f"{gap.get('expect')} with {gap.get('parked')} "
+                    f"frame(s) parked ahead — a frame was lost in "
+                    f"transport failover")
+    return None
+
+
+def blame_edge(edge: Edge, dumps: Dict[int, dict]) -> str:
+    """One line naming the true blocking edge: the blocked side's oldest
+    receive matched against the peer's send-side queue state."""
+    peer = dumps.get(edge.peer)
+    if peer is None:
+        return (f"BLAME: {edge.describe()}: no dump from rank "
+                f"{edge.peer} (dead wire or rank gone) — rank-local "
+                f"evidence only: {edge.detail}")
+    if edge.kind.startswith("ERA"):
+        return _blame_era(edge, peer)
+    ppml = _pml(peer)
+    qpos = _queue_position(peer, edge.rank)
+    if edge.kind in ("MATCH", "DATA"):
+        # does the peer hold a matching blocked send?
+        for s in ppml.get("pending_sends", []):
+            if int(s.get("dst", -1)) == edge.rank and \
+                    int(s.get("cid", -1)) == edge.cid and \
+                    int(s.get("tag", 1 << 62)) == edge.tag:
+                extra = f"; {qpos}" if qpos else ""
+                return (f"BLAME: {edge.describe()}: rank {edge.peer}'s "
+                        f"RTS ({s.get('nbytes', '?')}B) is unanswered "
+                        f"— the CTS/RTS leg is the blocking edge"
+                        f"{extra}")
+        for s in ppml.get("flowing_sends", []):
+            if int(s.get("dst", -1)) == edge.rank and \
+                    int(s.get("cid", -1)) == edge.cid:
+                extra = f"; {qpos}" if qpos else ""
+                return (f"BLAME: {edge.describe()}: rank {edge.peer}'s "
+                        f"DATA stream is stalled at offset "
+                        f"{s.get('offset')} ({s.get('acked')} acked) "
+                        f"of {s.get('nbytes')}B{extra}")
+        sv = _seq_verdict(edge, dumps)
+        if sv is not None:
+            extra = f" ({qpos})" if qpos else ""
+            return f"BLAME: {edge.describe()}: {sv}{extra}"
+        if qpos and "queue" in qpos and "empty" not in qpos:
+            return (f"BLAME: {edge.describe()}: the frame is still in "
+                    f"rank {edge.peer}'s transport — {qpos}")
+        return (f"BLAME: {edge.describe()}: rank {edge.peer} shows no "
+                f"send-side state toward rank {edge.rank} — the "
+                f"message was never sent (application-level ordering "
+                f"or peer-side hang above MPI)"
+                + (f"; {qpos}" if qpos else ""))
+    # send-side edge (RTS / DATA-WINDOW): the peer owes a CTS or ACK
+    sv = _seq_verdict(edge, dumps)
+    return (f"BLAME: {edge.describe()}: waiting for rank "
+            f"{edge.peer}'s {'CTS' if edge.kind == 'RTS' else 'ACK'} "
+            f"— {edge.detail}"
+            + (f"; {sv}" if sv else "")
+            + (f"; {qpos}" if qpos else ""))
+
+
+def _blame_era(edge: Edge, peer_dump: dict) -> str:
+    """ERA edge verdict: what does the blamed peer's own era state say
+    about the same (cid, round)?"""
+    rounds = peer_dump.get("subsystems", {}).get(
+        "ft.era", {}).get("rounds", [])
+    rnd = next((r for r in rounds
+                if int(r.get("cid", -1)) == edge.cid
+                and int(r.get("round", -1)) == edge.tag), None)
+    if rnd is None or rnd.get("members") is None:
+        # members is recorded only when agree() is entered: round
+        # state with members null was created by the background
+        # handler from a peer's eager contribution — the rank itself
+        # never joined the round
+        return (f"BLAME: {edge.describe()}: rank {edge.peer} never "
+                f"entered agreement round {edge.tag} on cid {edge.cid} "
+                f"— it is stuck (or still computing) ABOVE the "
+                f"agreement; check its own waiting-on edges")
+    if rnd.get("decision"):
+        return (f"BLAME: {edge.describe()}: rank {edge.peer} already "
+                f"holds a decision for round {edge.tag} — the DECIDE "
+                f"frame toward rank {edge.rank} was lost on the wire")
+    if rnd.get("in_progress"):
+        return (f"BLAME: {edge.describe()}: rank {edge.peer} is also "
+                f"inside round {edge.tag} (contributions held "
+                f"{rnd.get('contribs')}, votes outstanding "
+                f"{rnd.get('votes_outstanding')}) — the round itself "
+                f"is wedged; follow rank {edge.peer}'s edge next")
+    return (f"BLAME: {edge.describe()}: rank {edge.peer} entered and "
+            f"exited round {edge.tag} without a decision (timeout or "
+            f"revoke-abort) — rank {edge.rank} is waiting on a round "
+            f"its peer already abandoned")
+
+
+def find_cycles(edges: Dict[int, Edge]) -> List[List[int]]:
+    """Cycles in the waiting-on map (rank -> blamed peer)."""
+    cycles: List[List[int]] = []
+    seen_cycle: set = set()
+    for start in sorted(edges):
+        path: List[int] = []
+        pos: Dict[int, int] = {}
+        r = start
+        while r in edges and r not in pos:
+            pos[r] = len(path)
+            path.append(r)
+            r = edges[r].peer
+        if r in pos:
+            cyc = path[pos[r]:]
+            key = frozenset(cyc)
+            if len(cyc) > 1 and key not in seen_cycle:
+                seen_cycle.add(key)
+                cycles.append(cyc)
+    return cycles
+
+
+# ------------------------------------------------------------------ report
+def _shift_ages(node: Any, delta: float) -> Any:
+    """Deep copy with every relative-age field (``*age_s``,
+    ``since_last_completion_s``) bumped by ``delta`` seconds, turning
+    "Xs ago at MY dump instant" into "Xs ago at the common reference
+    instant"."""
+    if isinstance(node, dict):
+        return {k: (round(v + delta, 3)
+                    if (k.endswith("age_s")
+                        or k == "since_last_completion_s")
+                    and isinstance(v, (int, float))
+                    and not isinstance(v, bool)
+                    else _shift_ages(v, delta))
+                for k, v in node.items()}
+    if isinstance(node, list):
+        return [_shift_ages(v, delta) for v in node]
+    return node
+
+
+def align_dumps(dumps: Dict[int, dict],
+                offsets: Dict[int, float]
+                ) -> Tuple[Dict[int, dict], Dict[int, float]]:
+    """mpisync alignment (``ts0 = ts_r - offset_r``, the trace_merge
+    convention, over each dump's monotonic ``ts_ns`` stamp): every
+    rank's ages are shifted onto the LATEST aligned dump instant so a
+    blocked recv's age and the peer's send-side ages — measured at
+    different moments on different clocks — compare on one timeline.
+    An all-zero offsets map still corrects same-clock dump-instant
+    skew. Returns the aligned dumps and the per-rank shift applied."""
+    aligned_ts = {r: d["ts_ns"] / 1e9 - offsets.get(r, 0.0)
+                  for r, d in dumps.items()
+                  if isinstance(d.get("ts_ns"), (int, float))}
+    if not aligned_ts:
+        return dumps, {r: 0.0 for r in dumps}
+    ref = max(aligned_ts.values())
+    skew = {r: round(ref - aligned_ts[r], 6) if r in aligned_ts
+            else 0.0 for r in dumps}
+    return ({r: _shift_ages(d, skew[r]) if skew[r] else d
+             for r, d in dumps.items()}, skew)
+
+
+def analyze(dumps: Dict[int, dict],
+            offsets: Optional[Dict[int, float]] = None) -> dict:
+    """The full merged verdict (the procmode check and the unit tests
+    drive this directly): per-rank summaries, every waiting-on edge,
+    blame lines for the stalled ranks, and any waiting cycles."""
+    offsets = offsets or {}
+    skew: Dict[int, float] = {r: 0.0 for r in dumps}
+    if offsets:
+        dumps, skew = align_dumps(dumps, offsets)
+    summaries: Dict[int, dict] = {}
+    oldest: Dict[int, Edge] = {}
+    for rank, dump in dumps.items():
+        stall = dump.get("stall", {})
+        edge = oldest_blocked_edge(rank, dump)
+        if edge is not None:
+            oldest[rank] = edge
+        summaries[rank] = {
+            "reason": dump.get("reason"),
+            "latched": bool(stall.get("latched")),
+            "since_last_completion_s":
+                stall.get("since_last_completion_s"),
+            "offset_s": offsets.get(rank, 0.0),
+            "dump_skew_s": skew.get(rank, 0.0),
+            "edges": [e.describe() for e in blocked_edges(rank, dump)],
+        }
+    # blame the stalled ranks: the sentinel AND the auto triggers (era
+    # timeout, watchdog conversion, sanitizer deadlock) all count —
+    # UNIONED, because a mixed stall (one rank latched, another dumped
+    # by an era timeout) needs every wedged rank's edge in the verdict;
+    # on-demand dumps of a healthy run blame none. peer-request dumps
+    # INHERIT the requester's reason text — a healthy peer with routine
+    # in-flight receives must not be blamed just because a stalled rank
+    # asked it to dump
+    stalled = {r for r, s in summaries.items() if s["latched"]}
+    stalled |= {r for r, d in dumps.items()
+                if not str(d.get("reason",
+                                 "")).startswith("peer-request")
+                and any(k in str(d.get("reason", ""))
+                        for k in ("stall", "era-timeout",
+                                  "watchdog", "deadlock"))
+                and r in oldest}
+    blames = [blame_edge(oldest[r], dumps) for r in sorted(stalled)
+              if r in oldest]
+    for r in sorted(stalled):
+        if r not in oldest:
+            # latched with no pml/era edge the walk can follow: say so
+            # instead of letting render() claim everything is healthy
+            blames.append(
+                f"BLAME: rank {r} is stalled "
+                f"({summaries[r]['reason']!r}) but shows no pml/era "
+                f"waiting-on edge — the pending work is outside the "
+                f"walk's view; inspect its dump directly")
+    # cycles only over the STALLED ranks' edges: dumps are never
+    # simultaneous, so two healthy on-demand snapshots of a routine
+    # ring exchange can each show an in-flight receive from the other
+    # — a false deadlock if every rank's edge joined the walk
+    cycles = find_cycles({r: e for r, e in oldest.items()
+                          if r in set(stalled)})
+    return {
+        "ranks": summaries,
+        "blames": blames,
+        "cycles": [" -> ".join(str(r) for r in c + [c[0]])
+                   for c in cycles],
+    }
+
+
+def _default_dir() -> str:
+    import tempfile
+
+    cands = [d for d in glob.glob(os.path.join(
+        tempfile.gettempdir(), "ompi-tpu-metrics-*"))
+        if os.path.isdir(d)]
+    if not cands:
+        return "."
+    return max(cands, key=lambda d: os.path.getmtime(d))
+
+
+def render(report: dict) -> str:
+    lines: List[str] = []
+    for rank in sorted(report["ranks"]):
+        s = report["ranks"][rank]
+        mark = "LATCHED" if s["latched"] else "ok"
+        lines.append(f"rank {rank}: {mark}  reason={s['reason']!r}  "
+                     f"no-completion={s['since_last_completion_s']}s")
+        for e in s["edges"]:
+            lines.append(f"  waiting-on: {e}")
+    for cyc in report["cycles"]:
+        lines.append(f"BLAME-CYCLE: {cyc} — every member waits on the "
+                     "next; break the cycle, not one edge")
+    lines.extend(report["blames"])
+    if not report["blames"] and not report["cycles"]:
+        lines.append("no stalled rank: all dumps look healthy")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mpidiag",
+        description="merge stall-rank<N>.json forensics dumps and "
+                    "name the blocking edge")
+    ap.add_argument("--dir", default=None,
+                    help="dump directory (default: the newest "
+                         "ompi-tpu-metrics-<job> temp dir, then CWD)")
+    ap.add_argument("--offsets", default=None,
+                    help="mpisync offsets (JSON or mpisync stdout): "
+                         "shifts every rank's ages onto one reference "
+                         "instant; an all-zero map corrects "
+                         "dump-instant skew on a shared clock")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged report as JSON")
+    opts = ap.parse_args(argv)
+    directory = opts.dir if opts.dir is not None else _default_dir()
+    dumps = read_dumps(directory)
+    if not dumps:
+        print(f"mpidiag: no stall-rank*.json under {directory} "
+              "(dumps come from the stall sentinel with "
+              "--mca forensics_enable 1, from comm.Dump_state(), or "
+              "from SIGUSR1)", file=sys.stderr)
+        return 1
+    offsets = load_offsets(opts.offsets) if opts.offsets else {}
+    report = analyze(dumps, offsets)
+    if opts.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,6 +114,31 @@ def qos_queued(snap: dict) -> str:
     return "/".join(str(v // 1024) for v in vals)
 
 
+def stall_cell(snap: dict) -> str:
+    """Stall-sentinel cell from the forensics sampler (`*<age>s` =
+    latched: pending work with no completion past the threshold; bare
+    `<age>s` = seconds since the last completion while armed). Pvar
+    fallback for snapshots written before the sampler existed — the
+    QKB-L/N/B pattern. Empty when the forensics plane never armed."""
+    row = snap.get("samplers", {}).get("forensics_stall")
+    if not isinstance(row, dict):
+        pv = snap.get("pvars", {})
+        if "forensics_stall_latched" not in pv:
+            return ""
+        row = {"latched": pv.get("forensics_stall_latched", 0),
+               "age_s": pv.get("forensics_last_completion_age_s", 0)}
+    try:
+        latched = int(row.get("latched") or 0)
+        age = float(row.get("age_s") or 0.0)
+    except (TypeError, ValueError):
+        return ""
+    if latched:
+        return f"*{age:.0f}s"
+    if age > 0:
+        return f"{age:.0f}s"
+    return ""
+
+
 def skew_by_rank(snaps: Dict[int, dict]) -> Dict[int, float]:
     """Worst coll_entry_skew_us EWMA per rank, pulled from every
     snapshot (comm roots hold the values for their members)."""
@@ -138,7 +163,8 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
     skews = skew_by_rank(snaps)
     lines = [f"{'RANK':>4} {'AGE-S':>6} {'COLLS':>8} {'COLL/S':>7} "
              f"{'TX-MB':>9} {'RX-MB':>9} {'SKEW-US':>8} {'TRIPS':>5} "
-             f"{'P50-US':>7} {'P99-US':>8} {'QKB-L/N/B':>10}"]
+             f"{'P50-US':>7} {'P99-US':>8} {'QKB-L/N/B':>10} "
+             f"{'STALL':>6}"]
     for rank in sorted(snaps):
         snap = snaps[rank]
         pv = snap.get("pvars", {})
@@ -162,7 +188,7 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
             f"{pv.get('metrics_straggler_trips', 0):>5} "
             f"{'' if p50 is None else format(p50, '.0f'):>7} "
             f"{'' if p99 is None else format(p99, '.0f'):>8} "
-            f"{qos_queued(snap):>10}")
+            f"{qos_queued(snap):>10} {stall_cell(snap):>6}")
     trips = sum(int(s.get("pvars", {}).get("metrics_straggler_trips", 0))
                 for s in snaps.values())
     lines.append(f"-- {len(snaps)} rank(s), {trips} straggler trip(s), "
