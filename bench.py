@@ -968,6 +968,90 @@ def bench_qos():
     return out
 
 
+def bench_serving():
+    """Elastic serving under churn (ROADMAP item 4): steady-state step
+    p99 vs p99-under-churn vs the recovery-time objective per fault
+    class, measured by tests/procmode/check_serving.py — steady mode
+    serves a warmed open-loop stream with no faults; churn mode
+    composes kill->respawn, preempt->flush, and kill->shrink+reshard
+    episodes under the same traffic (coordinated-omission-corrected
+    latencies, min-of-rounds over the churn runs for the RTOs, which
+    are detection-latency-dominated and noise-prone on a loaded host).
+    Gauges mirror into the metrics registry so the BENCH json and the
+    Prometheus export agree."""
+    import os
+    import re
+    import subprocess
+
+    from ompi_tpu.runtime import metrics
+
+    env = _procmode_env()
+    here = os.path.dirname(os.path.abspath(__file__))
+    ft = ["--mca", "ft_enable", "1",
+          "--mca", "ft_heartbeat_period", "0.25",
+          "--mca", "ft_heartbeat_timeout", "4.0",
+          "--mca", "ft_era_timeout", "60",
+          "--mca", "coll_sm_enable", "0",
+          "--mca", "ft_ckpt_enable", "1",
+          "--mca", "ft_ckpt_timeout", "10",
+          "--mca", "forensics_enable", "1",
+          "--mca", "forensics_stall_threshold_ms", "30000"]
+
+    def run(mode, extra, timeout):
+        return subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "3"]
+            + extra + ["tests/procmode/check_serving.py", mode],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=here)
+
+    out = {}
+    try:
+        r = run("steady", ["--mca", "coll_sm_enable", "0"], 180)
+    except Exception as e:  # pragma: no cover
+        return {"error": str(e)[:300]}
+    m = re.search(r"SERVING-SLO rank 0 p50=([0-9.]+)us p99=([0-9.]+)us "
+                  r"violations=(\d+)", r.stdout)
+    if not m or r.stdout.count("SERVING-OK") != 3:
+        return {"error": r.stdout[-300:] + r.stderr[-300:]}
+    out["steady"] = {"p50_us": float(m.group(1)),
+                     "p99_us": float(m.group(2)),
+                     "slo_violations": int(m.group(3))}
+    # churn: min-of-rounds on the per-class RTOs (2 rounds — each run
+    # respawns twice and reshards once, several seconds of real
+    # detection latency per episode)
+    rtos = {}
+    churn = None
+    for _ in range(2):
+        try:
+            r = run("churn", ft, 240)
+        except Exception as e:  # pragma: no cover
+            return {"error": str(e)[:300], **out}
+        if r.stdout.count("SERVING-OK") != 2:
+            return {"error": r.stdout[-300:] + r.stderr[-300:], **out}
+        m = re.search(r"SERVING-SLO rank 0 p50=([0-9.]+)us "
+                      r"p99=([0-9.]+)us violations=(\d+)", r.stdout)
+        if m:
+            churn = {"p50_us": float(m.group(1)),
+                     "p99_us": float(m.group(2)),
+                     "slo_violations": int(m.group(3))}
+        for fc, us in re.findall(r"'(\w+)': '([0-9.]+)us'", r.stdout):
+            v = float(us)
+            if fc not in rtos or v < rtos[fc]:
+                rtos[fc] = v
+    out["under_churn"] = churn
+    out["rto_us"] = rtos
+    out["steady_vs_churn_p99"] = round(
+        churn["p99_us"] / max(out["steady"]["p99_us"], 1e-9), 2) \
+        if churn else None
+    for mode in ("steady", "under_churn"):
+        if out.get(mode):
+            metrics.gauge_set("bench_serving_p99_us",
+                              out[mode]["p99_us"], mode=mode)
+    for fc, v in rtos.items():
+        metrics.gauge_set("bench_serving_rto_us", v, fault_class=fc)
+    return out
+
+
 def bench_host_paths():
     """Process-mode fast paths vs their frame-based fallbacks: coll/sm
     segment collectives (xhc analog) and the zero-copy shared-segment
@@ -1064,6 +1148,7 @@ def main() -> int:
     detail["coll_datapath"] = bench_coll_datapath()
     detail["persistent"] = bench_persistent()
     detail["qos"] = bench_qos()
+    detail["serving"] = bench_serving()
     detail["host_paths"] = bench_host_paths()
     detail["model_step"] = bench_mfu()
 

@@ -74,6 +74,21 @@ _PARITY_XCHG_TAG = 4243
 
 _counts: Dict[str, int] = {"failovers": 0, "retries": 0, "respawns": 0}
 
+# recovery-window depth: recover() publishes "a recovery is in flight
+# on this process" so step-boundary admission control (serve/policy's
+# AdmissionGate) can hold traffic instead of issuing collectives into
+# a membership that is mid-revoke/shrink/respawn. Single int bumped
+# and read under the GIL; nested recover() calls (a failure during
+# recovery's own collectives escalating into an outer retry) stack.
+_recovering = [0]  # mpiracer: relaxed-counter — GIL-atomic depth bumps; admission readers tolerate a one-poll-stale view
+
+
+def recovering() -> bool:
+    """Is a :func:`recover` call in flight on this process? The serve
+    admission gate polls this to queue steps for the recovery window
+    instead of tearing collectives across the dying membership."""
+    return _recovering[0] > 0
+
 register_pvar("ft", "failovers", lambda: _counts["failovers"],
               help="Completed revoke->agree->shrink recoveries")
 register_pvar("ft", "retries", lambda: _counts["retries"],
@@ -150,13 +165,17 @@ def recover(comm, checkpoint_dir: Optional[str] = None,
         raise MPIError(ERR_ARG, f"unknown recovery policy {policy!r}")
     from ompi_tpu.runtime import spc
 
-    if _trace.enabled():
-        with _trace.span("ft.recover", cat="ft", cid=comm.cid,
-                         policy=policy):
-            return _recover(comm, checkpoint_dir, step, policy,
-                            command, args, spc, elastic, replicated)
-    return _recover(comm, checkpoint_dir, step, policy, command, args,
-                    spc, elastic, replicated)
+    _recovering[0] += 1
+    try:
+        if _trace.enabled():
+            with _trace.span("ft.recover", cat="ft", cid=comm.cid,
+                             policy=policy):
+                return _recover(comm, checkpoint_dir, step, policy,
+                                command, args, spc, elastic, replicated)
+        return _recover(comm, checkpoint_dir, step, policy, command,
+                        args, spc, elastic, replicated)
+    finally:
+        _recovering[0] -= 1
 
 
 def _recover(comm, checkpoint_dir, step, policy, command, args, spc,

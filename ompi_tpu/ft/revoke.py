@@ -40,6 +40,17 @@ def revoke_comm(comm) -> None:
             pml.isend(token, 1, _int64(), r, REVOKE_TAG, comm.cid)
         except Exception:
             pass  # peer may already be dead; its detector will notice
+    # fail every pending operation on the revoked comm NOW (ULFM: the
+    # revocation completes pending operations with ERR_REVOKED). A rank
+    # blocked mid-collective on a LIVE peer that left for recovery has
+    # nothing the peer-death sweep can convert — without this drain it
+    # waits out the era timeout while the recovering peers' agreement
+    # stalls on it (the "agreement stalled on coordinator" soak class).
+    # Runs on the initiator AND on every flood receipt (_on_revoke
+    # re-enters here exactly once per rank — the revoked flag dedups).
+    drain = getattr(pml, "revoke_requests", None)
+    if drain is not None:
+        drain(comm.cid)
 
 
 def _int64():

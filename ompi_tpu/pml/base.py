@@ -342,28 +342,48 @@ class MatchingEngine:
             self._depth("pml.unexpected_queue", self._n_unexpected)
         return best
 
+    def _drain_posted(self, want_key, want_wild) -> List[RecvRequest]:  # locked-by: self.lock
+        """Shared removal+accounting body of the two failure drains:
+        pop every ``_posted_exact`` bucket whose (cid, src, tag) key
+        ``want_key`` accepts and every wildcard receive ``want_wild``
+        accepts, mark them matched (a late cancel_posted must no-op),
+        and settle the depth counter once. Call with the engine lock
+        held (it is an RLock; the pml's failure callbacks hold it)."""
+        out: List[RecvRequest] = []
+        for key in [k for k in self._posted_exact if want_key(k)]:
+            out.extend(self._posted_exact.pop(key))
+        doomed_wild = [req for req in self._posted_wild
+                       if want_wild(req)]
+        for req in doomed_wild:
+            self._posted_wild.remove(req)
+        out.extend(doomed_wild)
+        for req in out:
+            req.matched = True
+            self._n_posted -= 1
+        if out:
+            self._depth("pml.posted_queue", self._n_posted)
+        return out
+
     def drain_posted_for_src(self, src: int) -> List[RecvRequest]:  # locked-by: self.lock
         """Remove every posted receive NAMING ``src`` (the ULFM
         peer-death drain: the pml completes them with ERR_PROC_FAILED) —
         both the fully-specified bucket entries and named-source ANY_TAG
         receives parked on the wildcard list. Only ANY_SOURCE receives
         stay posted — a live sender may still match them, which is
-        exactly the MPI_ERR_PROC_FAILED_PENDING nuance. Call with the
-        engine lock held (it is an RLock; the pml's failure callback
-        holds it)."""
-        out: List[RecvRequest] = []
-        for key in [k for k in self._posted_exact if k[1] == src]:
-            out.extend(self._posted_exact.pop(key))
-        named_wild = [req for req in self._posted_wild if req.src == src]
-        for req in named_wild:
-            self._posted_wild.remove(req)
-        out.extend(named_wild)
-        for req in out:
-            req.matched = True  # a late cancel_posted must no-op
-            self._n_posted -= 1
-        if out:
-            self._depth("pml.posted_queue", self._n_posted)
-        return out
+        exactly the MPI_ERR_PROC_FAILED_PENDING nuance."""
+        return self._drain_posted(lambda k: k[1] == src,
+                                  lambda req: req.src == src)
+
+    def drain_posted_for_cids(self, cids) -> List[RecvRequest]:  # locked-by: self.lock
+        """Remove every posted receive on one of the ``cids`` planes
+        (the ULFM revoke drain: the pml completes them with
+        ERR_REVOKED). Unlike the peer-death drain, ANY_SOURCE receives
+        go too — revocation dooms the whole communicator, so there is
+        no live sender left whose match should be awaited (MPI 4.x
+        MPI_Comm_revoke semantics: pending operations on the revoked
+        communicator complete raising an exception)."""
+        return self._drain_posted(lambda k: k[0] in cids,
+                                  lambda req: req.cid in cids)
 
     def find_unexpected(self, src: int, tag: int, cid: int) -> Optional[UnexpectedFrag]:
         probe = RecvRequest(None, 0, None, src, tag, cid)  # matcher only

@@ -224,11 +224,21 @@ class Ob1Pml:
             register_progress(_watchdog_cb, low_priority=True)
         if _inject._enable_var._value:
             _inject.note_rank(my_rank)  # chaos recv-side rank identity
-        # Stall-forensics introspection contract (runtime/forensics):
-        # the provider runs only at dump time, the pending probe is a
-        # few len() loads per sentinel poll. Weakly bound like the
-        # detector callback above — the registry is rebind-by-name, so
-        # the newest pml instance (tests build several) reports.
+        self.bind_forensics()
+
+    def bind_forensics(self) -> None:
+        """(Re)bind THIS instance as the stall-forensics 'pml' provider
+        and pending probe (runtime/forensics introspection contract:
+        the provider runs only at dump time, the probe is a few len()
+        loads per sentinel poll). The registry is rebind-by-name with
+        weak binding, so the newest pml instance reports — a transient
+        test pml shadows the live one (its dead weakref then reads as
+        ZERO pending work, blinding the sentinel); tests that build
+        bare pmls call this on the world pml afterward to hand the
+        name back."""
+        import weakref as _weakref
+
+        ref = _weakref.ref(self)
 
         def _fx_pending(_ref=ref):
             pml = _ref()
@@ -347,14 +357,17 @@ class Ob1Pml:
         }
 
     # ------------------------------------------------ peer-death watchdog
-    def _fail_requests(self, victims, why: str) -> None:
-        """Complete each victim with ERR_PROC_FAILED. MUST be called
-        WITHOUT engine.lock held: flowing sends are completed under
-        their _pump_lock to serialize against a concurrent _pump (whose
-        success completion would otherwise race last-writer-wins with
-        the failure), and _pump's self-btl inline delivery acquires
-        engine.lock — taking _pump_lock under engine.lock would invert
-        that order and deadlock."""
+    def _fail_requests(self, victims, why: str,
+                       code: int = ERR_PROC_FAILED) -> None:
+        """Complete each victim with ``code`` (ERR_PROC_FAILED for the
+        peer-death sweeps, ERR_REVOKED for the revoke drain). MUST be
+        called WITHOUT engine.lock held: flowing sends are completed
+        under their _pump_lock to serialize against a concurrent _pump
+        (whose success completion would otherwise race
+        last-writer-wins with the failure), and _pump's self-btl inline
+        delivery acquires engine.lock — taking _pump_lock under
+        engine.lock would invert that order and deadlock."""
+        from ompi_tpu.core.errors import Error_string
         from ompi_tpu.runtime import spc
 
         def fail(req) -> None:
@@ -363,9 +376,10 @@ class Ob1Pml:
             # pvar/spc surface) the moment _set_complete runs
             _wd_trips[0] += 1
             spc.record("pml_watchdog_trip")
-            self.log.error("failing %s with ERR_PROC_FAILED: %s",
-                           type(req).__name__, why)
-            req._set_complete(ERR_PROC_FAILED)
+            self.log.error("failing %s with %s: %s",
+                           type(req).__name__,
+                           Error_string(code).split(":")[0], why)
+            req._set_complete(code)
 
         for req in victims:
             lock = getattr(req, "_pump_lock", None)
@@ -401,26 +415,83 @@ class Ob1Pml:
             # keep their pre-watchdog semantics; the opt-in
             # pml_peer_timeout arm fails its victims directly)
             return
-        victims = []
         with self.engine.lock:
-            # victim only when WE popped it: a concurrent _incoming_cts /
-            # _incoming_data that won the pop owns the request's
-            # completion — appending it anyway would race their success
-            # verdict last-writer-wins
-            for msgid, sreq in list(self._pending_sends.items()):
-                if sreq.dst == rank and \
-                        self._pending_sends.pop(msgid, None) is not None:
-                    victims.append(sreq)
-            for msgid, sreq in list(self._flowing.items()):
-                if getattr(sreq, "_peer", None) == rank and \
-                        self._flowing.pop(msgid, None) is not None:
-                    victims.append(sreq)
-            for msgid, rreq in list(self._active_recvs.items()):
-                if rreq.status.source == rank and \
-                        self._active_recvs.pop(msgid, None) is not None:
-                    victims.append(rreq)
+            victims = self._claim_requests(
+                lambda sreq: sreq.dst == rank,
+                lambda sreq: getattr(sreq, "_peer", None) == rank,
+                lambda rreq: rreq.status.source == rank)
             victims.extend(self.engine.drain_posted_for_src(rank))
         self._fail_requests(victims, f"rank {rank} is failed")
+
+    def _claim_requests(self, want_pending, want_flowing, want_active):
+        """Claim-and-pop the protocol-store requests the predicates
+        accept (one predicate per store: unanswered RTS sends, flowing
+        DATA streams, matched-but-unfinished receives). Victim only
+        when WE popped it: a concurrent _incoming_cts / _incoming_data
+        that won the pop owns the request's completion — appending it
+        anyway would race their success verdict last-writer-wins. The
+        ONE claim idiom both failure sweeps (peer death, revoke)
+        share. engine.lock is an RLock: both sweeps already hold it
+        (their posted-queue drain must be atomic with this scan), and
+        re-acquiring here keeps the function safe standalone. The three
+        stores are popped by NAME, not through a loop alias — the
+        mpiracer lock-ownership inference reads direct attribute
+        writes under the with-block."""
+        victims = []
+        with self.engine.lock:
+            for msgid, req in list(self._pending_sends.items()):
+                if want_pending(req) and \
+                        self._pending_sends.pop(msgid, None) is not None:
+                    victims.append(req)
+            for msgid, req in list(self._flowing.items()):
+                if want_flowing(req) and \
+                        self._flowing.pop(msgid, None) is not None:
+                    victims.append(req)
+            for msgid, req in list(self._active_recvs.items()):
+                if want_active(req) and \
+                        self._active_recvs.pop(msgid, None) is not None:
+                    victims.append(req)
+        return victims
+
+    def revoke_requests(self, base_cid: int) -> int:
+        """ULFM revoke drain (MPI 4.x MPIX_Comm_revoke semantics):
+        every pending operation on the revoked communicator — posted
+        receives (ANY_SOURCE included), matched receives mid-
+        rendezvous, unanswered RTS sends, flow-controlled DATA streams
+        — completes with ERR_REVOKED the moment the revoke notice
+        lands. Without this a survivor blocked on a LIVE peer that
+        abandoned the collective for recovery hangs until the era
+        timeout: the dead rank's peers fail fast over EOF, but a rank
+        whose pending traffic all names live peers has nothing the
+        peer-death sweep can convert (the era-agreement-stalled-on-
+        coordinator soak class — found by the serving churn loop with
+        forensics armed).
+
+        Swept planes: the user cid plus the collective/NBC/partitioned/
+        IO derived planes. The ft control planes (shrink agreement
+        FT_CID_BIT, diskless commit CKPT_CID_BIT, dpm bridge
+        DPM_CID_BIT) are exempt — recovery itself runs on them AFTER
+        the revoke, and the era/commit channels convert their own
+        losses. Returns the number of requests failed."""
+        from ompi_tpu.coll.basic import COLL_CID_BIT
+        from ompi_tpu.coll.sched import NBC_CID_BIT
+        from ompi_tpu.core.errors import ERR_REVOKED
+        from ompi_tpu.io.file import IO_CID_BIT
+        from ompi_tpu.pml.partitioned import PART_CID_BIT
+
+        cids = {base_cid, base_cid | COLL_CID_BIT,
+                base_cid | NBC_CID_BIT, base_cid | PART_CID_BIT,
+                base_cid | IO_CID_BIT}
+
+        def doomed(req) -> bool:
+            return req.cid in cids
+
+        with self.engine.lock:
+            victims = self._claim_requests(doomed, doomed, doomed)
+            victims.extend(self.engine.drain_posted_for_cids(cids))
+        self._fail_requests(victims, f"communicator {base_cid} revoked",
+                            code=ERR_REVOKED)
+        return len(victims)
 
     def _watchdog_poll(self) -> int:
         """Low-priority progress callback (armed only when

@@ -29,9 +29,13 @@ the disabled path of every hook is one live-Var attribute load):
 
 1. an explicit per-send override (``pml.isend(..., qos=...)`` — the
    coll round engine tags phase traffic this way);
-2. system tags (<= -4000): the ``qos_tag_map`` cvar, which demotes the
-   known background planes to BULK and promotes the ft control plane
-   to LATENCY by default;
+2. the ``qos_tag_map`` cvar: system tags (<= -4000) always resolve
+   through it (the default demotes the known background planes to BULK
+   and promotes the ft control plane to LATENCY), and explicitly
+   listed POSITIVE tags do too — the recovery state-movement planes
+   (respawn state delivery 4242, diskless reconstruction exchange
+   4243, reshard rounds 4300) default to BULK so a recovery storm
+   cannot contend head-on with foreground step traffic;
 3. a per-communicator override via comm attrs
    (:func:`set_comm_class` / ``comm.Set_qos_class``), looked up
    through the live-comm registry with a flat cid-keyed cache so the
@@ -71,7 +75,8 @@ _BY_NAME = {v: k for k, v in NAMES.items()}
 #: above mca/var — the pml imports us, not the reverse)
 _SYSTEM_TAG_BASE = -4000
 #: user cids live below the plane bits (pml/base._PLANE_MASK inverse)
-_CID_MASK = (1 << 25) - 1
+_PLANE_SHIFT = 25
+_CID_MASK = (1 << _PLANE_SHIFT) - 1
 
 _enable_var = register_var(
     "btl_tcp", "shape_enable", 0,
@@ -96,16 +101,29 @@ _segment_var = register_var(
 _tag_map_var = register_var(
     "qos", "tag_map", "-4600:bulk,-4500:bulk,-4242:latency,"
                       "-4243:latency,-4244:latency,-4245:latency,"
-                      "-4800:latency",
+                      "-4800:latency,"
+                      "4242:bulk,4243:bulk,4300:bulk",
     typ=str,
-    help="Default QoS class per system tag plane: 'tag:class' pairs, "
-         "comma-separated. The default demotes the known background "
-         "planes (diskless ckpt replication -4600, metrics shipping "
-         "-4500) to bulk and promotes the ft control plane (revoke "
-         "-4242, heartbeat -4243, era -4244, failure flood -4245) and "
-         "the stall-forensics dump requests (-4800 — a dump request "
-         "diagnosing a bulk backlog must not queue behind it) to "
-         "latency; unlisted system tags ride normal", level=5)
+    help="Default QoS class per tag plane: 'tag:class' pairs, comma-"
+         "separated. System tags (<= -4000) always resolve through "
+         "this map; POSITIVE tags resolve through it only when listed "
+         "AND only on the plane-free user cid — derived planes carry "
+         "internal tag sequences that must not collide — (ahead of "
+         "any per-comm override). The default demotes the "
+         "known background planes (diskless ckpt replication -4600, "
+         "metrics shipping -4500) to bulk, promotes the ft control "
+         "plane (revoke -4242, heartbeat -4243, era -4244, failure "
+         "flood -4245) and the stall-forensics dump requests (-4800 — "
+         "a dump request diagnosing a bulk backlog must not queue "
+         "behind it) to latency, and demotes the RECOVERY state-"
+         "movement planes to bulk: respawn state delivery (4242), the "
+         "diskless XOR-reconstruction/buddy-blob exchange (4243), and "
+         "reshard rounds (4300) — during a recovery storm these bytes "
+         "must not contend head-on with foreground step traffic "
+         "(tests/procmode/check_serving.py iso measures the A/B). An "
+         "application whose own traffic uses one of the mapped "
+         "positive tags can unlist it here; unlisted tags ride their "
+         "comm's class or normal", level=5)
 
 # classification counters (plain int bumps, the btl _ctr discipline) —
 # stamped-by-class totals prove the demotion map engages
@@ -182,13 +200,17 @@ def _invalidate_tag_map(_var=None) -> None:
 watch_var("qos", "tag_map", _invalidate_tag_map)
 
 
-def _tag_class(tag: int) -> int:
+def _tag_map() -> Dict[int, int]:
     global _tag_classes
     m = _tag_classes
     if m is None:
         with _lock:
             m = _tag_classes = _parse_tag_map()
-    return m.get(tag, NORMAL)
+    return m
+
+
+def _tag_class(tag: int) -> int:
+    return _tag_map().get(tag, NORMAL)
 
 
 # ----------------------------------------------- per-communicator override
@@ -267,12 +289,27 @@ def _comm_class(cid: int) -> int:
 
 def classify(tag: int, cid: int) -> int:
     """Class of one outbound message (called by the pml only when
-    shaping is on): tag map for system planes, comm override for user
-    traffic, NORMAL otherwise. Bumps the stamped-by-class counters."""
+    shaping is on): tag map for system planes AND explicitly-listed
+    user tags (the recovery state-movement planes — respawn delivery
+    4242, parity exchange 4243, reshard 4300 — ride user-plane tags on
+    fresh/shrunk comms, so the map is the only boundary that can see
+    them), comm override for everything else, NORMAL otherwise. The
+    (cid, tag)->class mapping stays deterministic — tag-keyed entries
+    apply on every comm — so the per-(peer, class) MATCH seq planes
+    stay consistent. Bumps the stamped-by-class counters."""
     if tag <= _SYSTEM_TAG_BASE:
         cls = _tag_class(tag)
     else:
-        cls = _comm_class(cid)
+        # positive-tag map entries apply ONLY on the plane-free user
+        # cid: derived planes carry internal tag sequences (the NBC
+        # schedule allocator counts up from 0 per comm), so a
+        # long-running comm's 4243rd nonblocking collective would
+        # otherwise collide with the recovery entries and silently ride
+        # BULK — the recovery planes themselves are plain comm.Send /
+        # Recv traffic with no plane bits
+        cls = _tag_map().get(tag) if (cid >> _PLANE_SHIFT) == 0 else None
+        if cls is None:
+            cls = _comm_class(cid)
     _ctr[NAMES[cls]] += 1
     return cls
 

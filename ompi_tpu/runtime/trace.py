@@ -58,8 +58,15 @@ _enable_var = register_var(
     help="Record cross-layer spans into per-thread ring buffers and "
          "export Chrome-trace JSON at finalize", level=3)
 _dir_var = register_var(
-    "trace", "dir", ".", typ=str,
-    help="Directory for the per-rank trace-rank<N>.json export", level=3)
+    "trace", "dir", "", typ=str,
+    help="Directory for the per-rank trace-rank<N>.json export. Empty "
+         "(default) = a per-job subdir of the system temp dir "
+         "(ompi-tpu-trace-<launcher pid>) — NOT the CWD, which "
+         "littered repo checkouts with trace files every procmode run "
+         "(the metrics_dir PR 13 fix, applied to traces). "
+         "tools/trace_merge.py finds the newest such dir by default "
+         "(mpidiag reads stall dumps under metrics_dir, not here); "
+         "point this somewhere durable to keep exports", level=3)
 _cap_var = register_var(
     "trace", "buffer_events", 65536,
     help="Ring-buffer capacity (events) per thread; the oldest events "
@@ -198,8 +205,17 @@ def _emit_mpit(kind: str, name: str, cat: str) -> None:
 
 # ----------------------------------------------------------------- export
 def _rank() -> int:
+    # UNIVERSE rank (job base + local rank): a respawned replacement is
+    # world rank 0 of ITS spawn job but shares the parent job's export
+    # dirs — keying exports by the local rank made its
+    # stall/metrics/trace files collide with the original rank 0's
+    # (last writer wins, the replacement's forensics evidence vanished
+    # — found triaging the preempt soak seeds). Universe ranks are also
+    # what mpidiag's blame edges name, so the merged walk can reach the
+    # replacement's dump.
     try:
-        return int(os.environ.get("OMPI_TPU_RANK", "0"))  # mpilint: disable=raw-environ — rank identity for the export filename
+        base = int(os.environ.get("OMPI_TPU_BASE", "0"))  # mpilint: disable=raw-environ — job-offset identity for the export filename
+        return base + int(os.environ.get("OMPI_TPU_RANK", "0"))  # mpilint: disable=raw-environ — rank identity for the export filename
     except ValueError:
         return 0
 
@@ -249,13 +265,30 @@ def _sanitize(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return out
 
 
+def default_trace_dir() -> str:
+    """Where exports land when ``trace_dir`` is unset: a per-JOB subdir
+    of the system temp dir, keyed by the launcher pid so every rank of
+    one mpirun shares it and tools/trace_merge.py can merge the rank
+    files (the metrics.default_snapshot_dir discipline — two concurrent
+    jobs on one host must not overwrite each other's trace-rank0.json);
+    singletons key by their own pid."""
+    import tempfile
+
+    job = os.environ.get("OMPI_TPU_LAUNCHER_PID") or str(os.getpid())  # mpilint: disable=raw-environ — launcher/job identity (the wireup pdeathsig key), not config
+    return os.path.join(tempfile.gettempdir(), f"ompi-tpu-trace-{job}")
+
+
 def export(path: Optional[str] = None) -> str:
     """Write everything recorded so far as Chrome-trace JSON (the
     "JSON Object Format": traceEvents + metadata); returns the path."""
     rank = _rank()
     if path is None:
-        path = os.path.join(_dir_var._value or ".",
-                            f"trace-rank{rank}.json")
+        base = _dir_var._value or default_trace_dir()
+        try:
+            os.makedirs(base, exist_ok=True)
+        except OSError:
+            base = "."  # unwritable temp dir: last-resort CWD
+        path = os.path.join(base, f"trace-rank{rank}.json")
     events = []
     for tid, (ph, ts, name, cat, args) in _collect():
         ev: Dict[str, Any] = {"name": name, "cat": cat or "default",

@@ -1,0 +1,26 @@
+"""Elastic serving harness: traffic, SLOs, and world-size churn as a
+first-class tested scenario (ROADMAP item 4).
+
+- :mod:`ompi_tpu.serve.slo` — SLO tracking (coordinated-omission
+  corrected latency, violation latch + hysteresis) and per-fault-class
+  recovery-time-objective clocks.
+- :mod:`ompi_tpu.serve.traffic` — deterministic seedable traffic:
+  payload oracle, open/closed-loop pacing, procmode collective steps
+  and mesh-mode inference-shaped steps.
+- :mod:`ompi_tpu.serve.policy` — step-boundary admission/degradation:
+  never tear a collective across a dying membership.
+- :mod:`ompi_tpu.serve.churn` — fault episodes (kill_respawn /
+  kill_shrink / preempt_flush) composed with recovery under load.
+- :mod:`ompi_tpu.serve.harness` — the composed ServingHarness the
+  procmode proof (tests/procmode/check_serving.py) drives.
+"""
+
+from ompi_tpu.serve.slo import RTOClock, SLOTracker  # noqa: F401
+from ompi_tpu.serve.traffic import TrafficGen  # noqa: F401
+from ompi_tpu.serve.policy import AdmissionGate, NeedsRecovery  # noqa: F401
+from ompi_tpu.serve.churn import (  # noqa: F401
+    FAULT_CLASSES,
+    ChurnDriver,
+    Episode,
+)
+from ompi_tpu.serve.harness import ServingHarness  # noqa: F401

@@ -1,0 +1,221 @@
+"""World-size churn driver: fault plans composed with recovery while
+traffic keeps flowing.
+
+Every chaos proof before this module armed ONE fault plan, ran ONE
+recovery, and checked ONE arithmetic identity. The churn driver makes
+the composition a first-class scenario: a sequence of
+:class:`Episode`\\ s, each naming a *fault class*, is injected into a
+live serving stream (``serve/traffic.TrafficGen``), recovered through
+the policy that class prescribes, and timed by the
+``serve/slo.RTOClock`` from the entry of the step the fault tore to
+the first post-recovery step that verified bitwise-correct.
+
+Fault classes (``FAULT_CLASSES``):
+
+- ``kill_respawn`` — the victim dies cold (``kill(rank,after=N)``);
+  recovery is PR 5's respawn-and-rejoin: shrink, rebuild the dead
+  rank's state from survivor memory, spawn a replacement, re-rank back
+  to the original world. Capacity is restored; survivors roll back to
+  the committed diskless epoch.
+- ``kill_shrink`` — the victim dies cold; recovery DEGRADES: shrink to
+  the surviving N-1 and live-reshard the committed epoch onto the
+  shrunk world (PR 6's ``reshard_epoch`` — each survivor serves its
+  own blob plus the replicas it holds for the dead). Capacity drops,
+  traffic keeps flowing.
+- ``preempt_flush`` — the TPU preemption model
+  (``preempt(rank,after=N,grace_ms=M)``): the victim flushes a final
+  blob to its buddy inside the grace window, then exits; respawn
+  recovery sees a final blob for every dead rank and skips the
+  rollback — survivors keep live state, only the newcomer restores.
+
+Episodes are armed from the LIVE communicator: plans name universe
+ranks (``ft/inject`` matches on the pml identity), so the driver
+translates the episode's comm-rank victim through ``group.ranks`` at
+arm time — after a respawn the same comm rank may be a brand-new
+universe rank (and a later episode can preempt the replacement, which
+is exactly the composition this module exists to test).
+
+The driver is deliberately state-agnostic: the application (or the
+:class:`~ompi_tpu.serve.harness.ServingHarness`) passes
+``on_recovered(comm, state_or_None, fault_class)`` and owns what
+"state" means. The driver owns the choreography — arm, classify the
+failure, run the class's recovery, install the recovered comm into the
+admission gate, keep the RTO clock honest.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_OTHER, ERR_INTERN
+from ompi_tpu.ft.recovery import FAILURE_CODES
+from ompi_tpu.mca.var import register_pvar
+from ompi_tpu.runtime import trace as _trace
+from ompi_tpu.serve.policy import AdmissionGate, NeedsRecovery
+from ompi_tpu.serve.slo import RTOClock
+from ompi_tpu.utils.output import get_logger
+
+log = get_logger("serve.churn")
+
+FAULT_CLASSES = ("kill_respawn", "kill_shrink", "preempt_flush")
+
+#: failure codes the serving loop routes into recovery: the ULFM set
+#: plus the dead-transport/lost-frame codes that can surface before
+#: the detector confirms (the check_diskless lesson)
+SERVE_FAILURE_CODES = FAILURE_CODES + (ERR_OTHER, ERR_INTERN)
+
+_ctr: Dict[str, int] = {"episodes": 0, "recoveries": 0}  # mpiracer: relaxed-counter — serving-loop-only bumps; pvar readers tolerate a stale view
+
+register_pvar("serve", "churn_episodes", lambda: _ctr["episodes"],
+              help="Fault episodes armed by the churn driver")
+register_pvar("serve", "churn_recoveries", lambda: _ctr["recoveries"],
+              help="Recoveries the churn driver completed (one per "
+                   "survived episode)")
+
+
+class Episode:
+    """One planned fault: ``fault_class`` (see FAULT_CLASSES),
+    ``victim`` as a COMM rank at arm time, ``after`` pml user ops on
+    the victim before it dies, ``grace_ms`` for preemption notices."""
+
+    __slots__ = ("fault_class", "victim", "after", "grace_ms")
+
+    def __init__(self, fault_class: str, victim: int, after: int,
+                 grace_ms: float = 500.0):
+        if fault_class not in FAULT_CLASSES:
+            raise MPIError(ERR_ARG,
+                           f"unknown fault class {fault_class!r}: "
+                           f"expected one of {FAULT_CLASSES}")
+        self.fault_class = fault_class
+        self.victim = int(victim)
+        self.after = int(after)
+        self.grace_ms = float(grace_ms)
+
+    def plan(self, comm) -> Tuple[str, int]:
+        """The ft_inject_plan string for the LIVE comm (universe-rank
+        translated) and the victim's universe rank."""
+        urank = comm.group.world_rank(self.victim)
+        if self.fault_class == "preempt_flush":
+            return (f"preempt({urank},after={self.after},"
+                    f"grace_ms={self.grace_ms:g})", urank)
+        return f"kill({urank},after={self.after})", urank
+
+
+class ChurnDriver:
+    """Arm/recover choreography for one serving stream (module doc)."""
+
+    def __init__(self, gate: AdmissionGate, rto: Optional[RTOClock]
+                 = None, respawn_command: Optional[str] = None,
+                 respawn_args: Tuple[str, ...] = (),
+                 on_recovered: Optional[Callable] = None):
+        self.gate = gate
+        self.rto = rto if rto is not None else RTOClock()
+        self.respawn_command = respawn_command
+        self.respawn_args = tuple(respawn_args)
+        self.on_recovered = on_recovered
+        self.current: Optional[Episode] = None
+        self.history: List[Tuple[str, float]] = []  # (class, rto_us)
+
+    # ------------------------------------------------------------ arming
+    def arm(self, episode: Episode, seed: int = 0) -> int:
+        """Install the episode's fault plan (every rank calls this at
+        the same step boundary — the plan only fires on the victim, but
+        arming is collective-symmetric so the episode schedule is
+        deterministic). Returns the victim's universe rank."""
+        from ompi_tpu.ft import inject
+
+        plan, urank = episode.plan(self.gate.comm)
+        inject.install(plan, seed)
+        self.current = episode
+        _ctr["episodes"] += 1
+        if _trace.enabled():
+            _trace.instant("serve.churn.arm", cat="serve",
+                           fault_class=episode.fault_class,
+                           victim=urank, after=episode.after)
+        log.warning("churn: armed %s (victim comm rank %d = universe "
+                    "%d, after=%d ops)", episode.fault_class,
+                    episode.victim, urank, episode.after)
+        return urank
+
+    def disarm(self) -> None:
+        from ompi_tpu.ft import inject
+
+        inject.install("")
+        self.current = None
+
+    # ---------------------------------------------------------- recovery
+    def is_failure(self, exc: BaseException) -> bool:
+        return (isinstance(exc, NeedsRecovery)
+                or (isinstance(exc, MPIError)
+                    and exc.code in SERVE_FAILURE_CODES))
+
+    def handle_failure(self, step: int, exc: BaseException,
+                       t_fail_ns: Optional[int] = None) -> None:
+        """The TrafficGen ``on_error`` seam: classify, start the RTO
+        clock (anchored at ``t_fail_ns`` — the torn step's issue
+        instant), run the armed episode's recovery, install the
+        recovered comm. Re-raises anything that is not a survivable
+        peer failure."""
+        if not self.is_failure(exc):
+            raise exc
+        ep = self.current
+        fault_class = ep.fault_class if ep is not None else "unplanned"
+        self.rto.start(fault_class, t_ns=t_fail_ns)
+        log.warning("churn: step %d tore (%s) — recovering as %s",
+                    step, exc, fault_class)
+        newcomm, state = self._recover(fault_class)
+        self.gate.install(newcomm)
+        _ctr["recoveries"] += 1
+        if self.on_recovered is not None:
+            self.on_recovered(newcomm, state, fault_class)
+
+    def _recover(self, fault_class: str):
+        from ompi_tpu.ft.recovery import recover
+        from ompi_tpu.serve.policy import degrade_mode
+
+        comm = self.gate.comm
+        if fault_class == "unplanned" and degrade_mode() == "degrade":
+            # no armed episode names a recovery: the operator's
+            # serve_degrade_mode decides — 'degrade' sheds capacity
+            # (shrink + reshard, latency recovers first), 'queue'
+            # (default) falls through to the capacity-restoring respawn
+            fault_class = "kill_shrink"
+        if fault_class == "kill_shrink":
+            # degrade: shrink to the survivors, then live-reshard the
+            # committed diskless epoch onto the shrunk world
+            n_old = comm.Get_size()
+            my_old = comm.Get_rank()
+            shrunk, _ = recover(comm, policy="shrink")
+            from ompi_tpu.reshard.elastic import reshard_epoch
+
+            state, epoch = reshard_epoch(shrunk, my_old, n_old,
+                                         replicated=("step", "acc"))
+            log.warning("churn: degraded %d -> %d ranks, epoch %d "
+                        "resharded", n_old, shrunk.Get_size(), epoch)
+            return shrunk, state
+        # kill_respawn / preempt_flush / unplanned: restore capacity
+        newcomm, state = recover(comm, policy="respawn",
+                                 command=self.respawn_command,
+                                 args=self.respawn_args or None)
+        return newcomm, state
+
+    # ------------------------------------------------------ step verdicts
+    def note_correct_step(self, step: int) -> Optional[float]:
+        """Called after every step that completed AND verified bitwise
+        correct: closes any running RTO clock (this is the recovery
+        endpoint the objective is defined against). Returns the
+        measured RTO in microseconds when a clock closed."""
+        ep_class = None
+        for fc in FAULT_CLASSES + ("unplanned",):
+            if self.rto.running(fc):
+                ep_class = fc
+                break
+        if ep_class is None:
+            return None
+        rto_us = self.rto.stop(ep_class)
+        if rto_us is not None:
+            self.history.append((ep_class, rto_us))
+            log.warning("churn: %s recovered — RTO %.0fus (first "
+                        "bitwise-correct step %d)", ep_class, rto_us,
+                        step)
+        return rto_us

@@ -1,0 +1,261 @@
+"""The composed elastic serving harness (ROADMAP item 4).
+
+:class:`ServingHarness` glues the four serving pieces into the
+millions-of-users story the procmode proof drives:
+
+- **state** — a row-sharded "model": each rank owns a contiguous block
+  of global rows, ``shard[j, c] = gid*1000 + c`` at init (the embedded
+  global row id makes a misrouted reshard visible), plus replicated
+  ``step``/``acc`` audit scalars. Every applied step adds the step's
+  verified wire total to every element, so the final state is a
+  closed-form function of (layout, applied steps) — bitwise, because
+  every addend is an integer-valued float.
+- **traffic** — ``serve/traffic.TrafficGen`` paces arrivals
+  (open-loop by default); each arrival serves ONE state step: an
+  ``Allreduce`` of the seeded contribution verified bitwise against
+  the closed form for the live membership. After a rollback the state
+  step counter rewinds and later arrivals REPLAY the lost steps —
+  the arrival counter and the model version are distinct, exactly as
+  in a real serving system.
+- **SLO/RTO** — ``serve/slo``: per-arrival latency (measured from the
+  intended arrival tick, coordinated-omission corrected) with
+  violation latching; an RTO clock per fault class anchored at the
+  torn step's issue instant and stopped by the first post-recovery
+  step that verified bitwise-correct.
+- **churn + admission** — ``serve/churn.ChurnDriver`` arms fault
+  episodes and runs each class's recovery;
+  ``serve/policy.AdmissionGate`` refuses to tear collectives across a
+  membership already known dying and holds arrivals for the recovery
+  window.
+
+Durability rides PR 5's diskless plane: the harness commits an
+in-memory epoch after every applied step (``serve_save_epochs``) and
+registers the live state for preemption final-flush, so kill episodes
+roll back at most one step and preempt episodes lose nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ompi_tpu.core.errors import MPIError, ERR_ARG
+from ompi_tpu.mca.var import register_var
+from ompi_tpu.serve import slo as _slo
+from ompi_tpu.serve import traffic as _traffic
+from ompi_tpu.serve.churn import ChurnDriver, Episode
+from ompi_tpu.serve.policy import AdmissionGate
+from ompi_tpu.utils.output import get_logger
+
+log = get_logger("serve.harness")
+
+_save_var = register_var(
+    "serve", "save_epochs", True,
+    help="Commit a diskless in-memory epoch after every applied "
+         "serving step (the durability floor kill episodes roll back "
+         "to); preemption final-flush is registered either way",
+    level=5)
+_count_var = register_var(
+    "serve", "step_count", 512,
+    help="Elements in each serving step's contribution vector (512 "
+         "f64 = one 4KB allreduce, the latency-class payload the QoS "
+         "A/B established)", level=6)
+
+
+class ServingHarness:
+    """One rank's serving stream (see module doc). ``state=None``
+    builds the initial shard for this rank; a respawned newcomer
+    passes the state ``rejoin()`` delivered instead."""
+
+    def __init__(self, comm, rows_per_rank: int = 4, cols: int = 8,
+                 seed: Optional[int] = None,
+                 state: Optional[Dict[str, np.ndarray]] = None,
+                 respawn_command: Optional[str] = None,
+                 respawn_args: Tuple[str, ...] = (),
+                 save_epochs: Optional[bool] = None,
+                 tracker: Optional[_slo.SLOTracker] = None):
+        from ompi_tpu.ft import diskless as _dk
+
+        self.seed = _slo.seed() if seed is None else int(seed)
+        self.count = int(_count_var._value)
+        # epoch commits need the diskless plane armed (ft_ckpt_enable):
+        # with it off, save() is a documented no-op returning False and
+        # the harness serves without a rollback floor (steady/bench
+        # streams run this way)
+        self.save_epochs = (bool(_save_var._value)
+                            if save_epochs is None else bool(save_epochs)) \
+            and bool(_dk._enable_var._value)
+        self.cols = cols
+        if state is None:
+            r, n = comm.Get_rank(), comm.Get_size()
+            gid0 = r * rows_per_rank
+            base = (np.arange(gid0, gid0 + rows_per_rank,
+                              dtype=np.float64)[:, None] * 1000.0
+                    + np.arange(cols, dtype=np.float64)[None, :])
+            state = {"shard": base,
+                     "step": np.zeros(1, np.int64),
+                     "acc": np.zeros(1, np.float64)}
+        self.state = state
+        self.tracker = tracker if tracker is not None \
+            else _slo.SLOTracker()
+        self.gate = AdmissionGate(comm)
+        self.churn = ChurnDriver(
+            self.gate, respawn_command=respawn_command,
+            respawn_args=respawn_args,
+            on_recovered=self._on_recovered)
+        self.gen = _traffic.TrafficGen(self.tracker, seed=self.seed)
+        self._out = np.zeros(self.count, np.float64)
+        self._attach(comm)
+
+    # ----------------------------------------------------------- plumbing
+    def _attach(self, comm) -> None:
+        """Bind the diskless plane to the live comm: replication
+        handler, preemption final-flush provider, and (fresh streams)
+        the baseline epoch every rollback floor rests on."""
+        from ompi_tpu.ft import diskless
+
+        diskless.attach(comm)
+        diskless.set_state_provider(comm, lambda: self.state)
+
+    def commit_baseline(self) -> None:
+        """Commit epoch 0 of the CURRENT state (collective). Fresh
+        streams call this once before serving; a rejoined newcomer
+        must not — its epoch clock is already aligned."""
+        from ompi_tpu.ft import diskless
+
+        if self.save_epochs and not diskless.save(self.gate.comm,
+                                                  self.state):
+            raise MPIError(ERR_ARG,
+                           "serving baseline epoch did not commit")
+
+    def state_step(self) -> int:
+        return int(self.state["step"][0])
+
+    def new_stream(self, **labels) -> _slo.SLOTracker:
+        """Swap in a fresh SLO tracker + pacing stream. Measurement
+        discipline: wireup/warmup stalls are one-time costs a steady-
+        state SLO claim must not count (and under coordinated-omission
+        correction ONE 500ms warmup stall backfills ~100 synthetic
+        samples — it would dominate a short run's distribution), so
+        benches serve a warmup phase, then cut over."""
+        self.tracker = _slo.SLOTracker(**labels)
+        self.gen = _traffic.TrafficGen(self.tracker, seed=self.seed)
+        return self.tracker
+
+    def _on_recovered(self, comm, state, fault_class: str) -> None:
+        """ChurnDriver seam: adopt the recovered comm/state. ``state``
+        is None on the preemption final-flush path (live state keeps
+        flowing) — which can leave survivors ONE step apart (recovery's
+        documented skew: a symmetric collective can complete on a
+        strict subset before the victim's death tears it on the rest),
+        so the live-state path reconciles forward before serving
+        resumes."""
+        if state is not None:
+            self.state = state
+        self._attach(comm)
+        if state is None:
+            self.reconcile_live(comm)
+        log.warning("serving: recovered (%s) at state step %d on %d "
+                    "ranks", fault_class, self.state_step(),
+                    comm.Get_size())
+
+    def reconcile_live(self, comm=None) -> int:
+        """Post-recovery step-skew reconcile for live-state (final-
+        flush) recoveries: agree on the MAX applied step, and ranks
+        behind replay the missing steps from the traffic oracle — the
+        completed step summed every pre-death member's contribution,
+        and respawn restored that membership, so ``step_sum(seed, i,
+        comm.size)`` is bit-identical to the wire total the ahead rank
+        applied. Collective; the respawned newcomer runs it too (its
+        flushed state may be the ahead or the behind copy) — rejoin
+        callers invoke it directly when ``meta['kind'] == 'final'``.
+        Returns the number of steps replayed locally."""
+        comm = self.gate.comm if comm is None else comm
+        from ompi_tpu.core import op as _op
+
+        mine = np.array([self.state_step()], np.int64)
+        top = np.zeros(1, np.int64)
+        comm.Allreduce(mine, top, op=_op.MAX)
+        filled = 0
+        while self.state_step() < int(top[0]):
+            s = _traffic.step_sum(self.seed, self.state_step(),
+                                  comm.Get_size())
+            self.state = {"shard": self.state["shard"] + s,
+                          "step": self.state["step"] + 1,
+                          "acc": self.state["acc"] + s}
+            filled += 1
+        if filled:
+            log.warning("serving: forward-reconciled %d skewed "
+                        "step(s) to %d", filled, self.state_step())
+        return filled
+
+    # ---------------------------------------------------------- the steps
+    def _serve_one(self, arrival: int) -> None:
+        comm = self.gate.admit()
+        i = self.state_step()
+        out = _traffic.coll_step(comm, self.seed, i, self.count,
+                                 out=self._out)
+        s = float(out[0])  # the verified WIRE value, not the oracle
+        self.state = {"shard": self.state["shard"] + s,
+                      "step": self.state["step"] + 1,
+                      "acc": self.state["acc"] + s}
+        if self.save_epochs:
+            from ompi_tpu.ft import diskless
+
+            diskless.save(comm, self.state)
+        self.churn.note_correct_step(i)
+
+    def _on_error(self, arrival: int, exc: BaseException) -> None:
+        self.churn.handle_failure(arrival, exc,
+                                  t_fail_ns=self.gen.last_issue_ns)
+
+    def serve_until(self, target_step: int) -> None:
+        """Serve arrivals until the state reaches ``target_step``
+        applied steps — rollbacks consume extra arrivals (the replay
+        traffic), exactly like production retries."""
+        while self.state_step() < target_step:
+            self.gen.run(target_step - self.state_step(),
+                         self._serve_one, on_error=self._on_error,
+                         start_step=self.gen.steps_done)
+
+    def run_episode(self, episode: Episode, steps_after: int,
+                    seed: Optional[int] = None) -> None:
+        """Arm one fault episode, then serve until ``steps_after``
+        MORE steps are applied beyond the current state step — the
+        fault fires mid-stream, recovery runs inline, and the serving
+        target guarantees enough post-recovery steps to close the RTO
+        clock."""
+        self.churn.arm(episode, self.seed if seed is None else seed)
+        try:
+            self.serve_until(self.state_step() + steps_after)
+        finally:
+            self.churn.disarm()
+
+    # ------------------------------------------------------------- audits
+    def verify_state(self) -> None:
+        """The exactness audit (collective): every rank's shard must
+        equal the closed form — row-id base plus the replicated
+        ``acc`` every verified step accumulated — for the FINAL
+        layout. Row ownership is derived from an allgather of row
+        counts, so a mis-resharded row (wrong gid base) or a torn
+        step (wrong acc) fails bitwise."""
+        comm = self.gate.comm
+        rows = int(self.state["shard"].shape[0])
+        counts = np.zeros(comm.Get_size(), np.int64)
+        comm.Allgather(np.array([rows], np.int64), counts)
+        gid0 = int(counts[:comm.Get_rank()].sum())
+        acc = float(self.state["acc"][0])
+        want = ((np.arange(gid0, gid0 + rows,
+                           dtype=np.float64)[:, None] * 1000.0
+                 + np.arange(self.cols, dtype=np.float64)[None, :])
+                + acc)
+        if not np.array_equal(self.state["shard"], want):
+            raise AssertionError(
+                f"serving state diverged on rank {comm.Get_rank()}: "
+                f"shard[0] {self.state['shard'][0][:3]} vs "
+                f"{want[0][:3]} (rows {gid0}..{gid0 + rows - 1}, "
+                f"acc {acc})")
+
+    def rto_report(self) -> List[Tuple[str, float]]:
+        return list(self.churn.history)

@@ -1,0 +1,165 @@
+"""Admission and degradation policy at the serving step boundary.
+
+The rule this module enforces: **never tear a collective across a
+dying membership**. Entering an allreduce whose peer is already known
+dead buys nothing but a watchdog/heartbeat conversion timeout — the
+step pays seconds of failure-detection latency that the step boundary
+could have paid in microseconds. So every step passes through
+:meth:`AdmissionGate.admit` first:
+
+- while a **recovery window** is open (``ft/recovery`` publishes it —
+  any rank of this process is inside ``recover()``), admission blocks
+  with bounded exponential backoff until the window closes, then
+  returns the recovered communicator the window installed. Steps that
+  arrive meanwhile are the *queued* steps — their latency keeps
+  accruing against their open-loop arrival tick, which is exactly what
+  the SLO tracker should see (admission control does not launder
+  queueing delay out of the user's wait).
+- when the communicator's membership intersects the failure oracle
+  (``ft/detector.known_failed``) or the comm is revoked, admission
+  raises :class:`NeedsRecovery` — the churn driver's cue to run
+  recovery NOW instead of issuing one more doomed collective.
+- otherwise the step is admitted unchanged.
+
+Degradation (``serve_degrade_mode``) is the recovery policy for
+UNPLANNED failures — a step that tears with no armed churn episode
+naming its class: ``queue`` runs the capacity-restoring respawn (steps
+hold at the gate until the original world is back), ``degrade`` runs
+shrink + live-reshard (capacity drops, latency recovers first).
+Planned episodes carry their own fault class and ignore the knob. The
+gate itself is policy-free about which recovery ran — it re-reads the
+comm the window installed.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from ompi_tpu.core.errors import MPIError, ERR_PENDING, ERR_PROC_FAILED
+from ompi_tpu.mca.var import register_var, register_pvar
+
+_mode_var = register_var(
+    "serve", "degrade_mode", "queue", typ=str,
+    help="Recovery policy for UNPLANNED failures (no armed churn "
+         "episode names one): 'queue' = capacity-restoring respawn — "
+         "steps hold at the admission gate until the original world is "
+         "back; 'degrade' = shrink to the survivors and live-reshard "
+         "the committed epoch — capacity drops, latency recovers "
+         "first. Planned episodes carry their own fault class and "
+         "ignore this knob", level=5)
+_backoff_var = register_var(
+    "serve", "admission_backoff_ms", 2.0, float,
+    help="Initial backoff while a step waits out a recovery window at "
+         "the admission gate (doubles per retry, capped at 64x)",
+    level=6)
+_max_wait_var = register_var(
+    "serve", "admission_max_wait_ms", 60000.0, float,
+    help="Bound on one step's wait at the admission gate: a recovery "
+         "window still open past this raises ERR_PROC_FAILED instead "
+         "of queueing forever (the serving layer's hang budget)",
+    level=6)
+
+_ctr: Dict[str, int] = {"queued": 0, "degraded": 0, "refused": 0}  # mpiracer: relaxed-counter — serving-loop-only bumps; pvar readers tolerate a stale view
+
+register_pvar("serve", "queued_steps", lambda: _ctr["queued"],
+              help="Steps that waited out a recovery window at the "
+                   "admission gate before running")
+register_pvar("serve", "degraded_steps", lambda: _ctr["degraded"],
+              help="Steps admitted onto a SHRUNK world (degrade mode: "
+                   "capacity dropped, traffic kept flowing)")
+register_pvar("serve", "admission_refusals", lambda: _ctr["refused"],
+              help="Steps refused at the admission gate because the "
+                   "membership was already known dying (NeedsRecovery "
+                   "raised instead of tearing a collective)")
+
+
+class NeedsRecovery(MPIError):
+    """Admission verdict: the communicator's membership is dying — run
+    recovery before issuing another collective. Carries the failed
+    ranks the oracle knew about."""
+
+    def __init__(self, dead, detail: str):
+        super().__init__(ERR_PROC_FAILED,
+                         f"admission refused: {detail}")
+        self.dead = sorted(dead)
+
+
+class AdmissionGate:
+    """Step-boundary admission control for one serving stream (see the
+    module doc). The gate tracks the LIVE communicator: recovery seams
+    call :meth:`install` with the comm that recovery produced, and
+    every admit returns the current one."""
+
+    def __init__(self, comm, degraded_size: Optional[int] = None):
+        self.comm = comm
+        #: the capacity the stream considers "full" — admits below it
+        #: count as degraded steps
+        self.full_size = comm.Get_size() if degraded_size is None \
+            else int(degraded_size)
+
+    def install(self, comm) -> None:
+        """Recovery seam: swap in the communicator recovery produced
+        (shrunk, respawned, or re-ranked)."""
+        self.comm = comm
+
+    def dying_members(self):
+        from ompi_tpu.ft.detector import known_failed
+
+        failed = known_failed()
+        return [r for r in self.comm.group.ranks if r in failed]
+
+    def admit(self, wait: Optional[Callable[[], None]] = None):
+        """Admit one step: returns the live communicator to run it on.
+        Blocks (bounded backoff) while a recovery window is open;
+        raises :class:`NeedsRecovery` when the membership is dying and
+        no recovery has started yet. ``wait`` (test seam) replaces the
+        backoff sleep."""
+        from ompi_tpu.ft import recovery as _recovery
+
+        waited = False
+        backoff_s = float(_backoff_var._value) / 1000.0
+        deadline = time.monotonic() + \
+            float(_max_wait_var._value) / 1000.0
+        while _recovery.recovering():
+            waited = True
+            if time.monotonic() > deadline:
+                # ERR_PENDING, deliberately NOT a survivable failure
+                # code: the window being stuck open means a recover()
+                # is already in flight on this process — classifying
+                # this as a peer failure would send the churn driver
+                # into a SECOND concurrent recovery on the same comm.
+                # Fail fast instead; only the operator can unstick a
+                # recovery that blew the hang budget.
+                raise MPIError(
+                    ERR_PENDING,
+                    "admission gate: recovery window still open past "
+                    f"serve_admission_max_wait_ms "
+                    f"({float(_max_wait_var._value):.0f}ms)")
+            if wait is not None:
+                wait()
+            else:
+                time.sleep(backoff_s)
+            backoff_s = min(backoff_s * 2.0,
+                            float(_backoff_var._value) / 1000.0 * 64.0)
+        if waited:
+            _ctr["queued"] += 1
+        comm = self.comm
+        dead = self.dying_members()
+        if dead or comm.revoked:
+            _ctr["refused"] += 1
+            raise NeedsRecovery(
+                dead, f"{len(dead)} member(s) of {comm.name} known "
+                      f"failed ({dead}), revoked={comm.revoked}")
+        if comm.Get_size() < self.full_size:
+            _ctr["degraded"] += 1
+        return comm
+
+
+def degrade_mode() -> str:
+    return str(_mode_var._value)
+
+
+def reset_for_testing() -> None:
+    for k in _ctr:
+        _ctr[k] = 0

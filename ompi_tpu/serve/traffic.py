@@ -1,0 +1,212 @@
+"""Deterministic, seedable serving traffic.
+
+The generator side of the elastic serving harness: step *payloads* are
+a pure function of ``(seed, step index, member rank)`` — integer-valued
+floats, so collective SUMs are exact in f64 regardless of reduction
+order and every step has a closed-form expected result any rank can
+compute for the CURRENT membership. That closed form is the harness's
+correctness oracle: a step is *bitwise-correct* when its collective
+output equals the expectation exactly (``np.array_equal``), which is
+also what arms/stops the RTO clock and what the final state audit
+rests on.
+
+Pacing (:class:`TrafficGen`):
+
+- **open-loop** (``serve_period_us`` > 0) — arrivals are scheduled on
+  a fixed cadence regardless of completion times (the production
+  model: users do not stop clicking because the service stalled).
+  Latency is measured from the *intended* arrival tick, so time a step
+  spent queued behind a stall counts against it, and the SLOTracker's
+  coordinated-omission backfill covers the arrivals a stall swallowed.
+  After a stall the due clock re-anchors (no compensating burst —
+  the same rule check_qos.py established).
+- **closed-loop** (``serve_period_us`` = 0) — issue-as-fast-as-served,
+  latency measured from issue; no backfill.
+
+Two step shapes ship with the harness:
+
+- :func:`coll_step` — the procmode serving step: an ``Allreduce`` of a
+  seeded contribution vector over the live communicator, verified
+  against :func:`expected_total`. This is the step the churn driver
+  tears and recovers.
+- :func:`make_mesh_step` — the mesh-mode inference-shaped step: a
+  tensor-parallel matmul whose partial products are combined by the
+  mesh allreduce (the pjit partition-rule pattern real serving code
+  runs), on an :class:`~ompi_tpu.parallel.mesh.XlaComm`. Single
+  controller — no churn, but the same SLO plumbing.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ompi_tpu.mca.var import register_pvar
+from ompi_tpu.serve import slo as _slo
+
+_ctr: Dict[str, int] = {"steps": 0, "errors": 0}  # mpiracer: relaxed-counter — serving-loop-only bumps; pvar readers tolerate a stale view
+
+register_pvar("serve", "steps", lambda: _ctr["steps"],
+              help="Serving steps completed (verified or not) by "
+                   "TrafficGen loops on this rank")
+register_pvar("serve", "step_errors", lambda: _ctr["errors"],
+              help="Serving steps that raised (torn collectives "
+                   "routed into recovery by the churn driver)")
+
+
+# ------------------------------------------------------------- payloads
+def contribution(seed: int, step: int, member: int) -> float:
+    """One member's scalar contribution to one step: a small positive
+    integer, pure in (seed, step, member) — same everywhere, every
+    run."""
+    return float((seed * 31 + step * 7 + (member + 1) * 13) % 97 + 1)
+
+
+def step_input(seed: int, step: int, rank: int,
+               count: int) -> np.ndarray:
+    """This rank's contribution vector: ``contribution + element
+    index``. The element ramp makes a misrouted or misaligned buffer
+    visible (a constant vector would hide it)."""
+    return contribution(seed, step, rank) + np.arange(count,
+                                                      dtype=np.float64)
+
+
+def expected_total(seed: int, step: int, nmembers: int,
+                   count: int) -> np.ndarray:
+    """Closed-form Allreduce(SUM) of :func:`step_input` over comm ranks
+    ``0..nmembers-1`` — exact in f64 (integer-valued addends), so the
+    comparison is bitwise, not approximate."""
+    s = sum(contribution(seed, step, m) for m in range(nmembers))
+    return s + nmembers * np.arange(count, dtype=np.float64)
+
+
+def step_sum(seed: int, step: int, nmembers: int) -> float:
+    """The scalar every member folds into its state shard when a step
+    is applied (``expected_total[0]``)."""
+    return float(sum(contribution(seed, step, m)
+                     for m in range(nmembers)))
+
+
+def coll_step(comm, seed: int, step: int, count: int = 512,
+              out: Optional[np.ndarray] = None) -> np.ndarray:
+    """One procmode serving step: Allreduce the seeded contribution and
+    verify bitwise against the closed form for the LIVE membership.
+    Raises AssertionError on mismatch (a wrong-but-completed collective
+    must never read as recovered)."""
+    x = step_input(seed, step, comm.Get_rank(), count)
+    if out is None:
+        out = np.zeros(count, np.float64)
+    comm.Allreduce(x, out)
+    want = expected_total(seed, step, comm.Get_size(), count)
+    if not np.array_equal(out, want):
+        raise AssertionError(
+            f"serving step {step} corrupt on rank {comm.Get_rank()}: "
+            f"got {out[:3]}... want {want[:3]}...")
+    return out
+
+
+def make_mesh_step(world, hidden: int = 64) -> Callable[[int, int], Any]:
+    """Mesh-mode inference-shaped step factory: ``y = sum_over_mesh(x_r
+    @ W)`` — each mesh position holds one row-block of the activation,
+    the matmul partials combine through the mesh allreduce (the
+    tensor-parallel partition rule). Weights are integer-valued so the
+    result is exact; returns ``step_fn(seed, step) -> np.ndarray``
+    that verifies against the closed form and raises on mismatch."""
+    W = world.world_size
+    wmat = (np.arange(hidden, dtype=np.float64).reshape(1, hidden)
+            % 7 + 1.0)
+
+    def step_fn(seed: int, step: int) -> np.ndarray:
+        rows = np.stack([
+            np.full(1, contribution(seed, step, r)) for r in range(W)])
+        partial = world.shard(rows.astype(np.float64)) @ wmat
+        # (W, hidden): every mesh row holds the same reduced activation
+        total = np.asarray(world.allreduce(partial))
+        want = step_sum(seed, step, W) * wmat[0]
+        if not np.array_equal(total[0], want):
+            raise AssertionError(
+                f"mesh serving step {step} corrupt: {total[0][:3]} "
+                f"vs {want[:3]}")
+        return total[0]
+
+    return step_fn
+
+
+# ------------------------------------------------------------ the loop
+class TrafficGen:
+    """Paced serving loop driving ``step_fn(step_index)`` under an
+    :class:`~ompi_tpu.serve.slo.SLOTracker` (see module doc for the
+    open/closed-loop semantics). ``on_error`` is the churn seam: when
+    ``step_fn`` raises, the handler gets ``(step_index, exc)`` and
+    either returns (the step is retried — recovery swapped the comm
+    underneath) or re-raises. A handler that keeps failing is bounded
+    by ``max_retries_per_step``."""
+
+    def __init__(self, tracker: _slo.SLOTracker,
+                 seed: Optional[int] = None,
+                 period_us: Optional[float] = None,
+                 max_retries_per_step: int = 4):
+        self.tracker = tracker
+        self.seed = _slo.seed() if seed is None else int(seed)
+        self.period_us = _slo.period_us() if period_us is None \
+            else float(period_us)
+        self.max_retries = int(max_retries_per_step)
+        self.steps_done = 0
+        #: monotonic_ns issue instant of the most recent attempt — the
+        #: RTO clock's anchor for the step a fault tears
+        self.last_issue_ns = 0
+
+    def run(self, nsteps: int, step_fn: Callable[[int], Any],
+            on_error: Optional[Callable[[int, BaseException], None]]
+            = None, start_step: int = 0) -> int:
+        """Serve ``nsteps`` steps (``start_step`` onward); returns the
+        next step index. Latency per step is measured from the
+        intended arrival tick (open-loop) or the issue instant
+        (closed-loop) and fed through the tracker."""
+        period_s = self.period_us / 1e6
+        due = time.perf_counter()
+        step = start_step
+        end = start_step + nsteps
+        while step < end:
+            if period_s > 0:
+                due += period_s
+                now = time.perf_counter()
+                if now < due:
+                    time.sleep(due - now)
+                else:
+                    due = now  # re-anchor after a stall, never burst
+            t_issue = time.perf_counter()
+            # open-loop latency anchors at the DUE tick (<= t_issue):
+            # queueing delay behind a stall is the user's wait too
+            t_anchor = min(due, t_issue) if period_s > 0 else t_issue
+            retries = 0
+            while True:
+                self.last_issue_ns = time.monotonic_ns()
+                try:
+                    step_fn(step)
+                    break
+                # Exception, not BaseException: KeyboardInterrupt /
+                # SystemExit must propagate immediately, never count a
+                # step error or reach an on_error handler that might
+                # swallow them
+                except Exception as e:
+                    _ctr["errors"] += 1
+                    if on_error is None:
+                        raise
+                    retries += 1
+                    if retries > self.max_retries:
+                        raise
+                    on_error(step, e)  # recovery seam; may re-raise
+            self.tracker.observe(
+                (time.perf_counter() - t_anchor) * 1e6)
+            self.steps_done += 1
+            _ctr["steps"] += 1
+            step += 1
+        return step
+
+
+def reset_for_testing() -> None:
+    for k in _ctr:
+        _ctr[k] = 0

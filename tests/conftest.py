@@ -36,6 +36,13 @@ os.environ.setdefault(
     "OMPI_TPU_MCA_metrics_dir",
     tempfile.mkdtemp(prefix="ompi-tpu-test-metrics-"))
 
+# Trace exports likewise (the check_crash procmode proof used to drop
+# trace-rank0.json into the launch CWD — the repo root): tests that
+# enable tracing write to a throwaway dir unless they choose one.
+os.environ.setdefault(
+    "OMPI_TPU_MCA_trace_dir",
+    tempfile.mkdtemp(prefix="ompi-tpu-test-trace-"))
+
 # Persistent compile cache: the suite's wall time is dominated by XLA
 # CPU compiles of the big shard_map programs (train step, multislice);
 # repeat runs (CI retries, the judge's second pass, local dev) hit the

@@ -61,6 +61,29 @@ ITERS = 6
 SELF = __file__
 
 
+def _reconcile_final(comm, holder, step: int) -> int:
+    """Final-flush recoveries keep live state, and recovery.py
+    documents the consequence: a symmetric collective CAN complete on
+    a strict subset of survivors before the victim's death tears it on
+    the rest, leaving members one step apart. Un-reconciled, the rank
+    ahead reaches the final Barrier while the others wait out its
+    Allreduce contribution — the preempt soak-seed deadlock (seeds 6
+    and 18: the recv-side delay rule widens the subset-completion
+    race). Reconcile FORWARD: agree on the max applied step and replay
+    the missing steps from the closed form — a completed step at the
+    full world summed every contribution (1+2+3), so the fill is
+    bit-identical to the wire total the ahead rank applied.
+    Collective over the post-recovery comm (newcomer included)."""
+    mine = np.array([step], np.int64)
+    top = np.zeros(1, np.int64)
+    comm.Allreduce(mine, top, op=ompi_tpu.MAX)
+    while step < int(top[0]):
+        holder["state"] = {"x": holder["state"]["x"] + 6.0,
+                           "step": np.array([step + 1], np.int64)}
+        step += 1
+    return step
+
+
 def _step_loop(variant: str) -> int:
     """The shared proof body: accumulate ITERS allreduce steps with a
     mid-run death + respawn recovery; verify exactness."""
@@ -72,6 +95,12 @@ def _step_loop(variant: str) -> int:
         assert me == 1, f"newcomer must take the dead rank's rank, got {me}"
         assert state is not None, "newcomer received no state"
         step = int(state["step"][0])
+        if meta.get("kind") == "final":
+            # join the survivors' skew reconcile (below) — the flushed
+            # state may be the ahead or the behind copy
+            holder = {"state": state}
+            step = _reconcile_final(comm, holder, step)
+            state = holder["state"]
     else:
         comm = get_world()
         me = comm.Get_rank()
@@ -113,6 +142,10 @@ def _step_loop(variant: str) -> int:
                 raise AssertionError(
                     "epoch-mode survivor got no rollback state")
             step = int(holder["state"]["step"][0])
+            if restored is None:
+                # final-flush path: survivors keep live state — close
+                # the documented one-step skew before serving resumes
+                step = _reconcile_final(comm, holder, step)
             contrib = np.full(4, float(me + 1))
             if not save_every_step:
                 diskless.set_state_provider(comm,

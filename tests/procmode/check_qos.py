@@ -29,12 +29,14 @@ Default mode (3 ranks):
   (phase-tagged rounds riding BULK/plane-1) stays bitwise-equal with
   shaping on AND chaos delay/dup armed.
 
-``sever`` mode (2 ranks, ``pml_peer_timeout`` armed, shaping on): a
-BULK rendezvous and a segmented blob ship are severed mid-stream; the
-sender's Wait raises, the receiver's matched recv converts through the
-pml_peer_timeout watchdog with ERR_PROC_FAILED instead of hanging, and
-the receiver's partial blob reassembly is purged by the peer-failure
-sweep.
+``sever`` mode (2 ranks, ``pml_peer_timeout`` armed, shaping on): the
+sever-during-recovery regression — a respawn-state-delivery rendezvous
+(RESPAWN_STATE_TAG, BULK via the qos_tag_map recovery-plane defaults,
+no explicit override) and a segmented blob ship are severed
+mid-stream; the sender's Wait raises, the receiver's matched recv
+converts through the pml_peer_timeout watchdog with ERR_PROC_FAILED
+instead of hanging, and the receiver's partial blob reassembly is
+purged by the peer-failure sweep.
 """
 
 import sys
@@ -45,10 +47,11 @@ import numpy as np
 
 import ompi_tpu
 import ompi_tpu.coll.persist  # noqa: F401  registers the cvars/pvars
-from ompi_tpu import COMM_WORLD, qos
+from ompi_tpu import COMM_WORLD, qos  # noqa: F401  (qos: class consts)
 from ompi_tpu.core.datatype import BYTE
 from ompi_tpu.core.errors import MPIError
 from ompi_tpu.ft import diskless
+from ompi_tpu.ft.recovery import RESPAWN_STATE_TAG
 from ompi_tpu.mca.var import all_pvars, set_var
 from ompi_tpu.runtime import metrics
 
@@ -296,7 +299,7 @@ def main_sever() -> None:
     if r == 1:
         buf = np.zeros(NB, np.uint8)
         rreq = comm.pml.irecv(buf, NB, BYTE, comm.group.world_rank(0),
-                              5, comm.cid)
+                              RESPAWN_STATE_TAG, comm.cid)
         comm.Barrier()  # recv posted
         try:
             rreq.Wait()
@@ -319,8 +322,14 @@ def main_sever() -> None:
         # pace the DATA stream (send-side chaos delay) so "mid-stream"
         # is a wide deterministic window for the sever to land in
         inject.install("delay(0,1,ms=5)")
+        # the RESPAWN_STATE_TAG rendezvous classifies BULK from the
+        # qos_tag_map default (no explicit qos= override) — the sever
+        # lands mid recovery-state-delivery, the exact storm the
+        # recovery planes were demoted for
         sreq = comm.pml.isend(data, NB, BYTE, comm.group.world_rank(1),
-                              5, comm.cid, qos=qos.BULK)
+                              RESPAWN_STATE_TAG, comm.cid)
+        assert all_pvars()["qos_stamped_bulk"].value > 0, \
+            "respawn-state rendezvous was not map-classified BULK"
         # a segmented system blob rides along on the same doomed link
         # (own thread: its paced segments must be mid-flight when the
         # sever lands so the receiver is left holding a PARTIAL)

@@ -315,6 +315,113 @@ def test_mpilint_enforces_guard_on_inject_hooks():
     assert not lint_source(good, "ompi_tpu/pml/ob1.py")
 
 
+# ---------------------------------------------------------- revoke drain
+def _posted(pml, src, tag, cid):
+    import numpy as np
+
+    from ompi_tpu.core.datatype import INT64
+
+    return pml.irecv(np.zeros(1, np.int64), 1, INT64, src, tag, cid)
+
+
+def test_revoke_drain_fails_pending_ops_with_err_revoked():
+    """The ULFM revoke contract (the era-stall soak-class fix): the
+    moment a comm is revoked, every pending operation on it — posted
+    receives INCLUDING ANY_SOURCE, matched receives, unanswered RTS
+    sends — completes with ERR_REVOKED; the ft control planes
+    (shrink agreement, diskless commits, dpm bridge) and OTHER comms
+    stay untouched, because recovery runs on them after the revoke."""
+    from ompi_tpu.coll.basic import COLL_CID_BIT
+    from ompi_tpu.coll.sched import NBC_CID_BIT
+    from ompi_tpu.comm.communicator import ANY_SOURCE
+    from ompi_tpu.core.errors import ERR_REVOKED
+    from ompi_tpu.ft.revoke import FT_CID_BIT
+    from ompi_tpu.pml.base import SendRequest
+    from ompi_tpu.pml.ob1 import Ob1Pml
+
+    pml = Ob1Pml(my_rank=0)
+    cid = 7
+    exempt = []
+    try:
+        doomed = [
+            _posted(pml, 5, 1, cid),               # user exact
+            _posted(pml, ANY_SOURCE, 2, cid),      # wildcard: goes too
+            _posted(pml, 5, -13, cid | COLL_CID_BIT),  # blocking coll
+            _posted(pml, 5, 3, cid | NBC_CID_BIT),  # nonblocking coll
+        ]
+        # an unanswered rendezvous RTS on the revoked comm
+        sreq = SendRequest(5, 4, cid, 64)
+        pml._pending_sends[991] = sreq
+        doomed.append(sreq)
+        exempt = [
+            _posted(pml, 5, 90, cid | FT_CID_BIT),  # shrink agreement
+            _posted(pml, 5, 1, cid + 1),            # a different comm
+        ]
+        n = pml.revoke_requests(cid)
+        assert n == len(doomed)
+        for req in doomed:
+            assert req.is_complete
+            with pytest.raises(MPIError) as ei:
+                req.Wait()
+            assert ei.value.code == ERR_REVOKED
+        for req in exempt:
+            assert not req.is_complete
+        assert 991 not in pml._pending_sends
+    finally:
+        # cancel the survivors (leaked posted receives read as pending
+        # work) and hand the rebind-by-name forensics hooks back to the
+        # live world pml — a transient pml otherwise shadows it with a
+        # soon-dead weakref and the sentinel reads ZERO pending work in
+        # every later test module (chaos sorts before forensics)
+        for req in exempt:
+            pml.cancel_recv(req)
+        _rebind_world_forensics()
+
+
+def _rebind_world_forensics() -> None:
+    from ompi_tpu.pml.base import world_pml
+
+    wp = world_pml()
+    if wp is not None and hasattr(wp, "bind_forensics"):
+        wp.bind_forensics()
+
+
+def test_revoke_comm_drains_and_dedups():
+    """revoke_comm floods + drains on the first call; the revoked flag
+    dedups re-entry (a flood receipt on an already-revoked comm must
+    not re-run the sweep or the flood)."""
+    from ompi_tpu.core.errors import ERR_REVOKED
+    from ompi_tpu.ft.revoke import revoke_comm
+    from ompi_tpu.pml.ob1 import Ob1Pml
+
+    class _Grp:
+        ranks = [0]
+
+    class _Comm:
+        cid = 11
+        name = "revoke-unit"
+        revoked = False
+        group = _Grp()
+
+        def __init__(self, pml):
+            self.pml = pml
+
+    pml = Ob1Pml(my_rank=0)
+    try:
+        comm = _Comm(pml)
+        req = _posted(pml, 3, 1, 11)
+        revoke_comm(comm)
+        assert comm.revoked
+        with pytest.raises(MPIError) as ei:
+            req.Wait()
+        assert ei.value.code == ERR_REVOKED
+        # re-entry: nothing left to drain, no error, flag stays
+        revoke_comm(comm)
+        assert comm.revoked
+    finally:
+        _rebind_world_forensics()
+
+
 # ---------------------------------------------------------- procmode proof
 def test_chaos_kill_mid_allreduce(tmp_path):
     """The headline: a rank dies mid-allreduce (injected), survivors

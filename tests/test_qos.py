@@ -81,6 +81,49 @@ def test_tag_map_cvar_rewrite_takes_effect():
     assert qos.classify(-4600, 0) == qos.NORMAL  # map replaced, not merged
 
 
+def test_recovery_planes_classify_bulk_by_default():
+    """The DEFAULT map demotes the recovery state-movement planes:
+    respawn state delivery (RESPAWN_STATE_TAG 4242), the diskless
+    parity/buddy-blob exchange (4243), and reshard rounds (4300) ride
+    BULK — positive tags resolve through the map only when listed."""
+    from ompi_tpu.ft.recovery import RESPAWN_STATE_TAG
+    from ompi_tpu.mca.var import all_vars
+    from ompi_tpu.reshard.exec import RESHARD_TAG
+
+    set_var("qos", "tag_map", all_vars()["qos_tag_map"].default)
+    assert qos.classify(RESPAWN_STATE_TAG, 0) == qos.BULK
+    assert qos.classify(4243, 0) == qos.BULK
+    assert qos.classify(RESHARD_TAG, 0) == qos.BULK
+    assert qos.classify(-4800, 0) == qos.LATENCY  # forensics dumps
+    assert qos.classify(4244, 0) == qos.NORMAL    # unlisted user tag
+    # positive-tag entries apply ONLY on the plane-free user cid: a
+    # derived plane's internal tag sequence (the NBC allocator counts
+    # up from 0 per comm — its 4243rd schedule uses tag 4242) must not
+    # collide with the recovery entries and silently ride BULK
+    from ompi_tpu.coll.sched import NBC_CID_BIT
+
+    assert qos.classify(RESPAWN_STATE_TAG, 7 | NBC_CID_BIT) == qos.NORMAL
+    assert qos.classify(RESHARD_TAG, 7 | NBC_CID_BIT) == qos.NORMAL
+
+
+def test_listed_recovery_tag_beats_comm_override():
+    """A mapped positive tag wins over the per-comm class: an operator
+    promoting a comm to LATENCY must not drag the recovery bytes on it
+    up too (the map entry is the ONLY boundary that sees them)."""
+    from ompi_tpu.ft.recovery import RESPAWN_STATE_TAG
+    from ompi_tpu.mca.var import all_vars
+
+    set_var("qos", "tag_map", all_vars()["qos_tag_map"].default)
+    comm = Communicator(Group([0]), 613, name="qos-recovery")
+    _live_comms[613] = comm
+    try:
+        comm.Set_qos_class("latency")
+        assert qos.classify(5, 613) == qos.LATENCY
+        assert qos.classify(RESPAWN_STATE_TAG, 613) == qos.BULK
+    finally:
+        _live_comms.pop(613, None)
+
+
 def test_comm_attr_override_and_derived_planes():
     comm = Communicator(Group([0]), 611, name="qos-test")
     _live_comms[611] = comm

@@ -1,0 +1,589 @@
+"""Elastic serving harness: SLO tracker, RTO clocks, traffic oracle,
+admission policy, churn episodes, and the procmode churn/steady proofs.
+
+The SLO-tracker units are the satellite coverage ISSUE 15 names:
+coordinated-omission correction on a seeded stall, the violation latch
+and its re-arm hysteresis, RTO clock start/stop semantics per fault
+class, and the cvar/pvar/histogram/info registration surface.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ompi_tpu.serve  # noqa: F401  registers the serve_* surface
+from ompi_tpu.core.errors import (
+    MPIError,
+    ERR_ARG,
+    ERR_PROC_FAILED,
+    ERR_REVOKED,
+)
+from ompi_tpu.mca.var import all_pvars, all_vars, get_var, set_var
+from ompi_tpu.runtime import metrics
+from ompi_tpu.serve import churn as schurn
+from ompi_tpu.serve import policy as spolicy
+from ompi_tpu.serve import slo as sslo
+from ompi_tpu.serve import traffic as straffic
+from ompi_tpu.serve.churn import ChurnDriver, Episode
+from ompi_tpu.serve.policy import AdmissionGate, NeedsRecovery
+from ompi_tpu.serve.slo import RTOClock, SLOTracker
+
+from tests.test_process_mode import run_mpi as _run_mpi_base, REPO, \
+    subprocess_env
+
+pv = all_pvars()
+
+
+@pytest.fixture(autouse=True)
+def clean_serve():
+    yield
+    sslo.reset_for_testing()
+    straffic.reset_for_testing()
+    spolicy.reset_for_testing()
+    metrics.reset_for_testing()
+
+
+# ------------------------------------------------------------ SLO tracker
+def test_coordinated_omission_backfill_on_seeded_stall():
+    """A step that stalled k paced periods backfills the k arrivals it
+    swallowed, each one period less late (the HdrHistogram rule)."""
+    t = SLOTracker(slo_us=10000.0, period_us=1000.0, case="co")
+    n = t.observe(3500.0)                 # 3500, 2500, 1500, 500
+    assert n == 4
+    assert t.hist.count == 4
+    assert t.violations == 0              # all under the 10ms SLO
+    n = t.observe(500.0)
+    assert n == 1                         # under one period: no backfill
+    assert t.hist.count == 5
+
+
+def test_coordinated_omission_counts_backfilled_violations():
+    t = SLOTracker(slo_us=100.0, period_us=100.0, case="viol")
+    n = t.observe(350.0)                  # 350, 250, 150, 50
+    assert n == 4
+    assert t.violations == 3              # the backfilled arrivals that
+    assert t.episodes == 1                # would still have violated
+
+
+def test_closed_loop_records_one_sample():
+    t = SLOTracker(slo_us=100.0, period_us=0.0, case="closed")
+    assert t.observe(5000.0) == 1
+    assert t.hist.count == 1
+
+
+def test_violation_latch_and_rearm_hysteresis():
+    t = SLOTracker(slo_us=100.0, period_us=0.0, case="latch")
+    t.observe(150.0)                      # first violation: episode 1
+    assert (t.violations, t.episodes) == (1, 1)
+    t.observe(160.0)                      # still latched: same episode
+    assert (t.violations, t.episodes) == (2, 1)
+    t.observe(70.0)                       # below SLO but above slo/2:
+    assert t.latched()                    # hysteresis holds the latch
+    t.observe(150.0)
+    assert t.episodes == 1
+    t.observe(40.0)                       # below slo/2: re-arms
+    assert not t.latched()
+    t.observe(150.0)                      # next burst: episode 2
+    assert t.episodes == 2
+    assert pv["serve_slo_violations"].value >= 4
+    assert pv["serve_slo_episodes"].value >= 2
+
+
+def test_backfilled_tails_do_not_rearm_the_latch():
+    """The latch transitions on the REAL arrival only: a multi-period
+    stall's backfilled tail always lands under one period — letting it
+    re-arm would fire one episode (and banner) PER stalled step of a
+    single outage burst."""
+    t = SLOTracker(slo_us=50000.0, period_us=5000.0, case="tails")
+    t.observe(60000.0)            # 60000, 55000 violate; tail 0..50000
+    assert t.episodes == 1 and t.violations == 2
+    assert t.latched()            # the sub-slo/2 tails did NOT re-arm
+    t.observe(60000.0)            # same burst: no new episode
+    assert t.episodes == 1
+    t.observe(10000.0)            # real arrival below slo/2: re-arms
+    assert not t.latched()
+    t.observe(60000.0)            # next burst: episode 2
+    assert t.episodes == 2
+
+
+def test_tracker_feeds_metrics_histogram():
+    t = SLOTracker(slo_us=1e9, period_us=0.0, stream="h")
+    for us in (10.0, 20.0, 4000.0):
+        t.observe(us)
+    assert t.p50() <= t.p99()
+    snap = metrics.snapshot()
+    hs = [h for h in snap["histograms"] if h["name"] == "serve_step_us"
+          and h["labels"].get("stream") == "h"]
+    assert hs and hs[0]["count"] == 3
+
+
+# -------------------------------------------------------------- RTO clock
+def test_rto_start_stop_semantics_per_fault_class():
+    rc = RTOClock()
+    rc.start("kill_respawn", t_ns=1_000)
+    rc.start("kill_shrink", t_ns=2_000)
+    assert rc.running("kill_respawn") and rc.running("kill_shrink")
+    # independent stopwatches, stopped in any order
+    us = rc.stop("kill_shrink", t_ns=5_002_000)
+    assert us == pytest.approx(5000.0)
+    assert not rc.running("kill_shrink")
+    assert rc.running("kill_respawn")
+    us = rc.stop("kill_respawn", t_ns=2_001_000)
+    assert us == pytest.approx(2000.0)
+    assert rc.last_us["kill_shrink"] == pytest.approx(5000.0)
+    assert pv["serve_rto_measured"].value >= 2
+
+
+def test_rto_start_is_first_wins_while_running():
+    """A second fault mid-recovery extends the SAME outage."""
+    rc = RTOClock()
+    rc.start("preempt_flush", t_ns=1_000)
+    rc.start("preempt_flush", t_ns=900_000)  # ignored: clock is live
+    assert rc.stop("preempt_flush",
+                   t_ns=1_001_000) == pytest.approx(1000.0)
+    # after a stop, start re-arms from the new anchor
+    rc.start("preempt_flush", t_ns=5_000)
+    assert rc.stop("preempt_flush",
+                   t_ns=6_000) == pytest.approx(1.0)
+
+
+def test_rto_stop_without_start_is_noop_and_cancel_drops():
+    rc = RTOClock()
+    assert rc.stop("kill_respawn") is None
+    rc.start("kill_respawn")
+    rc.cancel("kill_respawn")
+    assert not rc.running("kill_respawn")
+    assert rc.stop("kill_respawn") is None
+
+
+def test_rto_histogram_labeled_by_fault_class():
+    rc = RTOClock()
+    rc.start("kill_shrink", t_ns=0)
+    rc.stop("kill_shrink", t_ns=7_000_000)
+    snap = metrics.snapshot()
+    hs = [h for h in snap["histograms"] if h["name"] == "serve_rto_us"]
+    assert any(h["labels"].get("fault_class") == "kill_shrink"
+               and h["count"] == 1 for h in hs)
+    g = metrics.gauge_get("serve_rto_last_us", fault_class="kill_shrink")
+    assert g == pytest.approx(7000.0)
+
+
+# ---------------------------------------------------------- traffic oracle
+def test_payload_oracle_matches_member_sum():
+    for seed in (0, 7, 123):
+        for step in (0, 3, 11):
+            for n in (2, 3, 5):
+                tot = sum(straffic.step_input(seed, step, r, 16)
+                          for r in range(n))
+                want = straffic.expected_total(seed, step, n, 16)
+                assert np.array_equal(tot, want)
+                assert want[0] == straffic.step_sum(seed, step, n)
+                # integer-valued floats: the bitwise-exactness premise
+                assert np.array_equal(want, np.rint(want))
+
+
+def test_traffic_is_pure_in_seed_step_member():
+    assert straffic.contribution(3, 5, 1) == straffic.contribution(3, 5, 1)
+    assert straffic.contribution(3, 5, 1) != \
+        straffic.contribution(4, 5, 1) or \
+        straffic.contribution(3, 6, 1) != straffic.contribution(3, 5, 1)
+
+
+def test_trafficgen_drives_steps_and_counts():
+    t = SLOTracker(slo_us=1e9, period_us=0.0, case="gen")
+    gen = straffic.TrafficGen(t, seed=1, period_us=0.0)
+    served = []
+    nxt = gen.run(5, served.append)
+    assert nxt == 5 and served == [0, 1, 2, 3, 4]
+    assert gen.steps_done == 5
+    assert t.hist.count == 5
+    assert pv["serve_steps"].value >= 5
+
+
+def test_trafficgen_on_error_retries_then_bounds():
+    t = SLOTracker(slo_us=1e9, period_us=0.0, case="err")
+    gen = straffic.TrafficGen(t, seed=1, period_us=0.0,
+                              max_retries_per_step=2)
+    fails = {"n": 0}
+
+    def flaky(step):
+        if step == 1 and fails["n"] < 1:
+            fails["n"] += 1
+            raise MPIError(ERR_PROC_FAILED)
+
+    handled = []
+    gen.run(3, flaky, on_error=lambda s, e: handled.append(s))
+    assert handled == [1]
+    assert pv["serve_step_errors"].value >= 1
+
+    def always(step):
+        raise MPIError(ERR_PROC_FAILED)
+
+    with pytest.raises(MPIError):
+        gen.run(1, always, on_error=lambda s, e: None, start_step=9)
+
+
+def test_trafficgen_open_loop_paces_arrivals():
+    t = SLOTracker(slo_us=1e9, period_us=5000.0, case="pace")
+    gen = straffic.TrafficGen(t, seed=1, period_us=5000.0)
+    t0 = time.perf_counter()
+    gen.run(4, lambda s: None)
+    assert time.perf_counter() - t0 >= 0.015  # >= 3 full periods
+
+
+def test_mesh_inference_step_serves():
+    """Mesh-mode inference-shaped step (tensor-parallel matmul +
+    mesh allreduce) under the serving loop on the virtual 8-way mesh."""
+    from ompi_tpu.parallel import mesh_world
+
+    world = mesh_world()
+    step_fn = straffic.make_mesh_step(world, hidden=16)
+    t = SLOTracker(slo_us=1e9, period_us=0.0, case="mesh")
+    gen = straffic.TrafficGen(t, seed=7, period_us=0.0)
+    gen.run(3, lambda s: step_fn(7, s))
+    assert t.hist.count == 3
+
+
+# ---------------------------------------------------------------- policy
+class _FakeGroup:
+    def __init__(self, ranks):
+        self.ranks = list(ranks)
+
+    def world_rank(self, r):
+        return self.ranks[r]
+
+
+class _FakeComm:
+    def __init__(self, ranks=(0, 1, 2), revoked=False, name="fake"):
+        self.group = _FakeGroup(ranks)
+        self.revoked = revoked
+        self.name = name
+
+    def Get_size(self):
+        return len(self.group.ranks)
+
+    def Get_rank(self):
+        return 0
+
+
+@pytest.fixture
+def no_failures(monkeypatch):
+    from ompi_tpu.ft import detector
+
+    monkeypatch.setattr(detector, "known_failed", lambda: set())
+
+
+def test_admit_passes_healthy_comm(no_failures):
+    comm = _FakeComm()
+    gate = AdmissionGate(comm)
+    assert gate.admit() is comm
+
+
+def test_admit_refuses_dying_membership(monkeypatch):
+    from ompi_tpu.ft import detector
+
+    monkeypatch.setattr(detector, "known_failed", lambda: {7})
+    gate = AdmissionGate(_FakeComm(ranks=(0, 7, 9)))
+    before = pv["serve_admission_refusals"].value
+    with pytest.raises(NeedsRecovery) as ei:
+        gate.admit()
+    assert ei.value.dead == [7]
+    assert ei.value.code == ERR_PROC_FAILED
+    assert pv["serve_admission_refusals"].value == before + 1
+
+
+def test_admit_refuses_revoked_comm(no_failures):
+    gate = AdmissionGate(_FakeComm(revoked=True))
+    with pytest.raises(NeedsRecovery):
+        gate.admit()
+
+
+def test_admit_queues_for_recovery_window(no_failures):
+    """Steps arriving during a recovery window wait it out (bounded
+    backoff) and run on the comm the window installed."""
+    from ompi_tpu.ft import recovery
+
+    comm = _FakeComm()
+    shrunk = _FakeComm(ranks=(0, 1))
+    gate = AdmissionGate(comm)
+    recovery._recovering[0] += 1
+    polls = {"n": 0}
+
+    def fake_wait():
+        polls["n"] += 1
+        if polls["n"] >= 3:  # the window closes mid-wait
+            recovery._recovering[0] -= 1
+            gate.install(shrunk)
+
+    before_q = pv["serve_queued_steps"].value
+    before_d = pv["serve_degraded_steps"].value
+    try:
+        got = gate.admit(wait=fake_wait)
+    finally:
+        recovery._recovering[0] = 0
+    assert got is shrunk and polls["n"] == 3
+    assert pv["serve_queued_steps"].value == before_q + 1
+    # the shrunk world is below full capacity: the step is degraded
+    assert pv["serve_degraded_steps"].value == before_d + 1
+
+
+def test_admit_bounded_wait_raises(no_failures):
+    """The hang-budget timeout is ERR_PENDING — deliberately OUTSIDE
+    the churn driver's survivable-failure set, or a stuck recovery
+    window would trigger a SECOND concurrent recover() on the comm."""
+    from ompi_tpu.core.errors import ERR_PENDING
+    from ompi_tpu.ft import recovery
+    from ompi_tpu.serve.churn import SERVE_FAILURE_CODES
+
+    old = get_var("serve", "admission_max_wait_ms")
+    set_var("serve", "admission_max_wait_ms", 30.0)
+    recovery._recovering[0] += 1
+    try:
+        with pytest.raises(MPIError) as ei:
+            AdmissionGate(_FakeComm()).admit(
+                wait=lambda: time.sleep(0.02))
+        assert ei.value.code == ERR_PENDING
+        assert ei.value.code not in SERVE_FAILURE_CODES
+        assert "max_wait" in str(ei.value)
+        d = ChurnDriver(AdmissionGate(_FakeComm()))
+        assert not d.is_failure(ei.value)  # fails fast, no re-recovery
+    finally:
+        recovery._recovering[0] = 0
+        set_var("serve", "admission_max_wait_ms", old)
+
+
+def test_recovering_flag_tracks_recover_depth():
+    from ompi_tpu.ft import recovery
+
+    assert not recovery.recovering()
+    recovery._recovering[0] += 1
+    try:
+        assert recovery.recovering()
+    finally:
+        recovery._recovering[0] -= 1
+
+
+# ----------------------------------------------------------------- churn
+def test_episode_plans_translate_to_universe_ranks():
+    comm = _FakeComm(ranks=(0, 4, 2))
+    plan, urank = Episode("kill_respawn", victim=1, after=10).plan(comm)
+    assert plan == "kill(4,after=10)" and urank == 4
+    plan, urank = Episode("preempt_flush", victim=2, after=5,
+                          grace_ms=750).plan(comm)
+    assert plan == "preempt(2,after=5,grace_ms=750)" and urank == 2
+    plan, _ = Episode("kill_shrink", victim=0, after=3).plan(comm)
+    assert plan == "kill(0,after=3)"
+
+
+def test_episode_rejects_unknown_fault_class():
+    with pytest.raises(MPIError) as ei:
+        Episode("meteor_strike", victim=0, after=1)
+    assert ei.value.code == ERR_ARG
+
+
+def test_churn_failure_classification():
+    d = ChurnDriver(AdmissionGate(_FakeComm()))
+    assert d.is_failure(MPIError(ERR_PROC_FAILED))
+    assert d.is_failure(MPIError(ERR_REVOKED))
+    assert d.is_failure(NeedsRecovery([1], "x"))
+    assert not d.is_failure(MPIError(ERR_ARG))
+    assert not d.is_failure(ValueError("nope"))
+    with pytest.raises(ValueError):
+        d.handle_failure(0, ValueError("nope"))
+
+
+def test_degrade_mode_steers_unplanned_recovery(monkeypatch):
+    """serve_degrade_mode is the UNPLANNED-failure policy: 'degrade'
+    sheds capacity (shrink + reshard) where 'queue' (default) restores
+    it (respawn); planned episodes carry their class and ignore it."""
+    from ompi_tpu.ft import recovery as _rec
+    from ompi_tpu.reshard import elastic as _el
+
+    calls = []
+    shrunk = _FakeComm(ranks=(0, 1))
+
+    def fake_recover(comm, ckdir=None, step=None, policy="shrink",
+                     **kw):
+        calls.append(policy)
+        return shrunk, ({"x": 1} if policy == "respawn" else None)
+
+    monkeypatch.setattr(_rec, "recover", fake_recover)
+    monkeypatch.setattr(_el, "reshard_epoch",
+                        lambda *a, **k: ({"x": 2}, 0))
+    old = get_var("serve", "degrade_mode")
+    try:
+        set_var("serve", "degrade_mode", "degrade")
+        d = ChurnDriver(AdmissionGate(_FakeComm()))
+        # no armed episode: the cvar steers the recovery
+        d.handle_failure(0, MPIError(ERR_PROC_FAILED))
+        assert calls == ["shrink"]
+        assert d.gate.comm is shrunk
+        set_var("serve", "degrade_mode", "queue")
+        d2 = ChurnDriver(AdmissionGate(_FakeComm()))
+        d2.handle_failure(0, MPIError(ERR_PROC_FAILED))
+        assert calls == ["shrink", "respawn"]
+        # a planned episode's class wins regardless of the cvar
+        set_var("serve", "degrade_mode", "degrade")
+        d3 = ChurnDriver(AdmissionGate(_FakeComm()))
+        d3.current = Episode("kill_respawn", victim=1, after=1)
+        d3.handle_failure(0, MPIError(ERR_PROC_FAILED))
+        assert calls == ["shrink", "respawn", "respawn"]
+    finally:
+        set_var("serve", "degrade_mode", old)
+
+
+def test_note_correct_step_closes_running_clock():
+    d = ChurnDriver(AdmissionGate(_FakeComm()))
+    assert d.note_correct_step(0) is None  # no outage: no RTO
+    d.rto.start("kill_shrink", t_ns=0)
+    rto = d.note_correct_step(1)
+    assert rto is not None and rto > 0
+    assert d.history and d.history[0][0] == "kill_shrink"
+    assert d.note_correct_step(2) is None  # clock closed
+
+
+# ----------------------------------------------------------- registration
+def test_serve_cvars_and_pvars_registered():
+    vars_ = all_vars()
+    for name in ("serve_slo_us", "serve_period_us", "serve_seed",
+                 "serve_degrade_mode", "serve_admission_backoff_ms",
+                 "serve_admission_max_wait_ms", "serve_save_epochs",
+                 "serve_step_count"):
+        assert name in vars_, name
+    assert vars_["serve_degrade_mode"].default == "queue"
+    for name in ("serve_steps", "serve_step_errors",
+                 "serve_slo_violations", "serve_slo_episodes",
+                 "serve_rto_measured", "serve_queued_steps",
+                 "serve_degraded_steps", "serve_admission_refusals",
+                 "serve_churn_episodes", "serve_churn_recoveries"):
+        assert name in pv, name
+
+
+def test_info_cli_lists_serve_surface(capsys):
+    from ompi_tpu.tools.info import main as info_main
+
+    info_main(["--level", "9", "--param", "serve", "--pvars"])
+    out = capsys.readouterr().out
+    assert "serve_slo_us" in out
+    assert "serve_degrade_mode" in out
+    assert "serve_slo_violations" in out
+
+
+def test_qos_tag_map_default_covers_recovery_planes():
+    """The recovery state-movement planes classify BULK by default."""
+    from ompi_tpu import qos
+    from ompi_tpu.ft.recovery import RESPAWN_STATE_TAG
+
+    # pin the REGISTERED default: an earlier suite's test (test_qos's
+    # fixture) may have left the live cvar at a reduced map
+    old = get_var("qos", "tag_map")
+    set_var("qos", "tag_map", all_vars()["qos_tag_map"].default)
+    try:
+        assert qos.classify(RESPAWN_STATE_TAG, 0) == qos.BULK
+        assert qos.classify(4243, 0) == qos.BULK   # parity exchange
+        assert qos.classify(4300, 0) == qos.BULK   # reshard rounds
+        assert qos.classify(4241, 0) == qos.NORMAL  # unlisted user tag
+    finally:
+        set_var("qos", "tag_map", old)
+        qos.reset_for_testing()
+
+
+# ------------------------------------------------------------- procmode
+FT_SERVE = (("ft_enable", "1"),
+            ("ft_heartbeat_period", "0.25"),
+            ("ft_heartbeat_timeout", "4.0"),
+            ("ft_era_timeout", "60"),
+            ("coll_sm_enable", "0"),
+            ("ft_ckpt_enable", "1"),
+            ("ft_ckpt_timeout", "10"),
+            ("forensics_enable", "1"),
+            ("forensics_stall_threshold_ms", "30000"))
+
+
+def run_mpi(np_, script, *args, timeout=240, mca=(), env_extra=()):
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np",
+           str(np_)]
+    for k, v in mca:
+        cmd += ["--mca", k, str(v)]
+    cmd += [script, *args]
+    env = subprocess_env()
+    env.update(dict(env_extra))
+    return subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                          timeout=timeout, env=env)
+
+
+def _blame(dump_dir: str) -> str:
+    """On a churn failure, the forensics dumps ARE the diagnosis: merge
+    them and return mpidiag's blame lines for the assertion message —
+    a hang must never die as a bare timeout."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "mpidiag.py"),
+             "--dir", dump_dir], capture_output=True, text=True,
+            timeout=60)
+        return r.stdout + r.stderr
+    except Exception as e:  # pragma: no cover
+        return f"(mpidiag failed: {e})"
+
+
+def test_serving_churn_procmode(tmp_path):
+    """The ISSUE 15 acceptance proof: sustained traffic across
+    kill->respawn, kill->shrink+elastic-reshard, and preempt->flush in
+    ONE run — exact arithmetic, a measured RTO per fault class from
+    the metrics plane, zero un-blamed hangs (forensics armed; any
+    failure surfaces mpidiag blame lines, not a bare timeout)."""
+    dumps = str(tmp_path / "dumps")
+    os.makedirs(dumps, exist_ok=True)
+    try:
+        r = run_mpi(3, "tests/procmode/check_serving.py", "churn",
+                    timeout=220, mca=FT_SERVE,
+                    env_extra=(("OMPI_TPU_MCA_metrics_dir", dumps),))
+    except subprocess.TimeoutExpired as e:
+        raise AssertionError(
+            "serving churn run HUNG; mpidiag blame:\n"
+            + _blame(dumps)) from e
+    detail = r.stdout + r.stderr + "\nBLAME:\n" + _blame(dumps) \
+        if r.returncode != 0 else r.stdout
+    assert r.returncode == 0, detail
+    # the original rank 0 and the episode-2 newcomer survive to the end
+    assert r.stdout.count("SERVING-OK") == 2, detail
+    # substring search, not line parsing: the launcher merges rank
+    # stdout and two ranks' prints can interleave mid-line
+    import re
+
+    m = re.search(r"SERVING-RTO rank 0 (\{[^}]*\})", r.stdout)
+    assert m, r.stdout
+    for fc in ("kill_respawn", "preempt_flush", "kill_shrink"):
+        assert fc in m.group(1), m.group(1)
+
+
+def test_serving_steady_procmode():
+    """No churn: the SLO surface alone (the bench_serving baseline)."""
+    r = run_mpi(3, "tests/procmode/check_serving.py", "steady",
+                timeout=120, mca=(("coll_sm_enable", "0"),))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("SERVING-OK") == 3, r.stdout + r.stderr
+    assert r.stdout.count("SERVING-SLO") == 3, r.stdout
+
+
+@pytest.mark.slow
+def test_serving_recovery_isolation_ab(tmp_path):
+    """Recovery-traffic isolation A/B (acceptance: >= 2x, MIN-
+    allreduced, <= 3 stripe-style attempts inside the check). Slow-
+    marked: two storm phases x up to 3 attempts is a multi-minute
+    wire-saturating run; bench_serving and the PR record carry the
+    measured numbers (3/3 standalone >= 2x)."""
+    r = run_mpi(3, "tests/procmode/check_serving.py", "iso",
+                timeout=420,
+                mca=(("btl_btl", "^sm"),
+                     ("btl_tcp_shape_enable", "1"),
+                     ("btl_tcp_sndbuf", str(256 << 10)),
+                     ("btl_tcp_rcvbuf", str(256 << 10)),
+                     ("coll_sm_enable", "0")))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("SERVING-OK") == 3, r.stdout + r.stderr
+    assert "SERVING-ISO" in r.stdout

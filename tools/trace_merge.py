@@ -111,16 +111,56 @@ def merge(paths: List[str],
     }
 
 
+def default_traces() -> List[str]:
+    """Mirror the writer's default (trace.default_trace_dir): with
+    ``trace_dir`` unset, exports land in the newest
+    ``ompi-tpu-trace-<job>`` subdir of the system temp dir — find its
+    rank files, falling back to the CWD's ``trace-rank*.json``."""
+    import glob
+    import os
+    import tempfile
+
+    cands = [d for d in glob.glob(os.path.join(
+        tempfile.gettempdir(), "ompi-tpu-trace-*"))
+        if glob.glob(os.path.join(d, "trace-rank*.json"))]
+    if cands:
+        newest = max(cands, key=os.path.getmtime)
+        return sorted(glob.glob(os.path.join(newest,
+                                             "trace-rank*.json")))
+    return sorted(glob.glob("trace-rank*.json"))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="trace_merge",
         description="Merge per-rank trace-rank<N>.json files onto one "
                     "mpisync-aligned timeline")
-    ap.add_argument("traces", nargs="+", help="per-rank trace JSON files")
+    ap.add_argument("traces", nargs="*",
+                    help="per-rank trace JSON files (default: the "
+                         "newest ompi-tpu-trace-<job> temp dir's "
+                         "trace-rank*.json — where an unset trace_dir "
+                         "writes — then the CWD's)")
     ap.add_argument("-o", "--output", default="merged.json")
     ap.add_argument("--offsets", default=None,
                     help="mpisync offsets (JSON map or mpisync stdout)")
     opts = ap.parse_args(argv)
+    traces = opts.traces
+    if not traces:
+        traces = default_traces()
+        if traces:
+            import os
+
+            # name the guessed source: two concurrent jobs have two
+            # ompi-tpu-trace-* dirs and "newest mtime" is a guess the
+            # operator must be able to audit
+            print(f"trace_merge: merging newest default dir "
+                  f"{os.path.dirname(traces[0])}", file=sys.stderr)
+    if not traces:
+        print("trace_merge: no trace-rank*.json found (pass paths, or "
+              "set trace_dir / run from the export directory)",
+              file=sys.stderr)
+        return 2
+    opts.traces = traces
     offsets = load_offsets(opts.offsets) if opts.offsets else {}
     doc = merge(opts.traces, offsets)
     with open(opts.output, "w") as f:
