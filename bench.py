@@ -1006,7 +1006,8 @@ def bench_serving():
 
     out = {}
     try:
-        r = run("steady", ["--mca", "coll_sm_enable", "0"], 180)
+        r = run("steady", ["--mca", "coll_sm_enable", "0",
+                           "--mca", "metrics_enable", "1"], 180)
     except Exception as e:  # pragma: no cover
         return {"error": str(e)[:300]}
     m = re.search(r"SERVING-SLO rank 0 p50=([0-9.]+)us p99=([0-9.]+)us "
@@ -1016,6 +1017,19 @@ def bench_serving():
     out["steady"] = {"p50_us": float(m.group(1)),
                      "p99_us": float(m.group(2)),
                      "slo_violations": int(m.group(3))}
+    # per-step critical-path breakdown (mean us per category over the
+    # measured steps): check_serving steady prints what the harness fed
+    # the critpath histograms; mirrored per-category so the BENCH json
+    # and the Prometheus export carry the same decomposition
+    m = re.search(r"SERVING-CRIT rank 0 compute=([0-9.]+)us "
+                  r"wire=([0-9.]+)us wait=([0-9.]+)us defer=([0-9.]+)us",
+                  r.stdout)
+    if m:
+        breakdown = {cat: float(m.group(k + 1)) for k, cat in
+                     enumerate(("compute", "wire", "wait", "defer"))}
+        out["steady"]["step_breakdown_us"] = breakdown
+        for cat, v in breakdown.items():
+            metrics.gauge_set("bench_serving_step_us", v, category=cat)
     # churn: min-of-rounds on the per-class RTOs (2 rounds — each run
     # respawns twice and reshards once, several seconds of real
     # detection latency per episode)

@@ -92,6 +92,7 @@ from ompi_tpu.mca.var import (
     watch_var,
 )
 from ompi_tpu.runtime import mpool
+from ompi_tpu.runtime import trace as _trace
 
 _enable_var = register_var(
     "coll_persist", "enable", 1,
@@ -379,10 +380,11 @@ class _Builder:
 
     def rnd(self, sends: Sequence = (), recvs: Sequence = (),
             ordered: bool = True, wait: bool = False,
-            qos=None, plane: int = 0) -> None:
+            qos=None, plane: int = 0, chunk=None) -> None:
         self.steps.append(("r", Round(sends=sends, recvs=recvs,
                                       ordered=ordered, wait=wait,
-                                      qos=qos, plane=plane)))
+                                      qos=qos, plane=plane,
+                                      chunk=chunk)))
 
     def do(self, fn: Callable[[], None]) -> None:
         self.steps.append(("c", fn))
@@ -463,6 +465,12 @@ def start(comm, plan: PersistPlan) -> NbcRequest:
     failed activation (watchdog peer death, schedule error) discards
     the plan's blocks through :meth:`PersistPlan.fail`."""
     plan.active = True
+    if _trace.enabled():
+        # replay boundary in the trace: coll.round spans that follow
+        # (until the request completes) belong to this frozen replay
+        _trace.instant("coll.persist.start", cat="coll", verb=plan.verb,
+                       provider=plan.provider,
+                       overlap_rounds=plan.overlap_rounds)
     if plan.overlap_rounds and _sched._window_var._value > 1:
         # window<=1 forces every wait round into an ordered barrier —
         # the schedule still replays bitwise-identically, but no round
@@ -783,7 +791,7 @@ def _ring_allreduce(comm, spin, rpin, op, count, dt):
                     b.overlap += 1
                 b.rnd(sends=[(send, right)],
                       recvs=[(ke * isz, left, stage)],
-                      ordered=False, wait=True)
+                      ordered=False, wait=True, chunk=c)
             b.do(lambda _d=bslice(rtyped, rb, c0, c1),
                  _s=bslice(styped, rb, c0, c1), _g=gtyped, _f=fold:
                  _f(_d, _s, _g))
@@ -819,7 +827,7 @@ def _ring_allreduce(comm, spin, rpin, op, count, dt):
                   recvs=[(ke * isz, (blk - 1) % n,
                           bslice(rtyped, blk, c0, c1).view(np.uint8))
                          for blk in range(n) if blk != own],
-                  ordered=False, qos=_qos_mod.BULK, plane=1)
+                  ordered=False, qos=_qos_mod.BULK, plane=1, chunk=c)
     if m > 1:
         b.rnd()  # request-less ordered round: drain the window
     if rpin.post:

@@ -64,6 +64,7 @@ from ompi_tpu.core.request import Request
 from ompi_tpu.mca.var import register_var, register_pvar
 from ompi_tpu.runtime import forensics as _forensics
 from ompi_tpu.runtime import mpool
+from ompi_tpu.runtime import trace as _trace
 
 # Distinct CID plane per traffic class: COLL_CID_BIT = 1<<30 (coll/basic),
 # PART_CID_BIT = 1<<29 (pml/partitioned) — NBC takes 1<<28 so overlapping
@@ -235,10 +236,14 @@ class Round:
     on distinct tags. REQUIRED whenever two phases of one schedule
     carry different QoS classes to the same peer: the shaped btl
     reorders across classes, and same-(cid, src, tag) frames arriving
-    out of send order would bind to the wrong posted receives."""
+    out of send order would bind to the wrong posted receives.
+    ``chunk``  — pipeline-chunk ordinal (or None): purely descriptive
+    trace stamp so coll/persist's chunked replays keep their stage
+    structure visible in the merged timeline (the ``coll.round`` span
+    tools/mpicrit.py groups wire edges under)."""
 
     __slots__ = ("sends", "recvs", "ordered", "wait", "free", "qos",
-                 "plane")
+                 "plane", "chunk")
 
     def __init__(self,
                  sends: Sequence[Tuple[np.ndarray, int]] = (),
@@ -247,7 +252,8 @@ class Round:
                  wait: bool = False,
                  free: Sequence[np.ndarray] = (),
                  qos: Optional[int] = None,
-                 plane: int = 0):
+                 plane: int = 0,
+                 chunk: Optional[int] = None):
         self.sends = list(sends)
         self.recvs = list(recvs)
         self.ordered = ordered
@@ -255,6 +261,7 @@ class Round:
         self.free = free
         self.qos = qos
         self.plane = plane
+        self.chunk = chunk
 
 
 Schedule = Generator[Round, List[np.ndarray], None]
@@ -267,11 +274,14 @@ class _RoundState:
     PR 9 dying-conn lesson: an in-flight drain may still land in a
     block, and a recycled block would alias its next owner)."""
 
-    __slots__ = ("_held",)
+    __slots__ = ("_held", "rounds")
 
     def __init__(self):
         # id(view) -> (pool, block, view): the view keeps id() stable
         self._held: Dict[int, tuple] = {}
+        # rounds issued so far — the trace-only ordinal stamped on
+        # coll.round spans (per schedule, not per communicator)
+        self.rounds = 0
 
     def alloc(self, nbytes: int) -> np.ndarray:
         pool = mpool.class_pool(nbytes)
@@ -312,6 +322,9 @@ def _issue(comm, rnd: Round, tag: int, cid: int, state: _RoundState):
     post: List[tuple] = []
     legacy = _copy_mode_var._value
     moved = 0
+    tr = _trace.enabled()
+    if tr:
+        t0 = _trace.now()
     if rnd.plane:
         # tag sub-plane: far above the per-comm NBC sequence counters,
         # symmetric across ranks (both sides build the same rounds)
@@ -348,6 +361,15 @@ def _issue(comm, rnd: Round, tag: int, cid: int, state: _RoundState):
                                    comm.group.world_rank(dst), tag, cid,
                                    qos=rnd.qos))
     _bump("moved", moved)
+    if tr:
+        # stage structure into the trace: (cid, tag, round, chunk,
+        # plane) lets tools/mpicrit.py group the wire edges a round
+        # produced under the schedule stage that issued them
+        state.rounds += 1
+        _trace.record_span("coll.round", t0, _trace.now(), cat="coll",
+                           cid=cid, tag=tag, round=state.rounds,
+                           chunk=rnd.chunk, plane=rnd.plane,
+                           sends=len(rnd.sends), recvs=len(rnd.recvs))
     return reqs, bufs, post
 
 

@@ -149,6 +149,24 @@ class Header:
         self.qos = kind_byte >> QOS_SHIFT
 
 
+def edge_args(hdr: Header, dst: int) -> dict:
+    """Trace-span args forming one side of a cross-rank causal edge.
+
+    The correlation tuple is already unique on the wire — EAGER/RTS
+    frames by ``(src, dst, cid, tag, seq)`` per QoS class (the match-
+    plane continuity gate depends on exactly that), DATA/CTS/FIN/ACK by
+    ``(msgid, offset)`` — so send-side and deliver-side spans that both
+    record it can be joined OFFLINE into happens-before edges
+    (tools/mpicrit.py) with no wire-format change. Keep the two sides
+    symmetric: a field dropped on one side silently orphans every edge
+    of that kind, which is why tools/trace_lint.py's ``edge-key`` rule
+    gates both span shapes."""
+    return {"kind": hdr.kind, "src": hdr.src, "dst": dst,
+            "cid": hdr.cid, "tag": hdr.tag, "seq": hdr.seq,
+            "msgid": hdr.msgid, "offset": hdr.offset,
+            "nbytes": hdr.nbytes, "qos": hdr.qos}
+
+
 class SendRequest(Request):
     def __init__(self, dst: int, tag: int, cid: int, nbytes: int):
         super().__init__()

@@ -53,6 +53,7 @@ from ompi_tpu.pml.base import (
     RecvRequest,
     SendRequest,
     UnexpectedFrag,
+    edge_args,
     pack_header,
 )
 from ompi_tpu.runtime import forensics as _forensics
@@ -647,7 +648,11 @@ class Ob1Pml:
     def isend(self, buf, count: int, datatype: Datatype, dst: int,
               tag: int, cid: int, qos: Optional[int] = None) -> SendRequest:
         if _trace.enabled():
-            with _trace.span("pml.send", cat="pml", dst=dst, tag=tag,
+            # the verb-level edge key: (src, dst, cid, tag) — the seq /
+            # msgid half lives on the pml.send.frame spans recorded at
+            # frame issue, where those ids are actually assigned
+            with _trace.span("pml.send", cat="pml", src=self.my_rank,
+                             dst=dst, cid=cid, tag=tag,
                              nbytes=count * datatype.size):
                 return self._isend(buf, count, datatype, dst, tag, cid,
                                    qos)
@@ -752,11 +757,14 @@ class Ob1Pml:
         stream with a permanent gap. Seq spaces are per (dst, class):
         the shaped btl guarantees FIFO only within a class."""
         key = (dst, cls)
+        tr = _trace.enabled()
         with self._order_lock(key):
             seq = self._seq_to.get(key, 0) + 1
             self._seq_to[key] = seq
             hdr = pack_header(kind, self.my_rank, cid, tag, seq,
                               nbytes, offset, msgid, qos=cls)
+            if tr:
+                t0 = _trace.now()
             try:
                 self._send_frame(dst, hdr, payload)
             except BaseException:
@@ -773,6 +781,13 @@ class Ob1Pml:
                         self._seq_to.get(key) == seq:
                     self._seq_to[key] = seq - 1
                 raise
+            if tr:
+                # send half of the causal edge: the seq committed above
+                # is the join key the deliver-side span mirrors — a
+                # retroactive span because it only exists post-commit
+                _trace.record_span("pml.send.frame", t0, _trace.now(),
+                                   cat="pml",
+                                   **edge_args(Header(hdr), dst))
 
     def irecv(self, buf, count: int, datatype: Datatype, src: int,
               tag: int, cid: int) -> RecvRequest:
@@ -854,8 +869,11 @@ class Ob1Pml:
         the btl recv callbacks registered per hdr type in ob1)."""
         if _trace.enabled():
             hdr = Header(raw_hdr)
-            with _trace.span("pml.deliver", cat="pml", kind=hdr.kind,
-                             src=hdr.src, nbytes=hdr.nbytes):
+            # deliver half of the causal edge: the full correlation
+            # tuple (see pml.base.edge_args) joins this span to the
+            # sender's pml.send.frame offline
+            with _trace.span("pml.deliver", cat="pml",
+                             **edge_args(hdr, self.my_rank)):
                 return self._handle_incoming(hdr, payload)
         return self._handle_incoming(Header(raw_hdr), payload)
 
@@ -1236,6 +1254,7 @@ class Ob1Pml:
         """Drain the convertor into DATA frames while the flow-control
         window is open. Re-entered from _incoming_ack as credits return."""
         conv = sreq.convertor
+        tr = _trace.enabled()
         with sreq._pump_lock:
             if sreq._complete.is_set():
                 return
@@ -1244,6 +1263,8 @@ class Ob1Pml:
                         not sreq._depth
                         or sreq._offset - sreq._acked < sreq._depth):
                     frag = conv.pack_frag(sreq._frag_size)
+                    if tr:
+                        t0 = _trace.now()
                     # seq slot carries MY window size so the receiver
                     # paces ACKs to the sender's actual depth — config
                     # skew (different pipeline_depth per process) must
@@ -1272,6 +1293,13 @@ class Ob1Pml:
                             self._send_frame(sreq._peer, dhdr, frag)
                             sreq._btls = [self._btl_for(sreq._peer)]
                             sreq._weights, sreq._credits = [1], [0]
+                    if tr:
+                        # DATA half of the edge: keyed (msgid, offset) —
+                        # the receiver's pml.deliver mirrors both
+                        _trace.record_span(
+                            "pml.send.frame", t0, _trace.now(),
+                            cat="pml",
+                            **edge_args(Header(dhdr), sreq._peer))
                     sreq._offset += frag.nbytes
                     from ompi_tpu.runtime import spc
 

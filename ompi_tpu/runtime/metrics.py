@@ -388,6 +388,12 @@ def on_coll_entry(comm, verb: str) -> None:
         i = _idx.get(cid, 0)
         _idx[cid] = i + 1
     rank = int(getattr(comm, "rank", 0))
+    if _trace.enabled():
+        # the (cid, call_index) stamp in the trace: tools/mpicrit.py
+        # names "blocked on rank R <verb> entry" by matching the walk's
+        # wait segment against the nearest preceding coll.entry
+        _trace.instant("coll.entry", cat="coll", cid=cid, idx=i,
+                       verb=verb)
     _plane.ensure(pml)
     root_world = comm.group.world_rank(0)
     if root_world == pml.my_rank:
@@ -512,6 +518,57 @@ def _trip_local(cid: int, skew_us: float, ewma_us: float,
     if _trace.enabled():
         _trace.instant("metrics.straggler", cat="metrics", cid=cid,
                        skew_us=skew_us, ewma_us=ewma_us)
+
+
+# ------------------------------------------------- critical-path breakdown
+# Live per-step attribution (critpath_{compute,wire,wait,defer}_us
+# histograms + the critpath_bound sampler): fed by serve/harness's
+# coarse on-rank timer per step, and by anything replaying
+# tools/mpicrit.py's offline walk back into the registry. The live feed
+# is an approximation (it cannot see cross-rank edges); mpicrit over
+# the merged traces is the ground truth.
+_critpath: Dict[str, Any] = {
+    "steps": 0, "category": "", "rank": -1,
+    "compute_us": 0.0, "wire_us": 0.0, "wait_us": 0.0, "defer_us": 0.0,
+}
+
+register_pvar("metrics", "critpath_steps",
+              lambda: _critpath["steps"],
+              help="Steps with a critical-path breakdown recorded "
+                   "(note_critpath calls; serve/harness feeds one per "
+                   "served step when metrics are on)")
+register_pvar("metrics", "critpath_bound_rank",
+              lambda: _critpath["rank"],
+              help="Rank the most recent step's critical path ran "
+                   "through (-1 before the first breakdown; the live "
+                   "harness feed reports its own world rank)")
+register_pvar("metrics", "critpath_bound_category",
+              lambda: _critpath["category"],
+              help="Dominant category of the most recent step's "
+                   "critical path: compute / wire / wait / defer "
+                   "(string pvar — JSON snapshot only)")
+
+
+def note_critpath(compute_us: float, wire_us: float, wait_us: float,
+                  defer_us: float, rank: int) -> None:
+    """Fold one step's critical-path breakdown into the live plane:
+    per-category latency histograms plus the critpath_bound sampler /
+    pvars naming the dominant category and bound rank. Call sites
+    guard on ``enabled()`` (auto-derived hook contract)."""
+    vals = {"compute": float(compute_us), "wire": float(wire_us),
+            "wait": float(wait_us), "defer": float(defer_us)}
+    for cat, v in vals.items():
+        observe(f"critpath_{cat}_us", v)
+    bound = max(vals, key=lambda c: vals[c])
+    with _lock:
+        _critpath["steps"] += 1
+        _critpath["category"] = bound
+        _critpath["rank"] = int(rank)
+        for cat, v in vals.items():
+            _critpath[cat + "_us"] = v
+
+
+register_sampler("critpath_bound", lambda: dict(_critpath))
 
 
 # ---------------------------------------------------------------- snapshot
@@ -855,10 +912,13 @@ def reset_for_testing() -> None:
         _ewmas.clear()
         _samplers.clear()
         _idx.clear()
+        _critpath.update(steps=0, category="", rank=-1, compute_us=0.0,
+                         wire_us=0.0, wait_us=0.0, defer_us=0.0)
     _tracker.clear()
     _trips[0] = 0
     _exported = False
     _plane.reset()
+    register_sampler("critpath_bound", lambda: dict(_critpath))
 
 
 from ompi_tpu.hook import register_hook  # noqa: E402

@@ -161,6 +161,17 @@ class span:
         return False
 
 
+def step(n: int) -> "span":
+    """Step marker: ``with trace.step(n):`` brackets ONE training or
+    serving step on this rank. tools/mpicrit.py cuts the merged
+    cross-rank timeline at these spans and walks each step's critical
+    path, so every rank must bracket the SAME logical step with the
+    same ``n`` (serve/harness drives this automatically from its state
+    step counter; examples/bench call it around their own loops). Call
+    sites guard with ``if trace.enabled():`` like any span site."""
+    return span("trace.step", cat="step", step=int(n))
+
+
 def record_span(name: str, t0: int, t1: int, cat: str = "",
                 **args: Any) -> None:
     """Retroactive span from saved ``now()`` timestamps — for sites that

@@ -36,12 +36,15 @@ roll back at most one step and preempt episodes lose nothing.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ompi_tpu.core.errors import MPIError, ERR_ARG
 from ompi_tpu.mca.var import register_var
+from ompi_tpu.runtime import metrics as _metrics
+from ompi_tpu.runtime import trace as _trace
 from ompi_tpu.serve import slo as _slo
 from ompi_tpu.serve import traffic as _traffic
 from ompi_tpu.serve.churn import ChurnDriver, Episode
@@ -192,6 +195,16 @@ class ServingHarness:
 
     # ---------------------------------------------------------- the steps
     def _serve_one(self, arrival: int) -> None:
+        # auto-driven step markers: one trace.step span per applied
+        # state step, the cut points tools/mpicrit.py attributes within
+        if _trace.enabled():
+            with _trace.step(self.state_step()):
+                return self._serve_one_inner(arrival)
+        return self._serve_one_inner(arrival)
+
+    def _serve_one_inner(self, arrival: int) -> None:
+        if _metrics._enable_var._value:
+            return self._serve_one_timed(arrival)
         comm = self.gate.admit()
         i = self.state_step()
         out = _traffic.coll_step(comm, self.seed, i, self.count,
@@ -205,6 +218,37 @@ class ServingHarness:
 
             diskless.save(comm, self.state)
         self.churn.note_correct_step(i)
+
+    def _serve_one_timed(self, arrival: int) -> None:
+        """The metrics-enabled step, feeding the live critpath plane a
+        coarse on-rank breakdown per step: admission gate = wait, the
+        verified allreduce = wire, state update + epoch commit =
+        compute (defer is offline-only — the shaped-queue residency is
+        invisible without the merged trace). An APPROXIMATION by
+        design: a single rank cannot see cross-rank edges, so "wire"
+        here includes peers' compute skew; tools/mpicrit.py over the
+        merged traces is the ground truth the histograms converge to
+        in steady state."""
+        t0 = time.monotonic_ns()
+        comm = self.gate.admit()
+        t1 = time.monotonic_ns()
+        i = self.state_step()
+        out = _traffic.coll_step(comm, self.seed, i, self.count,
+                                 out=self._out)
+        t2 = time.monotonic_ns()
+        s = float(out[0])  # the verified WIRE value, not the oracle
+        self.state = {"shard": self.state["shard"] + s,
+                      "step": self.state["step"] + 1,
+                      "acc": self.state["acc"] + s}
+        if self.save_epochs:
+            from ompi_tpu.ft import diskless
+
+            diskless.save(comm, self.state)
+        self.churn.note_correct_step(i)
+        t3 = time.monotonic_ns()
+        _metrics.note_critpath((t3 - t2) / 1e3, (t2 - t1) / 1e3,
+                               (t1 - t0) / 1e3, 0.0,
+                               comm.group.world_rank(comm.Get_rank()))
 
     def _on_error(self, arrival: int, exc: BaseException) -> None:
         self.churn.handle_failure(arrival, exc,

@@ -26,7 +26,7 @@ def _load_everything() -> None:
     import ompi_tpu.coll.neighbor  # topology collectives
     import ompi_tpu.runtime.spc  # spc vars
     import ompi_tpu.runtime.trace  # trace cvars + pvars
-    import ompi_tpu.runtime.metrics  # metrics cvars + straggler pvar
+    import ompi_tpu.runtime.metrics  # metrics cvars + straggler/critpath pvars (metrics_critpath_steps/bound_rank/bound_category)
     import ompi_tpu.runtime.sanitizer  # sanitizer cvars + pvar
     import ompi_tpu.pml.monitoring  # pml_monitoring enable cvar
     import ompi_tpu.runtime.topology  # topo binding vars

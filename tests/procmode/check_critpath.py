@@ -1,0 +1,109 @@
+"""Critical-path attribution ground truth, selected by argv[1].
+
+Three ranks serve WARMUP unmarked wireup steps and then STEPS measured
+steps, each bracketed by ``trace.step(i)`` on every rank (the SAME
+logical step number everywhere — the cut contract tools/mpicrit.py
+documents). One step = an optional injected imbalance plus a seeded
+allreduce verified against its closed form. The caller owns the
+injection and asserts the attribution:
+
+``compute`` — rank 2 sleeps ~400ms INSIDE its step bracket before
+  entering the allreduce. Every other rank blocks in the collective
+  until rank 2 arrives, so the walk must land its dominant segment on
+  rank 2 as on-rank compute and name ``compute @ rank 2`` for all
+  STEPS steps. (400ms, not 40: this host's scheduler-noise p99 is
+  ~130ms — check_serving's measured floor — and the injected signal
+  must dominate any stall the OS hands an innocent rank.)
+
+``wire`` — no in-script delay; the caller arms
+  ``ft_inject_plan=delay(0,1,ms=60,side=recv)`` so every frame on the
+  0 -> 1 edge sits 60ms in rank 1's deliver funnel. Delivery completes
+  after the sleep, so D.end - S.end (the wire term) carries the
+  injection and mpicrit must name the 0 -> 1 edge as the bound.
+
+Both modes then flip the trace cvar OFF (live Var, no process restart,
+and NO trace.reset() — the buffered phase-A spans must still export at
+exit), replay the identical seeded steps, and compare bitwise: tracing
+must be observation, never arithmetic. Prints per rank:
+
+    CRIT-STEP n=<i> wall_us=<w>   (rank 0, one per measured step)
+    CRIT-EQ rank <r>              (phase B bitwise-equal to phase A)
+    CRIT-OK rank <r>
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD
+from ompi_tpu.mca.var import set_var
+from ompi_tpu.runtime import trace
+
+comm = COMM_WORLD
+r = comm.Get_rank()
+n = comm.Get_size()
+
+WARMUP = 2
+STEPS = 5
+COUNT = 4096
+SLEEP_S = 0.4
+
+
+def one_step(i: int, mode: str) -> np.ndarray:
+    """One logical step: injected imbalance + seeded allreduce."""
+    if mode == "compute" and r == 2:
+        time.sleep(SLEEP_S)
+    x = np.arange(COUNT, dtype=np.float64) * (i + 1) + r
+    out = np.zeros(COUNT, np.float64)
+    comm.Allreduce(x, out)
+    # closed form: n*arange*(i+1) + sum(ranks) — every step, every rank
+    want = np.arange(COUNT, dtype=np.float64) * (n * (i + 1)) \
+        + n * (n - 1) / 2.0
+    np.testing.assert_array_equal(out, want)
+    return out
+
+
+def run_phase(mode: str, traced: bool) -> list:
+    res = []
+    for k in range(WARMUP):
+        one_step(1000 + k, mode)  # wireup: outside any step bracket
+    for i in range(STEPS):
+        comm.Barrier()  # align step starts: rank 0's wall ~= global wall
+        t0 = time.perf_counter()
+        if traced and trace.enabled():
+            with trace.step(i):
+                res.append(one_step(i, mode))
+        else:
+            res.append(one_step(i, mode))
+        wall_us = (time.perf_counter() - t0) * 1e6
+        if traced and r == 0:
+            print(f"CRIT-STEP n={i} wall_us={wall_us:.0f}", flush=True)
+    return res
+
+
+def main() -> int:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "compute"
+    assert mode in ("compute", "wire"), mode
+    assert n == 3, n
+    assert trace.enabled(), "caller must arm --mca trace_enable 1"
+
+    a = run_phase(mode, traced=True)
+
+    # flip the cvar off live — NOT trace.reset(): the phase-A rings must
+    # still export at Finalize for the caller to attribute
+    set_var("trace", "enable", False)
+    b = run_phase(mode, traced=False)
+
+    np.testing.assert_array_equal(np.concatenate(a), np.concatenate(b))
+    print(f"CRIT-EQ rank {r}", flush=True)
+
+    comm.Barrier()
+    ompi_tpu.Finalize()
+    print(f"CRIT-OK rank {r}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -159,6 +159,24 @@ def steady_mode() -> int:
     h.verify_state()
     tr = h.tracker
     assert pv["forensics_stall_trips"].value == 0
+    if get_var("metrics", "enable"):
+        # per-step critical-path breakdown (mean us per category from
+        # the critpath histograms the harness fed) — bench_serving
+        # parses this into the metrics registry so BENCH json ==
+        # Prometheus export (the established mirroring discipline)
+        snap = metrics.snapshot()
+        means = {}
+        for cat in ("compute", "wire", "wait", "defer"):
+            hh = [x for x in snap["histograms"]
+                  if x["name"] == f"critpath_{cat}_us"]
+            n = sum(x["count"] for x in hh)
+            means[cat] = (sum(x["sum"] for x in hh) / n) if n else 0.0
+        assert sum(x["count"] for x in snap["histograms"]
+                   if x["name"] == "critpath_compute_us") >= 4 * PHASE
+        print(f"SERVING-CRIT rank {me} "
+              f"compute={means['compute']:.0f}us "
+              f"wire={means['wire']:.0f}us wait={means['wait']:.0f}us "
+              f"defer={means['defer']:.0f}us", flush=True)
     print(f"SERVING-SLO rank {me} p50={tr.p50():.0f}us "
           f"p99={tr.p99():.0f}us violations={tr.violations} "
           f"episodes={tr.episodes}", flush=True)

@@ -1,0 +1,334 @@
+"""Cross-rank critical-path attribution (tools/mpicrit.py) — the DAG
+join, the backward walk, the clock-skew clamp, the trace_lint edge-key
+rule, the mpitop BOUND cell, and the procmode ground truth.
+
+The units run the walker over synthetic aligned timelines where every
+segment is hand-placed, so additivity (categories sum EXACTLY to the
+step wall) and each category's definition are asserted to the
+microsecond. The procmode tests then inject a known imbalance into a
+real 3-rank job — a 40ms sleep on one rank's compute, then a 40ms
+chaos delay on one wire edge — and gate mpicrit naming the injected
+bound on every measured step (the acceptance scenario)."""
+
+import glob
+import os
+import re
+
+from tests.test_process_mode import run_mpi
+
+from tools import mpicrit
+from tools.mpicrit import (edge_key, extract, format_line, summarize,
+                           walk_step)
+from tools.mpitop import bound_cell
+from tools.trace_lint import RULE_EDGE, lint_events
+from tools.trace_merge import load_aligned
+
+STEPS = 5            # check_critpath.py measured steps
+SLEEP_US = 400000.0  # the injected compute imbalance (check_critpath)
+WIRE_US = 60000.0    # the injected per-frame wire delay (ft_inject)
+
+
+# ----------------------------------------------------------- helpers
+def B(name, ts, tid=1, pid=0, **args):
+    ev = {"name": name, "cat": "t", "ph": "B", "ts": float(ts),
+          "pid": pid, "tid": tid}
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def E(name, ts, tid=1, pid=0):
+    return {"name": name, "cat": "t", "ph": "E", "ts": float(ts),
+            "pid": pid, "tid": tid}
+
+
+def frame_args(src, dst, seq, kind=1, cid=1, tag=5, qos=0,
+               msgid=0, offset=0):
+    return dict(kind=kind, src=src, dst=dst, cid=cid, tag=tag, seq=seq,
+                msgid=msgid, offset=offset, nbytes=64, qos=qos)
+
+
+def edge(src_events, dst_events, src, dst, seq, s0, s1, d0, d1, **kw):
+    """One send->deliver pair: frame span on ``src``, deliver on ``dst``."""
+    a = frame_args(src, dst, seq, **kw)
+    src_events += [B("pml.send.frame", s0, **a), E("pml.send.frame", s1)]
+    dst_events += [B("pml.deliver", d0, **a), E("pml.deliver", d1)]
+
+
+def step_span(events, n, t0, t1):
+    events += [B("trace.step", t0, step=n), E("trace.step", t1)]
+
+
+def total_us(s):
+    return sum(s[f"{c}_us"] for c in ("compute", "wire", "wait", "defer"))
+
+
+# ---------------------------------------------------------- edge keys
+def test_edge_key_eager_uses_tag_seq():
+    k = edge_key(frame_args(0, 1, seq=7))
+    assert k == (0, 1, 1, 1, 5, 7, 0)
+
+
+def test_edge_key_data_keys_by_msgid_offset_not_seq():
+    """DATA frames carry the window DEPTH in the seq slot (pml._pump) —
+    two frames of one message differ only in offset, and the key must
+    never consult seq for them."""
+    a = edge_key(frame_args(0, 1, seq=8, kind=4, msgid=33, offset=0))
+    b = edge_key(frame_args(0, 1, seq=8, kind=4, msgid=33, offset=4096))
+    assert a == (0, 1, 1, 4, 33, 0)
+    assert b == (0, 1, 1, 4, 33, 4096)
+    assert a != b
+
+
+def test_edge_key_control_and_partial_are_none():
+    assert edge_key(frame_args(0, 1, seq=0, kind=3)) is None  # CTS
+    broken = frame_args(0, 1, seq=0)
+    del broken["tag"]
+    assert edge_key(broken) is None
+    assert edge_key({}) is None
+
+
+def test_edge_key_json_stringified_ints_coerce():
+    """Span args ride through the exporter's ``default=str``."""
+    a = {k: str(v) for k, v in frame_args(2, 0, seq=3).items()}
+    assert edge_key(a) == (2, 0, 1, 1, 5, 3, 0)
+
+
+# ------------------------------------------------------------ the walk
+def test_walk_two_rank_chain_is_additive():
+    """compute + wire + defer + terminal compute telescope to the wall."""
+    r0, r1 = [], []
+    step_span(r0, 0, 0.0, 1000.0)
+    step_span(r1, 0, 0.0, 5000.0)
+    # send on r0 100..200 (defer 100), delivered on r1 2900..3000
+    edge(r0, r1, 0, 1, seq=0, s0=100, s1=200, d0=2900, d1=3000)
+    att = walk_step(0, extract({0: r0, 1: r1}))
+    assert att["wall_us"] == 5000.0
+    assert att["compute"] == {1: 2000.0, 0: 100.0}
+    assert sum(att["wire"].values()) == 2800.0
+    assert sum(att["defer"].values()) == 100.0
+    assert att["wait_us"] == 0.0 and not att["flagged"]
+    s = summarize(att, extract({0: r0, 1: r1}))
+    assert total_us(s) == att["wall_us"]
+    assert s["bound_category"] == "wire"
+    assert s["wire_edge"] == [0, 1] and s["bound_rank"] == 0
+
+
+def test_walk_negative_wire_clamps_and_flags():
+    """A recv 'preceding' its send after clock alignment is an mpisync
+    error bar: wire clamps to >= 0, the pair is flagged, and the
+    segment stays additive (defer recomputed as deliver-end minus
+    send-begin)."""
+    r0, r1 = [], []
+    step_span(r0, 0, 0.0, 3500.0)
+    step_span(r1, 0, 0.0, 4000.0)
+    # send 1000..3000 but deliver "ends" at 2500: wire would be -500
+    edge(r0, r1, 0, 1, seq=0, s0=1000, s1=3000, d0=2000, d1=2500)
+    data = extract({0: r0, 1: r1})
+    att = walk_step(0, data)
+    assert len(att["flagged"]) == 1
+    assert att["flagged"][0]["edge"] == [0, 1]
+    assert att["flagged"][0]["wire_us"] == -500.0
+    assert sum(att["wire"].values()) == 0.0
+    assert sum(att["defer"].values()) == 1500.0  # 2500 - 1000
+    s = summarize(att, data)
+    assert total_us(s) == att["wall_us"] == 4000.0
+    assert "clock-skew-flagged" in format_line(s)
+
+
+def test_walk_wait_names_late_entry_and_verb():
+    """No inbound edge: the walk terminates on the late rank, its late
+    step entry becomes the wait term, and the nearest coll.entry
+    instant names what peers were blocked on."""
+    r0, r1 = [], []
+    step_span(r0, 0, 0.0, 900.0)
+    step_span(r1, 0, 3000.0, 4000.0)
+    r1.append({"name": "coll.entry", "cat": "coll", "ph": "i",
+               "ts": 3100.0, "pid": 1, "tid": 1,
+               "args": {"cid": 1, "idx": 7, "verb": "allreduce"}})
+    data = extract({0: r0, 1: r1})
+    att = walk_step(0, data)
+    assert att["wait_us"] == 3000.0 and att["wait_rank"] == 1
+    assert att["compute"] == {1: 1000.0}
+    s = summarize(att, data)
+    assert total_us(s) == att["wall_us"] == 4000.0
+    assert s["bound_category"] == "wait" and s["bound_rank"] == 1
+    assert s["wait_verb"] == "allreduce"
+    assert "blocked on rank 1 allreduce entry" in format_line(s)
+
+
+def test_walk_multi_hop_compute_bound_names_the_sleeper():
+    """Three ranks, the middle hop's sender sat 2000us on-rank (the
+    sleep): the chain walks 2 edges back and pins compute on rank 1."""
+    r0, r1, r2 = [], [], []
+    step_span(r0, 3, 0.0, 2500.0)
+    step_span(r1, 3, 0.0, 3000.0)
+    step_span(r2, 3, 0.0, 4000.0)       # the last finisher: walk root
+    # r0 sends early; r1 receives, "computes" 2000us, sends to r2
+    edge(r0, r1, 0, 1, seq=0, s0=100, s1=150, d0=200, d1=250)
+    edge(r1, r2, 1, 2, seq=0, s0=2250, s1=2300, d0=3800, d1=3900)
+    data = extract({0: r0, 1: r1, 2: r2})
+    att = walk_step(3, data)
+    s = summarize(att, data)
+    assert total_us(s) == att["wall_us"] == 4000.0
+    assert s["bound_category"] == "compute"
+    assert s["bound_rank"] == 1          # 2000us between deliver and send
+    assert att["compute"][1] == 2000.0
+
+
+def test_walk_ignores_previous_step_delivers():
+    """A deliver from before the step's global begin must not pull the
+    walk into the previous step (the t0_min floor)."""
+    r0, r1 = [], []
+    step_span(r0, 1, 1000.0, 1500.0)
+    step_span(r1, 1, 1000.0, 2000.0)
+    edge(r0, r1, 0, 1, seq=0, s0=100, s1=150, d0=200, d1=250)  # stale
+    data = extract({0: r0, 1: r1})
+    att = walk_step(1, data)
+    assert att["compute"] == {1: 1000.0}  # walked straight to its entry
+    assert sum(att["wire"].values()) == 0.0
+
+
+def test_walk_clamps_at_step_begin_and_stays_additive():
+    """Barrier traffic straddles the step cut: a matched send that
+    STARTED before the step's global begin must not drag the chain
+    below the cut (which would double-count against wait) — the hop
+    clamps at t0_min and the categories still sum exactly."""
+    r0, r1 = [], []
+    step_span(r0, 0, 0.0, 800.0)
+    step_span(r1, 0, 0.0, 1000.0)
+    # send began 500us BEFORE the step; delivery landed inside it
+    edge(r0, r1, 0, 1, seq=0, s0=-500, s1=-400, d0=50, d1=100)
+    data = extract({0: r0, 1: r1})
+    att = walk_step(0, data)
+    assert att["compute"] == {1: 900.0}
+    assert sum(att["wire"].values()) == 100.0   # clamped send end -> 0
+    assert sum(att["defer"].values()) == 0.0
+    assert att["wait_us"] == 0.0
+    s = summarize(att, data)
+    assert total_us(s) == att["wall_us"] == 1000.0
+
+
+def test_attribute_orders_steps_and_top_sorts_by_wall():
+    r0 = []
+    for n, wall in ((0, 500.0), (1, 3000.0), (2, 1000.0)):
+        step_span(r0, n, n * 10000.0, n * 10000.0 + wall)
+    out = mpicrit.attribute({0: r0})
+    assert [s["step"] for s in out] == [0, 1, 2]
+    top = sorted(out, key=lambda s: -s["wall_us"])[:1]
+    assert top[0]["step"] == 1
+
+
+# ----------------------------------------------------- trace_lint rule
+def test_lint_edge_key_full_tuple_is_clean():
+    evs = []
+    step_span(evs, 0, 0.0, 10.0)
+    edge(evs, evs, 0, 1, seq=0, s0=1, s1=2, d0=3, d1=4)
+    evs.sort(key=lambda e: e["ts"])
+    assert lint_events(evs) == []
+
+
+def test_lint_edge_key_missing_member_is_finding():
+    a = frame_args(0, 1, seq=0)
+    del a["msgid"]
+    evs = [B("pml.deliver", 0.0, **a), E("pml.deliver", 1.0)]
+    errs = lint_events(evs)
+    assert len(errs) == 1 and errs[0].rule == RULE_EDGE
+    assert "msgid" in errs[0].message
+
+
+def test_lint_step_marker_needs_numeric_step():
+    evs = [B("trace.step", 0.0), E("trace.step", 1.0)]
+    errs = lint_events(evs)
+    assert len(errs) == 1 and errs[0].rule == RULE_EDGE
+    evs = [B("trace.step", 0.0, step=True), E("trace.step", 1.0)]
+    assert [e.rule for e in lint_events(evs)] == [RULE_EDGE]
+    evs = [B("trace.step", 0.0, step=4), E("trace.step", 1.0)]
+    assert lint_events(evs) == []
+
+
+def test_lint_unpaired_step_marker_is_finding():
+    evs = [B("trace.step", 0.0, step=4)]
+    assert any("never closed" in e.message for e in lint_events(evs))
+
+
+# ------------------------------------------------------- mpitop BOUND
+def test_bound_cell_from_sampler_pvar_fallback_and_empty():
+    snap = {"samplers": {"critpath_bound": {
+        "steps": 12, "category": "compute", "rank": 2}}}
+    assert bound_cell(snap) == "comp@2"
+    snap = {"pvars": {"metrics_critpath_steps": 3,
+                      "metrics_critpath_bound_category": "wire",
+                      "metrics_critpath_bound_rank": 0}}
+    assert bound_cell(snap) == "wire@0"
+    assert bound_cell({"pvars": {}}) == ""
+    assert bound_cell({"samplers": {"critpath_bound": {
+        "steps": 0, "category": "", "rank": -1}}}) == ""
+
+
+# ------------------------------------------------- procmode (3 ranks)
+def _run_and_attribute(tmp_path, mode, extra_mca=()):
+    r = run_mpi(3, "tests/procmode/check_critpath.py", mode, timeout=240,
+                mca=(("trace_enable", "1"),
+                     ("trace_dir", str(tmp_path)),
+                     ("coll_sm_enable", "0")) + tuple(extra_mca))
+    assert r.returncode == 0, r.stdout + r.stderr
+    # tracing is observation, never arithmetic: every rank replayed the
+    # phase bitwise-identically with the cvar flipped off
+    assert r.stdout.count("CRIT-EQ") == 3, r.stdout + r.stderr
+    assert r.stdout.count("CRIT-OK") == 3, r.stdout + r.stderr
+    walls = {int(m.group(1)): float(m.group(2)) for m in re.finditer(
+        r"CRIT-STEP n=(\d+) wall_us=([0-9.]+)", r.stdout)}
+    assert sorted(walls) == list(range(STEPS)), r.stdout
+    paths = sorted(glob.glob(os.path.join(str(tmp_path),
+                                          "trace-rank*.json")))
+    assert len(paths) == 3, (paths, r.stdout[-2000:], r.stderr[-2000:])
+    by_step = {s["step"]: s
+               for s in mpicrit.attribute(load_aligned(paths, {}))}
+    assert sorted(by_step) == list(range(STEPS)), sorted(by_step)
+    return by_step, walls
+
+
+def _assert_sums(by_step, walls, skew_us=25000.0):
+    """Additivity, twice: categories sum EXACTLY to the trace-measured
+    step wall (the walk's telescoping invariant, clamps included), and
+    to the rank-0 stopwatch wall within a band — stopwatch and merged
+    trace cut the step at different points (barrier-exit skew; in wire
+    mode the pre-step barrier ITSELF crosses the delayed edge, so the
+    caller widens ``skew_us`` by a few injected frames). The band
+    catches a broken timeline, not scheduler noise."""
+    for n in range(STEPS):
+        s, wall = by_step[n], walls[n]
+        assert abs(total_us(s) - s["wall_us"]) <= 2.0, (n, s)
+        assert abs(total_us(s) - wall) <= max(0.5 * wall, skew_us), \
+            (n, total_us(s), wall, s)
+
+
+def test_procmode_compute_delay_names_the_rank(tmp_path):
+    """400ms sleep inside rank 2's step bracket: mpicrit must name
+    compute @ rank 2 on 5/5 measured steps."""
+    by_step, walls = _run_and_attribute(tmp_path, "compute")
+    for n in range(STEPS):
+        s = by_step[n]
+        assert s["bound_category"] == "compute", (n, s)
+        assert s["bound_rank"] == 2, (n, s)
+        assert s["compute_us"] >= 0.5 * SLEEP_US, (n, s)
+        assert walls[n] >= 0.75 * SLEEP_US, (n, walls)
+    _assert_sums(by_step, walls)
+
+
+def test_procmode_wire_delay_names_the_edge(tmp_path):
+    """60ms chaos delay in rank 1's deliver funnel for frames from rank
+    0 (ft_inject, side=recv): mpicrit must pin the bound on the 0 -> 1
+    edge (wire, or defer when the injection rides the send-side issue
+    path) on 5/5 measured steps."""
+    by_step, walls = _run_and_attribute(
+        tmp_path, "wire",
+        extra_mca=(("ft_inject_plan", "delay(0,1,ms=60,side=recv)"),))
+    for n in range(STEPS):
+        s = by_step[n]
+        assert s["bound_category"] in ("wire", "defer"), (n, s)
+        assert s["wire_edge"] == [0, 1], (n, s)
+        assert s["bound_rank"] == 0, (n, s)
+        assert s["wire_us"] + s["defer_us"] >= 0.8 * WIRE_US, (n, s)
+    _assert_sums(by_step, walls, skew_us=4 * WIRE_US)

@@ -8,6 +8,7 @@ class, and the cvar/pvar/histogram/info registration surface.
 """
 
 import os
+import re
 import subprocess
 import sys
 import time
@@ -562,12 +563,22 @@ def test_serving_churn_procmode(tmp_path):
 
 
 def test_serving_steady_procmode():
-    """No churn: the SLO surface alone (the bench_serving baseline)."""
+    """No churn: the SLO surface plus the per-step critical-path
+    breakdown (metrics on: every applied step feeds the critpath
+    histograms, and the SERVING-CRIT line bench_serving mirrors into
+    gauges must parse)."""
     r = run_mpi(3, "tests/procmode/check_serving.py", "steady",
-                timeout=120, mca=(("coll_sm_enable", "0"),))
+                timeout=120, mca=(("coll_sm_enable", "0"),
+                                  ("metrics_enable", "1")))
     assert r.returncode == 0, r.stdout + r.stderr
     assert r.stdout.count("SERVING-OK") == 3, r.stdout + r.stderr
     assert r.stdout.count("SERVING-SLO") == 3, r.stdout
+    crit = re.findall(r"SERVING-CRIT rank \d compute=(\d+)us "
+                      r"wire=(\d+)us wait=(\d+)us defer=(\d+)us",
+                      r.stdout)
+    assert len(crit) == 3, r.stdout
+    for vals in crit:  # the coll_step leg dominates a steady step
+        assert float(vals[1]) > 0, crit
 
 
 @pytest.mark.slow

@@ -1,0 +1,390 @@
+"""mpicrit — cross-rank critical-path attribution per step.
+
+Per-rank traces (``trace_enable=1``) now carry the causal plane:
+
+- ``pml.send.frame`` / ``pml.deliver`` spans record the symmetric
+  correlation tuple (``pml.base.edge_args``): EAGER/RTS frames are
+  unique by ``(src, dst, cid, tag, seq)`` per QoS class, DATA frames by
+  ``(msgid, offset)`` — the same uniqueness the wire match plane
+  depends on, so send→recv edges join OFFLINE with no wire change.
+- ``trace.step`` markers bracket one training/serving step per rank
+  (serve/harness drives them automatically; examples/bench call
+  ``trace.step(n)`` around their own loops).
+- ``coll.entry`` instants stamp each collective dispatch with its
+  ``(cid, call_index)``, naming what a late rank was entering.
+
+mpicrit aligns the rank timelines with the mpisync clock offsets
+(``trace_merge.load_offsets`` / ``load_aligned``), joins the edges into
+a cross-rank happens-before DAG per step, walks the critical path
+BACKWARD from the step's last finisher, and attributes the step wall to
+
+- **compute** — on-rank time between the last inbound delivery and the
+  next outbound send (or the step end),
+- **wire**    — delivery end minus send-call end on each chain edge
+  (clamped to >= 0: a recv *appearing* to precede its send after clock
+  alignment is an offset error bar, flagged, never a negative edge),
+- **defer**   — the send call's own duration (shaped-queue admission /
+  injected send-side delay riding the issue path),
+- **wait**    — the chain-terminating rank's late step entry relative
+  to the earliest rank (what every peer transitively waited on).
+
+The walk is additive by construction: hops clamp at the step's global
+begin, so the four categories sum exactly to the step wall. One line
+per step::
+
+    step 42: 14.2ms = compute 6.1 (rank 2) + wire 3.0 (2->0 BULK, \
+1.1 shaped-defer) + wait 5.1 (blocked on rank 2 allreduce entry)
+
+``--top N`` keeps the N slowest steps (regression view), ``--json``
+emits machine-readable attributions. The live metrics plane mirrors the
+same breakdown per step (``critpath_{compute,wire,wait,defer}_us``
+histograms + the ``critpath_bound`` sampler, fed by serve/harness) —
+coarser, since one rank cannot see cross-rank edges; this tool is the
+ground truth.
+
+Usage::
+
+    OMPI_TPU_MCA_trace_enable=1 mpirun -np 4 app.py
+    python -m ompi_tpu.tools.mpisync --out offsets.json  # multi-host
+    python tools/mpicrit.py trace-rank*.json --offsets offsets.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _TOOLS)
+
+from trace_merge import default_traces, load_aligned, load_offsets  # noqa: E402
+
+# pml/base.py header kinds / qos classes (mirrored literals: this tool
+# must stay importable without dragging the runtime in)
+_EAGER, _RTS, _DATA = 1, 2, 4
+_QOS_NAMES = {0: "NORMAL", 1: "LATENCY", 2: "BULK"}
+_CATS = ("compute", "wire", "wait", "defer")
+
+
+def _num(v: Any) -> Optional[int]:
+    """Span args ride through ``json default=str`` — coerce back."""
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def edge_key(args: Dict[str, Any]) -> Optional[tuple]:
+    """The offline join key for one frame span's args, or None for
+    control traffic (CTS/FIN/ACK have no send-side frame span — they
+    never form a data edge). Mirrors the wire-uniqueness contract
+    pml.base.edge_args documents."""
+    kind = _num(args.get("kind"))
+    src, dst, cid = (_num(args.get(k)) for k in ("src", "dst", "cid"))
+    if None in (kind, src, dst, cid):
+        return None
+    if kind == _DATA:
+        msgid, off = _num(args.get("msgid")), _num(args.get("offset"))
+        if None in (msgid, off):
+            return None
+        return (src, dst, cid, _DATA, msgid, off)
+    if kind in (_EAGER, _RTS):
+        tag, seq = _num(args.get("tag")), _num(args.get("seq"))
+        if None in (tag, seq):
+            return None
+        return (src, dst, cid, kind, tag, seq, _num(args.get("qos")) or 0)
+    return None
+
+
+def _paired_spans(events: List[Dict[str, Any]]):
+    """Yield (name, args, begin_ts, end_ts) for every closed B/E pair,
+    pairing LIFO per tid in file order (the trace_lint contract)."""
+    stacks: Dict[Any, List[Dict[str, Any]]] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "B":
+            stacks.setdefault(ev.get("tid"), []).append(ev)
+        elif ph == "E":
+            stack = stacks.get(ev.get("tid"))
+            if stack and stack[-1].get("name") == ev.get("name"):
+                b = stack.pop()
+                yield (b["name"], b.get("args") or {}, float(b["ts"]),
+                       float(ev["ts"]))
+
+
+class StepData:
+    """Everything the walker needs, extracted from aligned rank
+    timelines (``trace_merge.load_aligned`` output — or synthetic
+    event lists in the unit tests)."""
+
+    def __init__(self):
+        # step n -> {rank: (t_begin, t_end)}
+        self.steps: Dict[int, Dict[int, Tuple[float, float]]] = {}
+        # join key -> (src_rank, begin, end, qos)
+        self.sends: Dict[tuple, Tuple[int, float, float, int]] = {}
+        # rank -> [(end, begin, key)] sorted by end
+        self.delivers: Dict[int, List[Tuple[float, float, tuple]]] = {}
+        # rank -> [(ts, verb)] coll.entry instants, sorted
+        self.entries: Dict[int, List[Tuple[float, str]]] = {}
+
+
+def extract(aligned: Dict[int, List[Dict[str, Any]]]) -> StepData:
+    data = StepData()
+    for rank, events in aligned.items():
+        for name, args, b, e in _paired_spans(events):
+            if name == "trace.step":
+                n = _num(args.get("step"))
+                if n is not None:
+                    data.steps.setdefault(n, {})[rank] = (b, e)
+            elif name == "pml.send.frame":
+                key = edge_key(args)
+                if key is not None:
+                    data.sends[key] = (rank, b, e,
+                                       _num(args.get("qos")) or 0)
+            elif name == "pml.deliver":
+                key = edge_key(args)
+                if key is not None:
+                    data.delivers.setdefault(rank, []).append((e, b, key))
+        for ev in events:
+            if ev.get("ph") in ("i", "I") and \
+                    ev.get("name") == "coll.entry":
+                verb = str((ev.get("args") or {}).get("verb", ""))
+                data.entries.setdefault(rank, []).append(
+                    (float(ev["ts"]), verb))
+        data.delivers.get(rank, []).sort()
+        data.entries.get(rank, []).sort()
+    return data
+
+
+def _latest_edge(data: StepData, rank: int, t: float,
+                 floor: float) -> Optional[tuple]:
+    """The latest deliver on ``rank`` ending at or before ``t`` (and
+    after ``floor``) whose matched send starts before ``t`` — the next
+    hop of the backward walk. Returns (d_begin, d_end, src_rank,
+    s_begin, s_end, qos) or None."""
+    dl = data.delivers.get(rank)
+    if not dl:
+        return None
+    i = bisect.bisect_right(dl, (t, float("inf"), ())) - 1
+    while i >= 0:
+        d_end, d_begin, key = dl[i]
+        if d_end < floor:
+            return None
+        snd = data.sends.get(key)
+        if snd is not None:
+            q, s_begin, s_end, qos = snd
+            if q != rank and s_begin < t:
+                return (d_begin, d_end, q, s_begin, s_end, qos)
+        i -= 1
+    return None
+
+
+def walk_step(n: int, data: StepData,
+              max_hops: int = 100000) -> Optional[Dict[str, Any]]:
+    """Walk step ``n``'s critical path backward from the last
+    finisher; returns the attribution dict (µs everywhere)."""
+    windows = data.steps.get(n)
+    if not windows:
+        return None
+    t0_min = min(b for b, _ in windows.values())
+    r = max(windows, key=lambda k: windows[k][1])
+    t = windows[r][1]
+    att: Dict[str, Any] = {
+        "step": n, "wall_us": t - t0_min,
+        "compute": {}, "wire": {}, "defer": {},
+        "wait_us": 0.0, "wait_rank": None, "flagged": [],
+    }
+    dry = False
+    for _ in range(max_hops):
+        edge = _latest_edge(data, r, t, t0_min)
+        if edge is None:
+            dry = True
+            break
+        d_begin, d_end, q, s_begin, s_end, qos = edge
+        att["compute"][r] = att["compute"].get(r, 0.0) + (t - d_end)
+        # a matched send may START before the step's global begin
+        # (barrier traffic straddling the cut): clamp the hop at
+        # t0_min so the chain attributes exactly the step interval —
+        # descending past the cut would double-count against wait
+        s_begin_c = max(s_begin, t0_min)
+        s_end_c = max(s_end, t0_min)
+        ekey = (q, r, qos)
+        wire = d_end - s_end_c
+        defer = s_end_c - s_begin_c
+        if wire < 0.0:
+            # the recv "preceded" its send after clock alignment: an
+            # mpisync error bar, not causality — clamp, keep the
+            # segment additive, and flag the pair for the operator
+            att["flagged"].append(
+                {"edge": [q, r], "wire_us": wire, "step": n})
+            wire = 0.0
+            defer = max(d_end - s_begin_c, 0.0)
+        att["wire"][ekey] = att["wire"].get(ekey, 0.0) + wire
+        att["defer"][ekey] = att["defer"].get(ekey, 0.0) + defer
+        t, r = s_begin_c, q
+        if t <= t0_min:
+            break  # reached the global step begin: fully attributed
+    if dry:
+        # chain ran dry on rank r at time t: local compute back to its
+        # step entry, and everything before that entry is the wait the
+        # peers transitively paid for r's late arrival (when r was
+        # already active before its own marker, the remainder is wait
+        # too — additivity over the [t0_min, step end] interval holds
+        # in both cases)
+        w0 = windows.get(r, (t0_min,))[0]
+        att["compute"][r] = att["compute"].get(r, 0.0) \
+            + max(t - w0, 0.0)
+        wait = min(w0, t) - t0_min
+        if wait > 0.0:
+            att["wait_us"] = wait
+            att["wait_rank"] = r
+    return att
+
+
+def _entry_verb(data: StepData, rank: int,
+                window: Tuple[float, float]) -> str:
+    for ts, verb in data.entries.get(rank, ()):
+        if window[0] <= ts <= window[1] and verb:
+            return verb
+    return "step"
+
+
+def summarize(att: Dict[str, Any], data: StepData) -> Dict[str, Any]:
+    """Per-category totals + the bound naming for one attribution."""
+    totals = {
+        "compute": sum(att["compute"].values()),
+        "wire": sum(att["wire"].values()),
+        "defer": sum(att["defer"].values()),
+        "wait": att["wait_us"],
+    }
+    bound_cat = max(_CATS, key=lambda c: totals[c])
+    out = {
+        "step": att["step"], "wall_us": att["wall_us"],
+        "bound_category": bound_cat, "flagged": att["flagged"],
+        "wait_rank": att["wait_rank"],
+    }
+    for c in _CATS:
+        out[f"{c}_us"] = totals[c]
+    out["compute_by_rank"] = {str(k): v
+                              for k, v in sorted(att["compute"].items())}
+    if att["compute"]:
+        out["compute_rank"] = max(att["compute"],
+                                  key=lambda k: att["compute"][k])
+    else:
+        out["compute_rank"] = None
+    cost = {k: att["wire"][k] + att["defer"].get(k, 0.0)
+            for k in att["wire"]}
+    if cost:
+        top = max(cost, key=lambda k: cost[k])
+        out["wire_edge"] = list(top[:2])
+        out["wire_qos"] = _QOS_NAMES.get(top[2], str(top[2]))
+    else:
+        out["wire_edge"] = None
+        out["wire_qos"] = None
+    if bound_cat == "compute":
+        out["bound_rank"] = out["compute_rank"]
+    elif bound_cat in ("wire", "defer") and out["wire_edge"]:
+        out["bound_rank"] = out["wire_edge"][0]
+    else:
+        out["bound_rank"] = out["wait_rank"]
+    if att["wait_rank"] is not None:
+        win = data.steps.get(att["step"], {}).get(att["wait_rank"])
+        out["wait_verb"] = _entry_verb(data, att["wait_rank"], win) \
+            if win else "step"
+    else:
+        out["wait_verb"] = None
+    return out
+
+
+def format_line(s: Dict[str, Any]) -> str:
+    ms = lambda v: f"{v / 1000.0:.1f}"  # noqa: E731
+    parts = [f"compute {ms(s['compute_us'])} (rank {s['compute_rank']})"]
+    wired = s["wire_us"] + s["defer_us"]
+    if wired > 0 and s["wire_edge"]:
+        q, r = s["wire_edge"]
+        detail = f"{q}->{r} {s['wire_qos']}"
+        if s["defer_us"] > 0:
+            detail += f", {ms(s['defer_us'])} shaped-defer"
+        parts.append(f"wire {ms(wired)} ({detail})")
+    if s["wait_us"] > 0:
+        parts.append(f"wait {ms(s['wait_us'])} (blocked on rank "
+                     f"{s['wait_rank']} {s['wait_verb']} entry)")
+    line = (f"step {s['step']}: {ms(s['wall_us'])}ms = "
+            + " + ".join(parts))
+    if s["flagged"]:
+        line += f"  [{len(s['flagged'])} clock-skew-flagged edge(s)]"
+    return line
+
+
+def attribute(aligned: Dict[int, List[Dict[str, Any]]]
+              ) -> List[Dict[str, Any]]:
+    """aligned rank timelines -> one summary per step, step order."""
+    data = extract(aligned)
+    out = []
+    for n in sorted(data.steps):
+        att = walk_step(n, data)
+        if att is not None:
+            out.append(summarize(att, data))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mpicrit",
+        description="Per-step critical-path attribution over merged "
+                    "rank traces (compute / wire / wait / defer)")
+    ap.add_argument("traces", nargs="*",
+                    help="per-rank trace JSON files (default: the "
+                         "newest ompi-tpu-trace-<job> temp dir's "
+                         "trace-rank*.json, then the CWD's)")
+    ap.add_argument("--offsets", default=None,
+                    help="mpisync offsets (JSON map or mpisync stdout)")
+    ap.add_argument("--top", type=int, default=0, metavar="N",
+                    help="show only the N slowest steps (regression "
+                         "view; default: every step in order)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the attributions as JSON")
+    opts = ap.parse_args(argv)
+    traces = []
+    for t in opts.traces:  # a trace_dir is as natural an arg as files
+        if os.path.isdir(t):
+            traces.extend(sorted(
+                os.path.join(t, f) for f in os.listdir(t)
+                if f.startswith("trace-rank") and f.endswith(".json")))
+        else:
+            traces.append(t)
+    traces = traces or default_traces()
+    if not traces:
+        print("mpicrit: no trace-rank*.json found (enable with --mca "
+              "trace_enable 1; pass paths or set trace_dir)",
+              file=sys.stderr)
+        return 2
+    offsets = load_offsets(opts.offsets) if opts.offsets else {}
+    summaries = attribute(load_aligned(traces, offsets))
+    if not summaries:
+        print("mpicrit: no trace.step markers in the traces (serve/"
+              "harness drives them; wrap loops in trace.step(n))",
+              file=sys.stderr)
+        return 2
+    if opts.top:
+        summaries = sorted(summaries, key=lambda s: -s["wall_us"])
+        summaries = summaries[:opts.top]
+    if opts.json:
+        print(json.dumps(summaries, indent=2))
+        return 0
+    for s in summaries:
+        print(format_line(s))
+    flagged = sum(len(s["flagged"]) for s in summaries)
+    if flagged:
+        print(f"mpicrit: {flagged} edge pair(s) clamped to wire>=0 "
+              f"(recv preceded send after offset alignment — "
+              f"re-measure mpisync offsets)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

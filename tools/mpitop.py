@@ -7,9 +7,12 @@ views — optionally aligning snapshot ages with the mpisync clock
 offsets that ``tools/trace_merge.py`` already parses — and renders one
 row per rank: collective counts and rates, traffic totals, the
 straggler skew EWMA the comm root computed for that rank, trip counts,
-the p50/p99 of the pml send-latency histogram, and the per-rank
+the p50/p99 of the pml send-latency histogram, the per-rank
 queued-bytes-by-class cell (QKB-L/N/B, KB latency/normal/bulk) from
-the traffic-shaping gauges when ``btl_tcp_shape_enable`` is on.
+the traffic-shaping gauges when ``btl_tcp_shape_enable`` is on, and
+the BOUND cell (``<category>@<rank>``: the latest step's critical-path
+category and bound rank from the critpath sampler —
+tools/mpicrit.py is the offline ground truth).
 
 Usage::
 
@@ -139,6 +142,35 @@ def stall_cell(snap: dict) -> str:
     return ""
 
 
+def bound_cell(snap: dict) -> str:
+    """Critical-path cell ``<cat>@<rank>`` (e.g. ``comp@2``: the most
+    recent step with a breakdown was compute-bound through rank 2),
+    from the critpath_bound sampler; pvar fallback for snapshots
+    written before the sampler existed — the QKB-L/N/B pattern. Empty
+    when no step ever recorded a breakdown."""
+    row = snap.get("samplers", {}).get("critpath_bound")
+    if not isinstance(row, dict):
+        pv = snap.get("pvars", {})
+        if "metrics_critpath_bound_category" not in pv:
+            return ""
+        row = {"steps": pv.get("metrics_critpath_steps", 0),
+               "category": pv.get("metrics_critpath_bound_category", ""),
+               "rank": pv.get("metrics_critpath_bound_rank", -1)}
+    try:
+        steps = int(row.get("steps") or 0)
+    except (TypeError, ValueError):
+        return ""
+    cat = str(row.get("category") or "")
+    if not steps or not cat:
+        return ""
+    try:
+        rank = int(row.get("rank"))
+    except (TypeError, ValueError):
+        rank = -1
+    cell = cat[:4]
+    return f"{cell}@{rank}" if rank >= 0 else cell
+
+
 def skew_by_rank(snaps: Dict[int, dict]) -> Dict[int, float]:
     """Worst coll_entry_skew_us EWMA per rank, pulled from every
     snapshot (comm roots hold the values for their members)."""
@@ -164,7 +196,7 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
     lines = [f"{'RANK':>4} {'AGE-S':>6} {'COLLS':>8} {'COLL/S':>7} "
              f"{'TX-MB':>9} {'RX-MB':>9} {'SKEW-US':>8} {'TRIPS':>5} "
              f"{'P50-US':>7} {'P99-US':>8} {'QKB-L/N/B':>10} "
-             f"{'STALL':>6}"]
+             f"{'STALL':>6} {'BOUND':>8}"]
     for rank in sorted(snaps):
         snap = snaps[rank]
         pv = snap.get("pvars", {})
@@ -188,7 +220,8 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
             f"{pv.get('metrics_straggler_trips', 0):>5} "
             f"{'' if p50 is None else format(p50, '.0f'):>7} "
             f"{'' if p99 is None else format(p99, '.0f'):>8} "
-            f"{qos_queued(snap):>10} {stall_cell(snap):>6}")
+            f"{qos_queued(snap):>10} {stall_cell(snap):>6} "
+            f"{bound_cell(snap):>8}")
     trips = sum(int(s.get("pvars", {}).get("metrics_straggler_trips", 0))
                 for s in snaps.values())
     lines.append(f"-- {len(snaps)} rank(s), {trips} straggler trip(s), "

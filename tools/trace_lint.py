@@ -23,6 +23,11 @@ Checked subset:
 - timestamps must be monotonic non-decreasing per (pid, tid) stream in
   file order — our exporters emit sorted streams, and same-ts B/E
   pairing depends on that emission order.
+- ``edge-key`` rule: pml.send / pml.send.frame / pml.deliver spans must
+  carry their full correlation tuple (pml.base.edge_args symmetry — the
+  offline send→recv join in tools/mpicrit.py silently drops edges with
+  a missing member), and trace.step markers need a numeric ``step`` arg
+  (unpaired markers fall out of the generic B/E pairing check).
 
 Usage:  python tools/trace_lint.py trace-rank0.json [more.json ...]
 Exit status 0 = clean; 1 = violations (printed one per line); 2 = usage.
@@ -59,12 +64,27 @@ else:
     Finding, report = _mod.Finding, _mod.report
 
 RULE = "trace-schema"
+RULE_EDGE = "edge-key"
 _PHASES = {"B", "E", "X", "i", "I", "C", "M"}
 _NEED_TID = {"B", "E", "X", "C"}
 
+# The cross-rank causal-edge contract (pml.base.edge_args →
+# tools/mpicrit.py): frame-level send/deliver spans carry the FULL
+# correlation tuple symmetrically — a missing member breaks the offline
+# send→recv join silently, so it is a finding here instead. The
+# verb-level pml.send span carries only the verb half (seq/msgid are
+# assigned at frame issue, below it).
+_EDGE_KEYS = {
+    "pml.send": ("src", "dst", "cid", "tag"),
+    "pml.send.frame": ("kind", "src", "dst", "cid", "tag", "seq",
+                       "msgid", "offset"),
+    "pml.deliver": ("kind", "src", "dst", "cid", "tag", "seq",
+                    "msgid", "offset"),
+}
 
-def _f(message: str, hint: str = "") -> Finding:
-    return Finding(RULE, "<events>", 0, message, hint=hint)
+
+def _f(message: str, hint: str = "", rule: str = RULE) -> Finding:
+    return Finding(rule, "<events>", 0, message, hint=hint)
 
 
 def lint_events(events: List[Dict[str, Any]]) -> List[Finding]:
@@ -97,6 +117,32 @@ def lint_events(events: List[Dict[str, Any]]) -> List[Finding]:
             dur = ev.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:
                 errors.append(_f(f"event {i}: X event needs dur >= 0"))
+        if ph == "B":
+            need = _EDGE_KEYS.get(ev.get("name"))
+            if need is not None:
+                args = ev.get("args")
+                args = args if isinstance(args, dict) else {}
+                missing = [k for k in need if k not in args]
+                if missing:
+                    errors.append(_f(
+                        f"event {i}: {ev['name']} span missing edge-key "
+                        f"member(s) {', '.join(missing)}",
+                        hint="the pml send/deliver correlation tuple "
+                             "must be symmetric (pml.base.edge_args) "
+                             "or mpicrit's offline join drops the edge",
+                        rule=RULE_EDGE))
+            elif ev.get("name") == "trace.step":
+                args = ev.get("args")
+                step = args.get("step") if isinstance(args, dict) \
+                    else None
+                if not isinstance(step, (int, float)) or \
+                        isinstance(step, bool):
+                    errors.append(_f(
+                        f"event {i}: trace.step marker without a "
+                        f"numeric step arg",
+                        hint="mpicrit cuts the timeline at step "
+                             "markers keyed by args.step",
+                        rule=RULE_EDGE))
         if ph in ("B", "E"):
             timed.append(ev)
 

@@ -79,6 +79,32 @@ def _events_of(doc: Any) -> List[Dict[str, Any]]:
     raise ValueError("no traceEvents")
 
 
+def load_aligned(paths: List[str],
+                 offsets: Dict[int, float]) -> Dict[int, List[Dict[str, Any]]]:
+    """{rank: events} with every rank's timestamps shifted onto rank
+    0's clock (``ts - offset_r * 1e6`` microseconds) and ``pid``
+    rewritten to the rank — the per-rank view tools/mpicrit.py joins
+    cross-rank edges over. Unlike :func:`merge` there is NO global
+    rebase onto the earliest event: edge math needs the aligned
+    absolute times, not a display-friendly origin."""
+    out: Dict[int, List[Dict[str, Any]]] = {}
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        rank = _rank_of(doc, path)
+        shift_us = offsets.get(rank, 0.0) * 1e6
+        evs = []
+        for ev in _events_of(doc):
+            ev = dict(ev)
+            ev["pid"] = rank
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] - shift_us
+            evs.append(ev)
+        evs.sort(key=lambda e: e.get("ts", 0.0))
+        out[rank] = evs
+    return out
+
+
 def merge(paths: List[str],
           offsets: Dict[int, float]) -> Dict[str, Any]:
     merged: List[Dict[str, Any]] = []
