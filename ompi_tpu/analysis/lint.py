@@ -68,6 +68,7 @@ import os
 import re
 from typing import Dict, List, Optional, Set, Tuple
 
+from ompi_tpu.analysis import pkgmodel
 from ompi_tpu.analysis.report import ERROR, WARNING, Finding
 
 RULES: Dict[str, str] = {
@@ -330,9 +331,6 @@ def derive_parity():
     return missing_impl, extra_impl, dead_aliases
 
 
-_SUPPRESS_RE = re.compile(r"#\s*mpilint:\s*disable=([A-Za-z0-9_,\- ]+)")
-
-
 def rel_path(path: str) -> str:
     """Path relative to the ompi_tpu package root (forward slashes), or
     the basename for files outside the package (tools/, snippets)."""
@@ -344,11 +342,15 @@ def rel_path(path: str) -> str:
 
 
 def _suppressions(src: str) -> Dict[int, Set[str]]:
+    # the shared pkgmodel grammar: the old local regex was greedy, so a
+    # two-rule list with an ASCII `--` justification separator
+    # (`disable=a,b -- why`) swallowed the separator and the reason
+    # into the rule names and only the FIRST rule actually applied
     out: Dict[int, Set[str]] = {}
     for i, line in enumerate(src.splitlines(), 1):
-        m = _SUPPRESS_RE.search(line)
-        if m:
-            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        got = pkgmodel.parse_suppression(line, "mpilint")
+        if got is not None:
+            out[i] = got[0]
     return out
 
 

@@ -1,0 +1,695 @@
+"""mpiown — static buffer-ownership and zero-copy lifetime analysis.
+
+PRs 9-11 rebuilt the btl/coll datapath around an implicit ownership
+contract: pool blocks are acquired per size class, recycled on clean
+completion, DISCARDED (never recycled) on any failure path, and
+memoryview borrows must not outlive their backing block without an
+``_owned`` copy at the delivery boundary. This pass makes that contract
+machine-checkable on the shared pkgmodel substrate, the way mpilint
+guards the hot path and mpiracer guards the lock discipline.
+
+Inference
+---------
+A call to ``<pool>.acquire()`` / ``<pool>.acquire_pair()`` starts an
+*owned* obligation on the assigned name; ``<pool>.release()`` /
+``<pool>.free()`` (recycle) or ``<pool>.discard()`` settles it. A
+receiver is pool-like when its terminal identifier contains ``pool``
+(``pool``, ``_rx_pool``, ``class_pool(...)`` results) — ``lock.acquire``
+and ``sem.release`` never match. Obligations are tracked per function
+with branch/loop/``except`` merging; settles popped out of owning
+containers (``held.pop()`` drains) are deliberately untracked — the
+annotation on the acquiring side owns those.
+
+Annotations
+-----------
+``# owns: <attr>`` on an acquiring assignment (or on the statement that
+stores the block) declares the attribute as the block's owning home —
+the obligation transfers to the object graph and a later teardown path
+settles it from the container. ``# borrows: <name>`` on a view-taking
+assignment declares a READ-ONLY view over a buffer the function does
+not own (the zero-copy parse idiom); writes through it and un-copied
+escapes are findings. ``# mpiown: disable=<rule> — justification``
+suppresses per line, the mpiracer grammar: the justification is
+required, and a bare ``disable=`` raises the unsuppressable
+``bare-suppression`` finding in the CLI.
+
+Rules
+-----
+- ``pool-leak``: an acquired block has a control-flow path — including
+  ``except``/``raise`` edges — that exits its owning scope with the
+  obligation unsettled, the value neither stored to an annotated owning
+  attribute nor returned.
+- ``recycle-on-failure``: inside ``except`` handlers and failure-verdict
+  functions (``_conn_failed``/watchdog/``_fail_requests`` naming
+  conventions plus their same-module callees), a settle must be
+  ``discard``, never recycle — the PR 9 dying-conn lesson as a rule.
+- ``double-settle``: two settles of one block reachable on one path.
+- ``escaping-view``: a ``memoryview``/slice of a pool block stored into
+  ``self.*``/module state or shipped through ``deliver`` without the
+  ``ob1._owned`` gate or a counted copy (``bytes``/``bytearray``/
+  ``np.array``/``.copy()``).
+- ``borrow-mutation``: a write through a ``# borrows:``-declared send
+  view.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ompi_tpu.analysis.pkgmodel import (
+    ModuleInfo,
+    Package,
+    load_package,
+    load_source,
+)
+from ompi_tpu.analysis.report import Finding
+
+TOOL = "mpiown"
+
+RULES: Dict[str, str] = {
+    "pool-leak": "every acquired pool block is settled, stored to an "
+                 "annotated owning attribute, or returned on every "
+                 "control-flow path (except/raise edges included)",
+    "recycle-on-failure": "failure-verdict paths settle blocks with "
+                          "discard, never recycle (the dying-conn "
+                          "lesson)",
+    "double-settle": "no path settles one block twice",
+    "escaping-view": "views of pool blocks do not outlive them: no "
+                     "store into self./module state and no un-copied "
+                     "trip through deliver without the _owned gate",
+    "borrow-mutation": "no writes through a # borrows:-declared "
+                       "read-only send view",
+}
+
+# ----------------------------------------------------------- conventions
+_ACQUIRE_METHODS = {"acquire", "acquire_pair"}
+_RECYCLE_METHODS = {"release", "free"}
+_DISCARD_METHODS = {"discard"}
+_SETTLE_METHODS = _RECYCLE_METHODS | _DISCARD_METHODS
+# copy gates: wrapping a view in one of these severs the borrow
+_COPY_GATES = {"_owned", "bytes", "bytearray", "array",
+               "ascontiguousarray", "copy", "tobytes"}
+_VIEW_CALLS = {"memoryview", "frombuffer"}
+# calls that ship a payload across the delivery boundary
+_DELIVER_CALLS = {"deliver"}
+# container-store methods that can hand a block to an owning attribute
+_STORE_METHODS = {"append", "add", "setdefault", "extend", "insert"}
+# functions whose body is a failure-verdict context by naming convention
+_FAILURE_NAME_RE = re.compile(r"fail|watchdog", re.IGNORECASE)
+
+_OWNS_RE = re.compile(r"#\s*owns:\s*([A-Za-z0-9_,\. ]+)")
+_BORROWS_RE = re.compile(r"#\s*borrows:\s*([A-Za-z0-9_,\. ]+)")
+
+
+def _pool_like(node: ast.AST) -> bool:
+    """Is this expression a pool by naming convention? The terminal
+    identifier must contain ``pool`` — excludes locks, semaphores, and
+    the reshard staging trackers (``st.free``)."""
+    if isinstance(node, ast.Name):
+        return "pool" in node.id.lower()
+    if isinstance(node, ast.Attribute):
+        return "pool" in node.attr.lower()
+    if isinstance(node, ast.Subscript):
+        return _pool_like(node.value)
+    return False
+
+
+def _call_attr(node: ast.AST) -> Tuple[str, Optional[ast.AST]]:
+    """(method name, receiver) for an attribute call, else ("", None)."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        return node.func.attr, node.func.value
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id, None
+    return "", None
+
+
+def _is_acquire(node: ast.AST) -> bool:
+    """``pool.acquire()`` / ``pool.acquire_pair()``, possibly
+    subscripted (``pool.acquire_pair()[0]``)."""
+    if isinstance(node, ast.Subscript):
+        return _is_acquire(node.value)
+    name, recv = _call_attr(node)
+    return name in _ACQUIRE_METHODS and recv is not None \
+        and _pool_like(recv)
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _attr_target(node: ast.AST) -> Optional[str]:
+    """Terminal attribute name for a ``self.x`` / ``obj.x`` /
+    ``self.x[k]`` store target, else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        return _attr_target(node.value)
+    return None
+
+
+def _copy_gated(node: ast.AST) -> bool:
+    name, _recv = _call_attr(node)
+    return name in _COPY_GATES
+
+
+class _Annotations:
+    """Per-module ``# owns:`` / ``# borrows:`` line annotations."""
+
+    def __init__(self, src: str):
+        self.owns: Dict[int, Set[str]] = {}
+        self.borrows: Dict[int, Set[str]] = {}
+        for i, line in enumerate(src.splitlines(), 1):
+            m = _OWNS_RE.search(line)
+            if m:
+                self.owns[i] = {a.strip() for a in m.group(1).split(",")
+                                if a.strip()}
+            m = _BORROWS_RE.search(line)
+            if m:
+                self.borrows[i] = {a.strip() for a in m.group(1).split(",")
+                                   if a.strip()}
+
+    def owns_at(self, node: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for ln in range(node.lineno, (getattr(node, "end_lineno", None)
+                                      or node.lineno) + 1):
+            out |= self.owns.get(ln, set())
+        return out
+
+    def borrows_at(self, node: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for ln in range(node.lineno, (getattr(node, "end_lineno", None)
+                                      or node.lineno) + 1):
+            out |= self.borrows.get(ln, set())
+        return out
+
+
+# ------------------------------------------------------ per-function pass
+LIVE = "live"
+RECYCLED = "recycled"
+DISCARDED = "discarded"
+
+
+class _Env:
+    """Abstract state at one program point."""
+
+    __slots__ = ("blocks", "acq", "borrows", "terminated")
+
+    def __init__(self):
+        # name -> set of obligation states on the paths reaching here
+        self.blocks: Dict[str, Set[str]] = {}
+        # name -> (acquire line, attrs authorized by # owns: there)
+        self.acq: Dict[str, Tuple[int, Set[str]]] = {}
+        # name -> True when the borrow was # borrows:-declared read-only
+        self.borrows: Dict[str, bool] = {}
+        self.terminated = False
+
+    def copy(self) -> "_Env":
+        e = _Env()
+        e.blocks = {k: set(v) for k, v in self.blocks.items()}
+        e.acq = dict(self.acq)
+        e.borrows = dict(self.borrows)
+        e.terminated = self.terminated
+        return e
+
+    def merge(self, other: "_Env") -> None:
+        if other.terminated and not self.terminated:
+            return  # the other path exited: keep this path's state
+        if self.terminated and not other.terminated:
+            self.blocks = {k: set(v) for k, v in other.blocks.items()}
+            self.acq = dict(other.acq)
+            self.borrows = dict(other.borrows)
+            self.terminated = False
+            return
+        for k, v in other.blocks.items():
+            self.blocks.setdefault(k, set()).update(v)
+        for k, v in other.acq.items():
+            self.acq.setdefault(k, v)
+        for k, v in other.borrows.items():
+            self.borrows[k] = self.borrows.get(k, False) or v
+        self.terminated = self.terminated and other.terminated
+
+
+class _FnChecker:
+    """One function body, abstractly interpreted."""
+
+    def __init__(self, mod: ModuleInfo, ann: _Annotations, fn_name: str,
+                 failure_fn: bool, findings: List[Finding]):
+        self.mod = mod
+        self.ann = ann
+        self.fn_name = fn_name
+        self.failure_fn = failure_fn
+        self.findings = findings
+        self.handler_depth = 0
+
+    # ------------------------------------------------------------ report
+    def add(self, rule: str, line: int, msg: str, hint: str = "") -> None:
+        if self.mod.suppress.active(line, rule):
+            return
+        self.findings.append(Finding(rule, self.mod.path, line, msg,
+                                     hint=hint))
+
+    def in_failure_ctx(self) -> bool:
+        return self.failure_fn or self.handler_depth > 0
+
+    # ------------------------------------------------------------ driver
+    def run(self, body: List[ast.stmt]) -> None:
+        env = _Env()
+        for stmt in body:
+            self.exec_stmt(stmt, env)
+        if not env.terminated:
+            self.check_exit(env, line=0, why="falls off the end of "
+                            f"{self.fn_name}()")
+
+    def check_exit(self, env: _Env, line: int, why: str,
+                   keep: Set[str] = frozenset()) -> None:
+        for name, states in env.blocks.items():
+            if LIVE in states and name not in keep:
+                acq_line, _attrs = env.acq.get(name, (line, set()))
+                self.add("pool-leak", acq_line or line,
+                         f"block '{name}' acquired here {why} with the "
+                         "obligation unsettled",
+                         hint="settle with release()/discard(), store "
+                              "to a `# owns:` attribute, or return it")
+
+    # --------------------------------------------------------- statements
+    def exec_stmts(self, body: List[ast.stmt], env: _Env) -> None:
+        for stmt in body:
+            if env.terminated:
+                return
+            self.exec_stmt(stmt, env)
+
+    def exec_stmt(self, stmt: ast.stmt, env: _Env) -> None:
+        if isinstance(stmt, ast.Assign):
+            self.do_assign(stmt, stmt.targets, stmt.value, env)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self.do_assign(stmt, [stmt.target], stmt.value, env)
+        elif isinstance(stmt, ast.AugAssign):
+            self.do_write_through(stmt.target, stmt, env)
+        elif isinstance(stmt, ast.Expr):
+            self.do_expr(stmt.value, env)
+        elif isinstance(stmt, ast.Return):
+            keep: Set[str] = set()
+            if stmt.value is not None:
+                keep = _names_in(stmt.value)
+                for name in keep & set(env.blocks):
+                    # returning the block transfers the obligation
+                    env.blocks[name] = {s for s in env.blocks[name]
+                                        if s != LIVE}
+            self.check_exit(env, stmt.lineno,
+                            f"reaches the return at line {stmt.lineno}",
+                            keep=keep)
+            env.terminated = True
+        elif isinstance(stmt, ast.Raise):
+            self.check_exit(env, stmt.lineno,
+                            f"reaches the raise at line {stmt.lineno}")
+            env.terminated = True
+        elif isinstance(stmt, ast.If):
+            a, b = env.copy(), env.copy()
+            self.exec_stmts(stmt.body, a)
+            self.exec_stmts(stmt.orelse, b)
+            a.merge(b)
+            env.blocks, env.acq = a.blocks, a.acq
+            env.borrows, env.terminated = a.borrows, a.terminated
+        elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            body = env.copy()
+            self.exec_stmts(stmt.body, body)
+            if isinstance(stmt, ast.While):
+                self.exec_stmts(stmt.orelse, body)
+            env.merge(body)  # zero-or-more iterations
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.exec_stmts(stmt.body, env)
+        elif isinstance(stmt, ast.Try):
+            # handlers can be entered after ANY body statement: their
+            # entry state is the union of every body-point snapshot
+            at_handlers = env.copy()
+            for s in stmt.body:
+                if env.terminated:
+                    break
+                self.exec_stmt(s, env)
+                at_handlers.merge(env)
+            ends = env
+            self.exec_stmts(stmt.orelse, ends)
+            for h in stmt.handlers:
+                henv = at_handlers.copy()
+                henv.terminated = False
+                self.handler_depth += 1
+                self.exec_stmts(h.body, henv)
+                self.handler_depth -= 1
+                ends.merge(henv)
+            self.exec_stmts(stmt.finalbody, ends)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass  # nested defs are analyzed as their own scopes
+        # remaining statement kinds carry no obligations
+
+    # -------------------------------------------------------- assignment
+    def do_assign(self, stmt: ast.stmt, targets: List[ast.AST],
+                  value: ast.AST, env: _Env) -> None:
+        owns = self.ann.owns_at(stmt)
+        if _is_acquire(value):
+            self.bind_acquire(stmt, targets, owns, env)
+            return
+        borrowed = self.borrow_of(value, env) or \
+            bool(self.ann.borrows_at(stmt))
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if LIVE in env.blocks.get(t.id, ()):  # rebind while live
+                    acq_line, _a = env.acq.get(t.id, (stmt.lineno, set()))
+                    self.add("pool-leak", stmt.lineno,
+                             f"'{t.id}' (block acquired at line "
+                             f"{acq_line}) is rebound with the "
+                             "obligation unsettled")
+                env.blocks.pop(t.id, None)
+                if borrowed:
+                    env.borrows[t.id] = bool(self.ann.borrows_at(stmt)) \
+                        or env.borrows.get(t.id, False)
+                else:
+                    env.borrows.pop(t.id, None)
+            elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                self.do_write_through(t, stmt, env)
+                self.do_store(stmt, t, value, owns, env)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    if isinstance(elt, ast.Name):
+                        env.blocks.pop(elt.id, None)
+                        env.borrows.pop(elt.id, None)
+        self.scan_calls(value, env)
+
+    def bind_acquire(self, stmt: ast.stmt, targets: List[ast.AST],
+                     owns: Set[str], env: _Env) -> None:
+        attr_targets = [t for t in targets
+                        if isinstance(t, (ast.Attribute, ast.Subscript))]
+        if attr_targets:
+            covered = {a for t in attr_targets
+                       for a in [_attr_target(t)] if a in owns}
+            if not covered:
+                names = ", ".join(sorted(filter(None, (
+                    _attr_target(t) for t in attr_targets))))
+                self.add("pool-leak", stmt.lineno,
+                         f"acquired block stored to unannotated "
+                         f"attribute '{names}'",
+                         hint="declare the owning home with "
+                              "`# owns: <attr>` so teardown paths are "
+                              "held to settling it")
+            return  # annotated (or flagged): nothing tracked locally
+        # name targets: the first element of a tuple target is the block
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)) and t.elts:
+                t = t.elts[0]  # (block, hit) = pool.acquire_pair()
+            if isinstance(t, ast.Name):
+                if LIVE in env.blocks.get(t.id, ()):
+                    acq_line, _a = env.acq.get(t.id, (stmt.lineno, set()))
+                    self.add("pool-leak", stmt.lineno,
+                             f"'{t.id}' (block acquired at line "
+                             f"{acq_line}) is rebound by a new acquire "
+                             "with the obligation unsettled")
+                env.blocks[t.id] = {LIVE}
+                env.acq[t.id] = (stmt.lineno, owns)
+                return
+
+    def do_store(self, stmt: ast.stmt, target: ast.AST, value: ast.AST,
+                 owns: Set[str], env: _Env) -> None:
+        """``self.attr = value`` / ``self.attr[k] = value`` with tracked
+        names inside ``value``."""
+        attr = _attr_target(target)
+        if attr is None:
+            return  # subscript of a local: the name tracking covers it
+        names = _names_in(value)
+        live = [n for n in names if LIVE in env.blocks.get(n, ())]
+        authorized = attr is not None and (
+            attr in owns or any(attr in env.acq.get(n, (0, set()))[1]
+                                for n in live))
+        for n in live:
+            if authorized:
+                env.blocks[n] = {s for s in env.blocks[n] if s != LIVE}
+                env.borrows.pop(n, None)
+            else:
+                self.add("pool-leak", stmt.lineno,
+                         f"block '{n}' stored to unannotated attribute "
+                         f"'{attr}' — the obligation leaves this scope "
+                         "with no owning home on record",
+                         hint="annotate the store with `# owns: "
+                              f"{attr}`")
+        if not _copy_gated(value):
+            for n in (names & set(env.borrows)) - set(live):
+                if authorized:
+                    continue  # owning container pins the backing block
+                self.add("escaping-view", stmt.lineno,
+                         f"view '{n}' of a pool block escapes into "
+                         f"attribute '{attr}' without a counted copy",
+                         hint="copy through _owned()/bytes() or store "
+                              "it beside its block under `# owns:`")
+
+    def do_write_through(self, target: ast.AST, stmt: ast.stmt,
+                         env: _Env) -> None:
+        root = target
+        while isinstance(root, ast.Subscript):
+            root = root.value
+        if isinstance(root, ast.Name) and env.borrows.get(root.id):
+            self.add("borrow-mutation", stmt.lineno,
+                     f"write through '{root.id}', a # borrows:-declared "
+                     "read-only send view")
+
+    def borrow_of(self, value: ast.AST, env: _Env) -> bool:
+        """Does this expression take a view over a tracked block or an
+        existing borrow (memoryview/frombuffer/slice)?"""
+        node = value
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            if isinstance(base, ast.Name) and (
+                    base.id in env.blocks or base.id in env.borrows):
+                return True
+            node = base
+        name, _recv = _call_attr(node)
+        if name in _VIEW_CALLS and isinstance(node, ast.Call) \
+                and node.args:
+            src = node.args[0]
+            return bool(_names_in(src) & (set(env.blocks)
+                                          | set(env.borrows)))
+        return False
+
+    # ------------------------------------------------------------- calls
+    def do_expr(self, value: ast.AST, env: _Env) -> None:
+        self.scan_calls(value, env)
+
+    def scan_calls(self, node: ast.AST, env: _Env) -> None:
+        for call in [n for n in ast.walk(node)
+                     if isinstance(n, ast.Call)]:
+            name, recv = _call_attr(call)
+            if name in _SETTLE_METHODS and recv is not None \
+                    and _pool_like(recv):
+                self.do_settle(call, name, env)
+            elif name in _DELIVER_CALLS:
+                self.do_deliver(call, env)
+            elif name in _STORE_METHODS and recv is not None:
+                self.do_container_store(call, recv, env)
+
+    def do_settle(self, call: ast.Call, method: str, env: _Env) -> None:
+        recycle = method in _RECYCLE_METHODS
+        if recycle and self.in_failure_ctx():
+            where = "an except handler" if self.handler_depth else \
+                f"failure-verdict path {self.fn_name}()"
+            self.add("recycle-on-failure", call.lineno,
+                     f"recycle ({method}) inside {where} — a failing "
+                     "path may race an in-flight drain into this "
+                     "block; it must discard",
+                     hint="use discard() so the pool never hands the "
+                          "block to the next acquire")
+        for arg in call.args:
+            if not isinstance(arg, ast.Name):
+                continue
+            states = env.blocks.get(arg.id)
+            if states is None:
+                continue  # container-driven settle: untracked
+            if states & {RECYCLED, DISCARDED}:
+                self.add("double-settle", call.lineno,
+                         f"block '{arg.id}' is settled again on a path "
+                         "where it was already settled")
+            env.blocks[arg.id] = {RECYCLED if recycle else DISCARDED}
+
+    def do_deliver(self, call: ast.Call, env: _Env) -> None:
+        for arg in call.args:
+            if _copy_gated(arg):
+                continue
+            for n in _names_in(arg):
+                if env.borrows.get(n) is not None:
+                    self.add("escaping-view", call.lineno,
+                             f"view '{n}' of a pool block is shipped "
+                             "through deliver() without the _owned "
+                             "gate or a counted copy",
+                             hint="wrap in _owned()/bytes(), or "
+                                  "suppress where the downstream gate "
+                                  "provably copies")
+                    break
+
+    def do_container_store(self, call: ast.Call, recv: ast.AST,
+                           env: _Env) -> None:
+        """``self.held.append((pool, blk))``-style transfer into an
+        owning container attribute."""
+        attr = _attr_target(recv)
+        if attr is None:
+            return
+        owns = self.ann.owns_at(call)
+        names = set()
+        for arg in call.args:
+            names |= _names_in(arg)
+        live = [n for n in names if LIVE in env.blocks.get(n, ())]
+        for n in live:
+            if attr in owns or attr in env.acq.get(n, (0, set()))[1]:
+                env.blocks[n] = {s for s in env.blocks[n] if s != LIVE}
+                env.borrows.pop(n, None)
+            else:
+                self.add("pool-leak", call.lineno,
+                         f"block '{n}' handed to container attribute "
+                         f"'{attr}' with no `# owns:` annotation",
+                         hint=f"annotate the call with `# owns: {attr}`")
+
+
+# ------------------------------------------------------------ module pass
+def _failure_functions(tree: ast.Module) -> Set[str]:
+    """Function names that are failure-verdict contexts: the naming
+    convention plus same-module callees (``fail()`` -> ``_drop()``),
+    a cheap intra-module reachability closure."""
+    defs: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            called: Set[str] = set()
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call):
+                    name, _recv = _call_attr(n)
+                    if name:
+                        called.add(name)
+            defs[node.name] = called
+    failing = {n for n in defs if _FAILURE_NAME_RE.search(n)}
+    work = list(failing)
+    while work:
+        fn = work.pop()
+        for callee in defs.get(fn, ()):
+            if callee in defs and callee not in failing:
+                failing.add(callee)
+                work.append(callee)
+    return failing
+
+
+def _check_module(mod: ModuleInfo, findings: List[Finding]) -> None:
+    if mod.tree is None:
+        return
+    ann = _Annotations(mod.src)
+    failing = _failure_functions(mod.tree)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        checker = _FnChecker(mod, ann, node.name,
+                             node.name in failing, findings)
+        checker.run(node.body)
+
+
+# ------------------------------------------------------------- public API
+def analyze_package(pkg: Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in pkg.modules.values():
+        if mod.relp.startswith("analysis/"):
+            # offline CLI tooling: no pool traffic, and its embedded
+            # bad-code self-test snippets must not trip the tree gate
+            continue
+        _check_module(mod, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def analyze_paths(paths: List[str]) -> List[Finding]:
+    return analyze_package(load_package(paths, tool=TOOL))
+
+
+def analyze_source(src: str, path: str) -> List[Finding]:
+    return analyze_package(load_source(src, path, tool=TOOL))
+
+
+# -------------------------------------------------------- derive parity
+# The modules the ownership discipline currently spans — documentation
+# plus the rot-proofing parity check below, NOT a sweep filter: the
+# sweep always covers the whole tree.
+OWNERSHIP_MODULES = (
+    "btl/tcp.py",
+    "coll/persist.py",
+    "coll/sched.py",
+)
+
+
+def derive_datapath(pkg: Package) -> Set[str]:
+    """Rel paths of modules matched by the inference conventions (a
+    pool-like acquire or settle call anywhere in the module)."""
+    out: Set[str] = set()
+    for mod in pkg.modules.values():
+        if mod.tree is None or mod.relp.startswith("analysis/"):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name, recv = _call_attr(node)
+            if name in (_ACQUIRE_METHODS | _SETTLE_METHODS) \
+                    and recv is not None and _pool_like(recv):
+                out.add(mod.relp)
+                break
+    return out
+
+
+def derive_parity(pkg: Package) -> Tuple[Set[str], Set[str]]:
+    """(curated modules the conventions no longer match — a refactor
+    broke the naming convention and coverage silently shrank; derived
+    modules missing from the curated list — new pool traffic nobody
+    recorded). Both must stay empty; the --self-test gate enforces it
+    so the list cannot rot the way a hand-kept sweep filter would."""
+    derived = derive_datapath(pkg)
+    swept = {relp for relp in pkg.modules
+             if not relp.startswith("analysis/")}
+    missing = set(OWNERSHIP_MODULES) - (derived & swept)
+    unlisted = derived - set(OWNERSHIP_MODULES)
+    return missing, unlisted
+
+
+# -------------------------------------------------------------- self-test
+# One seeded violation per rule: the fake path scopes each snippet the
+# way the real tree would see it.
+SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
+    "pool-leak": ("ompi_tpu/btl/tcp.py", """
+def stage(pool, sink):
+    block = pool.acquire()
+    try:
+        sink.push(block)
+    except RuntimeError:
+        return None   # block still live on the except edge: must fire
+    pool.release(block)
+"""),
+    "recycle-on-failure": ("ompi_tpu/pml/ob1.py", """
+def drain(pool, conn):
+    block = pool.acquire()
+    try:
+        conn.recv_into(block)
+    except OSError:
+        pool.release(block)   # recycle on a failure path: must fire
+        return
+    pool.discard(block)
+"""),
+    "double-settle": ("ompi_tpu/coll/sched.py", """
+def run(pool):
+    block = pool.acquire()
+    pool.release(block)
+    pool.discard(block)   # second settle on the same path: must fire
+"""),
+    "escaping-view": ("ompi_tpu/btl/sm.py", """
+class Ring:
+    def park(self, pool):
+        block = pool.acquire()
+        view = memoryview(block)
+        self.stash = view   # un-copied view outlives the block: fire
+        pool.release(block)
+"""),
+    "borrow-mutation": ("ompi_tpu/pml/base.py", """
+def corrupt(buf):
+    v = memoryview(buf)  # borrows: buf
+    v[0] = 1   # write through a declared send view: must fire
+"""),
+}

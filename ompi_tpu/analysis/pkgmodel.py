@@ -1,18 +1,22 @@
-"""Shared whole-package AST model for the mpiracer passes.
+"""Shared whole-package AST model for the analysis passes.
 
-``ompi_tpu/analysis/threads.py`` (lock discipline / cross-thread races)
-and ``ompi_tpu/analysis/protocol.py`` (wire-protocol registry) both need
-the same substrate: every module of the package parsed once, with its
-mpiracer suppressions, import aliases, and statically-evaluable
-module-level integer constants resolved. This module holds that
-substrate and nothing rule-specific.
+``ompi_tpu/analysis/threads.py`` (lock discipline / cross-thread races),
+``ompi_tpu/analysis/protocol.py`` (wire-protocol registry), and
+``ompi_tpu/analysis/ownership.py`` (pool-block lifetime) all need the
+same substrate: every module of the package parsed once, with its
+suppressions, import aliases, and statically-evaluable module-level
+integer constants resolved. This module holds that substrate and
+nothing rule-specific.
 
-Suppression syntax (mirrors mpilint, separate namespace)::
+Suppression syntax (one namespace per tool — mpilint, mpiracer,
+mpiown — same grammar)::
 
     self._acked = n  # mpiracer: disable=lock-discipline — GIL-atomic,
                      # TOCTOU closed by the re-check under engine.lock
 
-A suppression line MUST carry a justification after the rule list
+The rule list splits on commas (``disable=a,b`` silences both rules);
+the justification follows an em-dash, ``--``, or ``:`` separator. A
+suppression line MUST carry a justification after the rule list
 (anything with a word character). A bare ``disable=`` silences its
 rules but raises the unsuppressable ``bare-suppression`` finding, so
 the zero-findings tier-1 gate enforces the justification discipline.
@@ -25,23 +29,44 @@ import os
 import re
 from typing import Dict, List, Optional, Set, Tuple
 
-_SUPPRESS_RE = re.compile(
-    r"#\s*mpiracer:\s*disable=([A-Za-z0-9_,\- ]+?)(?:\s*(?:—|--|:)\s*(.*))?$")
+# One compiled pattern per tool namespace: the rule list is lazy, so an
+# ASCII `--` (or em-dash / `:`) separator starts the justification
+# instead of being swallowed into the last rule name.
+_SUPPRESS_RES: Dict[str, "re.Pattern[str]"] = {}
+
+
+def suppress_re(tool: str) -> "re.Pattern[str]":
+    pat = _SUPPRESS_RES.get(tool)
+    if pat is None:
+        pat = _SUPPRESS_RES[tool] = re.compile(
+            r"#\s*" + re.escape(tool) +
+            r":\s*disable=([A-Za-z0-9_,\- ]+?)(?:\s*(?:—|--|:)\s*(.*))?$")
+    return pat
+
+
+def parse_suppression(line: str, tool: str):
+    """(rules, reason) for a ``# <tool>: disable=...`` comment on the
+    line, or None. Shared by every tool so multi-rule lists and the
+    justification grammar parse identically tree-wide."""
+    m = suppress_re(tool).search(line)
+    if not m:
+        return None
+    rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return rules, (m.group(2) or "")
 
 
 class Suppressions:
     """Per-line rule suppressions plus the justification contract."""
 
-    def __init__(self, src: str):
+    def __init__(self, src: str, tool: str = "mpiracer"):
         self.by_line: Dict[int, Set[str]] = {}
         self.bare: List[int] = []  # lines with disable= but no reason
         for i, line in enumerate(src.splitlines(), 1):
-            m = _SUPPRESS_RE.search(line)
-            if not m:
+            got = parse_suppression(line, tool)
+            if got is None:
                 continue
-            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            rules, reason = got
             self.by_line[i] = rules
-            reason = m.group(2) or ""
             if not re.search(r"\w", reason):
                 self.bare.append(i)
 
@@ -99,7 +124,7 @@ def _const_int(node: ast.AST, env: Dict[str, int]) -> Optional[int]:
 class ModuleInfo:
     """One parsed module: tree + suppressions + imports + constants."""
 
-    def __init__(self, path: str, src: str):
+    def __init__(self, path: str, src: str, tool: str = "mpiracer"):
         self.path = path
         self.relp = rel_path(path)
         # dotted name inside the package ("ompi_tpu.pml.ob1")
@@ -109,7 +134,7 @@ class ModuleInfo:
         self.dotted = "ompi_tpu." + dotted.replace("/", ".") \
             if dotted else "ompi_tpu"
         self.src = src
-        self.suppress = Suppressions(src)
+        self.suppress = Suppressions(src, tool)
         self.parse_error: Optional[Tuple[int, str]] = None
         try:
             self.tree: Optional[ast.Module] = ast.parse(src, filename=path)
@@ -194,7 +219,7 @@ class Package:
         return self.by_dotted.get(dotted.rsplit(".", 1)[0])
 
 
-def load_package(paths: List[str]) -> Package:
+def load_package(paths: List[str], tool: str = "mpiracer") -> Package:
     """Parse files and/or directory trees into a Package."""
     files: List[str] = []
     for p in paths:
@@ -208,10 +233,10 @@ def load_package(paths: List[str]) -> Package:
     mods = []
     for f in files:
         with open(f, encoding="utf-8") as fh:
-            mods.append(ModuleInfo(f, fh.read()))
+            mods.append(ModuleInfo(f, fh.read(), tool))
     return Package(mods)
 
 
-def load_source(src: str, path: str) -> Package:
+def load_source(src: str, path: str, tool: str = "mpiracer") -> Package:
     """Single-source package (self-test and unit tests)."""
-    return Package([ModuleInfo(path, src)])
+    return Package([ModuleInfo(path, src, tool)])

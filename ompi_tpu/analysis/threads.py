@@ -97,6 +97,27 @@ APP_ENTRY_MODULES = (
     "reshard/elastic.py",
     "osc/window.py",
     "io/file.py",
+    # PR 15 serving surface: harness steps, traffic pacing, churn
+    # verdicts are all driven from the app thread
+    "serve/harness.py",
+    "serve/traffic.py",
+    "serve/churn.py",
+)
+
+# Entries the serving/qos harnesses run on DAEMON THREADS beside the
+# app thread (the PR 15 storm/sink closures): a daemon thread is "some
+# thread that is not the app thread", which is exactly what the PROG
+# label models, so these seed PROG — state they share with the app
+# surface gets both labels and is race-checked instead of being
+# mislabeled app-only. Curated per (module, class, method) so a
+# generic name cannot be seeded package-wide; `None` for the class
+# matches module-level functions. NOTE: TrafficGen.run is NOT here on
+# purpose — the harness and the procmode checks call `gen.run(...)`
+# inline on the main thread (only the storm/sink closures around it
+# are daemons), so seeding it PROG would falsely dual-label the whole
+# collective stack it drives.
+DAEMON_ENTRY_FNS = (
+    ("ft/diskless.py", None, "_ship"),  # qos storm/sink blob shippers
 )
 
 # Registration calls whose fn argument becomes a progress-thread root.
@@ -700,6 +721,15 @@ def _seed_and_propagate(model: Model) -> None:
                     prog.append(f)
     for fi in prog:
         fi.label |= PROG
+
+    # daemon-thread entries: the PR 15 storm/sink shippers and
+    # TrafficGen's paced loop run on threading.Thread daemons while the
+    # app thread keeps stepping — seed them PROG ("not the app thread")
+    # so state they share with the app surface carries both labels
+    for relp, cls, name in DAEMON_ENTRY_FNS:
+        for fi in model.fns.values():
+            if fi.mod.relp == relp and fi.name == name and fi.cls == cls:
+                fi.label |= PROG
 
     # BFS per label
     edges: Dict[str, List[FnInfo]] = {}

@@ -1278,7 +1278,7 @@ class TcpBtl(Btl):
             self._adopt_legacy_rbuf(conn)
         buf = conn.rxb
         if buf is None:
-            buf = conn.rxb = _rx_pool.acquire()
+            buf = conn.rxb = _rx_pool.acquire()  # owns: rxb
             conn.rstart = conn.rend = 0
         if conn.rend == len(buf):
             # no room left: slide the parked partial frame to the
@@ -1294,7 +1294,14 @@ class TcpBtl(Btl):
                     total = _LEN.unpack_from(buf, 0)[0] & _LEN_MASK
                 nbuf = bytearray(max(4 + total, 2 * len(buf)))
                 nbuf[:pending] = buf
-                _rx_pool.release(buf)
+                # only a pool-sized block goes back: regrowing an
+                # ALREADY-grown buffer (a second jumbo outgrowing the
+                # first, or legacy-residue adoption that exactly filled
+                # its grown buffer) used to release the private
+                # bytearray here, spuriously decrementing the pool's
+                # outstanding count for a block it never handed out
+                if len(buf) == _RX_BLOCK:
+                    _rx_pool.release(buf)
                 conn.rxb = buf = nbuf
             _ctr["copied"] += pending
             conn.rstart, conn.rend = 0, pending
@@ -1328,7 +1335,7 @@ class TcpBtl(Btl):
             conn.last_rx = time.monotonic()
         conn.rend += n_in
         n = 0
-        mv = memoryview(buf)
+        mv = memoryview(buf)  # borrows: rxb
         off = conn.rstart
         end = conn.rend
         if conn.await_ack and end - off >= 4:
@@ -1379,7 +1386,7 @@ class TcpBtl(Btl):
             # advance below (re-delivering frames) and kill the
             # progress thread.
             try:
-                self.deliver(hdr, payload)
+                self.deliver(hdr, payload)  # mpiown: disable=escaping-view — the deliver is synchronous over this block; ob1's _owned gate copies any payload that must survive it
             except Exception:
                 self.log.exception("frame handler failed (frame dropped)")
             n += 1
@@ -1400,7 +1407,7 @@ class TcpBtl(Btl):
         single-drainer exclusivity."""
         pending = len(conn.rbuf)
         if conn.rxb is None:
-            conn.rxb = _rx_pool.acquire()
+            conn.rxb = _rx_pool.acquire()  # owns: rxb
             conn.rstart = conn.rend = 0
         live = conn.rend - conn.rstart
         if live + pending > len(conn.rxb):

@@ -352,7 +352,7 @@ class PersistPlan:
         while held:
             pool, block = held.pop()
             if recycle:
-                pool.release(block)
+                pool.release(block)  # mpiown: disable=recycle-on-failure — fail() always passes recycle=False; this arm is retire()'s clean-teardown path only
             else:
                 pool.discard(block)
 
@@ -375,8 +375,17 @@ class _Builder:
         if pool is None:
             return np.empty(max(nbytes, 1), dtype=np.uint8)[:nbytes]
         blk, _ = pool.acquire_pair()
-        self.held.append((pool, blk))
+        self.held.append((pool, blk))  # owns: held
         return np.frombuffer(blk, np.uint8, nbytes)
+
+    def abort(self) -> None:
+        """Settle every held block when a builder bails AFTER acquiring
+        staging (the non-commutative allreduce's bcast-leg fallback):
+        recycle is safe — the blocks were never exposed to a Start, so
+        no drain can be in flight into them."""
+        while self.held:
+            pool, blk = self.held.pop()
+            pool.release(blk)
 
     def rnd(self, sends: Sequence = (), recvs: Sequence = (),
             ordered: bool = True, wait: bool = False,
@@ -673,6 +682,10 @@ def _b_allreduce(comm, sendbuf, recvbuf, op):
         # recvbuf the bcast re-reads)
         sub = _b_bcast(comm, recvbuf, 0)
         if sub is None:
+            # the reduce leg already acquired fan-in staging into
+            # b.held; falling back without settling it leaked those
+            # blocks for process life (outstanding never decremented)
+            b.abort()
             return None
         bb, _ = sub
         b.steps.extend(bb.steps)

@@ -291,7 +291,7 @@ class _RoundState:
         if hit:
             _bump("pool_hits")
         view = np.frombuffer(block, np.uint8, nbytes)
-        self._held[id(view)] = (pool, block, view)
+        self._held[id(view)] = (pool, block, view)  # owns: _held
         return view
 
     def free(self, views) -> None:

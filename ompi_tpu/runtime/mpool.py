@@ -198,13 +198,17 @@ class BufferPool:
 
     def release(self, block) -> None:
         """Recycle a block. Only call when the caller can prove sole
-        ownership — a recycled block is handed to the next acquire."""
+        ownership — a recycled block is handed to the next acquire.
+        Parking is gated on an actual settle: a release with nothing
+        outstanding (a double-settle reaching the runtime despite the
+        static gate) must not park the same object twice and hand one
+        block to two acquirers."""
         with self._plock:
             if self.outstanding > 0:
                 self.outstanding -= 1
-            if len(block) == self.block_size and \
-                    len(self._free) < self.max_free:
-                self._free.append(block)
+                if len(block) == self.block_size and \
+                        len(self._free) < self.max_free:
+                    self._free.append(block)
 
     def discard(self, block) -> None:
         """Account a block as gone WITHOUT recycling it: teardown paths

@@ -56,8 +56,9 @@ def _load_everything() -> None:
     import ompi_tpu.runtime.forensics  # stall-forensics cvars + forensics_* pvars
     import ompi_tpu.serve  # elastic serving: serve_* SLO/RTO/admission cvars + pvars
     # (btl/tcp.py above also carries the btl_tcp_shape_* scheduler knobs)
-    # mpilint/mpiracer (ompi_tpu/analysis/) are build-time gates by
-    # design: they register no cvars/pvars, so there is nothing to load
+    # mpilint/mpiracer/mpiown (ompi_tpu/analysis/) are build-time gates
+    # by design: they register no cvars/pvars, so there is nothing to
+    # load
 
 
 def print_header(out) -> None:

@@ -285,6 +285,83 @@ def test_datapath_cvars_and_pvars_registered():
         assert name in pvars, name
 
 
+def test_class_pool_park_budget_caps_big_classes():
+    """The free list keeps at most _CLASS_PARK_BYTES of parked BYTES
+    per class (not max_free blocks): a burst of jumbo-class recvs must
+    not pin max_free * 8 MiB of idle memory for process lifetime."""
+    from ompi_tpu.runtime import mpool
+
+    cls = 1 << 23  # 8 MiB class: budget allows 4 parked, not 8
+    pool = mpool.class_pool(cls)
+    want = max(1, min(8, mpool._CLASS_PARK_BYTES // cls))
+    assert pool.max_free == want == 4
+    blocks = [pool.acquire() for _ in range(6)]
+    base_free = 0  # parked beyond the budget is the bug being pinned
+    for b in blocks:
+        pool.release(b)
+    assert len(pool._free) == min(base_free + 6, pool.max_free)
+    assert pool.outstanding == 0
+    pool._free.clear()  # do not pin 32 MiB across the rest of the run
+
+
+def test_pool_discard_accounts_without_recycling():
+    """discard settles the accounting pvars exactly like release but
+    never parks the block: a teardown path racing an in-flight reader
+    must not let the pool hand that block to someone else."""
+    from ompi_tpu.mca.var import all_pvars
+    from ompi_tpu.runtime import mpool
+
+    pool = mpool.BufferPool(4096, max_free=4)
+    try:
+        pv = all_pvars()
+        blocks0 = pv["mpool_pool_blocks"].value
+        bytes0 = pv["mpool_pool_bytes"].value
+        blk = pool.acquire()
+        assert pv["mpool_pool_blocks"].value == blocks0 + 1
+        assert pv["mpool_pool_bytes"].value == bytes0 + 4096
+        pool.discard(blk)
+        # accounted as gone...
+        assert pv["mpool_pool_blocks"].value == blocks0
+        assert pv["mpool_pool_bytes"].value == bytes0
+        # ...and NOT recycled: the next acquire allocates fresh
+        assert pool._free == []
+        nxt, hit = pool.acquire_pair()
+        assert hit is False
+        assert nxt is not blk
+        pool.release(nxt)
+    finally:
+        pool.close()
+
+
+def test_acquire_pair_settles_exactly_once():
+    """One acquire_pair, one settle: a second settle of the same block
+    (the mpiown double-settle class) must not drive outstanding
+    negative or double-park the block."""
+    from ompi_tpu.runtime import mpool
+
+    pool = mpool.BufferPool(1024, max_free=4)
+    try:
+        a, hit_a = pool.acquire_pair()
+        assert hit_a is False and pool.misses == 1
+        assert pool.outstanding == 1
+        pool.release(a)
+        assert pool.outstanding == 0
+        assert len(pool._free) == 1
+        # the buggy second settle: accounting must clamp, not corrupt —
+        # the same object parked twice would hand one block to TWO
+        # acquirers
+        pool.release(a)
+        assert pool.outstanding == 0
+        assert len(pool._free) == 1
+        b, hit_b = pool.acquire_pair()
+        assert hit_b is True and pool.hits == 1
+        assert b is a
+        pool.discard(b)
+        assert pool.outstanding == 0
+    finally:
+        pool.close()
+
+
 def test_info_cli_lists_datapath_surface(capsys):
     from ompi_tpu.tools.info import main as info_main
 

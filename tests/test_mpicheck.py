@@ -1,0 +1,90 @@
+"""mpicheck: the umbrella runner over every static gate.
+
+Tier-1 keeps the individual gates (test_mpilint / test_mpiracer /
+test_mpiown / the trace-schema checks); this file covers only the
+umbrella's own contracts — check routing, the --fast subset, the
+merged JSON shape, and the worst-of exit code.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools import mpicheck  # noqa: E402
+
+
+def _run(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.mpicheck", *args],
+        cwd=cwd, capture_output=True, text=True)
+
+
+def test_full_run_is_clean_and_covers_every_tree_gate():
+    r = _run()
+    assert r.returncode == 0, r.stdout + r.stderr
+    for name in ("mpilint", "mpiracer", "mpiown"):
+        assert f"{name}: OK" in r.stdout, r.stdout
+    # no trace args -> no trace_lint line
+    assert "trace_lint" not in r.stdout
+
+
+def test_fast_subset_skips_the_call_graph_pass():
+    r = _run("--fast")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "mpilint: OK" in r.stdout
+    assert "mpiown: OK" in r.stdout
+    assert "mpiracer" not in r.stdout
+
+
+def test_json_args_route_to_trace_lint(tmp_path):
+    bad = tmp_path / "trace.json"
+    bad.write_text(json.dumps({"traceEvents": [
+        {"ph": "B", "name": "x", "ts": 0, "pid": 1, "tid": 1},
+    ]}))  # B never closed: a trace-schema finding
+    r = _run("--fast", str(bad))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "trace_lint:" in r.stderr
+    assert "[trace-schema]" in r.stderr
+    # the tree gates still ran and stayed clean
+    assert "mpilint: OK" in r.stdout
+
+
+def test_merged_json_doc_keys_findings_by_check(tmp_path):
+    bad = tmp_path / "trace.json"
+    bad.write_text("not json at all")
+    r = _run("--fast", "--json", str(bad))
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["clean"] is False
+    assert set(doc["checks"]) == {"mpilint", "mpiown", "trace_lint"}
+    assert doc["checks"]["mpilint"]["clean"] is True
+    assert doc["checks"]["trace_lint"]["clean"] is False
+    # the flattened list carries the originating check per finding
+    assert any(f["check"] == "trace_lint" for f in doc["findings"])
+
+
+def test_worst_of_exit_code_over_a_dirty_tree(tmp_path):
+    pkg = tmp_path / "ompi_tpu" / "btl"
+    pkg.mkdir(parents=True)
+    (pkg / "x.py").write_text(
+        "def go(pool):\n    block = pool.acquire()\n")  # mpiown leak
+    r = _run("--fast", str(tmp_path / "ompi_tpu"))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "[pool-leak]" in r.stderr
+    assert "mpilint: OK" in r.stdout  # the clean gates still report OK
+
+
+def test_missing_path_is_a_usage_error():
+    r = _run("no/such/dir")
+    assert r.returncode == 2
+
+
+def test_run_checks_api_orders_and_labels():
+    checks = mpicheck.run_checks(
+        [os.path.join(REPO, "ompi_tpu")], [], fast=True)
+    assert sorted(checks) == ["mpilint", "mpiown"]
+    assert all(fs == [] for fs in checks.values())

@@ -75,6 +75,38 @@ def test_suppression_of_wrong_rule_does_not_silence():
     assert [f.rule for f in got] == ["raw-environ"]
 
 
+def test_multi_rule_suppression_applies_every_rule():
+    """The shared-grammar fix: the old local regex was greedy, so a
+    two-rule list with an ASCII ``--`` justification separator
+    (``disable=a,b -- why``) swallowed the separator and the reason
+    into the rule names and only the FIRST rule actually applied."""
+    src = (
+        "import os\n"
+        "def f(x={}): return os.environ.get('X')"
+        "  # mpilint: disable=mutable-default,raw-environ -- fixture\n"
+    )
+    assert lint.lint_source(src, "ompi_tpu/coll/basic.py") == []
+    # suppressing only the first still fires the second
+    one = src.replace(",raw-environ", "")
+    got = lint.lint_source(one, "ompi_tpu/coll/basic.py")
+    assert [f.rule for f in got] == ["raw-environ"]
+
+
+def test_multi_rule_suppression_whitespace_and_separator_variants():
+    base = (
+        "import os\n"
+        "def f(x={{}}): return os.environ.get('X')"
+        "  # mpilint: disable={rules} {sep} fixture\n"
+    )
+    for rules in ("mutable-default,raw-environ",
+                  "mutable-default, raw-environ",
+                  "raw-environ , mutable-default"):
+        for sep in ("—", "--", ":"):
+            src = base.format(rules=rules, sep=sep)
+            assert lint.lint_source(
+                src, "ompi_tpu/coll/basic.py") == [], (rules, sep)
+
+
 # ------------------------------------------------------- individual rules
 def test_hot_guard_accepts_guard_variable_assignment():
     """progress.py's `tracing = _trace.enabled()` idiom must pass."""

@@ -112,6 +112,24 @@ def test_bare_suppression_is_itself_a_finding():
     assert [f.rule for f in got] == ["bare-suppression"]
 
 
+def test_multi_rule_suppression_with_ascii_separator():
+    """The shared pkgmodel grammar parses a two-rule list with the
+    ASCII `--` separator — both rules apply, and the justification
+    counts (not bare)."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def lk(self):\n"
+        "        with self._lock:\n"
+        "            self._n = 1\n"
+        "    def unl(self):\n"
+        "        self._n = 2"
+        "  # mpiracer: disable=lock-discipline,cross-thread-race"
+        " -- fixture\n"
+    )
+    assert mpiracer.analyze_source(src, "ompi_tpu/coll/basic.py") == []
+
+
 def test_wrong_rule_suppression_does_not_silence():
     src = (
         "import threading\n"
@@ -421,6 +439,54 @@ def test_idle_blocks_pvar_bump_is_locked_and_counts():
     got = threads.analyze_paths(
         [os.path.join(PKG, "runtime", "progress.py")])
     assert not any(f.rule == "cross-thread-race" for f in got), got
+
+
+def test_serve_surface_and_daemon_entries_are_seeded():
+    """PR 17 fix: the PR 15 serving stack was invisible to the thread
+    reachability pass — serve/* was in no entry list, and the qos
+    storm/sink daemon threads enter the package through the PRIVATE
+    ft/diskless._ship (private names are never APP-seeded), so none of
+    the state they touch was race-checked. serve/* now seeds APP and
+    _ship is a curated daemon (PROG) entry. TrafficGen.run stays
+    app-only ON PURPOSE: the harness and the procmode checks call
+    gen.run(...) inline on the main thread — only the storm/sink
+    closures around it are daemons — and PROG-seeding it would falsely
+    dual-label the whole collective stack it drives."""
+    for relp in ("serve/harness.py", "serve/traffic.py",
+                 "serve/churn.py"):
+        assert relp in threads.APP_ENTRY_MODULES, relp
+    assert ("ft/diskless.py", None, "_ship") in threads.DAEMON_ENTRY_FNS
+    model = threads.build_model(pkgmodel.load_package([PKG]))
+    threads._seed_and_propagate(model)
+    ship = model.fns["ft/diskless.py::_ship"]
+    assert ship.label & threads.PROG          # the daemon side
+    assert ship.label & threads.APP           # commit/save app callers
+    run = model.fns["serve/traffic.py::TrafficGen.run"]
+    assert run.label & threads.APP
+    assert not run.label & threads.PROG       # main-thread caller only
+    su = model.fns["serve/harness.py::ServingHarness.serve_until"]
+    assert su.label & threads.APP
+
+
+def test_daemon_entry_convention_is_class_scoped(monkeypatch):
+    """A (module, None, name) daemon entry matches the module-level
+    function only — a same-named method is untouched (and vice versa),
+    so a generic name cannot be seeded package-wide."""
+    src = (
+        "class A:\n"
+        "    def go(self):\n"
+        "        pass\n"
+        "def go():\n"
+        "    pass\n"
+    )
+    monkeypatch.setattr(threads, "DAEMON_ENTRY_FNS",
+                        (("ft/x.py", None, "go"),))
+    model = threads.build_model(
+        pkgmodel.load_source(src, "ompi_tpu/ft/x.py"))
+    threads._seed_and_propagate(model)
+    labels = {f.qual: f.label for f in model.fns.values()}
+    assert labels["ft/x.py::go"] & threads.PROG
+    assert not labels["ft/x.py::A.go"] & threads.PROG
 
 
 def test_qos_cache_invalidation_rebinds_atomically():

@@ -1,0 +1,128 @@
+"""mpicheck — the umbrella correctness-tooling runner.
+
+One command over every static gate the tree carries::
+
+    python -m tools.mpicheck                    # all gates over ompi_tpu/
+    python -m tools.mpicheck --fast             # skip the slow call-graph pass
+    python -m tools.mpicheck --json             # one merged machine doc
+    python -m tools.mpicheck trace-rank0.json   # .json args go to trace_lint
+
+Gates (each keeps its own standalone CLI and its own tier-1 test —
+mpicheck is a convenience front end, not a replacement):
+
+- ``mpilint``   — project contracts (hot-guard, cvar-once, hot-copy, ...)
+- ``mpiracer``  — lock discipline / cross-thread races / wire protocol
+- ``mpiown``    — buffer ownership & zero-copy lifetimes
+- ``trace_lint``— Chrome-trace schema + causal edge keys, for any
+  ``.json`` positional argument (skipped when none are given)
+
+``--fast`` runs mpilint + mpiown (+ trace_lint on .json args) and skips
+mpiracer, whose whole-package call-graph build and per-label BFS
+dominate the wall clock — the subset for an edit-compile loop; CI runs
+the full set.
+
+Exit status is the worst across the gates: 0 = every gate clean,
+1 = findings somewhere, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ompi_tpu.analysis.report import Finding, format_finding  # noqa: E402
+from ompi_tpu.analysis import lint as _lint  # noqa: E402
+from tools import mpiown as _mpiown  # noqa: E402
+from tools import mpiracer as _mpiracer  # noqa: E402
+from tools import trace_lint as _trace_lint  # noqa: E402
+
+
+def _default_tree() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ompi_tpu")
+
+
+def run_checks(tree_paths: List[str], trace_paths: List[str],
+               fast: bool = False) -> Dict[str, List[Finding]]:
+    """Every gate's findings keyed by gate name. ``fast`` skips
+    mpiracer; trace_lint runs only over ``trace_paths``."""
+    checks: Dict[str, List[Finding]] = {}
+    checks["mpilint"] = _lint.lint_paths(tree_paths)
+    if not fast:
+        checks["mpiracer"] = _mpiracer.analyze_paths(tree_paths)
+    checks["mpiown"] = _mpiown.analyze_paths(tree_paths)
+    if trace_paths:
+        got: List[Finding] = []
+        for p in trace_paths:
+            got.extend(_trace_lint.lint_file(p))
+        checks["trace_lint"] = got
+    return checks
+
+
+def _to_json(checks: Dict[str, List[Finding]]) -> str:
+    def enc(f: Finding) -> dict:
+        return {"rule": f.rule, "path": f.path, "line": f.line,
+                "severity": f.severity, "message": f.message,
+                "hint": f.hint}
+
+    merged = [dict(enc(f), check=name)
+              for name, fs in checks.items() for f in fs]
+    return json.dumps({
+        "checks": {name: {"findings": [enc(f) for f in fs],
+                          "clean": not fs}
+                   for name, fs in checks.items()},
+        "findings": merged,
+        "clean": not merged,
+    }, indent=2)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mpicheck",
+        description="umbrella runner: mpilint + mpiracer + mpiown "
+                    "(+ trace_lint for .json args), worst-of exit code")
+    ap.add_argument("paths", nargs="*",
+                    help="package files/dirs and/or trace .json files "
+                         "(default tree: the ompi_tpu package next to "
+                         "this tool)")
+    ap.add_argument("--fast", action="store_true",
+                    help="skip mpiracer (the slow whole-package "
+                         "call-graph pass) — the edit-loop subset; CI "
+                         "runs everything")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one merged JSON doc (per-check and "
+                         "flattened findings); exit codes unchanged")
+    opts = ap.parse_args(argv)
+
+    trace_paths = [p for p in opts.paths if p.endswith(".json")]
+    tree_paths = [p for p in opts.paths if not p.endswith(".json")]
+    if not tree_paths:
+        tree_paths = [_default_tree()]
+    for p in tree_paths + trace_paths:
+        if not os.path.exists(p):
+            print(f"mpicheck: no such path: {p}", file=sys.stderr)
+            return 2
+
+    checks = run_checks(tree_paths, trace_paths, fast=opts.fast)
+
+    if opts.json:
+        print(_to_json(checks))
+    else:
+        for name, fs in checks.items():
+            for f in fs:
+                print(f"{name}: {format_finding(f)}", file=sys.stderr)
+            if not fs:
+                print(f"{name}: OK")
+    n_err = sum(1 for fs in checks.values() for f in fs
+                if f.severity == "error")
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,208 @@
+"""mpiown CLI — static buffer-ownership & zero-copy lifetime analysis.
+
+Thin wrapper over ``ompi_tpu.analysis.ownership`` (pool-block
+obligation tracking over the shared pkgmodel substrate). Shares the
+Finding/reporter/exit-code format with mpilint and mpiracer::
+
+    python -m tools.mpiown [PATH ...]     # default: ompi_tpu/
+    python -m tools.mpiown --self-test    # every rule vs a bad snippet
+    python -m tools.mpiown --list-rules
+    python -m tools.mpiown --json
+
+Annotations: ``# owns: <attr>`` on an acquiring/storing statement
+declares the block's owning attribute; ``# borrows: <name>`` declares a
+read-only send view. Suppression:
+``# mpiown: disable=<rule>[,<rule>...] — justification`` on the
+offending line. The justification is REQUIRED: a bare ``disable=``
+raises the unsuppressable ``bare-suppression`` finding.
+
+``--self-test`` additionally runs the derive-parity check over the real
+tree: every module the ownership inference conventions match must be in
+the curated ``OWNERSHIP_MODULES`` record and the swept set — no
+hand-list that rots (the mpilint auto-derive lesson).
+
+Exit status: 0 = clean, 1 = findings (including the expected seeded
+violations under --self-test), 2 = usage error, a rule that failed to
+fire in --self-test, or a derive-parity break.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ompi_tpu.analysis.report import Finding, format_finding, report  # noqa: E402
+from ompi_tpu.analysis import ownership as _ownership  # noqa: E402
+from ompi_tpu.analysis import pkgmodel as _pkgmodel  # noqa: E402
+
+COMMON_RULES: Dict[str, str] = {
+    "bare-suppression": "every mpiown suppression carries a "
+                        "justification after the rule list",
+    "parse-error": "every analyzed file must parse (a broken file "
+                   "would silently escape every other rule)",
+}
+
+RULES: Dict[str, str] = {**_ownership.RULES, **COMMON_RULES}
+
+COMMON_SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
+    "bare-suppression": ("ompi_tpu/coll/basic.py", """
+def run(pool):
+    block = pool.acquire()
+    pool.release(block)
+    pool.release(block)  # mpiown: disable=double-settle
+"""),
+    "parse-error": ("ompi_tpu/coll/basic.py", """
+def broken(:
+    return
+"""),
+}
+
+SELF_TEST_SNIPPETS: Dict[str, Tuple[str, str]] = {
+    **_ownership.SELF_TEST_SNIPPETS,
+    **COMMON_SELF_TEST_SNIPPETS,
+}
+
+
+def _common_findings(pkg: _pkgmodel.Package) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in pkg.modules.values():
+        if mod.parse_error is not None:
+            line, msg = mod.parse_error
+            findings.append(Finding("parse-error", mod.path, line,
+                                    f"unparseable file: {msg}"))
+            continue
+        for line in mod.suppress.bare:
+            findings.append(Finding(
+                "bare-suppression", mod.path, line,
+                "mpiown suppression without a justification — the "
+                "rule list must be followed by the reason the "
+                "violation is intentional",
+                hint="append `— <why this is safe>` after the rules"))
+    return findings
+
+
+def analyze_package(pkg: _pkgmodel.Package) -> List[Finding]:
+    findings = _common_findings(pkg)
+    findings += _ownership.analyze_package(pkg)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def analyze_paths(paths: List[str]) -> List[Finding]:
+    return analyze_package(
+        _pkgmodel.load_package(paths, tool=_ownership.TOOL))
+
+
+def analyze_source(src: str, path: str) -> List[Finding]:
+    return analyze_package(
+        _pkgmodel.load_source(src, path, tool=_ownership.TOOL))
+
+
+def _real_tree() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ompi_tpu")
+
+
+def self_test() -> Tuple[List[Finding], List[str], List[str]]:
+    """Analyze every embedded bad snippet and check derive parity over
+    the real tree. Returns (all findings, rule ids that FAILED to fire,
+    parity failure messages)."""
+    findings: List[Finding] = []
+    missed: List[str] = []
+    for rule, (fake_path, src) in SELF_TEST_SNIPPETS.items():
+        got = analyze_source(src, fake_path)
+        findings.extend(got)
+        if not any(f.rule == rule for f in got):
+            missed.append(rule)
+    parity: List[str] = []
+    pkg = _pkgmodel.load_package([_real_tree()], tool=_ownership.TOOL)
+    missing, unlisted = _ownership.derive_parity(pkg)
+    for relp in sorted(missing):
+        parity.append(
+            f"derive-parity: OWNERSHIP_MODULES entry '{relp}' is no "
+            "longer matched by the inference conventions (or left the "
+            "swept set) — coverage silently shrank")
+    for relp in sorted(unlisted):
+        parity.append(
+            f"derive-parity: module '{relp}' has pool traffic the "
+            "conventions match but is missing from OWNERSHIP_MODULES — "
+            "record it so the sweep set cannot rot")
+    return findings, missed, parity
+
+
+def _to_json(findings: List[Finding]) -> str:
+    return json.dumps({
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "severity": f.severity, "message": f.message,
+             "hint": f.hint}
+            for f in findings
+        ],
+        "clean": not findings,
+    }, indent=2)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mpiown",
+        description="static buffer-ownership / zero-copy lifetime "
+                    "analysis for the ompi_tpu datapath")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the ompi_tpu "
+                         "package next to this tool)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="analyze the embedded bad snippet for every "
+                         "rule and run the derive-parity check; exits "
+                         "1 when all rules correctly fire on the "
+                         "seeded violations, 2 when any rule is "
+                         "silent or parity breaks")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule ids and contracts")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON on stdout; exit codes "
+                         "unchanged")
+    opts = ap.parse_args(argv)
+
+    if opts.list_rules:
+        width = max(len(r) for r in RULES)
+        for rule, desc in RULES.items():
+            print(f"{rule:<{width}}  {desc}")
+        return 0
+
+    if opts.self_test:
+        findings, missed, parity = self_test()
+        for f in findings:
+            print(format_finding(f), file=sys.stderr)
+        for rule in missed:
+            print(f"SELF-TEST FAIL: rule '{rule}' did not fire on its "
+                  "seeded violation", file=sys.stderr)
+        for msg in parity:
+            print(f"SELF-TEST FAIL: {msg}", file=sys.stderr)
+        if missed or parity:
+            return 2
+        print(f"self-test: all {len(SELF_TEST_SNIPPETS)} rules fired "
+              f"({len(findings)} seeded findings); derive parity holds "
+              f"over {len(_ownership.OWNERSHIP_MODULES)} datapath "
+              "modules")
+        return 1 if findings else 2
+
+    paths = opts.paths or [_real_tree()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"mpiown: no such path: {p}", file=sys.stderr)
+            return 2
+    findings = analyze_paths(paths)
+    if opts.json:
+        print(_to_json(findings))
+        return 1 if any(f.severity == "error" for f in findings) else 0
+    return report(findings, clean_paths=None if findings else paths)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
