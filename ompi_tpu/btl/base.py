@@ -35,6 +35,11 @@ class Btl:
     NAME = "base"
     eager_limit: Optional[int] = 65536
     NEEDS_POLL = True
+    #: link-reliability upcall (btl/tcp reconnect-and-replay): wireup
+    #: binds this to the pml's ``link_restored(rank)`` so a healed link
+    #: replays the pml's dead-letter stash for that peer. Transports
+    #: without link state never call it; None = no listener.
+    link_restored_cb: Optional[Callable[[int], None]] = None
 
     def __init__(self, deliver: Callable[[bytes, bytes], None]):
         # deliver(header_bytes, payload) — the PML's handle_incoming.

@@ -98,6 +98,29 @@ def on_failure(cb: Callable[[int], None]) -> None:
     _callbacks.append(cb)  # mpiracer: disable=cross-thread-race — GIL-atomic append at registration time; mark_failed iterates a list() snapshot
 
 
+def note_link_degraded(rank: int) -> None:
+    """Link-reliability grace seam (btl/tcp LINK_DEGRADED): while the
+    tcp link layer is inside its bounded redial window for ``rank``,
+    the heartbeat silence the outage itself causes must not convert
+    into a confirmed death — refresh the observed edge's clock so the
+    ring observer charges staleness from NOW, not from before the
+    blip. Called at degrade entry and on every link-timer tick while
+    the window is open, so a long redial keeps its grace; the link
+    layer's own escalation (budget blown -> mark_failed) keeps death
+    detection bounded by btl_tcp_link_deadline_s."""
+    ref = _live_hb[0]
+    det = ref() if ref is not None else None
+    if det is not None and det.observed == rank:
+        det.last_seen = time.monotonic()
+
+
+def note_link_restored(rank: int) -> None:
+    """Link healed (resync complete): reset the observed edge's
+    staleness so the outage tail is not charged against the next
+    heartbeat-timeout window."""
+    note_link_degraded(rank)
+
+
 class HeartbeatDetector:
     """Ring heartbeat: rank r observes (r-1) mod n and pings (r+1) mod n
     (reference topology: comm_ft_detector.c ring observation)."""

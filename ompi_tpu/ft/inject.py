@@ -27,11 +27,25 @@ is a wildcard)::
     drop(src, dst, frac=F)         drop outbound frames with prob. F
     drop(src, dst, nth=N)          drop every Nth frame
     delay(src, dst, ms=M)          sleep M ms before queuing a frame
-    sever(src, dst)                break the link: conn marked dead,
-                                   peer marked failed (the pml's
-                                   request-failing sweep on that mark
-                                   arms only with ft_enable)
+    sever(src, dst, after=N)       break the link on the Nth matching
+                                   frame (default the first): conn
+                                   marked dead, peer marked failed (the
+                                   pml's request-failing sweep on that
+                                   mark arms only with ft_enable)
     dup(src, dst, nth=N)           queue every Nth frame twice
+    corrupt(src, dst, nth=N|frac=F)  flip bits in the wire payload of
+                                   matching frames (reliable links CRC-
+                                   NACK and retransmit; legacy links
+                                   see the historical desync/_conn_failed)
+    sever_transient(src, dst,      break the link on the Nth matching
+            after=N, down_ms=M)    frame, then hold it DOWN for M ms —
+                                   redials fail (link_down) until the
+                                   window closes; drives the degraded->
+                                   reconnect-and-replay path
+    blackhole(src, dst, ms=M)      from the first matching frame, drop
+                                   ALL matching frames for M ms (a
+                                   silent wire stall: no reset, no EOF
+                                   — heals via retransmit timeout)
 
 Wire rules take an optional ``side=recv`` to apply at the receiver's
 deliver funnel instead of the sender's tcp enqueue. ``frac`` draws from
@@ -62,8 +76,11 @@ register_topic(
     "The ft_inject_plan cvar could not be parsed:\n  {error}\n"
     "Grammar: ';'-separated kill(rank,after=N) / preempt(rank,after=N,"
     "grace_ms=M) / drop(src,dst,frac=F|nth=N) / delay(src,dst,ms=M) / "
-    "sever(src,dst) / dup(src,dst,nth=N), optional side=recv on wire "
-    "rules ('*' = any rank).\n"
+    "sever(src,dst,after=N) / dup(src,dst,nth=N) / "
+    "corrupt(src,dst,nth=N|frac=F) / "
+    "sever_transient(src,dst,after=N,down_ms=M) / "
+    "blackhole(src,dst,ms=M), optional side=recv on drop/delay/dup "
+    "wire rules ('*' = any rank).\n"
     "Fix the plan or unset the cvar; injection refuses to start with "
     "a plan it cannot honor.")
 
@@ -72,9 +89,12 @@ _plan_var = register_var(
     help="Chaos plan: ';'-separated kill(rank,after=N) / "
          "preempt(rank,after=N,grace_ms=M) / "
          "drop(src,dst,frac=F|nth=N) / delay(src,dst,ms=M) / "
-         "sever(src,dst) / dup(src,dst,nth=N) actions applied at the "
+         "sever(src,dst,after=N) / dup(src,dst,nth=N) / "
+         "corrupt(src,dst,nth=N|frac=F) / "
+         "sever_transient(src,dst,after=N,down_ms=M) / "
+         "blackhole(src,dst,ms=M) actions applied at the "
          "btl wire and pml op-counter hooks (empty = injection off; "
-         "wire rules take side=recv to apply at the receiver)",
+         "drop/delay/dup take side=recv to apply at the receiver)",
     level=9)
 _seed_var = register_var(
     "ft", "inject_seed", 0,
@@ -87,8 +107,18 @@ log = get_logger("ft.inject")
 DROP = 1
 DUP = 2
 SEVER = 4
+CORRUPT = 8
+# rides SEVER for sever_transient: the outage is RECOVERABLE — a
+# reliability-engaged btl degrades-and-redials instead of killing the
+# link outright (plain sever keeps its permanent instant-death verdict
+# on every datapath, reliable or not)
+TRANSIENT = 16
 
-_WIRE_ACTIONS = ("drop", "delay", "sever", "dup")
+_WIRE_ACTIONS = ("drop", "delay", "sever", "dup", "corrupt",
+                 "sever_transient", "blackhole")
+# send-only wire actions: they act on the sender's connection/wire
+# bytes, which a receive-side deliver filter cannot reach
+_SEND_ONLY = ("sever", "sever_transient", "corrupt", "blackhole")
 _DIE_ACTIONS = ("kill", "preempt")  # victim-terminating op-counter rules
 
 
@@ -109,7 +139,7 @@ _enable_var = _LiveFlag()
 
 class _Rule:
     __slots__ = ("action", "src", "dst", "frac", "nth", "ms", "after",
-                 "side", "count", "rng", "fired_edges")
+                 "side", "count", "rng", "fired_edges", "until")
 
     def __init__(self, action: str, src: Optional[int], dst: Optional[int],
                  frac: Optional[float], nth: Optional[int],
@@ -124,6 +154,7 @@ class _Rule:
         self.side = side
         self.count = 0
         self.fired_edges = set()  # sever one-shot latch, per (src,dst)
+        self.until: Optional[float] = None  # blackhole window end (mono)
         # stable per-rule stream: identical across ranks and runs for a
         # given (plan position irrelevant) rule shape + seed
         key = zlib.crc32(f"{action}:{src}:{dst}:{frac}:{nth}".encode())
@@ -135,8 +166,11 @@ class _Rule:
             extra.append(f"frac={self.frac}")
         if self.nth is not None:
             extra.append(f"nth={self.nth}")
-        if self.action == "delay":
-            extra.append(f"ms={self.ms}")
+        if self.action in ("delay", "blackhole"):
+            extra.append(f"ms={self.ms:g}")
+        if self.action == "sever_transient":
+            extra.append(f"after={self.after}")
+            extra.append(f"down_ms={self.ms:g}")
         if self.action == "kill":
             return f"kill({self.src},after={self.after})"
         if self.action == "preempt":
@@ -155,6 +189,11 @@ _send_rules: List[_Rule] = []
 _recv_rules: List[_Rule] = []
 _my_rank: Optional[int] = None
 _faults: Dict[str, int] = {}
+# sever_transient down-windows: unordered edge -> monotonic end time.
+# Consulted by the tcp redial loop (link_down) so BOTH sides see the
+# outage for the full window — the severed conn plus every reconnect
+# attempt inside it — then heal together.
+_down_until: Dict[tuple, float] = {}
 
 register_pvar("ft", "injected_faults",
               lambda: sum(_faults.values()),
@@ -163,7 +202,7 @@ register_pvar("ft", "injected_faults",
                    "spc_ft_inject_* counters)")
 
 
-_ACTION_RE = re.compile(r"^\s*([a-z]+)\s*\(([^)]*)\)\s*$")
+_ACTION_RE = re.compile(r"^\s*([a-z_]+)\s*\(([^)]*)\)\s*$")
 
 
 def _parse_action(text: str, seed: int) -> _Rule:
@@ -219,19 +258,39 @@ def _parse_action(text: str, seed: int) -> _Rule:
     frac = float(kv.pop("frac")) if "frac" in kv else None
     nth = int(kv.pop("nth")) if "nth" in kv else None
     ms = float(kv.pop("ms", "0"))
+    after = 0
+    if action == "sever":
+        # optional Nth-frame gate (default: first matching frame), so a
+        # permanent sever can be placed mid-stream instead of landing
+        # on wireup traffic
+        after = max(int(kv.pop("after", "1")), 1)
+    if action == "sever_transient":
+        # Nth matching frame triggers the sever; down_ms rides the ms
+        # slot (the link stays DOWN — redials fail via link_down() —
+        # until the window closes)
+        after = max(int(kv.pop("after", "1")), 1)
+        ms = float(kv.pop("down_ms", "500"))
+        if ms <= 0:
+            raise ValueError(
+                f"ft_inject_plan: sever_transient needs down_ms>0 "
+                f"in {text!r}")
     if kv:
         raise ValueError(
             f"ft_inject_plan: unknown {action}() args {sorted(kv)}")
     if action == "drop" and frac is None and nth is None:
         frac = 1.0  # drop(src,dst) = drop everything on the edge
-    if action == "dup" and nth is None:
+    if action in ("dup", "corrupt") and frac is None and nth is None:
         nth = 1
     if action == "delay" and ms <= 0:
         raise ValueError(f"ft_inject_plan: delay needs ms=M in {text!r}")
-    if action == "sever" and side == "recv":
-        raise ValueError("ft_inject_plan: sever is send-side only "
-                         "(it kills the sender's connection)")
-    return _Rule(action, src, dst, frac, nth, ms, 0, side, seed)
+    if action == "blackhole" and ms <= 0:
+        raise ValueError(
+            f"ft_inject_plan: blackhole needs ms=M in {text!r}")
+    if action in _SEND_ONLY and side == "recv":
+        raise ValueError(
+            f"ft_inject_plan: {action} is send-side only (it acts on "
+            f"the sender's connection/wire bytes)")
+    return _Rule(action, src, dst, frac, nth, ms, after, side, seed)
 
 
 def parse_plan(text: str, seed: int = 0) -> List[_Rule]:
@@ -253,6 +312,7 @@ def install(plan: Optional[str] = None, seed: Optional[int] = None) -> None:
     if seed is None:
         seed = int(_seed_var._value or 0)
     rules = parse_plan(plan, seed)
+    _down_until.clear()  # mpiracer: disable=cross-thread-race — stale outage windows must not survive a re-arm; install() runs before the hooks it arms, so no wire thread races the clear
     _kill_rules = [r for r in rules if r.action in _DIE_ACTIONS]
     _send_rules = [r for r in rules
                    if r.action not in _DIE_ACTIONS and r.side == "send"]
@@ -267,7 +327,25 @@ def uninstall() -> None:
     global _kill_rules, _send_rules, _recv_rules
     _kill_rules, _send_rules, _recv_rules = [], [], []
     _faults.clear()
+    _down_until.clear()
     _enable_var._value = False
+
+
+def link_down(a: int, b: int) -> bool:
+    """True while a sever_transient down-window is open on the
+    unordered edge (a, b) — the tcp redial loop consults this so
+    reconnect attempts inside the outage fail like the real wire
+    would, instead of instantly reconnecting over loopback."""
+    if not _down_until:
+        return False
+    edge = (a, b) if a <= b else (b, a)
+    t = _down_until.get(edge)
+    if t is None:
+        return False
+    if time.monotonic() >= t:
+        del _down_until[edge]
+        return False
+    return True
 
 
 def note_rank(rank: int) -> None:
@@ -372,18 +450,47 @@ def wire_send(my_rank: int, peer: int) -> int:
         rule.count += 1
         if rule.action == "sever":
             # one-shot PER EDGE (a wildcard rule severs every matching
-            # link once): the first matching frame kills that
-            # connection; after that the dead-conn check raises on its
-            # own, and re-firing would inflate ft_injected_faults (one
-            # severed link = one fault) and re-run the btl's failure
-            # path per frame
-            if (my_rank, peer) not in rule.fired_edges:
+            # link once): the Nth matching frame (after=, default the
+            # first) kills that connection; after that the dead-conn
+            # check raises on its own, and re-firing would inflate
+            # ft_injected_faults (one severed link = one fault) and
+            # re-run the btl's failure path per frame
+            if (my_rank, peer) not in rule.fired_edges and \
+                    rule.count >= rule.after:
                 rule.fired_edges.add((my_rank, peer))
                 _fire(rule, my_rank, peer)
                 flags |= SEVER
         elif rule.action == "delay":
             _fire(rule, my_rank, peer)
             time.sleep(rule.ms / 1000.0)
+        elif rule.action == "sever_transient":
+            # like sever's one-shot-per-edge latch, but gated on the
+            # Nth matching frame, and the edge additionally enters a
+            # down-window during which link_down() holds redials off
+            if (my_rank, peer) not in rule.fired_edges and \
+                    rule.count >= rule.after:
+                rule.fired_edges.add((my_rank, peer))
+                edge = (my_rank, peer) if my_rank <= peer \
+                    else (peer, my_rank)
+                _down_until[edge] = time.monotonic() + rule.ms / 1000.0
+                _fire(rule, my_rank, peer)
+                flags |= SEVER | TRANSIENT
+        elif rule.action == "blackhole":
+            # silent outage: from the first matching frame, every
+            # matching frame vanishes for ms — no reset, no EOF, so
+            # only a retransmit timeout can notice. One fault counted
+            # per window (per-frame counts would make
+            # ft_injected_faults depend on send timing)
+            now = time.monotonic()
+            if rule.until is None:
+                rule.until = now + rule.ms / 1000.0
+                _fire(rule, my_rank, peer)
+            if now < rule.until:
+                flags |= DROP
+        elif rule.action == "corrupt":
+            if _hits(rule):
+                _fire(rule, my_rank, peer)
+                flags |= CORRUPT
         elif rule.action == "drop":
             if _hits(rule):
                 _fire(rule, my_rank, peer)

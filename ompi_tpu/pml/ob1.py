@@ -622,6 +622,33 @@ class Ob1Pml:
             self.dead_letter[dst] = frames
         raise exc
 
+    def link_restored(self, rank: int) -> None:
+        """Link-reliability upcall (wireup binds the btl's
+        ``link_restored_cb`` here): a degraded link to ``rank`` healed
+        through reconnect-and-replay — re-drive any dead-letter
+        backlog stashed for that peer while its transports looked
+        dead. A frame is popped only AFTER a transport accepts it; a
+        replay that dies mid-drain re-stashes the remainder OURS FIRST
+        (the stash is older than anything a concurrent sender stashed
+        meanwhile) instead of dropping acked frames."""
+        frames = self.dead_letter.pop(rank, None)  # mpiracer: disable=cross-thread-race — GIL-atomic claim of the whole backlog list, same discipline as _send_frame
+        if not frames:
+            return
+        self.log.info("link to rank %d restored: replaying %d "
+                      "dead-letter frame(s)", rank, len(frames))
+        try:
+            while frames:
+                qhdr, qpayload = frames[0]
+                self._send_frame(rank, qhdr, qpayload)
+                frames.pop(0)
+        except Exception:
+            self.dead_letter[rank] = frames + self.dead_letter.pop(  # mpiracer: disable=cross-thread-race — same GIL-atomic stash discipline as the dead-letter pops above; worst case a concurrent failover re-appends, and replay dedups by pml seq
+                rank, [])
+            self.log.warning(
+                "dead-letter replay to rank %d failed with %d "
+                "frame(s) left; re-stashed", rank, len(frames),
+                exc_info=True)
+
     # Lazy endpoint resolution for peers outside the initial add_procs
     # set (spawned jobs, connect/accept) — set by wireup (reference:
     # ob1's add_procs called again from dpm for dynamic processes).

@@ -158,6 +158,24 @@ def clip(seq, cap: int = CAP) -> List[Any]:
     return list(itertools.islice(iter(seq), cap))
 
 
+def _pending_readings() -> Dict[str, Any]:
+    """Every pending-work probe's instantaneous reading, per-probe
+    guarded: the dump answers "was anything in flight?" without the
+    reader re-deriving it from each subsystem's queue lists (the
+    probes are the SAME counters the sentinel polls, so a dump and
+    the sentinel verdict it explains can never disagree about what
+    counted as pending)."""
+    with _lock:
+        probes = dict(_pending_probes)
+    out: Dict[str, Any] = {}
+    for name, fn in sorted(probes.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:  # one sick probe must not sink the dump
+            out[name] = f"error: {type(e).__name__}: {e}"
+    return out
+
+
 def debug_state() -> Dict[str, Any]:
     """The uniform introspection surface: every provider's snapshot in
     one JSON-serializable document. A broken provider contributes an
@@ -460,6 +478,7 @@ def dump(reason: str = "on-demand", path: Optional[str] = None,
             "ts_ns": time.monotonic_ns(),  # mpisync-alignable clock
             "wall_time": time.time(),
             "stall": _sentinel.state(),
+            "pending": _pending_readings(),
             "subsystems": debug_state(),
         }
         if path is None:
@@ -561,7 +580,8 @@ _trigger_ts = [0.0]
 
 def trigger(reason: str) -> Optional[str]:
     """Auto-trigger entry for the existing failure verdicts (sanitizer
-    deadlock cycle, ob1 watchdog conversion, era agreement timeout):
+    deadlock cycle, ob1 watchdog conversion, era agreement timeout,
+    btl/tcp link escalation after a failed reconnect-and-replay):
     dump locally FIRST, then request peer dumps — unconditionally, so
     a rank whose own disk is unwritable still harvests every peer's
     evidence (only the rate limit, which means peers were asked

@@ -618,12 +618,19 @@ def snapshot() -> Dict[str, Any]:
             for e in _ewmas.values() if e.value is not None]
         samplers = dict(_samplers)
     sampled: Dict[str, Any] = {}
+    errors: Dict[str, str] = {}
     for name, fn in samplers.items():
         try:
             sampled[name] = fn()
-        except Exception:
-            pass
+        except Exception as e:
+            # a broken sampler must not sink the snapshot, but it must
+            # not vanish either: a missing key reads as "never
+            # registered" to mpitop, hiding the regression. Record the
+            # failure so the consumer can tell absent from broken.
+            errors[name] = f"{type(e).__name__}: {e}"
     out["samplers"] = sampled
+    if errors:
+        out["sampler_errors"] = errors
     return out
 
 

@@ -185,6 +185,15 @@ def init_process_mode():
 
     pml.endpoint_resolver = _resolve_endpoint
 
+    # link-reliability upcall: a tcp link healed by reconnect-and-replay
+    # tells the pml so its dead-letter stash for that peer re-drives
+    # (getattr: monitoring/vprotocol wrappers forward it; a pml without
+    # the seam simply leaves the btl callback unbound)
+    if tcp is not None:
+        _restored = getattr(pml, "link_restored", None)
+        if _restored is not None:
+            tcp.link_restored_cb = _restored
+
     for _, _, mod in modules:
         register_progress(mod.progress)
 

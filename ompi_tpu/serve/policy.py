@@ -116,14 +116,22 @@ class AdmissionGate:
         no recovery has started yet. ``wait`` (test seam) replaces the
         backoff sleep."""
         from ompi_tpu.ft import recovery as _recovery
+        from ompi_tpu.utils.backoff import Schedule
 
         waited = False
-        backoff_s = float(_backoff_var._value) / 1000.0
-        deadline = time.monotonic() + \
-            float(_max_wait_var._value) / 1000.0
+        # shared schedule object (utils/backoff): doubling from the
+        # base, capped at 64x, jittered so queued steps don't re-probe
+        # the recovery window in lockstep. No attempt budget — the
+        # deadline below is the only bound, and it is checked BEFORE
+        # the sleep so the ERR_PENDING diagnosis fires exactly at the
+        # hang budget rather than one backoff late.
+        sched = Schedule(
+            base_s=float(_backoff_var._value) / 1000.0,
+            cap_s=float(_backoff_var._value) / 1000.0 * 64.0,
+            deadline_s=float(_max_wait_var._value) / 1000.0)
         while _recovery.recovering():
             waited = True
-            if time.monotonic() > deadline:
+            if sched.expired():
                 # ERR_PENDING, deliberately NOT a survivable failure
                 # code: the window being stuck open means a recover()
                 # is already in flight on this process — classifying
@@ -136,12 +144,11 @@ class AdmissionGate:
                     "admission gate: recovery window still open past "
                     f"serve_admission_max_wait_ms "
                     f"({float(_max_wait_var._value):.0f}ms)")
+            delay = sched.next_delay()
             if wait is not None:
                 wait()
-            else:
-                time.sleep(backoff_s)
-            backoff_s = min(backoff_s * 2.0,
-                            float(_backoff_var._value) / 1000.0 * 64.0)
+            elif delay:
+                time.sleep(delay)
         if waited:
             _ctr["queued"] += 1
         comm = self.comm

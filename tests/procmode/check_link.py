@@ -1,0 +1,230 @@
+"""Link-reliability scenarios: the btl_tcp self-healing datapath
+(CRC-verified, ack'd-retransmit framing with reconnect-and-replay)
+exercised by deterministic fault injection, selected by argv[1].
+
+``transient`` — 2 ranks, plan severs the established 0 -> 1 link on the
+    Nth frame and holds it DOWN for a window
+    (``sever_transient(0,1,after=N,down_ms=M)``). The link DEGRADES on
+    both sides (send failure on 0, EOF on 1), the lower rank redials
+    through the down-window with backoff, the resync handshake replays
+    the retained tail, and the ping-pong stream + final allreduce
+    complete bitwise-equal with ZERO failed ranks. The
+    btl_tcp_link_recoveries pvar accounts for the heal.
+
+``corrupt`` — 2 ranks, every 2nd wire frame 0 -> 1 is bit-flipped in
+    flight (``corrupt(0,1,nth=2)``). The receiver's CRC32 rejects each
+    mangled frame and NACKs; the sender retransmits the retained
+    original (retransmits bypass injection — they model the
+    good-on-rewire case). Stream stays exact, crc_errors/retransmits
+    pvars account for every reject, zero failed ranks.
+
+``sever`` — permanent sever (``sever(0,1,after=N)``): on a reliable
+    link this skips the degrade window on the sending side and falls
+    through to the pre-reliability failure path immediately; the
+    peer's side exhausts its redial budget (shrunk via
+    btl_tcp_link_deadline_s) and escalates too. Both ranks see
+    ERR_PROC_FAILED within the budget — bounded, not a hang.
+
+``legacy`` — btl_tcp_reliable=0 baseline: the same traffic rides the
+    pre-reliability wire format (no envelope, no acks — the A/B
+    leg); every link pvar must read zero.
+
+``interop`` — mixed fleet: rank 1 disables the feature before init,
+    rank 0 keeps the default. The handshake negotiates DOWN to plain
+    framing (both sides must advertise), traffic stays correct, and
+    the reliable-capable rank records no link activity.
+
+Reference analogs: the BTL failover tests of opal/mca/btl/tcp and the
+ftagree fault-injection hooks.
+"""
+
+import faulthandler
+import os
+import signal as _signal
+import sys
+import time
+
+import numpy as np
+
+ITERS = 30
+
+
+def _ping_pong(comm, r):
+    """Deterministic numbered stream + an exactness witness."""
+    buf = np.zeros(8, np.int64)
+    for i in range(ITERS):
+        if r == 0:
+            comm.Send(np.full(8, 1000 + i, np.int64), dest=1, tag=i)
+            comm.Recv(buf, source=1, tag=i)
+            assert buf[0] == 2000 + i, (i, buf)
+        else:
+            comm.Recv(buf, source=0, tag=i)
+            assert buf[0] == 1000 + i, (i, buf)
+            comm.Send(np.full(8, 2000 + i, np.int64), dest=0, tag=i)
+    # bitwise witness: int64 sums are exact, so any lost, duplicated,
+    # or corrupted-but-delivered frame shows up as a wrong word
+    contrib = np.arange(8, dtype=np.int64) + 100 * (r + 1)
+    total = np.zeros_like(contrib)
+    comm.Allreduce(contrib, total)
+    expect = (np.arange(8, dtype=np.int64) * 2) + 100 * (1 + 2)
+    assert np.array_equal(total, expect), (total, expect)
+
+
+def _no_failures():
+    from ompi_tpu.ft import detector
+
+    assert not detector.known_failed(), detector.known_failed()
+
+
+def transient_mode() -> int:
+    import ompi_tpu
+    from ompi_tpu import COMM_WORLD
+    from ompi_tpu.mca.var import all_pvars
+
+    r = COMM_WORLD.Get_rank()
+    _ping_pong(COMM_WORLD, r)
+    COMM_WORLD.Barrier()
+    _no_failures()
+    pv = all_pvars()
+    recoveries = pv["btl_tcp_link_recoveries"].value
+    # both sides degrade (send failure on 0, EOF on 1) and both heal
+    # through the one resync — the pvar must account for it
+    assert recoveries >= 1, recoveries
+    if r == 0:
+        from ompi_tpu.ft import inject
+
+        counts = inject.fault_counts()
+        assert counts.get("sever_transient", 0) == 1, counts
+    print(f"rank {r}: LINK-TRANSIENT-OK recoveries={recoveries}",
+          flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+def corrupt_mode() -> int:
+    import ompi_tpu
+    from ompi_tpu import COMM_WORLD
+    from ompi_tpu.mca.var import all_pvars
+
+    r = COMM_WORLD.Get_rank()
+    _ping_pong(COMM_WORLD, r)
+    COMM_WORLD.Barrier()
+    _no_failures()
+    pv = all_pvars()
+    if r == 0:
+        # the sender healed every reject by retransmitting the
+        # retained original
+        retx = pv["btl_tcp_retransmits"].value
+        assert retx >= 1, retx
+        print(f"rank {r}: LINK-CORRUPT-OK retransmits={retx}",
+              flush=True)
+    else:
+        # the receiver CRC-rejected the mangled copies instead of
+        # delivering garbage or desyncing the stream
+        crc = pv["btl_tcp_crc_errors"].value
+        assert crc >= 3, crc
+        print(f"rank {r}: LINK-CORRUPT-OK crc_errors={crc}", flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+def sever_mode() -> int:
+    from ompi_tpu import COMM_WORLD
+    from ompi_tpu.core.errors import (
+        MPIError,
+        ERR_PROC_FAILED,
+        ERR_PROC_FAILED_PENDING,
+        ERR_REVOKED,
+    )
+
+    r = COMM_WORLD.Get_rank()
+    buf = np.zeros(8, np.int64)
+    t0 = time.monotonic()
+    try:
+        for i in range(200):
+            if r == 0:
+                COMM_WORLD.Send(np.full(8, i, np.int64), dest=1, tag=i)
+                COMM_WORLD.Recv(buf, source=1, tag=i)
+            else:
+                COMM_WORLD.Recv(buf, source=0, tag=i)
+                COMM_WORLD.Send(np.full(8, i, np.int64), dest=0, tag=i)
+    except MPIError as e:
+        if e.code in (ERR_PROC_FAILED, ERR_PROC_FAILED_PENDING,
+                      ERR_REVOKED):
+            # within budget: the sending side escalates at the injected
+            # sever; the peer side exhausts the (shrunk) redial
+            # deadline — neither rides the full default outage window
+            elapsed = time.monotonic() - t0
+            assert elapsed < 15.0, elapsed
+            print(f"rank {r}: LINK-SEVER-OK elapsed={elapsed:.2f}s",
+                  flush=True)
+            return 0
+        raise
+    print(f"rank {r}: severed link never escalated", flush=True)
+    return 1
+
+
+def legacy_mode() -> int:
+    import ompi_tpu
+    from ompi_tpu import COMM_WORLD
+    from ompi_tpu.mca.var import all_pvars, get_var
+
+    assert int(get_var("btl_tcp", "reliable")) == 0
+    r = COMM_WORLD.Get_rank()
+    _ping_pong(COMM_WORLD, r)
+    COMM_WORLD.Barrier()
+    _no_failures()
+    pv = all_pvars()
+    for name in ("btl_tcp_link_recoveries", "btl_tcp_retransmits",
+                 "btl_tcp_crc_errors", "btl_tcp_link_dedup_frames",
+                 "btl_tcp_retx_released"):
+        assert pv[name].value == 0, (name, pv[name].value)
+    print(f"rank {r}: LINK-LEGACY-OK", flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+def interop_mode() -> int:
+    # rank 1 opts out BEFORE any transport exists: the handshake must
+    # negotiate the pair down to plain framing (both sides advertise,
+    # or neither envelopes)
+    from ompi_tpu.mca.var import set_var
+
+    if int(os.environ.get("OMPI_TPU_RANK", "0")) == 1:
+        set_var("btl_tcp", "reliable", 0)
+    import ompi_tpu
+    from ompi_tpu import COMM_WORLD
+    from ompi_tpu.mca.var import all_pvars
+
+    r = COMM_WORLD.Get_rank()
+    _ping_pong(COMM_WORLD, r)
+    COMM_WORLD.Barrier()
+    _no_failures()
+    # negotiated down: the reliable-capable rank never enveloped either
+    pv = all_pvars()
+    assert pv["btl_tcp_link_recoveries"].value == 0
+    assert pv["btl_tcp_retransmits"].value == 0
+    print(f"rank {r}: LINK-INTEROP-OK", flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+def main() -> int:
+    faulthandler.register(_signal.SIGUSR1)  # hang diagnosis: kill -USR1
+    mode = sys.argv[1]
+    if mode == "transient":
+        return transient_mode()
+    if mode == "corrupt":
+        return corrupt_mode()
+    if mode == "sever":
+        return sever_mode()
+    if mode == "legacy":
+        return legacy_mode()
+    if mode == "interop":
+        return interop_mode()
+    print(f"unknown mode {mode}", flush=True)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

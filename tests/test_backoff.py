@@ -1,0 +1,76 @@
+"""utils/backoff.Schedule — the one retry policy every loop shares.
+
+The three call sites (tcp connect establishment, the serving admission
+gate, the link redialer) are covered end-to-end by their own suites;
+these tests pin the POLICY: exponential doubling under a cap, jitter
+bounds, both budgets binding, and deadline-clamped sleeps.
+"""
+
+import random
+
+import pytest
+
+from ompi_tpu.utils.backoff import Schedule
+
+
+class _FixedRng(random.Random):
+    """random() always returns the constructed value (jitter pinning)."""
+
+    def __init__(self, value):
+        super().__init__(0)
+        self._value = value
+
+    def random(self):
+        return self._value
+
+
+def test_doubling_under_cap_no_jitter():
+    s = Schedule(base_s=0.1, cap_s=1.0, jitter=0.0)
+    delays = [s.next_delay() for _ in range(6)]
+    assert delays == pytest.approx([0.1, 0.2, 0.4, 0.8, 1.0, 1.0])
+
+
+def test_jitter_bounds_and_determinism():
+    # r=0 pins the low edge (1-jitter), r->1 the high edge (1+jitter)
+    lo = Schedule(base_s=1.0, cap_s=8.0, jitter=0.5, rng=_FixedRng(0.0))
+    hi = Schedule(base_s=1.0, cap_s=8.0, jitter=0.5,
+                  rng=_FixedRng(1.0 - 1e-12))
+    assert lo.next_delay() == pytest.approx(0.5)
+    assert hi.next_delay() == pytest.approx(1.5, rel=1e-6)
+    # an injected seeded rng replays the exact schedule
+    a = [Schedule(base_s=0.5, jitter=0.5,
+                  rng=random.Random(7)).next_delay() for _ in range(1)]
+    b = [Schedule(base_s=0.5, jitter=0.5,
+                  rng=random.Random(7)).next_delay() for _ in range(1)]
+    assert a == b
+
+
+def test_retry_budget_binds():
+    s = Schedule(base_s=0.0, retries=3, jitter=0.0)
+    assert [s.next_delay() is not None for _ in range(4)] == \
+        [True, True, True, False]
+    assert s.exhausted()
+    assert s.sleep() is False  # exhausted: returns without sleeping
+
+
+def test_deadline_budget_binds():
+    s = Schedule(base_s=0.0, deadline_s=-1.0, jitter=0.0)
+    assert s.expired() and s.exhausted()
+    assert s.next_delay() is None
+
+
+def test_deadline_clamps_delay():
+    # huge base, tiny deadline: the sleep must not stretch past the
+    # remaining budget
+    s = Schedule(base_s=100.0, cap_s=100.0, deadline_s=0.05, jitter=0.0)
+    d = s.next_delay()
+    assert d is not None and d <= 0.05
+
+
+def test_unbounded_schedule_never_exhausts_and_clamps_exponent():
+    s = Schedule(base_s=1e-9, cap_s=0.25, jitter=0.0)
+    s.attempt = 10_000  # a long-lived admission-gate loop
+    assert not s.exhausted()
+    assert s.remaining() == float("inf")
+    d = s.next_delay()  # 1 << min(n, 62): no bignum blowup
+    assert d == pytest.approx(0.25)

@@ -438,8 +438,13 @@ def test_chaos_drop_trips_watchdog():
     """Total frame loss on one edge: the pml_peer_timeout watchdog
     converts both stalled rendezvous sides into ERR_PROC_FAILED within
     the timeout — no hang, no orphans."""
+    # legacy wire: with link reliability on, single-frame drops are
+    # healed by the retransmit timer BELOW the pml (tests/test_link.py
+    # covers that); the watchdog conversion under unhealable loss is a
+    # legacy-path contract
     r = run_mpi(2, "tests/procmode/check_chaos.py", "drop", timeout=90,
                 mca=(("btl_btl", "^sm"),
+                     ("btl_tcp_reliable", "0"),
                      ("pml_peer_timeout", "2.0"),
                      ("ft_inject_plan", "drop(1,0,frac=1.0)")))
     assert r.returncode == 0, r.stdout + r.stderr
@@ -449,8 +454,12 @@ def test_chaos_drop_trips_watchdog():
 def test_chaos_delay_dup_stream_stays_correct():
     """Latency + duplication injection: the MATCH-plane seq gate
     swallows duplicates, traffic stays correct, counters read back."""
+    # legacy wire: the link layer dedups injected dups by link seq
+    # before the pml ever sees them (tests/test_link.py covers that);
+    # the MATCH-plane seq gate is the legacy-path contract here
     r = run_mpi(2, "tests/procmode/check_chaos.py", "jitter", timeout=90,
                 mca=(("btl_btl", "^sm"),
+                     ("btl_tcp_reliable", "0"),
                      ("ft_inject_plan",
                       "delay(0,1,ms=25);dup(0,1,nth=3)")))
     assert r.returncode == 0, r.stdout + r.stderr
@@ -465,6 +474,7 @@ def test_chaos_jitter_lands_on_idle_blocking_drain():
     timeouts)."""
     r = run_mpi(2, "tests/procmode/check_chaos.py", "jitter", timeout=90,
                 mca=(("btl_btl", "^sm"),
+                     ("btl_tcp_reliable", "0"),  # pml dup gate, as above
                      ("runtime_idle_block_us", "500000"),
                      ("ft_inject_plan",
                       "delay(0,1,ms=25);dup(0,1,nth=3)")))

@@ -30,8 +30,12 @@ _CHAOS_MCA = (("coll_hier_fake_nodes", "2"),
               ("coll_hier_min_samples", "4"),
               ("coll_hier_retune_factor", "3.0"),
               # absolute margin >> any plausible host-noise EWMA swing,
-              # << the injected degradation
-              ("coll_hier_retune_min_us", "50000"),
+              # << the injected degradation. 50ms proved too tight on a
+              # loaded CI host (a full-suite run folded a 65ms EWMA
+              # swing into the POST-switch flat plan and bounced it
+              # back, tripping the switches-once assert); 100ms still
+              # sits well under the 150ms-per-call injection
+              ("coll_hier_retune_min_us", "100000"),
               ("coll_hier_inject_stage", "cross"),
               ("coll_hier_inject_delay_ms", "150"),
               ("coll_hier_inject_after", "12"))

@@ -355,6 +355,48 @@ def _blame_era(edge: Edge, peer_dump: dict) -> str:
             f"its peer already abandoned")
 
 
+def link_verdicts(dumps: Dict[int, dict]) -> List[str]:
+    """One LINK line per degraded/suspect tcp connection: the link
+    layer's own evidence (reconnect-and-replay in flight) is a
+    DIFFERENT verdict class from a blocked pml edge — a degraded link
+    explains a stall without either pml showing a wedged queue,
+    because the btl retains frames silently while it redials."""
+    lines: List[str] = []
+    for rank in sorted(dumps):
+        for ent in _tcp(dumps[rank]).get("conns", []):
+            link = ent.get("link")
+            if not link:
+                continue
+            peer = ent.get("peer")
+            unacked = (int(link.get("tx_seq", 0))
+                       - int(link.get("tx_acked", 0)))
+            if ent.get("state") == "degraded":
+                lines.append(
+                    f"LINK: rank {rank}→{peer} degraded "
+                    f"{link.get('degraded_s', '?')}s, {unacked} "
+                    f"frame(s) unacked, redial "
+                    f"{link.get('redial_attempts', '?')}/"
+                    f"{link.get('redial_budget', '?')} "
+                    f"(escalates to rank failure in "
+                    f"{link.get('deadline_in_s', '?')}s)")
+            elif link.get("retx_oldest_age_s", 0) and \
+                    float(link["retx_oldest_age_s"]) > 1.0:
+                # established but the ack clock has stopped: the next
+                # retransmit strike-out will degrade this link
+                lines.append(
+                    f"LINK: rank {rank}→{peer} established but "
+                    f"{link.get('retx_frames', 0)} frame(s) "
+                    f"({link.get('retx_bytes', 0)}B) unacked for "
+                    f"{link['retx_oldest_age_s']}s — ack clock "
+                    f"stalled, retransmit strike-out pending")
+            elif int(link.get("reconnects", 0)) > 0:
+                lines.append(
+                    f"LINK: rank {rank}→{peer} healthy after "
+                    f"{link['reconnects']} reconnect(s), "
+                    f"{link.get('crc_errors', 0)} crc error(s)")
+    return lines
+
+
 def find_cycles(edges: Dict[int, Edge]) -> List[List[int]]:
     """Cycles in the waiting-on map (rank -> blamed peer)."""
     cycles: List[List[int]] = []
@@ -478,6 +520,7 @@ def analyze(dumps: Dict[int, dict],
     return {
         "ranks": summaries,
         "blames": blames,
+        "links": link_verdicts(dumps),
         "cycles": [" -> ".join(str(r) for r in c + [c[0]])
                    for c in cycles],
     }
@@ -503,6 +546,7 @@ def render(report: dict) -> str:
                      f"no-completion={s['since_last_completion_s']}s")
         for e in s["edges"]:
             lines.append(f"  waiting-on: {e}")
+    lines.extend(report.get("links", []))
     for cyc in report["cycles"]:
         lines.append(f"BLAME-CYCLE: {cyc} — every member waits on the "
                      "next; break the cycle, not one edge")

@@ -9,7 +9,10 @@ row per rank: collective counts and rates, traffic totals, the
 straggler skew EWMA the comm root computed for that rank, trip counts,
 the p50/p99 of the pml send-latency histogram, the per-rank
 queued-bytes-by-class cell (QKB-L/N/B, KB latency/normal/bulk) from
-the traffic-shaping gauges when ``btl_tcp_shape_enable`` is on, and
+the traffic-shaping gauges when ``btl_tcp_shape_enable`` is on, the
+LNK link-health cell (degraded links + retained frames while a
+reconnect-and-replay is in flight; recoveries/CRC rejects once
+healthy) from the ``btl_tcp_link`` sampler, and
 the BOUND cell (``<category>@<rank>``: the latest step's critical-path
 category and bound rank from the critpath sampler —
 tools/mpicrit.py is the offline ground truth).
@@ -171,6 +174,39 @@ def bound_cell(snap: dict) -> str:
     return f"{cell}@{rank}" if rank >= 0 else cell
 
 
+def lnk_cell(snap: dict) -> str:
+    """Link-health cell from the btl_tcp_link sampler (`*<n>d/<f>f` =
+    n degraded link(s) with f retained frame(s) awaiting
+    reconnect-and-replay; `<r>r/<c>c` = r lifetime recoveries, c CRC
+    rejects on a currently-healthy datapath). Pvar fallback for
+    snapshots written before the sampler existed — the QKB-L/N/B
+    pattern (the pvars carry no live degraded/retained figures, so the
+    fallback only ever renders the healthy form). Empty when the
+    reliable layer never engaged."""
+    row = snap.get("samplers", {}).get("btl_tcp_link")
+    if not isinstance(row, dict):
+        pv = snap.get("pvars", {})
+        if "btl_tcp_link_recoveries" not in pv:
+            return ""
+        row = {"degraded_links": 0, "retx_frames": 0,
+               "recoveries": pv.get("btl_tcp_link_recoveries", 0),
+               "retransmits": pv.get("btl_tcp_retransmits", 0),
+               "crc_errors": pv.get("btl_tcp_crc_errors", 0)}
+    try:
+        degraded = int(row.get("degraded_links") or 0)
+        frames = int(row.get("retx_frames") or 0)
+        recov = int(row.get("recoveries") or 0)
+        crc = int(row.get("crc_errors") or 0)
+        retx = int(row.get("retransmits") or 0)
+    except (TypeError, ValueError):
+        return ""
+    if degraded:
+        return f"*{degraded}d/{frames}f"
+    if recov or crc or retx:
+        return f"{recov}r/{crc}c"
+    return ""
+
+
 def skew_by_rank(snaps: Dict[int, dict]) -> Dict[int, float]:
     """Worst coll_entry_skew_us EWMA per rank, pulled from every
     snapshot (comm roots hold the values for their members)."""
@@ -196,7 +232,7 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
     lines = [f"{'RANK':>4} {'AGE-S':>6} {'COLLS':>8} {'COLL/S':>7} "
              f"{'TX-MB':>9} {'RX-MB':>9} {'SKEW-US':>8} {'TRIPS':>5} "
              f"{'P50-US':>7} {'P99-US':>8} {'QKB-L/N/B':>10} "
-             f"{'STALL':>6} {'BOUND':>8}"]
+             f"{'STALL':>6} {'LNK':>8} {'BOUND':>8}"]
     for rank in sorted(snaps):
         snap = snaps[rank]
         pv = snap.get("pvars", {})
@@ -221,7 +257,7 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
             f"{'' if p50 is None else format(p50, '.0f'):>7} "
             f"{'' if p99 is None else format(p99, '.0f'):>8} "
             f"{qos_queued(snap):>10} {stall_cell(snap):>6} "
-            f"{bound_cell(snap):>8}")
+            f"{lnk_cell(snap):>8} {bound_cell(snap):>8}")
     trips = sum(int(s.get("pvars", {}).get("metrics_straggler_trips", 0))
                 for s in snaps.values())
     lines.append(f"-- {len(snaps)} rank(s), {trips} straggler trip(s), "
