@@ -1066,6 +1066,45 @@ def bench_serving():
     return out
 
 
+def bench_link_telemetry():
+    """Fabric-telemetry readout on a healthy 2-rank link: the
+    runtime/linkmodel.py passive estimators (SRTT off the reliability
+    envelope's ack clock, delivered goodput, loss_ppm) measured by
+    tests/procmode/check_linkmodel.py stats mode. The numbers mirror
+    into the metrics registry as gauges so the BENCH json and the
+    Prometheus export agree (the PR 4 discipline)."""
+    import os
+    import re
+    import subprocess
+
+    from ompi_tpu.runtime import metrics
+
+    env = _procmode_env()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "2",
+             "--mca", "btl_btl", "^sm",
+             "--mca", "linkmodel_enable", "1",
+             "tests/procmode/check_linkmodel.py", "stats"],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except Exception as e:  # pragma: no cover
+        return {"error": str(e)[:300]}
+    m = re.search(r"LINKBENCH rank 0 srtt_us=([0-9.]+) "
+                  r"goodput_bps=([0-9.]+) loss_ppm=([0-9.]+)", r.stdout)
+    if not m or r.stdout.count("LINKSTATS-OK") != 2:
+        return {"error": r.stdout[-300:] + r.stderr[-300:]}
+    out = {
+        "srtt_us": float(m.group(1)),
+        "goodput_gbps": float(m.group(2)) / 1e9,
+        "loss_ppm": float(m.group(3)),
+    }
+    metrics.gauge_set("bench_link_srtt_us", out["srtt_us"])
+    metrics.gauge_set("bench_link_goodput_gbps", out["goodput_gbps"])
+    metrics.gauge_set("bench_link_loss_ppm", out["loss_ppm"])
+    return out
+
+
 def bench_host_paths():
     """Process-mode fast paths vs their frame-based fallbacks: coll/sm
     segment collectives (xhc analog) and the zero-copy shared-segment
@@ -1162,6 +1201,7 @@ def main() -> int:
     detail["coll_datapath"] = bench_coll_datapath()
     detail["persistent"] = bench_persistent()
     detail["qos"] = bench_qos()
+    detail["link_telemetry"] = bench_link_telemetry()
     detail["serving"] = bench_serving()
     detail["host_paths"] = bench_host_paths()
     detail["model_step"] = bench_mfu()

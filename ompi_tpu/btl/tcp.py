@@ -107,6 +107,7 @@ from ompi_tpu import qos as _qos
 from ompi_tpu.btl.base import Btl, btl_framework
 from ompi_tpu.ft import inject as _inject
 from ompi_tpu.runtime import forensics as _forensics
+from ompi_tpu.runtime import linkmodel as _linkmodel
 from ompi_tpu.mca.component import Component
 from ompi_tpu.mca.var import (register_var, register_pvar, get_var,
                               watch_var)
@@ -255,6 +256,27 @@ _link_deadline_var = register_var(
          "dead conn, pml failover/dead-letter). Also bounds how long "
          "the outage refreshes the ft detector's heartbeat staleness "
          "on the peer's behalf", level=5)
+_retx_adaptive_var = register_var(
+    "btl_tcp", "retx_adaptive", 1,
+    help="RTT-adaptive retransmit timer: once a conn holds >= "
+         "btl_tcp_rtt_min_samples Karn-accepted RTT samples its "
+         "effective timeout is min(btl_tcp_retx_timeout_ms, "
+         "max(25ms floor, srtt + 4*rttvar)) — the fixed cvar becomes "
+         "the CEILING, so a fast link retransmits in a few RTTs "
+         "instead of waiting out a wan-sized constant while a slow "
+         "link inflates past the constant and stops striking "
+         "spuriously. 0 = fixed timer everywhere (the A/B baseline)",
+    level=5)
+_rtt_min_samples_var = register_var(
+    "btl_tcp", "rtt_min_samples", 8,
+    help="Karn-accepted RTT samples a conn must fold before the "
+         "adaptive retransmit timer trusts its srtt/rttvar (below "
+         "this the fixed btl_tcp_retx_timeout_ms applies)", level=6)
+
+# adaptive-timer floor: below this the strike loop would outpace ack
+# coalescing (receivers ack at timeout/2 or 8-frames/1MB, whichever
+# first) and read its own batching as loss
+_RETX_FLOOR_S = 0.025
 
 # shaped-path counters + live queued-bytes-by-class gauges (plain int
 # bumps like _ctr; the by-class gauges take _qlock because different
@@ -428,6 +450,49 @@ def register_link_sampler() -> None:
 
 register_link_sampler()
 
+
+def _linkmodel_rows() -> list:
+    """Per-conn estimator rows for the fabric-telemetry registry
+    (runtime/linkmodel.py pulls these on its fold cadence). Lock-free
+    diagnostic snapshot like _link_rollup: a torn read skews one fold,
+    never the conn."""
+    rows = []
+    for btl in list(_live_btls):
+        if btl._closed:
+            continue
+        with btl._conn_lock:
+            conns = list(btl.conns.values())
+        for c in conns:
+            if not c.rel or c.dead is not None:
+                continue
+            oldest = 0.0
+            try:  # mpiracer: disable=cross-thread-race — lock-free diagnostic snapshot, see docstring
+                if c.retx:
+                    oldest = max(
+                        0.0, time.monotonic() - min(
+                            ts for _, _, ts, _ in c.retx.values()))
+            except (RuntimeError, ValueError):
+                pass  # dict mutated mid-walk: skip the age this fold
+            rows.append({
+                "peer": c.peer,
+                "state": c.state,
+                "srtt": c.srtt,
+                "rttvar": c.rttvar,
+                "rtt_n": c.rtt_n,
+                "acked_b": list(c.acked_b),
+                "tx_frames": c.tx_seq,
+                "rx_frames": c.rx_frames,
+                "retx_n": c.retx_n,
+                "nack_retx_n": c.nack_retx_n,
+                "crc_errs": c.crc_errs,
+                "dedup_n": c.dedup_n,
+                "queue_age_s": oldest,
+            })
+    return rows
+
+
+_linkmodel.register_source(_linkmodel_rows)
+
 # a DEGRADED link is pending work (its retained frames complete only
 # through heal-or-escalate): the stall sentinel must read a wedged heal
 # as a stall — whose dump then carries the per-conn link evidence the
@@ -565,7 +630,10 @@ class _Conn:
                  "rx_seen", "unacked_n", "unacked_b", "last_ack_tx",
                  "retx_strikes", "last_retx_t", "degraded_at",
                  "redial_deadline", "redial_n", "reconnects",
-                 "crc_errs", "last_crc", "esc_eof")
+                 "crc_errs", "last_crc", "esc_eof",
+                 # link telemetry (runtime/linkmodel.py + adaptive retx)
+                 "srtt", "rttvar", "rtt_n", "karn", "acked_b",
+                 "retx_n", "nack_retx_n", "dedup_n", "rx_frames")
 
     def __init__(self, sock: socket.socket, peer: Optional[int] = None):
         self.sock = sock
@@ -655,6 +723,23 @@ class _Conn:
         # preserves the pre-reliability semantics: EOF marked the peer
         # failed only under ft_enable; write errors unconditionally
         self.esc_eof = False
+        # ---- link telemetry: Jacobson/Karn RTT off the ack clock
+        # (always-on when reliable — the adaptive retransmit timer
+        # needs it even with the linkmodel plane off), per-class acked
+        # wire bytes (goodput = DELIVERED, not enqueued), and per-conn
+        # loss attribution counters (the _lctr globals can't pin a
+        # storm on an edge)
+        self.srtt = 0.0
+        self.rttvar = 0.0
+        self.rtt_n = 0
+        self.karn: set = set()  # seqs retransmitted: never RTT-sampled
+        self.acked_b = [0, 0, 0]   # cumulative acked wire bytes by class
+        self.retx_n = 0        # frames this conn retransmitted
+        self.nack_retx_n = 0   # ...of which the peer NACKed (CRC reject
+        # at the receiver: EVIDENCED wire corruption, unlike a timeout
+        # retransmit, which may just be a slow ack)
+        self.dedup_n = 0       # inbound duplicates this conn discarded
+        self.rx_frames = 0     # reliable frames this conn delivered
 
 
 class TcpBtl(Btl):
@@ -769,6 +854,28 @@ class TcpBtl(Btl):
                         "crc_errors": conn.crc_errs,
                         "last_crc_age_s": None if conn.last_crc is None
                         else round(now - conn.last_crc, 3),
+                        # fabric telemetry (runtime/linkmodel.py):
+                        # mpidiag's wire-bound verdict splits on these
+                        "srtt_us": round(conn.srtt * 1e6, 1)
+                        if conn.rtt_n else None,
+                        "rttvar_us": round(conn.rttvar * 1e6, 1)
+                        if conn.rtt_n else None,
+                        "rtt_samples": conn.rtt_n,
+                        "acked_bytes_by_class": {
+                            _qos.NAMES[c]: conn.acked_b[c]
+                            for c in range(3)},
+                        # directional (linkmodel discipline): loss_ppm
+                        # charges the outbound edge, and only counts
+                        # NACK-evidenced retransmits (a CRC reject at
+                        # the peer) — a timeout retransmit may just be
+                        # a slow ack; the conn's own crc/dedup counts
+                        # describe inbound frames
+                        "loss_ppm": round(
+                            1e6 * conn.nack_retx_n
+                            / max(conn.tx_seq, 1), 1),
+                        "rx_loss_ppm": round(
+                            1e6 * (conn.crc_errs + conn.dedup_n)
+                            / max(conn.rx_frames, 1), 1),
                     }
                     if conn.retx:
                         oldest = next(iter(conn.retx.values()))
@@ -962,7 +1069,13 @@ class TcpBtl(Btl):
                 f"tcp frame of {HDR_SIZE + nbytes} bytes exceeds "
                 f"the {limit}-byte framing limit")
         drop = dup = corrupt = False
+        sent_at = None
         if _inject._enable_var._value:  # chaos wire hook (ft/inject.py)
+            # an injected delay() sleeps INLINE right here, before the
+            # envelope stamps its retention instant — stamp the send
+            # instant first so the chaos latency lands inside the RTT
+            # sample, exactly as a slow wire would
+            sent_at = time.monotonic()
             verdict = _inject.wire_send(self.my_rank, peer)
             if verdict:
                 if verdict & _inject.SEVER:
@@ -1035,7 +1148,7 @@ class TcpBtl(Btl):
             if conn.rel:
                 cls = header[0] >> QOS_SHIFT
                 txv = self._rel_envelope(conn, header, mv, nbytes,
-                                         zflag, cls)
+                                         zflag, cls, sent_at)
                 self._evict_window(conn)
                 if conn.dead is not None:
                     # window overflow while degraded escalated inline
@@ -1207,7 +1320,8 @@ class TcpBtl(Btl):
     # degrades (redial + resync + replay) instead of dying. The methods
     # below are that whole state machine.
     def _rel_envelope(self, conn: _Conn, header, mv, nbytes: int,
-                      zflag: int, cls: int) -> List:
+                      zflag: int, cls: int,
+                      sent_at: Optional[float] = None) -> List:
         """Build + RETAIN one immutable reliable envelope; returns its
         vec list. Caller holds conn.wlock (seq assignment must be
         atomic with transmit order). Ownership copies happen here: the
@@ -1234,7 +1348,12 @@ class TcpBtl(Btl):
                             seq, ack, crc & 0xFFFFFFFF)
         vecs: List = [head, header, mv] if nbytes else [head, header]
         wire = 4 + 12 + HDR_SIZE + nbytes
-        conn.retx[seq] = (wire, vecs, time.monotonic(), cls)
+        # sent_at: send() pre-stamps before the chaos inject hook (an
+        # injected delay() sleeps inline there, and that latency must
+        # land inside the RTT sample like a slow wire's would)
+        conn.retx[seq] = (wire, vecs,
+                          time.monotonic() if sent_at is None
+                          else sent_at, cls)
         conn.retx_bytes += wire
         return vecs
 
@@ -1255,6 +1374,7 @@ class TcpBtl(Btl):
         while conn.retx_bytes > window and len(conn.retx) > 1:
             seq = next(iter(conn.retx))
             nb = conn.retx.pop(seq)[0]
+            conn.karn.discard(seq)
             conn.retx_bytes -= nb
             if seq > conn.tx_released:
                 conn.tx_released = seq
@@ -1321,14 +1441,42 @@ class TcpBtl(Btl):
         at one compare when the ack is stale."""
         if ackv <= conn.tx_acked:  # mpiracer: disable=cross-thread-race — monotonic-int pre-check; the locked re-check below decides
             return
+        sample = None
         with conn.wlock:
             if ackv <= conn.tx_acked:
                 return
             conn.tx_acked = ackv
             retx = conn.retx
+            now = time.monotonic()
             for seq in [s for s in retx if s <= ackv]:
-                conn.retx_bytes -= retx.pop(seq)[0]
+                wire, _vecs, ts, cls = retx.pop(seq)
+                conn.retx_bytes -= wire
+                conn.acked_b[cls] += wire  # DELIVERED bytes: goodput
+                if seq in conn.karn:
+                    # Karn: an ack after a retransmission is ambiguous
+                    # about which copy it acknowledges — never sample
+                    conn.karn.discard(seq)
+                else:
+                    # one cumulative ack releases a batch; the
+                    # youngest released frame carries the least
+                    # ack-coalescing delay, so it is the sample
+                    sample = now - ts
             conn.retx_strikes = 0  # ack progress resets the timer
+            if sample is not None and sample >= 0.0:
+                # Jacobson/Karn fold (RFC 6298 constants), kept on the
+                # conn: the adaptive retransmit timer reads it even
+                # with the linkmodel plane off
+                if conn.rtt_n == 0:
+                    conn.srtt = sample
+                    conn.rttvar = sample / 2.0
+                else:
+                    d = sample - conn.srtt
+                    conn.srtt += 0.125 * d
+                    conn.rttvar += 0.25 * (abs(d) - conn.rttvar)
+                conn.rtt_n += 1
+        if sample is not None and sample >= 0.0 \
+                and _linkmodel._enable_var._value:
+            _linkmodel.note_rtt_sample(conn.peer, sample)
 
     def _rel_retransmit(self, conn: _Conn) -> None:
         """NACK service: retransmit every retained frame in seq order
@@ -1349,6 +1497,9 @@ class TcpBtl(Btl):
                     break  # a transmit failure degraded us mid-loop
                 nb, vecs, _ts, cls = conn.retx[seq]
                 conn.retx[seq] = (nb, vecs, now, cls)  # re-age
+                conn.karn.add(seq)  # Karn: never RTT-sample this seq
+                conn.retx_n += 1
+                conn.nack_retx_n += 1
                 _lctr["retransmits"] += 1
                 self._rel_transmit(conn, list(vecs), cls)
 
@@ -1437,6 +1588,8 @@ class TcpBtl(Btl):
                         break  # transmit failure re-degraded us
                     nb, vecs, _ts, cls = conn.retx[seq]
                     conn.retx[seq] = (nb, vecs, now, cls)
+                    conn.karn.add(seq)  # replay = retransmit: no sample
+                    conn.retx_n += 1
                     _lctr["retransmits"] += 1
                     self._rel_transmit(conn, list(vecs), cls)
                 self._rel_send_ack(conn)
@@ -1461,7 +1614,8 @@ class TcpBtl(Btl):
         if restored:
             from ompi_tpu.ft.detector import note_link_restored
 
-            note_link_restored(conn.peer)
+            note_link_restored(conn.peer,
+                               link=self._conn_link_stats(conn))
             cb = self.link_restored_cb
             if cb is not None:
                 # pml dead-letter replay seam (wireup binds it): frames
@@ -1507,6 +1661,24 @@ class TcpBtl(Btl):
 
             mark_failed(conn.peer)
 
+    def _conn_link_stats(self, conn: _Conn) -> dict:
+        """How the link was performing at a degrade/restore edge — the
+        ft detector carries this into its forensics debug_state and
+        the mpidiag LINK line (lock-free diagnostic snapshot)."""
+        st = {  # mpiracer: disable=cross-thread-race — lock-free diagnostic snapshot, see docstring
+            "srtt_us": round(conn.srtt * 1e6, 1) if conn.rtt_n else None,
+            "rtt_samples": conn.rtt_n,
+            "loss_ppm": round(1e6 * conn.nack_retx_n
+                              / max(conn.tx_seq, 1), 1),
+            "goodput_bps": None,
+        }
+        if _linkmodel._enable_var._value:
+            row = _linkmodel.edge(conn.peer)
+            if row is not None:
+                st["goodput_bps"] = round(
+                    sum(row["goodput_bps"].values()), 1)
+        return st
+
     def _link_interrupt(self, conn: _Conn, err: OSError,
                         eof: bool) -> None:
         """Enter LINK_DEGRADED: close the broken socket but KEEP the
@@ -1543,7 +1715,7 @@ class TcpBtl(Btl):
                            peer=conn.peer, err=str(err))
         from ompi_tpu.ft.detector import note_link_degraded
 
-        note_link_degraded(conn.peer)
+        note_link_degraded(conn.peer, link=self._conn_link_stats(conn))
         if conn.peer is not None:
             t = threading.Thread(
                 target=self._redial_loop,
@@ -1704,6 +1876,22 @@ class TcpBtl(Btl):
             if not eof or get_var("ft", "enable"):
                 mark_failed(conn.peer)
 
+    def _conn_timeout(self, conn: _Conn, ceiling_s: float) -> float:
+        """Effective retransmit timeout for one conn. With the
+        RTT-adaptive timer on (btl_tcp_retx_adaptive, default) and
+        enough Karn-accepted samples folded, the classic
+        srtt + 4*rttvar RTO applies — floored so ack coalescing never
+        reads as loss, and CEILINGED by btl_tcp_retx_timeout_ms: a
+        fast link retransmits in a few RTTs instead of waiting out a
+        wan-sized constant, a slow link inflates toward the cvar and
+        stops striking spuriously."""
+        if _retx_adaptive_var._value \
+                and conn.rtt_n >= int(_rtt_min_samples_var._value):
+            return min(ceiling_s,
+                       max(_RETX_FLOOR_S,
+                           conn.srtt + 4.0 * conn.rttvar))
+        return ceiling_s
+
     def _rel_tick(self, now: float) -> int:
         """Link-reliability timer pass (~25ms cadence from progress):
         periodic cumulative acks, retransmit timeouts with strike
@@ -1719,9 +1907,10 @@ class TcpBtl(Btl):
                                           note_link_degraded)
 
         work = 0
-        timeout = max(float(_retx_timeout_var._value), 1.0) / 1000.0
+        ceiling = max(float(_retx_timeout_var._value), 1.0) / 1000.0
         failed = None
         for conn in conns:
+            timeout = self._conn_timeout(conn, ceiling)
             if conn.state != "est":
                 # degraded: keep the detector's grace fresh while the
                 # window is open, enforce the outage budget
@@ -1790,6 +1979,8 @@ class TcpBtl(Btl):
                         break  # transmit failure degraded us mid-loop
                     nb, vecs, _ts, cls = conn.retx[seq]
                     conn.retx[seq] = (nb, vecs, rnow, cls)
+                    conn.karn.add(seq)  # Karn: never RTT-sample this seq
+                    conn.retx_n += 1
                     _lctr["retransmits"] += 1
                     self._rel_transmit(conn, list(vecs), cls)
                 work += 1
@@ -2438,6 +2629,7 @@ class TcpBtl(Btl):
                     # toward the ack cadence — the sender needs the
                     # ack to stop resending
                     _lctr["dedup"] += 1  # mpiracer: disable=cross-thread-race — relaxed counter, same discipline as _ctr; pvar readers tolerate a stale view
+                    conn.dedup_n += 1
                     conn.unacked_n += 1
                     if conn.unacked_n >= 8 or \
                             conn.unacked_b >= 1 << 20:
@@ -2454,6 +2646,7 @@ class TcpBtl(Btl):
                     # per-(peer, class) seq planes own ordering; the
                     # link layer owns only exactly-once
                     conn.rx_seen.add(seq)
+                conn.rx_frames += 1
                 conn.unacked_n += 1
                 conn.unacked_b += total
                 if _copy_mode_var._value:

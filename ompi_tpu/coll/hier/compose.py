@@ -196,8 +196,16 @@ class HierColl(CollModule):
                 dm = self._dm
                 order = [m for n in range(dm.n_nodes)
                          for m in dm.members_of_node(n)]
+            # the static min_bytes table is floored by the MEASURED
+            # bandwidth-delay product of this rank's cross links
+            # (linkmodel, when armed): a composed pipeline pays ~one
+            # extra cross-link RTT per stage, so composition pays off
+            # only once the payload dwarfs what the wire holds in one
+            # RTT. Frozen per (verb, dtype, flags) like min_bytes — the
+            # plan, not the hot path, reads the telemetry.
             sp = _StagePlan(eligible, order,
-                            int(get_var("coll_hier", "min_bytes")))
+                            max(int(get_var("coll_hier", "min_bytes")),
+                                _decide.link_floor_bytes()))
             st.bound[key] = sp
         return sp
 

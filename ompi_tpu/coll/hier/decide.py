@@ -329,6 +329,25 @@ def bind_plane(pml) -> None:
     _plane.ensure(pml)
 
 
+def link_floor_bytes() -> int:
+    """Measured composition floor from the fabric telemetry
+    (runtime/linkmodel): the worst bandwidth-delay product across this
+    rank's links. The stage tables fold it into min_bytes when plans
+    bind — the observability half of the egress-bandwidth model
+    (ROADMAP item 4): below one wire-RTT of payload the composed
+    pipeline's extra cross-link stage latency cannot pay for itself.
+    Returns 0 (no floor) when the linkmodel plane is off or has no
+    samples yet."""
+    from ompi_tpu.runtime import linkmodel as _linkmodel
+
+    if not _linkmodel._enable_var._value:
+        return 0
+    try:
+        return _linkmodel.cross_floor_bytes()
+    except Exception:
+        return 0  # telemetry must never fail a collective
+
+
 # ------------------------------------------------------------- plan sync
 def sync(comm, st: VerbState, idx: int) -> None:
     """The agreed-index plan agreement: the root publishes its active

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Set
 
 from ompi_tpu.mca.var import register_var, get_var
@@ -36,6 +37,14 @@ _propagator: Optional[Callable[[int], None]] = None
 _log = get_logger("ft.detector")
 _live_hb = [None]  # weakref to the running HeartbeatDetector, if any
 
+# degrade/restore edge journal: how the link was PERFORMING when it
+# died/healed (btl/tcp passes its linkmodel snapshot at the edge) —
+# forensics debug_state + the mpidiag LINK verdict read it. Bounded;
+# one entry per state EDGE (the link timer re-notes every tick while
+# an outage is open, which must not flood the journal).
+_link_events: deque = deque(maxlen=32)
+_link_state: Dict[int, str] = {}  # rank -> "degraded" | "restored"
+
 
 def _fx_debug_state() -> dict:
     """Stall-forensics provider (runtime/forensics contract): the
@@ -55,6 +64,14 @@ def _fx_debug_state() -> dict:
             "suspect": bool(det.observed != det.rank
                             and age > timeout / 2.0),
         }
+    with _failed_lock:
+        events = list(_link_events)
+    if events:
+        now = time.monotonic()
+        out["link_events"] = [
+            {"rank": ev["rank"], "event": ev["event"],
+             "age_s": round(now - ev["t"], 3), "link": ev["link"]}
+            for ev in events]
     return out
 
 
@@ -98,7 +115,27 @@ def on_failure(cb: Callable[[int], None]) -> None:
     _callbacks.append(cb)  # mpiracer: disable=cross-thread-race — GIL-atomic append at registration time; mark_failed iterates a list() snapshot
 
 
-def note_link_degraded(rank: int) -> None:
+def _note_link_event(rank: int, event: str, link: Optional[dict]) -> None:
+    """Journal one degrade/restore state EDGE with the link's last
+    performance snapshot (dedup: the tick-driven re-notes of an open
+    outage don't re-journal)."""
+    with _failed_lock:
+        if _link_state.get(rank) == event:
+            if link is not None:
+                # a tick-driven re-note raced ahead of the entry call
+                # that carries the snapshot: backfill it
+                for ev in reversed(_link_events):
+                    if ev["rank"] == rank and ev["event"] == event:
+                        if ev["link"] is None:
+                            ev["link"] = link
+                        break
+            return
+        _link_state[rank] = event
+        _link_events.append({"t": time.monotonic(), "rank": rank,
+                             "event": event, "link": link})
+
+
+def note_link_degraded(rank: int, link: Optional[dict] = None) -> None:
     """Link-reliability grace seam (btl/tcp LINK_DEGRADED): while the
     tcp link layer is inside its bounded redial window for ``rank``,
     the heartbeat silence the outage itself causes must not convert
@@ -107,18 +144,27 @@ def note_link_degraded(rank: int) -> None:
     blip. Called at degrade entry and on every link-timer tick while
     the window is open, so a long redial keeps its grace; the link
     layer's own escalation (budget blown -> mark_failed) keeps death
-    detection bounded by btl_tcp_link_deadline_s."""
+    detection bounded by btl_tcp_link_deadline_s. ``link`` (degrade
+    entry only) is the edge's last linkmodel snapshot — srtt/goodput/
+    loss at the moment the wire died — journaled for forensics and
+    the mpidiag LINK verdict."""
+    _note_link_event(rank, "degraded", link)
     ref = _live_hb[0]
     det = ref() if ref is not None else None
     if det is not None and det.observed == rank:
         det.last_seen = time.monotonic()
 
 
-def note_link_restored(rank: int) -> None:
+def note_link_restored(rank: int, link: Optional[dict] = None) -> None:
     """Link healed (resync complete): reset the observed edge's
     staleness so the outage tail is not charged against the next
-    heartbeat-timeout window."""
-    note_link_degraded(rank)
+    heartbeat-timeout window, and journal how the healed link is
+    performing."""
+    _note_link_event(rank, "restored", link)
+    ref = _live_hb[0]
+    det = ref() if ref is not None else None
+    if det is not None and det.observed == rank:
+        det.last_seen = time.monotonic()
 
 
 class HeartbeatDetector:
@@ -191,6 +237,8 @@ class HeartbeatDetector:
 def _reset_for_testing() -> None:
     with _failed_lock:
         _failed.clear()
+        _link_events.clear()
+        _link_state.clear()
     _callbacks.clear()
 
 

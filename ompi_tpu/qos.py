@@ -101,7 +101,7 @@ _segment_var = register_var(
 _tag_map_var = register_var(
     "qos", "tag_map", "-4600:bulk,-4500:bulk,-4242:latency,"
                       "-4243:latency,-4244:latency,-4245:latency,"
-                      "-4800:latency,"
+                      "-4800:latency,-4900:latency,"
                       "4242:bulk,4243:bulk,4300:bulk",
     typ=str,
     help="Default QoS class per tag plane: 'tag:class' pairs, comma-"
@@ -113,9 +113,11 @@ _tag_map_var = register_var(
          "known background planes (diskless ckpt replication -4600, "
          "metrics shipping -4500) to bulk, promotes the ft control "
          "plane (revoke -4242, heartbeat -4243, era -4244, failure "
-         "flood -4245) and the stall-forensics dump requests (-4800 — "
+         "flood -4245), the stall-forensics dump requests (-4800 — "
          "a dump request diagnosing a bulk backlog must not queue "
-         "behind it) to latency, and demotes the RECOVERY state-"
+         "behind it) and the fabric-telemetry probe echoes (-4900 — "
+         "an RTT probe queued behind bulk would measure the queue, "
+         "not the wire) to latency, and demotes the RECOVERY state-"
          "movement planes to bulk: respawn state delivery (4242), the "
          "diskless XOR-reconstruction/buddy-blob exchange (4243), and "
          "reshard rounds (4300) — during a recovery storm these bytes "

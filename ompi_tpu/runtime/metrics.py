@@ -421,6 +421,18 @@ def _root_record(pml, cid: int, idx: int, rank: int, ts_us: int,
                   f"{skew:.0f}us after the median rank; skew EWMA "
                   f"{v:.0f}us > threshold "
                   f"{float(_thresh_var._value):.0f}us")
+        # cross-reference the laggard's link health (linkmodel, when
+        # armed): "rank R is slow" reads very differently when the
+        # root's own wire to R is the degraded part
+        from ompi_tpu.runtime import linkmodel as _linkmodel
+
+        if _linkmodel._enable_var._value and w != pml.my_rank:
+            try:
+                lk = _linkmodel.describe_edge(w)
+            except Exception:
+                lk = None  # telemetry must never poison the verdict
+            if lk is not None:
+                detail += f"\n  root's {lk}"
         if w == pml.my_rank:
             _trip_local(cid, skew, v, detail)
         else:

@@ -190,6 +190,20 @@ def Finalize() -> None:
         from ompi_tpu.hook import run_hooks
 
         run_hooks("finalize_top")
+        try:
+            # freeze fabric telemetry BEFORE the exit fence: no peer
+            # leaves the fence (and starts closing sockets) until every
+            # rank has entered it, so this fold is guaranteed to see
+            # the fabric's last healthy instant. After the fence, a
+            # fast peer's teardown puts conns into their redial/
+            # degraded shutdown states — shutdown mechanics, not link
+            # weather, and folding them would make mpinet --check flag
+            # healthy edges
+            from ompi_tpu.runtime import linkmodel
+
+            linkmodel.quiesce()
+        except Exception:
+            pass
         if _world is not None:
             try:
                 from ompi_tpu.runtime import spc

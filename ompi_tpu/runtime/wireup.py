@@ -286,6 +286,7 @@ def init_process_mode():
     # ran only when this rank's own composed call finished).
     from ompi_tpu.coll.hier import decide as hier_decide
     from ompi_tpu.runtime import forensics as rt_forensics
+    from ompi_tpu.runtime import linkmodel as rt_linkmodel
     from ompi_tpu.runtime import metrics as rt_metrics
     from ompi_tpu.runtime import sanitizer as rt_sanitizer
 
@@ -296,6 +297,9 @@ def init_process_mode():
     # sentinel can latch and request this rank's dump the moment the
     # fence releases it — same pre-fence discipline as the planes above
     rt_forensics.bind_plane(pml)
+    # fabric-telemetry probe echo plane (-4900): a fast peer's idle
+    # prober can ping this rank right after the fence
+    rt_linkmodel.bind_plane(pml)
 
     hb = None
     if get_var("ft", "enable") and job == 0:

@@ -54,6 +54,7 @@ def _load_everything() -> None:
     import ompi_tpu.coll.persist  # coll_persist_* cvars + persist_* replay pvars
     import ompi_tpu.qos  # QoS classes: btl_tcp_shape_enable/segment + qos_* cvars/pvars
     import ompi_tpu.runtime.forensics  # stall-forensics cvars + forensics_* pvars
+    import ompi_tpu.runtime.linkmodel  # fabric telemetry: linkmodel_* cvars + rtt/goodput/probe pvars
     import ompi_tpu.serve  # elastic serving: serve_* SLO/RTO/admission cvars + pvars
     # (btl/tcp.py above also carries the btl_tcp_shape_* scheduler knobs)
     # mpilint/mpiracer/mpiown (ompi_tpu/analysis/) are build-time gates

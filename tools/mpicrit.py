@@ -300,7 +300,61 @@ def summarize(att: Dict[str, Any], data: StepData) -> Dict[str, Any]:
     return out
 
 
-def format_line(s: Dict[str, Any]) -> str:
+def load_linkmap(directory: str) -> Dict[tuple, dict]:
+    """(src, dst) -> linkmodel edge row, merged from every readable
+    metrics-rank*.json in ``directory`` (the btl_tcp_linkmodel sampler
+    runtime/linkmodel.py exports). Each rank reports its OWN outbound
+    edges, so the merge covers the full fabric."""
+    import glob
+
+    out: Dict[tuple, dict] = {}
+    for path in sorted(glob.glob(
+            os.path.join(directory, "metrics-rank*.json"))):
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue  # mid-rewrite or gone
+        row = snap.get("samplers", {}).get("btl_tcp_linkmodel")
+        if not isinstance(row, dict):
+            continue
+        for e in row.get("edges") or []:
+            try:
+                out[(int(e["src"]), int(e["dst"]))] = e
+            except (KeyError, TypeError, ValueError):
+                continue
+    return out
+
+
+# linkmodel_rtt_degraded_us / linkmodel_loss_degraded_ppm defaults
+# (mirrored literals: this tool must stay importable without the
+# runtime — runtime/linkmodel.py owns the cvars)
+_RTT_DEGRADED_US = 50000.0
+_LOSS_DEGRADED_PPM = 5000.0
+
+
+def link_note(linkmap: Dict[tuple, dict], q: int, r: int) -> str:
+    """Annotate one wire hop with the edge's measured RTT/goodput/loss
+    so a 'wire-bound' verdict splits into 'link degraded' vs 'link
+    healthy, sender slow'. Empty when the fabric telemetry never
+    covered the edge."""
+    e = linkmap.get((q, r)) or linkmap.get((r, q))
+    if not e or not e.get("rtt_samples"):
+        return ""
+    srtt = float(e.get("srtt_us") or 0.0)
+    loss = float(e.get("loss_ppm") or 0.0)
+    bps = e.get("goodput_bps")
+    total = sum(float(v) for v in bps.values()) \
+        if isinstance(bps, dict) else 0.0
+    degraded = (e.get("state") not in (None, "est")
+                or srtt > _RTT_DEGRADED_US or loss > _LOSS_DEGRADED_PPM)
+    health = "link DEGRADED" if degraded else "link healthy"
+    return (f"; {health}: srtt {srtt / 1000.0:.1f}ms, goodput "
+            f"{total / 1e9:.3f}Gbps, loss {loss:.0f}ppm")
+
+
+def format_line(s: Dict[str, Any],
+                linkmap: Optional[Dict[tuple, dict]] = None) -> str:
     ms = lambda v: f"{v / 1000.0:.1f}"  # noqa: E731
     parts = [f"compute {ms(s['compute_us'])} (rank {s['compute_rank']})"]
     wired = s["wire_us"] + s["defer_us"]
@@ -309,6 +363,8 @@ def format_line(s: Dict[str, Any]) -> str:
         detail = f"{q}->{r} {s['wire_qos']}"
         if s["defer_us"] > 0:
             detail += f", {ms(s['defer_us'])} shaped-defer"
+        if linkmap:
+            detail += link_note(linkmap, q, r)
         parts.append(f"wire {ms(wired)} ({detail})")
     if s["wait_us"] > 0:
         parts.append(f"wait {ms(s['wait_us'])} (blocked on rank "
@@ -348,6 +404,12 @@ def main(argv=None) -> int:
                          "view; default: every step in order)")
     ap.add_argument("--json", action="store_true",
                     help="emit the attributions as JSON")
+    ap.add_argument("--metrics", default=None, metavar="DIR",
+                    help="metrics-rank*.json snapshot dir: annotate "
+                         "wire hops with the edge's measured RTT/"
+                         "goodput/loss (linkmodel fabric telemetry; "
+                         "default: the newest ompi-tpu-metrics-<job> "
+                         "temp dir, when one exists)")
     opts = ap.parse_args(argv)
     traces = []
     for t in opts.traces:  # a trace_dir is as natural an arg as files
@@ -376,8 +438,21 @@ def main(argv=None) -> int:
     if opts.json:
         print(json.dumps(summaries, indent=2))
         return 0
+    mdir = opts.metrics
+    if mdir is None:
+        # mpitop's default-dir mirror: the newest per-job metrics temp
+        # dir, silently skipped when metrics never ran
+        import glob as _glob
+        import tempfile
+
+        cands = [d for d in _glob.glob(os.path.join(
+            tempfile.gettempdir(), "ompi-tpu-metrics-*"))
+            if os.path.isdir(d)]
+        mdir = max(cands, key=lambda d: os.path.getmtime(d)) \
+            if cands else None
+    linkmap = load_linkmap(mdir) if mdir else {}
     for s in summaries:
-        print(format_line(s))
+        print(format_line(s, linkmap))
     flagged = sum(len(s["flagged"]) for s in summaries)
     if flagged:
         print(f"mpicrit: {flagged} edge pair(s) clamped to wire>=0 "

@@ -355,6 +355,45 @@ def _blame_era(edge: Edge, peer_dump: dict) -> str:
             f"its peer already abandoned")
 
 
+def _link_perf(link: dict) -> str:
+    """Fabric-telemetry annotation for a LINK verdict (linkmodel
+    fields in the btl.tcp debug_state): how the wire was PERFORMING,
+    so 'wire-bound' splits into 'link degraded' vs 'link healthy,
+    sender slow'."""
+    if not link.get("rtt_samples"):
+        return ""
+    parts = [f"srtt {float(link['srtt_us']) / 1000.0:.1f}ms"]
+    loss = link.get("loss_ppm")
+    if loss is not None:
+        parts.append(f"loss {float(loss):.0f}ppm")
+    acked = link.get("acked_bytes_by_class")
+    if acked:
+        parts.append(f"{sum(acked.values())}B delivered")
+    return " [" + ", ".join(parts) + "]"
+
+
+def _degrade_snapshot(dump: dict, peer: Any) -> str:
+    """The ft detector's journal entry for this peer's degrade edge:
+    srtt/goodput AT THE MOMENT the wire died (the live conn fields
+    reset across the outage)."""
+    det = dump.get("subsystems", {}).get("ft.detector", {})
+    for ev in reversed(det.get("link_events", [])):
+        if ev.get("rank") == peer and ev.get("event") == "degraded":
+            lk = ev.get("link") or {}
+            parts = []
+            if lk.get("srtt_us") is not None:
+                parts.append(f"srtt {float(lk['srtt_us']) / 1000.0:.1f}ms")
+            if lk.get("goodput_bps") is not None:
+                parts.append(
+                    f"goodput {float(lk['goodput_bps']) / 1e9:.3f}Gbps")
+            if lk.get("loss_ppm") is not None:
+                parts.append(f"loss {float(lk['loss_ppm']):.0f}ppm")
+            if parts:
+                return "; at degrade: " + ", ".join(parts)
+            break
+    return ""
+
+
 def link_verdicts(dumps: Dict[int, dict]) -> List[str]:
     """One LINK line per degraded/suspect tcp connection: the link
     layer's own evidence (reconnect-and-replay in flight) is a
@@ -378,7 +417,8 @@ def link_verdicts(dumps: Dict[int, dict]) -> List[str]:
                     f"{link.get('redial_attempts', '?')}/"
                     f"{link.get('redial_budget', '?')} "
                     f"(escalates to rank failure in "
-                    f"{link.get('deadline_in_s', '?')}s)")
+                    f"{link.get('deadline_in_s', '?')}s)"
+                    + _degrade_snapshot(dumps[rank], peer))
             elif link.get("retx_oldest_age_s", 0) and \
                     float(link["retx_oldest_age_s"]) > 1.0:
                 # established but the ack clock has stopped: the next
@@ -388,12 +428,14 @@ def link_verdicts(dumps: Dict[int, dict]) -> List[str]:
                     f"{link.get('retx_frames', 0)} frame(s) "
                     f"({link.get('retx_bytes', 0)}B) unacked for "
                     f"{link['retx_oldest_age_s']}s — ack clock "
-                    f"stalled, retransmit strike-out pending")
+                    f"stalled, retransmit strike-out pending"
+                    + _link_perf(link))
             elif int(link.get("reconnects", 0)) > 0:
                 lines.append(
                     f"LINK: rank {rank}→{peer} healthy after "
                     f"{link['reconnects']} reconnect(s), "
-                    f"{link.get('crc_errors', 0)} crc error(s)")
+                    f"{link.get('crc_errors', 0)} crc error(s)"
+                    + _link_perf(link))
     return lines
 
 

@@ -1,0 +1,300 @@
+"""mpinet — fabric weathermap over per-rank metrics snapshots.
+
+Each rank's ``btl_tcp_linkmodel`` sampler (``runtime/linkmodel.py``,
+``--mca linkmodel_enable 1`` + ``--mca metrics_enable 1``) exports its
+OWN outbound edges: passive SRTT/RTTVAR off the reliability envelope's
+ack clock (Jacobson/Karn), per-QoS-class delivered goodput (EWMA over
+ACKED wire bytes), and loss_ppm (per-conn retransmit + CRC-reject
+attribution). mpinet merges the per-rank ``metrics-rank<N>.json``
+snapshots into the N×N fabric view — three matrices (RTT ms, goodput
+Gbit/s, loss ppm; rows = src, cols = dst, ``-`` = no reliable conn /
+no samples) plus a one-line-per-edge detail listing.
+
+``--watch`` refreshes top-style (the mpitop loop); ``--check`` prints
+one verdict line per DEGRADED edge (SRTT or loss past the thresholds,
+or the link mid-outage) and exits nonzero when any edge is degraded —
+the CI/harness gate.
+
+Exit codes (the mpidiag discipline, plus the checker's):
+
+- 0 — snapshots read; with ``--check``, every edge healthy
+- 1 — no ``metrics-rank*.json`` found (telemetry never enabled, or the
+  wrong directory)
+- 2 — ``--check`` found at least one degraded edge
+
+Usage::
+
+    OMPI_TPU_MCA_metrics_enable=1 OMPI_TPU_MCA_linkmodel_enable=1 \\
+        python -m ompi_tpu.tools.mpirun -np 4 app.py
+    python tools/mpinet.py                  # N x N weathermap
+    python tools/mpinet.py --watch          # live refresh
+    python tools/mpinet.py --check          # degraded-edge verdicts
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# linkmodel_rtt_degraded_us / linkmodel_loss_degraded_ppm defaults
+# (mirrored literals: this tool must stay importable without dragging
+# the runtime in — runtime/linkmodel.py owns the cvars)
+_RTT_DEGRADED_US = 50000.0
+_LOSS_DEGRADED_PPM = 5000.0
+
+
+def read_snapshots(directory: str) -> Dict[int, dict]:
+    """rank -> snapshot for every readable metrics-rank*.json (the
+    mpitop reader: a mid-rewrite file is skipped, never fatal)."""
+    out: Dict[int, dict] = {}
+    for path in sorted(glob.glob(
+            os.path.join(directory, "metrics-rank*.json"))):
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        out[int(snap.get("rank", 0))] = snap
+    return out
+
+
+def merge_edges(snaps: Dict[int, dict]) -> Dict[Tuple[int, int], dict]:
+    """(src, dst) -> linkmodel edge row. Each rank measures its own
+    outbound edges, so the union is the directed fabric."""
+    edges: Dict[Tuple[int, int], dict] = {}
+    for rank, snap in snaps.items():
+        row = snap.get("samplers", {}).get("btl_tcp_linkmodel")
+        if not isinstance(row, dict):
+            continue
+        for e in row.get("edges") or []:
+            try:
+                src = int(e.get("src", rank))
+                dst = int(e["dst"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            edges[(src, dst)] = e
+    return edges
+
+
+def _goodput(e: dict) -> float:
+    bps = e.get("goodput_bps")
+    if not isinstance(bps, dict):
+        return 0.0
+    total = 0.0
+    for v in bps.values():
+        try:
+            total += float(v)
+        except (TypeError, ValueError):
+            continue
+    return total
+
+
+_LOSS_MIN_EVENTS = 3    # mirrors linkmodel: one NACK burst != a loss rate
+_LOSS_MIN_FRAMES = 32
+
+
+def degraded(e: dict, rtt_us: float, loss_ppm: float) -> bool:
+    """The shared edge-health verdict (mirrors linkmodel.degraded(),
+    including its statistical gate on the loss verdict: a ppm over a
+    handful of frames is noise, not a rate — rows from older snapshots
+    without the count fields keep the ungated behavior)."""
+    if e.get("state") not in (None, "est"):
+        return True
+    try:
+        if int(e.get("rtt_samples") or 0) and \
+                float(e.get("srtt_us") or 0.0) > rtt_us:
+            return True
+        return (float(e.get("loss_ppm") or 0.0) > loss_ppm
+                and int(e.get("nack_retx_n", _LOSS_MIN_EVENTS))
+                >= _LOSS_MIN_EVENTS
+                and int(e.get("tx_frames", _LOSS_MIN_FRAMES))
+                >= _LOSS_MIN_FRAMES)
+    except (TypeError, ValueError):
+        return False
+
+
+def _matrix(ranks: List[int], edges: Dict[Tuple[int, int], dict],
+            title: str, cell) -> List[str]:
+    """One N x N matrix block: rows = src, cols = dst."""
+    width = max(7, max((len(str(r)) for r in ranks), default=1) + 2)
+    head = f"{title:<10}" + "".join(f"{('->' + str(d)):>{width}}"
+                                    for d in ranks)
+    lines = [head]
+    for s in ranks:
+        row = f"{('rank ' + str(s)):<10}"
+        for d in ranks:
+            if s == d:
+                row += f"{'.':>{width}}"
+                continue
+            e = edges.get((s, d))
+            row += f"{cell(e) if e else '-':>{width}}"
+        lines.append(row)
+    return lines
+
+
+def render(snaps: Dict[int, dict],
+           edges: Dict[Tuple[int, int], dict],
+           rtt_us: float, loss_ppm: float) -> str:
+    ranks = sorted(set(snaps)
+                   | {r for e in edges for r in e})
+    lines: List[str] = []
+
+    def rtt_cell(e: dict) -> str:
+        if not e.get("rtt_samples"):
+            return "-"
+        v = f"{float(e.get('srtt_us') or 0.0) / 1000.0:.1f}"
+        return "*" + v if degraded(e, rtt_us, loss_ppm) else v
+
+    def gbps_cell(e: dict) -> str:
+        v = _goodput(e)
+        return f"{v / 1e9:.2f}" if v > 0 else "-"
+
+    def loss_cell(e: dict) -> str:
+        try:
+            v = float(e.get("loss_ppm") or 0.0)
+        except (TypeError, ValueError):
+            return "-"
+        return f"{v:.0f}" if v > 0 else "0"
+
+    lines += _matrix(ranks, edges, "RTT-MS", rtt_cell)
+    lines.append("")
+    lines += _matrix(ranks, edges, "GBPS", gbps_cell)
+    lines.append("")
+    lines += _matrix(ranks, edges, "LOSS-PPM", loss_cell)
+    lines.append("")
+    for (s, d) in sorted(edges):
+        e = edges[(s, d)]
+        mark = "DEGRADED" if degraded(e, rtt_us, loss_ppm) else "ok"
+        srtt = e.get("srtt_us")
+        lines.append(
+            f"  {s}->{d} [{mark}] state={e.get('state', '?')} "
+            f"srtt={'-' if not e.get('rtt_samples') else srtt}us "
+            f"(n={e.get('rtt_samples', 0)}) "
+            f"goodput={_goodput(e) / 1e9:.3f}Gbps "
+            f"loss={e.get('loss_ppm', 0)}ppm "
+            f"qdelay={e.get('queue_delay_us', 0)}us")
+    lines.append(f"-- {len(snaps)} rank snapshot(s), {len(edges)} "
+                 f"measured edge(s), refreshed "
+                 f"{time.strftime('%H:%M:%S')}")
+    return "\n".join(lines)
+
+
+def check(edges: Dict[Tuple[int, int], dict],
+          rtt_us: float, loss_ppm: float) -> Tuple[List[str], int]:
+    """Verdict lines + exit code for --check: one line per degraded
+    edge naming it (src->dst) and why."""
+    lines: List[str] = []
+    for (s, d) in sorted(edges):
+        e = edges[(s, d)]
+        if not degraded(e, rtt_us, loss_ppm):
+            continue
+        why: List[str] = []
+        if e.get("state") not in (None, "est"):
+            why.append(f"state {e.get('state')}")
+        try:
+            srtt = float(e.get("srtt_us") or 0.0)
+            if int(e.get("rtt_samples") or 0) and srtt > rtt_us:
+                why.append(f"srtt {srtt / 1000.0:.1f}ms > "
+                           f"{rtt_us / 1000.0:.1f}ms")
+            loss = float(e.get("loss_ppm") or 0.0)
+            if loss > loss_ppm \
+                    and int(e.get("nack_retx_n", _LOSS_MIN_EVENTS)) \
+                    >= _LOSS_MIN_EVENTS \
+                    and int(e.get("tx_frames", _LOSS_MIN_FRAMES)) \
+                    >= _LOSS_MIN_FRAMES:
+                why.append(f"loss {loss:.0f}ppm > {loss_ppm:.0f}ppm")
+        except (TypeError, ValueError):
+            pass
+        lines.append(f"DEGRADED: link {s}->{d}: " + ", ".join(why))
+    if not lines:
+        lines.append(f"OK: {len(edges)} measured edge(s) healthy")
+        return lines, 0
+    return lines, 2
+
+
+def _default_dir() -> str:
+    """The mpitop default-dir mirror (metrics.default_snapshot_dir):
+    the most recently modified ompi-tpu-metrics-<job> temp dir, CWD
+    fallback."""
+    import tempfile
+
+    cands = [d for d in glob.glob(os.path.join(
+        tempfile.gettempdir(), "ompi-tpu-metrics-*"))
+        if os.path.isdir(d)]
+    if not cands:
+        return "."
+    return max(cands, key=lambda d: os.path.getmtime(d))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mpinet",
+        description="N x N fabric weathermap (RTT / goodput / loss) "
+                    "over per-rank metrics snapshots")
+    ap.add_argument("--dir", default=None,
+                    help="snapshot directory (default: the newest "
+                         "ompi-tpu-metrics-<job> dir under the system "
+                         "temp dir, falling back to the CWD)")
+    ap.add_argument("--watch", action="store_true",
+                    help="refresh top-style until interrupted")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period for --watch (default 2s)")
+    ap.add_argument("--check", action="store_true",
+                    help="verdict lines for degraded edges; exit 2 "
+                         "when any edge is degraded")
+    ap.add_argument("--rtt-degraded-us", type=float,
+                    default=_RTT_DEGRADED_US,
+                    help="SRTT degraded threshold (mirrors "
+                         "linkmodel_rtt_degraded_us)")
+    ap.add_argument("--loss-degraded-ppm", type=float,
+                    default=_LOSS_DEGRADED_PPM,
+                    help="loss_ppm degraded threshold (mirrors "
+                         "linkmodel_loss_degraded_ppm)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged edge rows as JSON")
+    opts = ap.parse_args(argv)
+    directory = opts.dir if opts.dir is not None else _default_dir()
+
+    while True:
+        snaps = read_snapshots(directory)
+        if not snaps:
+            print(f"mpinet: no metrics-rank*.json under {directory} "
+                  "(fabric telemetry needs --mca metrics_enable 1 "
+                  "--mca linkmodel_enable 1; snapshots land under "
+                  "metrics_dir, or a per-job ompi-tpu-metrics-<pid> "
+                  "temp dir when unset — pass --dir)",
+                  file=sys.stderr)
+            if not opts.watch:
+                return 1
+        else:
+            edges = merge_edges(snaps)
+            if opts.json:
+                print(json.dumps(
+                    [dict(e, src=s, dst=d)
+                     for (s, d), e in sorted(edges.items())], indent=2))
+                return 0
+            if opts.check:
+                lines, code = check(edges, opts.rtt_degraded_us,
+                                    opts.loss_degraded_ppm)
+                print("\n".join(lines))
+                return code
+            frame = render(snaps, edges, opts.rtt_degraded_us,
+                           opts.loss_degraded_ppm)
+            if not opts.watch:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+        try:
+            time.sleep(opts.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

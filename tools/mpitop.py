@@ -12,7 +12,10 @@ queued-bytes-by-class cell (QKB-L/N/B, KB latency/normal/bulk) from
 the traffic-shaping gauges when ``btl_tcp_shape_enable`` is on, the
 LNK link-health cell (degraded links + retained frames while a
 reconnect-and-replay is in flight; recoveries/CRC rejects once
-healthy) from the ``btl_tcp_link`` sampler, and
+healthy) from the ``btl_tcp_link`` sampler, the RTT-MS / GBPS fabric
+cells (worst-edge smoothed RTT and summed delivered goodput from the
+``btl_tcp_linkmodel`` sampler — tools/mpinet.py renders the full N×N
+weathermap), and
 the BOUND cell (``<category>@<rank>``: the latest step's critical-path
 category and bound rank from the critpath sampler —
 tools/mpicrit.py is the offline ground truth).
@@ -207,6 +210,56 @@ def lnk_cell(snap: dict) -> str:
     return ""
 
 
+def rtt_cell(snap: dict) -> str:
+    """Worst-edge smoothed RTT in ms from the btl_tcp_linkmodel
+    sampler (runtime/linkmodel.py fabric telemetry); pvar fallback
+    (linkmodel_srtt_max_us) for snapshots written before the sampler
+    existed — the QKB-L/N/B pattern. Empty when no edge ever folded a
+    Karn-accepted sample."""
+    row = snap.get("samplers", {}).get("btl_tcp_linkmodel")
+    if isinstance(row, dict):
+        vals = []
+        for e in row.get("edges") or []:
+            try:
+                if int(e.get("rtt_samples") or 0):
+                    vals.append(float(e.get("srtt_us") or 0.0))
+            except (TypeError, ValueError):
+                continue
+        if vals:
+            return f"{max(vals) / 1000.0:.1f}"
+        return ""
+    try:
+        v = float(snap.get("pvars", {}).get("linkmodel_srtt_max_us"))
+    except (TypeError, ValueError):
+        return ""
+    return f"{v / 1000.0:.1f}" if v > 0 else ""
+
+
+def gbps_cell(snap: dict) -> str:
+    """Summed delivered-goodput EWMA (all edges, all QoS classes) in
+    Gbit/s from the btl_tcp_linkmodel sampler; pvar fallback
+    (linkmodel_goodput_bps) — the QKB-L/N/B pattern. Goodput is ACKED
+    wire bytes, so this reads 0 while a link retains without
+    delivering. Empty when telemetry never folded."""
+    row = snap.get("samplers", {}).get("btl_tcp_linkmodel")
+    if isinstance(row, dict):
+        total = 0.0
+        for e in row.get("edges") or []:
+            bps = e.get("goodput_bps")
+            if isinstance(bps, dict):
+                for v in bps.values():
+                    try:
+                        total += float(v)
+                    except (TypeError, ValueError):
+                        continue
+        return f"{total / 1e9:.2f}" if total > 0 else ""
+    try:
+        v = float(snap.get("pvars", {}).get("linkmodel_goodput_bps"))
+    except (TypeError, ValueError):
+        return ""
+    return f"{v / 1e9:.2f}" if v > 0 else ""
+
+
 def skew_by_rank(snaps: Dict[int, dict]) -> Dict[int, float]:
     """Worst coll_entry_skew_us EWMA per rank, pulled from every
     snapshot (comm roots hold the values for their members)."""
@@ -232,7 +285,8 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
     lines = [f"{'RANK':>4} {'AGE-S':>6} {'COLLS':>8} {'COLL/S':>7} "
              f"{'TX-MB':>9} {'RX-MB':>9} {'SKEW-US':>8} {'TRIPS':>5} "
              f"{'P50-US':>7} {'P99-US':>8} {'QKB-L/N/B':>10} "
-             f"{'STALL':>6} {'LNK':>8} {'BOUND':>8}"]
+             f"{'STALL':>6} {'LNK':>8} {'RTT-MS':>7} {'GBPS':>6} "
+             f"{'BOUND':>8}"]
     for rank in sorted(snaps):
         snap = snaps[rank]
         pv = snap.get("pvars", {})
@@ -257,7 +311,8 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
             f"{'' if p50 is None else format(p50, '.0f'):>7} "
             f"{'' if p99 is None else format(p99, '.0f'):>8} "
             f"{qos_queued(snap):>10} {stall_cell(snap):>6} "
-            f"{lnk_cell(snap):>8} {bound_cell(snap):>8}")
+            f"{lnk_cell(snap):>8} {rtt_cell(snap):>7} "
+            f"{gbps_cell(snap):>6} {bound_cell(snap):>8}")
     trips = sum(int(s.get("pvars", {}).get("metrics_straggler_trips", 0))
                 for s in snaps.values())
     lines.append(f"-- {len(snaps)} rank(s), {trips} straggler trip(s), "
