@@ -1066,6 +1066,84 @@ def bench_serving():
     return out
 
 
+def bench_autoscale():
+    """SLO-driven autoscaling (ROADMAP item 4 / serve.autoscale): the
+    resize RTO per trigger class ('arrival' = the demand-driven grow
+    through dpm.spawn + Merge/Split + elastic reshard, 'idle' = the
+    planned shrink through the kill->shrink+reshard path), the
+    steady-state step p99, and the LATENCY-class foreground p99 while
+    the brownout ladder sheds BULK/NORMAL — measured by one
+    tests/procmode/check_autoscale.py scenario run (grow -> steady ->
+    flash-crowd brownout -> shrink, world size decided by the
+    controller). Gauges mirror into the metrics registry so the BENCH
+    json and the Prometheus export agree."""
+    import os
+    import re
+    import subprocess
+
+    from ompi_tpu.runtime import metrics
+
+    env = _procmode_env()
+    here = os.path.dirname(os.path.abspath(__file__))
+    cmd = [sys.executable, "-m", "ompi_tpu.tools.mpirun", "-np", "2",
+           "--mca", "ft_enable", "1",
+           "--mca", "ft_heartbeat_period", "0.25",
+           "--mca", "ft_heartbeat_timeout", "4.0",
+           "--mca", "ft_era_timeout", "60",
+           "--mca", "coll_sm_enable", "0",
+           "--mca", "ft_ckpt_enable", "1",
+           "--mca", "ft_ckpt_timeout", "10",
+           "--mca", "forensics_enable", "1",
+           "--mca", "forensics_stall_threshold_ms", "30000",
+           "--mca", "serve_slo_us", "1000000.0",
+           "tests/procmode/check_autoscale.py", "scenario"]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=220, env=env, cwd=here)
+    except Exception as e:  # pragma: no cover
+        return {"error": str(e)[:300]}
+    if r.stdout.count("AUTOSCALE-OK") != 2:
+        return {"error": r.stdout[-300:] + r.stderr[-300:]}
+    out = {"rto_us": {}}
+    m = re.search(r"AUTOSCALE-GROW rank \d world=3 rto=([0-9.]+)us",
+                  r.stdout)
+    if m:
+        out["rto_us"]["arrival"] = float(m.group(1))
+    m = re.search(r"AUTOSCALE-SHRINK rank \d world=2 rto=([0-9.]+)us",
+                  r.stdout)
+    if m:
+        out["rto_us"]["idle"] = float(m.group(1))
+    m = re.search(r"AUTOSCALE-STEADY rank \d p50=([0-9.]+)us "
+                  r"p99=([0-9.]+)us violations=(\d+)", r.stdout)
+    if m:
+        out["steady"] = {"p50_us": float(m.group(1)),
+                         "p99_us": float(m.group(2)),
+                         "slo_violations": int(m.group(3))}
+    m = re.search(r"AUTOSCALE-LAT rank \d steady_p99=([0-9.]+)us "
+                  r"brownout_p99=([0-9.]+)us", r.stdout)
+    if m:
+        out["latency_class_p99_us"] = {"steady": float(m.group(1)),
+                                       "brownout": float(m.group(2))}
+    m = re.search(r"AUTOSCALE-BROWNOUT rank \d cause=(\w+) "
+                  r"shed_bulk=(\d+) shed_normal=(\d+)", r.stdout)
+    if m:
+        out["brownout"] = {"cause": m.group(1),
+                           "shed_bulk": int(m.group(2)),
+                           "shed_normal": int(m.group(3))}
+        metrics.gauge_set("bench_autoscale_shed_steps",
+                          float(m.group(2)), slo_class="bulk")
+        metrics.gauge_set("bench_autoscale_shed_steps",
+                          float(m.group(3)), slo_class="normal")
+    for trigger, v in out["rto_us"].items():
+        metrics.gauge_set("bench_autoscale_rto_us", v, trigger=trigger)
+    for phase, v in out.get("latency_class_p99_us", {}).items():
+        metrics.gauge_set("bench_autoscale_fg_p99_us", v, phase=phase)
+    if out.get("steady"):
+        metrics.gauge_set("bench_autoscale_steady_p99_us",
+                          out["steady"]["p99_us"])
+    return out
+
+
 def bench_link_telemetry():
     """Fabric-telemetry readout on a healthy 2-rank link: the
     runtime/linkmodel.py passive estimators (SRTT off the reliability
@@ -1203,6 +1281,7 @@ def main() -> int:
     detail["qos"] = bench_qos()
     detail["link_telemetry"] = bench_link_telemetry()
     detail["serving"] = bench_serving()
+    detail["autoscale"] = bench_autoscale()
     detail["host_paths"] = bench_host_paths()
     detail["model_step"] = bench_mfu()
 

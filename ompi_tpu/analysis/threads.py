@@ -102,6 +102,9 @@ APP_ENTRY_MODULES = (
     "serve/harness.py",
     "serve/traffic.py",
     "serve/churn.py",
+    # autoscaler decisions/resizes run inline at the app-thread step
+    # boundary; its metrics sampler is seeded as a daemon entry below
+    "serve/autoscale.py",
 )
 
 # Entries the serving/qos harnesses run on DAEMON THREADS beside the
@@ -118,6 +121,10 @@ APP_ENTRY_MODULES = (
 # collective stack it drives.
 DAEMON_ENTRY_FNS = (
     ("ft/diskless.py", None, "_ship"),  # qos storm/sink blob shippers
+    # the autoscaler's serve_autoscale_by_class sampler runs on the
+    # metrics snapshot thread and reads controller + gate state the
+    # app thread mutates
+    ("serve/autoscale.py", "Autoscaler", "_sample"),
 )
 
 # Registration calls whose fn argument becomes a progress-thread root.

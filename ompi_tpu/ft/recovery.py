@@ -33,6 +33,13 @@ and ``ft/diskless.py`` (in-memory replicated epochs):
   state.
 - :func:`resilient` wraps user code in the retry loop so an
   application writes its step function once.
+- :func:`grow` / :func:`join_grow` are the PLANNED capacity-expansion
+  twins of respawn/rejoin — the same spawn + Merge/Split choreography
+  with nobody dead: existing members keep their ranks, newcomers take
+  the new top ranks, and live state is redistributed through the
+  elastic N→M reshard engine. The serve-layer autoscaler
+  (serve/autoscale.py) drives this for scale-up; scale-down rides the
+  ordinary shrink path.
 
 Counters: ``ft_failovers`` / ``ft_retries`` / ``ft_respawns`` pvars
 (mirrored as spc counters) join the watchdog's ``pml_watchdog_trips``
@@ -72,7 +79,8 @@ RESPAWN_STATE_TAG = 4242
 #: shrunk-comm tag for parity-reconstruction blob exchange
 _PARITY_XCHG_TAG = 4243
 
-_counts: Dict[str, int] = {"failovers": 0, "retries": 0, "respawns": 0}
+_counts: Dict[str, int] = {"failovers": 0, "retries": 0, "respawns": 0,
+                           "grows": 0}
 
 # recovery-window depth: recover() publishes "a recovery is in flight
 # on this process" so step-boundary admission control (serve/policy's
@@ -97,6 +105,9 @@ register_pvar("ft", "retries", lambda: _counts["retries"],
 register_pvar("ft", "respawns", lambda: _counts["respawns"],
               help="Respawn-and-rejoin recoveries completed (original "
                    "world size restored)")
+register_pvar("ft", "grows", lambda: _counts["grows"],
+              help="Planned grow resizes completed (capacity expansion "
+                   "— the respawn machinery with nobody dead)")
 
 
 def _agree_survivors(comm) -> None:
@@ -552,6 +563,138 @@ def rejoin() -> Tuple[Any, Optional[dict], dict]:
     log.warning("rejoined as rank %d of %s (state source: %s)",
                 newcomm.Get_rank(), newcomm.name, meta.get("kind"))
     return newcomm, state, meta
+
+
+# ------------------------------------------------------- planned grow
+def grow(comm, nprocs: int, command: Optional[str] = None,
+         args: Optional[Tuple[str, ...]] = None,
+         state: Optional[dict] = None,
+         replicated: Tuple[str, ...] = (),
+         note: Optional[dict] = None) -> Tuple[Any, Optional[dict]]:
+    """Planned capacity EXPANSION: the respawn machinery with nobody
+    dead. Collective over ``comm`` (every member is a survivor); spawns
+    ``nprocs`` newcomers, merges them in, and re-ranks so the existing
+    members keep ranks ``0..n-1`` and the newcomers take
+    ``n..n+nprocs-1``. When ``state`` is given (REQUIRED to be given on
+    every member or on none — the redistribution is collective), it is
+    redistributed onto the grown world through an N→M elastic reshard
+    plan (``replicated`` names keys broadcast verbatim); newcomers
+    receive their partition inside :func:`join_grow`.
+
+    The grow publishes a recovery window (``recovering()``) for its
+    whole duration, so serve-layer admission holds new steps — no
+    collective ever tears across the membership change. Unlike
+    :func:`recover` there is no revoke/agree/shrink: the membership is
+    healthy, only growing.
+
+    ``note`` is a small JSON-serializable dict delivered verbatim to
+    the newcomers (``join_grow`` returns it) — the caller's channel for
+    controller state that must arrive consistent with the survivors
+    (cooldown clocks, policy mode), keeping deterministic controllers
+    deterministic across the resize.
+
+    Returns ``(new_comm, new_state_or_None)``."""
+    from ompi_tpu.ft import diskless
+    from ompi_tpu.quant import negotiate as _qneg
+    from ompi_tpu.runtime import spc
+    from ompi_tpu.runtime.dpm import spawn
+
+    if nprocs < 1:
+        raise MPIError(ERR_ARG, f"grow(nprocs={nprocs}): need >= 1")
+    old_rank = comm.Get_rank()
+    n = comm.Get_size()
+    _recovering[0] += 1
+    try:
+        if command is None:
+            command = os.path.abspath(sys.argv[0])
+        if args is None:
+            args = tuple(sys.argv[1:])
+        info = {"env_OMPI_TPU_GROW": "1",
+                "env_OMPI_TPU_GROW_BASE": str(n),
+                "env_OMPI_TPU_GROW_SIZE": str(n + nprocs),
+                "env_OMPI_TPU_GROW_RESHARD":
+                    "1" if state is not None else "0"}
+        if note is not None:
+            info["env_OMPI_TPU_GROW_NOTE"] = json.dumps(note)
+        inter = spawn(comm, command, tuple(args or ()),
+                      maxprocs=nprocs, root=0, info=info)
+        merged = inter.Merge(high=False)
+        newcomm = merged.Split(0, key=old_rank)
+        newcomm.name = f"{comm.name}-grown"
+        # membership changed: stale cached quant cards would split the
+        # per-communicator codec verdict between old and new members
+        _qneg.invalidate_cards()
+        # epoch-clock alignment over the NEW comm (newcomers included):
+        # everyone adopts the fastest clock so the next collective
+        # save() stamps the same epoch on every member
+        clocks = _allgather_obj(newcomm,
+                                {"next": diskless.next_epoch()})
+        diskless.rollback_to(max(c["next"] for c in clocks) - 1)
+        new_state = None
+        if state is not None:
+            from ompi_tpu.reshard.elastic import reshard_states
+
+            new_state = reshard_states(
+                newcomm, {old_rank: state}, n_old=n,
+                my_old_rank=old_rank, replicated=tuple(replicated))
+        _counts["grows"] += 1
+        spc.record("ft_grow")
+        if _trace.enabled():
+            _trace.instant("ft.grow", cat="ft", n_old=n,
+                           n_new=n + nprocs)
+        log.warning("grow complete: %s %d -> %d ranks (me=%d)",
+                    newcomm.name, n, newcomm.Get_size(),
+                    newcomm.Get_rank())
+        return newcomm, new_state
+    finally:
+        _recovering[0] -= 1
+
+
+def is_grown() -> bool:
+    """Is this process a newcomer launched by a planned grow?"""
+    return os.environ.get("OMPI_TPU_GROW") == "1"  # mpilint: disable=raw-environ — grow identity rides the dpm launch channel, like rank identity
+
+
+def join_grow(replicated: Tuple[str, ...] = ()
+              ) -> Tuple[Any, Optional[dict], Optional[dict]]:
+    """Newcomer side of the planned-grow choreography (detect with
+    :func:`is_grown`): merge with the existing members, take rank
+    ``base + child_rank`` on the grown comm, align the epoch clock and
+    receive this rank's partition of the redistributed state.
+    ``replicated`` must match the survivors' ``grow(...)`` call.
+    Returns ``(comm, state_or_None, note_or_None)``."""
+    from ompi_tpu.ft import diskless
+    from ompi_tpu.runtime import state as _state
+    from ompi_tpu.runtime.dpm import Comm_get_parent
+
+    world = _state.get_world()
+    parent = Comm_get_parent()
+    if parent is None:
+        raise MPIError(ERR_ARG, "join_grow() outside a grown process")
+    base = int(os.environ["OMPI_TPU_GROW_BASE"])  # mpilint: disable=raw-environ — grow identity rides the dpm launch channel, like rank identity
+    want = int(os.environ["OMPI_TPU_GROW_SIZE"])  # mpilint: disable=raw-environ — grow identity rides the dpm launch channel, like rank identity
+    reshard = os.environ.get("OMPI_TPU_GROW_RESHARD") == "1"  # mpilint: disable=raw-environ — grow identity rides the dpm launch channel, like rank identity
+    raw_note = os.environ.get("OMPI_TPU_GROW_NOTE")  # mpilint: disable=raw-environ — grow identity rides the dpm launch channel, like rank identity
+    merged = parent.Merge(high=True)
+    if merged.Get_size() != want:
+        raise MPIError(
+            ERR_ARG,
+            f"grow merge produced {merged.Get_size()} ranks, expected "
+            f"{want} — member set and spawn count disagree")
+    newcomm = merged.Split(0, key=base + world.Get_rank())
+    # SAME clock-alignment allgather the survivors run in grow()
+    clocks = _allgather_obj(newcomm, {"next": diskless.next_epoch()})
+    diskless.rollback_to(max(c["next"] for c in clocks) - 1)
+    state = None
+    if reshard:
+        from ompi_tpu.reshard.elastic import reshard_states
+
+        state = reshard_states(newcomm, {}, n_old=base,
+                               my_old_rank=None,
+                               replicated=tuple(replicated))
+    log.warning("grew in as rank %d of %s (world %d -> %d)",
+                newcomm.Get_rank(), newcomm.name, base, want)
+    return newcomm, state, (json.loads(raw_note) if raw_note else None)
 
 
 def resilient(checkpoint_dir: Optional[str] = None,

@@ -21,7 +21,11 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ompi_tpu.core.errors import MPIError, ERR_ARG, ERR_SPAWN
-from ompi_tpu.mca.var import register_var, get_var
+from ompi_tpu.mca.var import register_var, get_var, register_pvar
+from ompi_tpu.utils.backoff import Schedule
+from ompi_tpu.utils.output import get_logger
+
+log = get_logger("runtime.dpm")
 
 register_var(
     "dpm", "spawn_timeout", 30.0, float,
@@ -30,6 +34,25 @@ register_var(
          "failing the spawn with MPI_ERR_SPAWN on every rank — a child "
          "that dies pre-handshake must not hang the parent job's "
          "intercomm exchange forever", level=6)
+register_var(
+    "dpm", "spawn_retries", 2, int,
+    help="Launch attempts the spawn root retries after a TRANSIENT "
+         "failure (exec error, child dead before wireup, wireup "
+         "timeout) before giving up; each retry gets a fresh job "
+         "allocation and the failed attempt's children are reaped "
+         "first. 0 restores the old raise-on-first-hiccup behavior",
+    level=6)
+register_var(
+    "dpm", "spawn_retry_backoff_ms", 100.0, float,
+    help="Initial backoff between spawn launch attempts (shared "
+         "utils/backoff schedule: doubles per retry, capped at 16x, "
+         "jittered so concurrent spawners desynchronize)", level=6)
+
+_ctr = {"retried": 0}  # mpiracer: relaxed-counter — spawn-root-only bumps; pvar readers tolerate a stale view
+
+register_pvar("dpm", "spawn_retries", lambda: _ctr["retried"],
+              help="Spawn launch attempts that failed transiently and "
+                   "were retried within the dpm_spawn_retries budget")
 
 _parent_intercomm = None
 
@@ -98,15 +121,36 @@ def spawn(comm, command: str, args: Sequence[str] = (), maxprocs: int = 1,
     job = base = -1
     err = ""
     if comm.rank == root:
-        try:
-            job, base = modex.spawn(maxprocs)
-            _launch_children(command, list(args), maxprocs, job, base,
-                             parent_root=comm.pml.my_rank,
-                             spawn_tag=job, info=info or {}, ctx=ctx)
-            _await_child_wireup(modex, base, ctx["spawned"][-maxprocs:])
-        except Exception as e:
-            job, base = -1, -1
-            err = str(e)
+        # Transient launcher failures get a bounded retry: each attempt
+        # allocates a FRESH job (the failed attempt's universe-rank
+        # block is abandoned — its children are already reaped by the
+        # helpers, and endpoints wire lazily so nobody ever dials the
+        # dead block). Budget exhaustion keeps the original contract:
+        # the last failure rides the Bcast below and every rank raises
+        # ERR_SPAWN together.
+        sched = Schedule(
+            base_s=float(get_var("dpm", "spawn_retry_backoff_ms")) / 1e3,
+            cap_s=float(get_var("dpm", "spawn_retry_backoff_ms"))
+            / 1e3 * 16.0,
+            retries=int(get_var("dpm", "spawn_retries")))
+        while True:
+            try:
+                job, base = modex.spawn(maxprocs)
+                _launch_children(command, list(args), maxprocs, job,
+                                 base, parent_root=comm.pml.my_rank,
+                                 spawn_tag=job, info=info or {}, ctx=ctx)
+                _await_child_wireup(modex, base,
+                                    ctx["spawned"][-maxprocs:])
+                break
+            except Exception as e:
+                job, base = -1, -1
+                err = str(e)
+                if not sched.sleep():
+                    break
+                _ctr["retried"] += 1
+                log.warning("spawn attempt failed (%s); retrying "
+                            "(%d/%d)", e, sched.attempt,
+                            int(get_var("dpm", "spawn_retries")))
     meta = np.array([job, base], np.int64)
     comm.Bcast(meta, root=root)
     job, base = int(meta[0]), int(meta[1])
@@ -275,13 +319,16 @@ def _launch_children(command: str, args: List[str], n: int, job: int,
         argv_base = [command]
     for i in range(n):
         env = dict(os.environ)  # mpilint: disable=raw-environ — launcher wire-up plumbing (env IS the launch channel)
-        # respawn identity is NOT inherited: a replacement process that
-        # later performs an ordinary Comm_spawn must not brand ITS
-        # children as respawned (they would run rejoin() and hang
-        # waiting for a state delivery no survivor sends) — a real
-        # respawn re-adds these explicitly through `info`
+        # respawn/grow identity is NOT inherited: a replacement (or
+        # grown-in) process that later performs an ordinary Comm_spawn
+        # must not brand ITS children as respawned/grown (they would
+        # run rejoin()/join_grow() and hang waiting for a choreography
+        # no survivor is running) — a real respawn or grow re-adds
+        # these explicitly through `info`
         for key in ("OMPI_TPU_RESPAWN", "OMPI_TPU_RESPAWN_TARGETS",
-                    "OMPI_TPU_RESPAWN_SIZE"):
+                    "OMPI_TPU_RESPAWN_SIZE", "OMPI_TPU_GROW",
+                    "OMPI_TPU_GROW_BASE", "OMPI_TPU_GROW_SIZE",
+                    "OMPI_TPU_GROW_RESHARD", "OMPI_TPU_GROW_NOTE"):
             env.pop(key, None)
         env.update({
             "OMPI_TPU_RANK": str(i),

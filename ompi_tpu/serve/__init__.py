@@ -13,6 +13,11 @@ first-class tested scenario (ROADMAP item 4).
   kill_shrink / preempt_flush) composed with recovery under load.
 - :mod:`ompi_tpu.serve.harness` — the composed ServingHarness the
   procmode proof (tests/procmode/check_serving.py) drives.
+- :mod:`ompi_tpu.serve.autoscale` — the closed-loop capacity
+  controller: SLO-driven world-size decisions (grow via dpm.spawn +
+  Merge/Split + elastic reshard, planned shrink via the kill→shrink
+  path) with brownout load shedding by SLO class when scale-up cannot
+  keep up (BULK first, then NORMAL, never LATENCY).
 """
 
 from ompi_tpu.serve.slo import RTOClock, SLOTracker  # noqa: F401
@@ -24,3 +29,9 @@ from ompi_tpu.serve.churn import (  # noqa: F401
     Episode,
 )
 from ompi_tpu.serve.harness import ServingHarness  # noqa: F401
+from ompi_tpu.serve.autoscale import (  # noqa: F401
+    Autoscaler,
+    BrownoutLadder,
+    ScalePolicy,
+    Signals,
+)

@@ -1,0 +1,656 @@
+"""SLO-driven autoscaling with brownout load shedding (ROADMAP 3).
+
+The closed-loop capacity controller for the elastic serving harness:
+watch the load, *decide* the world size, and when capacity cannot
+follow the load any further, degrade deliberately instead of letting
+the SLO collapse for everyone. Three pieces:
+
+- :class:`ScalePolicy` — the pure decision function. Target world size
+  from offered load (rank-equivalents), queue depth and SLO headroom;
+  **deterministic and hysteretic**: asymmetric up/down utilization
+  thresholds (scaling up is cheap to need and expensive to regret;
+  scaling down is the reverse), per-direction cooldowns, min/max world
+  clamps, and a bounded step size. Scale-down is additionally clamped
+  to ONE rank per decision regardless of ``max_step``: the diskless
+  buddy ring replicates each rank's epoch on its successors, so
+  retiring one top rank always leaves its replica on a survivor —
+  retiring a whole block could retire a rank together with every
+  holder of its state.
+- :class:`BrownoutLadder` — the degraded mode. When scale-up cannot
+  keep up, shed load by SLO class: BULK first, then NORMAL, **never
+  LATENCY** — the foreground is the reason the service exists. The
+  ladder is latched (one spike is not a flap storm) and re-arms in
+  stages: after ``rearm_evals`` consecutive calm evaluations one rung
+  is restored, most-important-first (NORMAL before BULK).
+- :class:`Autoscaler` — the controller loop, hooked into the harness
+  at every step boundary (``before_step``). Scale-up runs
+  ``ft/recovery.grow`` (dpm.spawn + the Merge/Split respawn machinery
+  with nobody dead, then an N→M elastic reshard); scale-down retires
+  the top ranks through the kill→shrink+reshard path (final-flush,
+  barrier, clean exit; survivors shrink and reshard the committed
+  epoch). Both directions open a recovery window, so the PR 15
+  admission gate holds arrivals for the resize — no collective ever
+  tears across a membership change.
+
+Determinism contract: every member must reach the SAME decision at the
+SAME state step, because resizes are collective. That holds when
+``signal_fn`` is a pure function of shared state — the closed-form
+traffic curves (serve/traffic) are built for exactly this. A live
+deployment feeding per-rank EWMAs must agree on them first (allreduce
+at the evaluation boundary); feeding raw local EWMAs into a
+multi-rank controller diverges by construction. Newcomers spawned by
+a grow receive the policy's cooldown clocks through the grow note, so
+the controller stays deterministic across its own resizes.
+
+Brownout triggers (all journaled): overload at the world clamp
+(``max_world``), spawn budget exhausted (ERR_SPAWN after dpm's bounded
+retry), or a measured resize RTO above ``serve_autoscale_rto_budget_ms``
+(scaling that takes longer than the spike it chases is not a remedy).
+
+Every decision is journaled: pvars (``serve_autoscale_*``,
+``serve_shed_steps_{bulk,normal}``), trace instants, MPI_T events, a
+show_help banner per mode transition, and the
+``serve_autoscale_by_class`` metrics sampler tools/mpitop.py renders.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, NamedTuple, Optional, Tuple
+
+from ompi_tpu.core.errors import (MPIError, ERR_PROC_FAILED,
+                                  ERR_REVOKED, ERR_SPAWN)
+from ompi_tpu.mca.var import register_var, register_pvar
+from ompi_tpu.mpit import register_event_type
+from ompi_tpu.runtime import metrics as _metrics
+from ompi_tpu.runtime import trace as _trace
+from ompi_tpu.serve import slo as _slo
+from ompi_tpu.serve import traffic as _traffic
+from ompi_tpu.utils.output import get_logger
+from ompi_tpu.utils.show_help import register_topic, show_help
+
+log = get_logger("serve.autoscale")
+
+# ------------------------------------------------------------------ knobs
+_eval_var = register_var(
+    "serve", "autoscale_eval_steps", 4,
+    help="Controller evaluation cadence: one scaling decision every N "
+         "applied state steps (0 disables evaluation; shedding keeps "
+         "whatever the last decision latched)", level=5)
+_min_var = register_var(
+    "serve", "autoscale_min_world", 1,
+    help="World-size floor the controller never scales below", level=5)
+_max_var = register_var(
+    "serve", "autoscale_max_world", 0,
+    help="World-size ceiling the controller never scales above (0 = "
+         "unbounded); sustained overload AT the ceiling latches "
+         "brownout load shedding", level=5)
+_up_util_var = register_var(
+    "serve", "autoscale_up_util", 0.8, float,
+    help="Scale-up threshold: demand above world*up_util asks for more "
+         "ranks. Asymmetric against autoscale_down_util by design — "
+         "the hysteresis band between them is what keeps a flat load "
+         "from flapping the world size", level=6)
+_down_util_var = register_var(
+    "serve", "autoscale_down_util", 0.5, float,
+    help="Scale-down threshold: demand below (world-1)*down_util "
+         "retires a rank (see autoscale_up_util for the asymmetry)",
+    level=6)
+_up_cd_var = register_var(
+    "serve", "autoscale_up_cooldown_steps", 4,
+    help="State steps after a scale-up before the next scale-up "
+         "decision may fire (per-direction cooldown)", level=6)
+_down_cd_var = register_var(
+    "serve", "autoscale_down_cooldown_steps", 8,
+    help="State steps after a scale-down before the next scale-down "
+         "may fire — longer than the up cooldown: giving back capacity "
+         "too eagerly pays a resize RTO to re-learn the load", level=6)
+_step_var = register_var(
+    "serve", "autoscale_max_step", 1,
+    help="Most ranks one scale-UP decision may add (scale-down is "
+         "always one rank per decision: the buddy-replica coverage "
+         "argument in the module doc)", level=6)
+_queue_high_var = register_var(
+    "serve", "autoscale_queue_high", 4,
+    help="Admission-gate queue depth that constitutes scale-up "
+         "pressure on its own (trigger class 'queue')", level=6)
+_headroom_var = register_var(
+    "serve", "autoscale_headroom_min", 0.1, float,
+    help="Minimum SLO headroom fraction ((slo - p99)/slo): below this "
+         "the controller asks for a rank even when the arrival-rate "
+         "signal is satisfied (trigger class 'slo')", level=6)
+_rearm_var = register_var(
+    "serve", "autoscale_rearm_evals", 2,
+    help="Consecutive calm evaluations before the brownout ladder "
+         "restores ONE shed class (staged re-arm, most important "
+         "first)", level=6)
+_rto_budget_var = register_var(
+    "serve", "autoscale_rto_budget_ms", 30000.0, float,
+    help="Resize RTO budget: a measured scale-up slower than this "
+         "latches brownout instead of scaling again (resizes slower "
+         "than the spike they chase are not a remedy)", level=6)
+
+register_topic(
+    "serve", "autoscale-mode",
+    "The serving autoscaler changed mode:\n{detail}\nModes: armed "
+    "(watching), scaling (a resize is in flight; admission holds at "
+    "the gate), brownout (capacity cannot follow load — shedding by "
+    "SLO class, BULK first, then NORMAL, never LATENCY; re-arms after "
+    "serve_autoscale_rearm_evals calm evaluations).")
+register_event_type("serve", "autoscale_decision",
+                    "One journaled autoscaling decision (world/target/"
+                    "trigger/demand payload)")
+register_event_type("serve", "brownout",
+                    "Brownout latched or released (cause and shed-set "
+                    "payload)")
+
+_ctr: Dict[str, int] = {  # mpiracer: relaxed-counter — serving-loop-only bumps; pvar readers tolerate a stale view
+    "decisions": 0, "ups": 0, "downs": 0, "brownouts": 0,
+    "shed_bulk": 0, "shed_normal": 0}
+
+register_pvar("serve", "autoscale_decisions",
+              lambda: _ctr["decisions"],
+              help="Controller evaluations journaled (every eval "
+                   "boundary, resize or hold)")
+register_pvar("serve", "autoscale_scale_ups", lambda: _ctr["ups"],
+              help="Scale-up resizes decided (grow via dpm.spawn + "
+                   "Merge/Split + elastic reshard)")
+register_pvar("serve", "autoscale_scale_downs", lambda: _ctr["downs"],
+              help="Scale-down resizes decided (planned retirement "
+                   "through the shrink+reshard path)")
+register_pvar("serve", "autoscale_brownouts",
+              lambda: _ctr["brownouts"],
+              help="Brownout latches (scale-up could not keep up; load "
+                   "shedding engaged)")
+register_pvar("serve", "shed_steps_bulk", lambda: _ctr["shed_bulk"],
+              help="BULK-class arrivals shed during brownout (fast-"
+                   "failed, no collective issued)")
+register_pvar("serve", "shed_steps_normal",
+              lambda: _ctr["shed_normal"],
+              help="NORMAL-class arrivals shed during brownout (BULK "
+                   "is always shed first; LATENCY is never shed)")
+
+#: sampler/mpitop mode encoding (the string rides the sampler too)
+MODES = ("armed", "scaling", "brownout")
+
+
+class Signals(NamedTuple):
+    """One evaluation's inputs. ``arrival_ranks`` is offered load in
+    rank-equivalents (one rank serves one arrival per pacing period at
+    full utilization); ``queue_depth`` is the admission-gate backlog;
+    ``slo_headroom`` is ``(slo - p99)/slo`` (1 = idle, <0 = violating).
+    Every member must feed the controller the SAME values — see the
+    module determinism contract."""
+
+    arrival_ranks: float
+    queue_depth: float = 0.0
+    slo_headroom: float = 1.0
+
+
+# ---------------------------------------------------------------- policy
+class ScalePolicy:
+    """The pure, deterministic, hysteretic target-size function (see
+    module doc). Holds only the per-direction cooldown clocks; every
+    knob defaults to its ``serve_autoscale_*`` cvar at decision time so
+    a mid-run retune applies without rebuilding the controller."""
+
+    def __init__(self, min_world: Optional[int] = None,
+                 max_world: Optional[int] = None,
+                 up_util: Optional[float] = None,
+                 down_util: Optional[float] = None,
+                 up_cooldown: Optional[int] = None,
+                 down_cooldown: Optional[int] = None,
+                 max_step: Optional[int] = None,
+                 queue_high: Optional[float] = None,
+                 headroom_min: Optional[float] = None):
+        self._min = min_world
+        self._max = max_world
+        self._up_util = up_util
+        self._down_util = down_util
+        self._up_cd = up_cooldown
+        self._down_cd = down_cooldown
+        self._step = max_step
+        self._queue_high = queue_high
+        self._headroom = headroom_min
+        #: state-step clocks of the last decision per direction (the
+        #: cooldowns); carried to grow newcomers in the resize note
+        self.last_up: Optional[int] = None
+        self.last_down: Optional[int] = None
+
+    # knob reads fall back to the live cvars
+    def min_world(self) -> int:
+        return max(int(_min_var._value) if self._min is None
+                   else int(self._min), 1)
+
+    def max_world(self) -> int:
+        m = int(_max_var._value) if self._max is None else int(self._max)
+        return m if m > 0 else 1 << 30
+
+    def up_util(self) -> float:
+        return float(_up_util_var._value) if self._up_util is None \
+            else float(self._up_util)
+
+    def down_util(self) -> float:
+        return float(_down_util_var._value) if self._down_util is None \
+            else float(self._down_util)
+
+    def _pressure(self, world: int, sig: Signals) -> Optional[str]:
+        """The scale-up trigger class, or None without up pressure.
+        Ordered: the arrival-rate signal is the primary (it carries
+        magnitude); queue depth and SLO headroom are the lagging
+        confirmations that catch a mis-modeled per-rank capacity."""
+        if sig.arrival_ranks > world * self.up_util():
+            return "arrival"
+        qh = float(_queue_high_var._value) if self._queue_high is None \
+            else float(self._queue_high)
+        if sig.queue_depth >= qh:
+            return "queue"
+        hm = float(_headroom_var._value) if self._headroom is None \
+            else float(self._headroom)
+        if sig.slo_headroom < hm:
+            return "slo"
+        return None
+
+    def decide(self, world: int, sig: Signals,
+               step: int) -> Tuple[int, Optional[str]]:
+        """Target world size for this evaluation. Returns ``(target,
+        trigger)``; ``trigger`` is the scale-up trigger class
+        ('arrival'|'queue'|'slo'), 'idle' for a scale-down, None for a
+        hold. Advances the cooldown clock of the direction taken."""
+        trigger = self._pressure(world, sig)
+        up_cd = int(_up_cd_var._value) if self._up_cd is None \
+            else int(self._up_cd)
+        if trigger is not None and world < self.max_world():
+            if self.last_up is not None and step - self.last_up < up_cd:
+                return world, None  # cooling down
+            import math
+
+            need = max(world + 1,
+                       math.ceil(sig.arrival_ranks
+                                 / max(self.up_util(), 1e-9)))
+            ms = max(int(_step_var._value) if self._step is None
+                     else int(self._step), 1)
+            target = min(world + ms, need, self.max_world())
+            self.last_up = step
+            return target, trigger
+        down_cd = int(_down_cd_var._value) if self._down_cd is None \
+            else int(self._down_cd)
+        if (trigger is None and world > self.min_world()
+                and sig.arrival_ranks < (world - 1) * self.down_util()):
+            if self.last_down is not None \
+                    and step - self.last_down < down_cd:
+                return world, None
+            self.last_down = step
+            return world - 1, "idle"  # ONE rank: replica coverage
+        return world, None
+
+    def overloaded(self, world: int, sig: Signals) -> bool:
+        """Scale-up pressure that scaling cannot relieve: pressure
+        exists and the world is already at the ceiling."""
+        return world >= self.max_world() \
+            and self._pressure(world, sig) is not None
+
+
+# -------------------------------------------------------------- brownout
+class BrownoutLadder:
+    """Latched shed ladder with staged re-arm (see module doc). The
+    rung order IS the policy: BULK before NORMAL, and 'latency' is
+    structurally not a rung — no escalation can ever shed it."""
+
+    RUNGS = ("bulk", "normal")
+
+    def __init__(self, rearm_evals: Optional[int] = None):
+        self._rearm = rearm_evals
+        self.shed: set = set()
+        self.latched = False
+        self._calm = 0
+
+    def rearm_evals(self) -> int:
+        return max(int(_rearm_var._value) if self._rearm is None
+                   else int(self._rearm), 1)
+
+    def should_shed(self, slo_class: str) -> bool:
+        return slo_class in self.shed
+
+    def note_eval(self, overloaded: bool) -> Optional[str]:
+        """One controller evaluation under the latch: escalate one
+        rung per overloaded eval, restore one rung per calm streak
+        (most important first — NORMAL comes back before BULK).
+        Returns the transition taken for journaling, or None."""
+        if overloaded:
+            self._calm = 0
+            if not self.latched:
+                self.latched = True
+                self.shed.add(self.RUNGS[0])
+                return f"shed:{self.RUNGS[0]}"
+            for rung in self.RUNGS:
+                if rung not in self.shed:
+                    self.shed.add(rung)
+                    return f"shed:{rung}"
+            return None
+        if not self.latched:
+            return None
+        self._calm += 1
+        if self._calm < self.rearm_evals():
+            return None
+        self._calm = 0
+        for rung in reversed(self.RUNGS):
+            if rung in self.shed:
+                self.shed.discard(rung)
+                if not self.shed:
+                    self.latched = False
+                    return f"restore:{rung}:disarm"
+                return f"restore:{rung}"
+        self.latched = False
+        return "disarm"
+
+
+# ------------------------------------------------------------ controller
+class Autoscaler:
+    """The closed-loop controller (see module doc). Construct with the
+    harness it steers and a deterministic ``signal_fn(step) ->
+    Signals`` (a float return is promoted to ``Signals(arrival_ranks=
+    f)``), then ``harness.attach_autoscaler(self)``."""
+
+    def __init__(self, harness,
+                 signal_fn: Callable[[int], "Signals | float"],
+                 policy: Optional[ScalePolicy] = None,
+                 ladder: Optional[BrownoutLadder] = None,
+                 spawn_command: Optional[str] = None,
+                 spawn_args: Tuple[str, ...] = (),
+                 replicated: Tuple[str, ...] = ("step", "acc")):
+        self.harness = harness
+        self.signal_fn = signal_fn
+        self.policy = policy if policy is not None else ScalePolicy()
+        self.ladder = ladder if ladder is not None else BrownoutLadder()
+        self.spawn_command = spawn_command
+        self.spawn_args = tuple(spawn_args)
+        self.replicated = tuple(replicated)
+        self.rto = _slo.RTOClock(name="serve_autoscale_rto_us")
+        self.mode = "armed"
+        self.brownout_cause: Optional[str] = None
+        self._last_eval: Optional[int] = None
+        self._attempt = 0  # shed attempts within the current step
+        self._cls: Optional[str] = None
+        self._pending_rto: Optional[str] = None
+        self._rto_blown: Optional[str] = None
+        self._spawn_failed = False
+        # the live-instance sampler: re-registration rebinds, so a
+        # rebuilt controller reports the LIVE instance
+        _metrics.register_sampler("serve_autoscale_by_class",
+                                  self._sample)
+        harness.attach_autoscaler(self)
+
+    # ------------------------------------------------------ step hooks
+    def before_step(self, harness) -> bool:
+        """Harness decision point before one arrival: evaluate the
+        policy at eval boundaries (may resize the world inline, inside
+        a recovery window the admission gate honors), then apply the
+        shed verdict for this arrival. Returns False to shed (no state
+        step, no collective). Deterministic in shared state — every
+        member sheds the same arrivals."""
+        step = harness.state_step()
+        es = int(_eval_var._value)
+        if es > 0 and step % es == 0 and step != self._last_eval:
+            self._last_eval = step
+            self._evaluate(harness, step)
+        # the arrival's SLO class: keyed on (state step, attempt) so
+        # the sequence is identical on every member AND advances while
+        # shedding (a shed keyed on the state step alone would shed
+        # the same stuck step forever)
+        cls = _traffic.slo_class_of(harness.seed,
+                                    step * 1009 + self._attempt)
+        self._cls = cls
+        if self.mode == "brownout" and self.ladder.should_shed(cls):
+            self._attempt += 1
+            _ctr["shed_" + cls] += 1
+            return False
+        return True
+
+    def note_step_applied(self, step: int) -> None:
+        """Harness completion note: one state step applied and verified
+        bitwise-correct on the live world — the resize RTO's stop
+        condition (same rule the churn driver uses for fault RTOs)."""
+        self._attempt = 0
+        if self._pending_rto is None:
+            return
+        trigger = self._pending_rto
+        self._pending_rto = None
+        rto_us = self.rto.stop(trigger)
+        if self.mode == "scaling":
+            self._set_mode("armed",
+                           f"resize settled (trigger {trigger}, rto "
+                           f"{0 if rto_us is None else rto_us:.0f}us)")
+        budget_us = float(_rto_budget_var._value) * 1000.0
+        if rto_us is not None and rto_us > budget_us:
+            # journal now, latch at the next evaluation (entering
+            # brownout is an eval-boundary decision like any other)
+            self._rto_blown = trigger
+            log.warning("resize RTO %.0fus blew the %.0fus budget "
+                        "(trigger %s)", rto_us, budget_us, trigger)
+
+    def last_class(self) -> Optional[str]:
+        """SLO class of the most recent arrival decision (the
+        harness's per-class latency tap reads this)."""
+        return self._cls
+
+    # ------------------------------------------------------ evaluation
+    def _evaluate(self, harness, step: int) -> None:
+        comm = harness.gate.comm
+        world = comm.Get_size()
+        sig = self.signal_fn(step)
+        if not isinstance(sig, Signals):
+            sig = Signals(arrival_ranks=float(sig))
+        _ctr["decisions"] += 1
+        # the journal: demand/world EWMAs + gauges every evaluation
+        _metrics.ewma_update("serve_autoscale_demand",
+                             sig.arrival_ranks)
+        _metrics.gauge_set("serve_autoscale_world", float(world))
+        overloaded = (self._spawn_failed
+                      or self._rto_blown is not None
+                      or self.policy.overloaded(world, sig))
+        if self.mode == "brownout":
+            act = self.ladder.note_eval(overloaded)
+            self._spawn_failed = False
+            self._rto_blown = None
+            if act is not None:
+                self._journal(step, world, world, f"brownout:{act}",
+                              sig)
+            if not self.ladder.latched:
+                self.brownout_cause = None
+                self._set_mode("armed", "brownout re-armed (calm "
+                               "evaluations restored every shed class)")
+            return
+        target, trigger = self.policy.decide(world, sig, step)
+        if target > world:
+            self._journal(step, world, target, f"up:{trigger}", sig)
+            self._scale_up(harness, world, target, trigger or "arrival")
+            return
+        if target < world:
+            self._journal(step, world, target, "down:idle", sig)
+            self._scale_down(harness, world, target)
+            return
+        if overloaded:
+            cause = ("spawn_budget" if self._spawn_failed else
+                     "rto_budget" if self._rto_blown is not None else
+                     "max_world")
+            self._spawn_failed = False
+            self._rto_blown = None
+            self._enter_brownout(step, world, cause, sig)
+
+    # --------------------------------------------------------- resizes
+    def _scale_up(self, harness, world: int, target: int,
+                  trigger: str) -> None:
+        from ompi_tpu.ft.recovery import grow
+
+        self._set_mode("scaling",
+                       f"scale-up {world}->{target} (trigger "
+                       f"{trigger})")
+        _ctr["ups"] += 1
+        self.rto.start(trigger)
+        self._pending_rto = trigger
+        try:
+            newcomm, state = grow(
+                harness.gate.comm, target - world,
+                command=self.spawn_command, args=self.spawn_args,
+                state=harness.state, replicated=self.replicated,
+                note=self.resize_note())
+        except MPIError as e:
+            if e.code != ERR_SPAWN:
+                raise
+            # spawn budget exhausted (dpm's bounded retry included):
+            # the world did NOT change — shed instead of spinning
+            self.rto.cancel(trigger)
+            self._pending_rto = None
+            self._spawn_failed = True
+            log.warning("scale-up spawn failed after retry budget: %s",
+                        e)
+            self._enter_brownout(self._last_eval or 0, world,
+                                 "spawn_budget", None)
+            return
+        harness.adopt_resize(newcomm, state)
+
+    def _scale_down(self, harness, world: int, target: int) -> None:
+        from ompi_tpu.ft import diskless
+        from ompi_tpu.ft.detector import mark_failed
+        from ompi_tpu.ft.recovery import recover
+        from ompi_tpu.reshard.elastic import reshard_epoch
+        from ompi_tpu.runtime import spc
+
+        comm = harness.gate.comm
+        me = comm.Get_rank()
+        victims = list(range(target, world))
+        self._set_mode("scaling",
+                       f"scale-down {world}->{target} (retiring comm "
+                       f"ranks {victims})")
+        _ctr["downs"] += 1
+        self.rto.start("idle")
+        self._pending_rto = "idle"
+        # every member reaches the SAME boundary before a victim dies:
+        # the barrier pins the retirement to this step edge, so no
+        # survivor can be mid-collective when the victim disappears
+        with spc.suppressed():
+            try:
+                comm.Barrier()
+            except MPIError as e:
+                if e.code not in (ERR_PROC_FAILED, ERR_REVOKED):
+                    raise
+                # the victim exits only after ITS barrier completed,
+                # and barrier completion anywhere proves every member
+                # already entered this boundary — so a survivor-side
+                # tear here (the victim's release frame can be lost
+                # when its process exits before the ack) is benign.
+                # Swallow it and continue the PLANNED retirement:
+                # unwinding would hand this member to the harness's
+                # UNPLANNED tear handler, which races the other
+                # survivors' shrink+reshard choreography (found as a
+                # cross-path deadlock by mpidiag under load).
+                log.warning("retirement barrier tore (%s): victim "
+                            "already gone, continuing planned shrink",
+                            e)
+        if me in victims:
+            # retire: final-flush ships this rank's state to its
+            # buddies and burns the grace window driving progress (the
+            # barrier frames drain with it), then exit cleanly — exit
+            # 0 because the launcher treats nonzero as a job abort
+            log.warning("autoscale: retiring (comm rank %d of %d)",
+                        me, world)
+            if _trace.enabled():
+                _trace.instant("serve.autoscale.retire", cat="serve",
+                               rank=me, world=world)
+            diskless.flush_final(0.25)
+            os._exit(0)
+        for v in victims:
+            mark_failed(comm.group.world_rank(v))
+        shrunk, _ = recover(comm, policy="shrink")
+        state, _epoch = reshard_epoch(shrunk, me, world,
+                                      replicated=self.replicated)
+        harness.adopt_resize(shrunk, state)
+
+    # ------------------------------------------------------- journaling
+    def _journal(self, step: int, world: int, target: int,
+                 decision: str, sig: Optional[Signals]) -> None:
+        from ompi_tpu import mpit
+
+        demand = 0.0 if sig is None else float(sig.arrival_ranks)
+        mpit.emit("serve", "autoscale_decision", step=step,
+                  world=world, target=target, decision=decision,
+                  demand=demand)
+        if _trace.enabled():
+            _trace.instant("serve.autoscale.decision", cat="serve",
+                           step=step, world=world, target=target,
+                           decision=decision, demand=demand)
+        log.warning("autoscale step %d: %s (world %d -> %d, demand "
+                    "%.2f)", step, decision, world, target, demand)
+
+    def _enter_brownout(self, step: int, world: int, cause: str,
+                        sig: Optional[Signals]) -> None:
+        from ompi_tpu import mpit
+
+        self.brownout_cause = cause
+        _ctr["brownouts"] += 1
+        act = self.ladder.note_eval(True)
+        self._set_mode("brownout",
+                       f"cause {cause}: shedding {sorted(self.ladder.shed)} "
+                       "(BULK first, then NORMAL, never LATENCY)")
+        mpit.emit("serve", "brownout", cause=cause,
+                  shed=sorted(self.ladder.shed))
+        self._journal(step, world, world, f"brownout:{act or 'latch'}",
+                      sig)
+
+    def _set_mode(self, mode: str, detail: str) -> None:
+        if mode == self.mode:
+            return
+        prev, self.mode = self.mode, mode
+        show_help("serve", "autoscale-mode", once=False,
+                  detail=f"  {prev} -> {mode}: {detail}")
+        if _trace.enabled():
+            _trace.instant("serve.autoscale.mode", cat="serve",
+                           prev=prev, mode=mode)
+
+    # ------------------------------------------------- resize handover
+    def resize_note(self) -> dict:
+        """Controller state a grow newcomer needs to keep decisions
+        identical to the survivors': the policy cooldown clocks (mode
+        is always 'scaling' at a grow — the newcomer starts 'armed',
+        which survivors reach at the first applied step). ``last_eval``
+        keeps the newcomer from re-evaluating the very step the grow
+        decision fired on."""
+        return {"last_up": self.policy.last_up,
+                "last_down": self.policy.last_down,
+                "last_eval": self._last_eval}
+
+    def apply_note(self, note: Optional[dict]) -> None:
+        """Newcomer side: adopt the survivors' cooldown clocks from the
+        grow note (``ft/recovery.join_grow`` returns it)."""
+        if not note:
+            return
+        if note.get("last_up") is not None:
+            self.policy.last_up = int(note["last_up"])
+        if note.get("last_down") is not None:
+            self.policy.last_down = int(note["last_down"])
+        if note.get("last_eval") is not None:
+            self._last_eval = int(note["last_eval"])
+
+    # ---------------------------------------------------------- sampler
+    def _sample(self) -> Dict[str, object]:
+        """The ``serve_autoscale_by_class`` sampler: numeric keys render
+        as one labeled Prometheus gauge family; the ``mode_name``
+        string is JSON-only (skipped by the renderer, read by
+        tools/mpitop.py)."""
+        gate = self.harness.gate
+        return {
+            "world": float(gate.comm.Get_size()),
+            "mode": float(MODES.index(self.mode)
+                          if self.mode in MODES else -1),
+            "shed_bulk": float(_ctr["shed_bulk"]),
+            "shed_normal": float(_ctr["shed_normal"]),
+            "queue_depth": float(gate.queue_depth()),
+            "oldest_wait_us": float(gate.oldest_wait_us()),
+            "mode_name": self.mode,
+        }
+
+
+def reset_for_testing() -> None:
+    for k in _ctr:
+        _ctr[k] = 0

@@ -109,6 +109,13 @@ class ServingHarness:
             on_recovered=self._on_recovered)
         self.gen = _traffic.TrafficGen(self.tracker, seed=self.seed)
         self._out = np.zeros(self.count, np.float64)
+        #: optional closed-loop capacity controller (serve/autoscale):
+        #: consulted at every step boundary for resize + shed decisions
+        self.scaler = None
+        #: phase label on the per-class latency histograms (a bench or
+        #: proof names its traffic phases so pre-spike and brownout
+        #: distributions stay separable in one metrics snapshot)
+        self.phase = ""
         self._attach(comm)
 
     # ----------------------------------------------------------- plumbing
@@ -144,7 +151,33 @@ class ServingHarness:
         benches serve a warmup phase, then cut over."""
         self.tracker = _slo.SLOTracker(**labels)
         self.gen = _traffic.TrafficGen(self.tracker, seed=self.seed)
+        if self.scaler is not None:
+            self.gen.on_observe = self._class_tap
         return self.tracker
+
+    def attach_autoscaler(self, scaler) -> None:
+        """Bind a serve/autoscale controller: it gets a decision point
+        before every arrival (resize or shed) and a completion note
+        after every applied step; per-SLO-class latency histograms
+        start flowing through the traffic tap."""
+        self.scaler = scaler
+        self.gen.on_observe = self._class_tap
+
+    def set_phase(self, name: str) -> None:
+        """Label subsequent per-class latency samples with a traffic
+        phase (steady/brownout/...) so one snapshot keeps the
+        distributions separable."""
+        self.phase = str(name)
+
+    def _class_tap(self, step: int, lat_us: float) -> None:
+        """TrafficGen per-arrival tap: attribute the latency sample the
+        tracker just saw to the arrival's SLO class (shed arrivals
+        report under their class too — a fast-failed BULK request is
+        still a BULK outcome)."""
+        cls = None if self.scaler is None else self.scaler.last_class()
+        if cls:
+            _metrics.observe("serve_class_step_us", lat_us,
+                             slo_class=cls, phase=self.phase)
 
     def _on_recovered(self, comm, state, fault_class: str) -> None:
         """ChurnDriver seam: adopt the recovered comm/state. ``state``
@@ -162,6 +195,28 @@ class ServingHarness:
         log.warning("serving: recovered (%s) at state step %d on %d "
                     "ranks", fault_class, self.state_step(),
                     comm.Get_size())
+
+    def adopt_resize(self, comm, state: Optional[Dict[str, np.ndarray]]
+                     = None) -> None:
+        """Autoscaler seam: adopt the comm (and resharded state) a
+        PLANNED resize produced, then commit a fresh epoch collectively
+        in the new layout — the rollback floor must cover the new
+        geometry before the next step can tear (a kill right after a
+        resize would otherwise reshard-restore into the OLD layout).
+        A grown-in newcomer calls this too (with its join_grow state),
+        which is what makes the commit collective."""
+        from ompi_tpu.ft import diskless
+
+        if state is not None:
+            self.state = state
+        self.gate.install(comm)
+        self.gate.full_size = comm.Get_size()
+        self._attach(comm)
+        if self.save_epochs and not diskless.save(comm, self.state):
+            raise MPIError(ERR_ARG,
+                           "post-resize epoch did not commit")
+        log.warning("serving: resized to %d ranks at state step %d",
+                    comm.Get_size(), self.state_step())
 
     def reconcile_live(self, comm=None) -> int:
         """Post-recovery step-skew reconcile for live-state (final-
@@ -203,6 +258,14 @@ class ServingHarness:
         return self._serve_one_inner(arrival)
 
     def _serve_one_inner(self, arrival: int) -> None:
+        # capacity decision point: the controller may resize the world
+        # here (inside its own admission-holding window) or shed this
+        # arrival by SLO class — a shed arrival consumes the arrival
+        # tick but applies NO state step and issues NO collective, so
+        # the decision's determinism (pure in shared state) is what
+        # keeps every member shedding the same arrivals
+        if self.scaler is not None and not self.scaler.before_step(self):
+            return
         if _metrics._enable_var._value:
             return self._serve_one_timed(arrival)
         comm = self.gate.admit()
@@ -218,6 +281,8 @@ class ServingHarness:
 
             diskless.save(comm, self.state)
         self.churn.note_correct_step(i)
+        if self.scaler is not None:
+            self.scaler.note_step_applied(i)
 
     def _serve_one_timed(self, arrival: int) -> None:
         """The metrics-enabled step, feeding the live critpath plane a
@@ -245,6 +310,8 @@ class ServingHarness:
 
             diskless.save(comm, self.state)
         self.churn.note_correct_step(i)
+        if self.scaler is not None:
+            self.scaler.note_step_applied(i)
         t3 = time.monotonic_ns()
         _metrics.note_critpath((t3 - t2) / 1e3, (t2 - t1) / 1e3,
                                (t1 - t0) / 1e3, 0.0,

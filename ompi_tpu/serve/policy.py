@@ -97,6 +97,33 @@ class AdmissionGate:
         #: count as degraded steps
         self.full_size = comm.Get_size() if degraded_size is None \
             else int(degraded_size)
+        # live wait-queue view (the autoscaler's scale-up signal and a
+        # metrics gauge pair): enter-instant per waiting step, keyed by
+        # a per-wait token. Plain dict mutated under the GIL; readers
+        # (sampler thread, controller) tolerate a one-poll-stale view.
+        self._waiting: Dict[int, int] = {}  # mpiracer: relaxed-counter — GIL-atomic dict ops; telemetry readers tolerate staleness
+        self._wait_seq = 0
+
+    # ------------------------------------------------- queue telemetry
+    def queue_depth(self) -> int:
+        """Steps currently waiting out a recovery/resize window at this
+        gate."""
+        return len(self._waiting)
+
+    def oldest_wait_us(self) -> float:
+        """Age of the longest-waiting queued step (0 when none)."""
+        w = list(self._waiting.values())
+        if not w:
+            return 0.0
+        return (time.monotonic_ns() - min(w)) / 1e3
+
+    def _publish_queue(self) -> None:
+        from ompi_tpu.runtime import metrics as _metrics
+
+        _metrics.gauge_set("serve_admission_queue_depth",
+                           float(self.queue_depth()))
+        _metrics.gauge_set("serve_admission_oldest_wait_us",
+                           self.oldest_wait_us())
 
     def install(self, comm) -> None:
         """Recovery seam: swap in the communicator recovery produced
@@ -129,26 +156,37 @@ class AdmissionGate:
             base_s=float(_backoff_var._value) / 1000.0,
             cap_s=float(_backoff_var._value) / 1000.0 * 64.0,
             deadline_s=float(_max_wait_var._value) / 1000.0)
-        while _recovery.recovering():
-            waited = True
-            if sched.expired():
-                # ERR_PENDING, deliberately NOT a survivable failure
-                # code: the window being stuck open means a recover()
-                # is already in flight on this process — classifying
-                # this as a peer failure would send the churn driver
-                # into a SECOND concurrent recovery on the same comm.
-                # Fail fast instead; only the operator can unstick a
-                # recovery that blew the hang budget.
-                raise MPIError(
-                    ERR_PENDING,
-                    "admission gate: recovery window still open past "
-                    f"serve_admission_max_wait_ms "
-                    f"({float(_max_wait_var._value):.0f}ms)")
-            delay = sched.next_delay()
-            if wait is not None:
-                wait()
-            elif delay:
-                time.sleep(delay)
+        token = None
+        try:
+            while _recovery.recovering():
+                if token is None:
+                    waited = True
+                    self._wait_seq += 1
+                    token = self._wait_seq
+                    self._waiting[token] = time.monotonic_ns()
+                self._publish_queue()  # depth + oldest age track the wait
+                if sched.expired():
+                    # ERR_PENDING, deliberately NOT a survivable failure
+                    # code: the window being stuck open means a recover()
+                    # is already in flight on this process — classifying
+                    # this as a peer failure would send the churn driver
+                    # into a SECOND concurrent recovery on the same comm.
+                    # Fail fast instead; only the operator can unstick a
+                    # recovery that blew the hang budget.
+                    raise MPIError(
+                        ERR_PENDING,
+                        "admission gate: recovery window still open past "
+                        f"serve_admission_max_wait_ms "
+                        f"({float(_max_wait_var._value):.0f}ms)")
+                delay = sched.next_delay()
+                if wait is not None:
+                    wait()
+                elif delay:
+                    time.sleep(delay)
+        finally:
+            if token is not None:
+                self._waiting.pop(token, None)
+                self._publish_queue()
         if waited:
             _ctr["queued"] += 1
         comm = self.comm

@@ -89,6 +89,64 @@ def step_sum(seed: int, step: int, nmembers: int) -> float:
                      for m in range(nmembers)))
 
 
+# ------------------------------------------------- load curves / classes
+# Closed-form offered-load curves for the autoscaling scenarios: demand
+# in RANK-EQUIVALENTS as a pure function of the step index, so every
+# member of a collective-symmetric controller computes the SAME target
+# world size at the same step boundary — no allreduce needed to agree
+# on what the traffic is doing (serve/autoscale.py's determinism rests
+# on this, the same way the state oracle rests on contribution()).
+
+def diurnal_demand(step: int, base: float, amp: float,
+                   period: int) -> float:
+    """Smooth day/night swing: ``base`` at the trough, ``base + amp``
+    at the peak, repeating every ``period`` steps."""
+    import math
+
+    phase = (step % max(int(period), 1)) / max(int(period), 1)
+    return float(base) + float(amp) * 0.5 * (1.0
+                                             - math.cos(2.0 * math.pi
+                                                        * phase))
+
+
+def spike_demand(step: int, base: float, peak: float, at: int,
+                 width: int) -> float:
+    """Square spike: ``peak`` for ``width`` steps starting at ``at``,
+    ``base`` everywhere else."""
+    return float(peak) if at <= step < at + width else float(base)
+
+
+def flash_crowd_demand(step: int, base: float, peak: float, at: int,
+                       ramp: int, hold: int) -> float:
+    """Flash crowd: linear ramp from ``base`` to ``peak`` over ``ramp``
+    steps starting at ``at``, hold at ``peak`` for ``hold`` steps, then
+    drop straight back to ``base`` (crowds arrive fast and leave
+    faster)."""
+    if step < at:
+        return float(base)
+    if step < at + ramp:
+        f = (step - at + 1) / max(int(ramp), 1)
+        return float(base) + (float(peak) - float(base)) * f
+    if step < at + ramp + hold:
+        return float(peak)
+    return float(base)
+
+
+#: deterministic SLO-class mix: per 8 arrivals, 2 LATENCY (foreground),
+#: 3 NORMAL, 3 BULK — the brownout shed ladder (BULK first, NORMAL
+#: next, LATENCY never) always has foreground work left to protect
+_CLASS_PATTERN = ("latency", "normal", "bulk", "normal",
+                  "latency", "bulk", "normal", "bulk")
+
+
+def slo_class_of(seed: int, k: int) -> str:
+    """SLO class of arrival ``k``: pure in ``(seed, k)`` — the same
+    everywhere, so load-shedding decisions keyed on it are
+    collective-symmetric by construction (the shedding analog of
+    :func:`contribution`)."""
+    return _CLASS_PATTERN[(seed * 17 + k * 5) % len(_CLASS_PATTERN)]
+
+
 def coll_step(comm, seed: int, step: int, count: int = 512,
               out: Optional[np.ndarray] = None) -> np.ndarray:
     """One procmode serving step: Allreduce the seeded contribution and
@@ -157,6 +215,11 @@ class TrafficGen:
         #: monotonic_ns issue instant of the most recent attempt — the
         #: RTO clock's anchor for the step a fault tears
         self.last_issue_ns = 0
+        #: optional per-arrival latency tap ``(step, latency_us)`` fed
+        #: the SAME sample the tracker sees — the serving harness wires
+        #: per-SLO-class histograms through this without the pacing
+        #: loop knowing about classes
+        self.on_observe: Optional[Callable[[int, float], None]] = None
 
     def run(self, nsteps: int, step_fn: Callable[[int], Any],
             on_error: Optional[Callable[[int, BaseException], None]]
@@ -199,8 +262,10 @@ class TrafficGen:
                     if retries > self.max_retries:
                         raise
                     on_error(step, e)  # recovery seam; may re-raise
-            self.tracker.observe(
-                (time.perf_counter() - t_anchor) * 1e6)
+            lat_us = (time.perf_counter() - t_anchor) * 1e6
+            self.tracker.observe(lat_us)
+            if self.on_observe is not None:
+                self.on_observe(step, lat_us)
             self.steps_done += 1
             _ctr["steps"] += 1
             step += 1

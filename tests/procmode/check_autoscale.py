@@ -1,0 +1,249 @@
+"""Closed-loop autoscaling scenario, selected by argv[1].
+
+``scenario`` (default, 2 ranks, ft + diskless buddies armed) — the
+composed autoscaling proof: one run drives closed-form traffic through
+grow -> steady -> flash-crowd brownout -> shrink, with the world size
+DECIDED by the serve/autoscale controller, never scripted:
+
+1. warmup (steps 0..4, demand 1.5): the controller holds at 2 ranks.
+2. grow (step-4 evaluation, diurnal demand ~2.0-2.3): scale-up
+   pressure (trigger class 'arrival') grows 2 -> 3 through dpm.spawn +
+   Merge/Split + the N->M elastic reshard; the spawned newcomer enters
+   this same script, detects ``is_grown()`` and joins mid-stream.
+3. steady (steps 5..12, diurnal): zero SLO violations, world holds at
+   3 (the diurnal swing stays inside the up/down hysteresis band).
+4. flash crowd (steps 12..18, demand ramps to 6.0 rank-equivalents):
+   the world is at ``max_world`` — scale-up cannot keep up, so the
+   controller latches BROWNOUT and sheds by SLO class: BULK at the
+   step-12 evaluation, NORMAL at step 14. LATENCY arrivals keep being
+   served (the applied steps during full shed are all latency-class by
+   construction), so the foreground p99 stays within its pre-spike
+   band.
+5. recovery (steps 18+, demand 1.0): staged re-arm restores NORMAL
+   (eval 18) then BULK + disarm (eval 20); the step-22 evaluation
+   scales 3 -> 2 down the kill->shrink+reshard path (the grown-in
+   newcomer retires cleanly; survivors reshard the committed epoch).
+
+The run must finish with exact arithmetic: state bitwise-equal to the
+closed-form oracle after EVERY resize and at the end (``acc`` equals
+the sum of per-step closed forms over the world size each step was
+actually served on), a measured resize RTO per trigger class
+('arrival' for the grow, 'idle' for the shrink) read back from the
+metrics plane, shed work accounted in the serve_shed_steps_* pvars,
+and ZERO forensics stall trips.
+
+Every number here is deterministic: demand is a pure function of the
+state step (serve/traffic closed-form curves), the SLO class of every
+arrival is ``slo_class_of(seed, step*1009 + attempt)``, and the policy
+is hysteretic with pinned thresholds — so the grow fires at exactly
+step 4, brownout latches at exactly step 12, and the shrink lands at
+exactly step 22, every run, on every rank.
+"""
+
+import faulthandler
+import signal as _signal
+import sys
+
+import ompi_tpu
+from ompi_tpu.ft.recovery import is_grown, join_grow
+from ompi_tpu.mca.var import all_pvars, set_var
+from ompi_tpu.runtime import metrics
+from ompi_tpu.serve import (Autoscaler, BrownoutLadder, ScalePolicy,
+                            ServingHarness)
+from ompi_tpu.serve import traffic as straffic
+
+SELF = __file__
+SEED = 3            # places NORMAL-class arrivals inside the full-shed
+                    # window (class walk starts: see slo_class_of)
+GROW_AT = 4         # evaluation that grows 2 -> 3
+SPIKE_AT = 12       # flash crowd onset == brownout latch evaluation
+CALM_AT = 18        # crowd gone; staged re-arm begins
+END = 26            # total applied steps
+pv = all_pvars()
+
+
+def demand(step: int) -> float:
+    """Offered load in rank-equivalents — pure in the state step."""
+    if step < GROW_AT:
+        return 1.5                                   # hold at 2 ranks
+    if step < SPIKE_AT:
+        # diurnal swing 2.0..2.3: above 2*0.8 (grow), below 3*0.8
+        # (hold at 3) and above 2*0.6 (no scale-down)
+        return straffic.diurnal_demand(step, base=2.0, amp=0.3,
+                                       period=8)
+    # flash crowd to 6.0 rank-equivalents, gone by CALM_AT
+    return straffic.flash_crowd_demand(step, base=1.0, peak=6.0,
+                                       at=SPIKE_AT, ramp=2, hold=4)
+
+
+def world_at(step: int) -> int:
+    """The world size each step is served on — the scenario's oracle
+    for the closed-form ``acc`` audit."""
+    if step < GROW_AT:
+        return 2
+    if step < 22:      # the step-22 evaluation shrinks BEFORE step 22
+        return 3
+    return 2
+
+
+def _mk_controller(h: ServingHarness) -> Autoscaler:
+    """Pinned policy on BOTH the origin members and the grown-in
+    newcomer — identical knobs are what keep the decision sequence
+    collective-symmetric."""
+    set_var("serve", "autoscale_eval_steps", 2)
+    # a loaded CI host can take seconds to fork+wire a newcomer; the
+    # RTO-budget brownout trigger has its own unit test
+    set_var("serve", "autoscale_rto_budget_ms", 120000.0)
+    policy = ScalePolicy(min_world=1, max_world=3, up_util=0.8,
+                         down_util=0.6, up_cooldown=2, down_cooldown=2,
+                         max_step=1)
+    return Autoscaler(h, demand, policy=policy,
+                      ladder=BrownoutLadder(rearm_evals=1),
+                      spawn_command=SELF, spawn_args=("scenario",))
+
+
+def _rto_us(name_class: str) -> str:
+    """Mean serve_autoscale_rto_us for one trigger class, read back
+    from the METRICS plane (not controller privates)."""
+    snap = metrics.snapshot()
+    for hh in snap["histograms"]:
+        if hh["name"] == "serve_autoscale_rto_us" and \
+                hh["labels"].get("fault_class") == name_class:
+            assert hh["count"] >= 1 and hh["sum"] > 0, name_class
+            return f"{hh['sum'] / hh['count']:.0f}us"
+    raise AssertionError(
+        f"no serve_autoscale_rto_us sample for trigger {name_class}")
+
+
+def _class_p99(phase: str, slo_class: str = "latency") -> float:
+    """p99 (upper-edge estimate) of serve_class_step_us for one
+    (class, phase) labelset from the snapshot histograms."""
+    for hh in metrics.snapshot()["histograms"]:
+        if hh["name"] != "serve_class_step_us":
+            continue
+        lbl = hh["labels"]
+        if lbl.get("slo_class") != slo_class or \
+                lbl.get("phase") != phase:
+            continue
+        total = hh["count"]
+        assert total > 0, (phase, slo_class)
+        seen = 0
+        for i, c in enumerate(hh["buckets"]):
+            seen += c
+            if seen >= 0.99 * total:
+                edge = hh["le"][i] if i < len(hh["le"]) else "+Inf"
+                return float("inf") if edge == "+Inf" else float(edge)
+    raise AssertionError(f"no {slo_class}/{phase} latency samples")
+
+
+def run_tail(h: ServingHarness, scaler: Autoscaler) -> int:
+    """The shared post-grow schedule: entered by origin members with
+    the grow already applied (state step 4, inside serve_until(5))
+    and by the newcomer right after join — every collective from here
+    on (steps, verify audits, epoch commits, the shrink) must be
+    issued in the same order on all three ranks."""
+    joined = is_grown()
+    h.serve_until(GROW_AT + 1)
+    comm = h.gate.comm
+    me = comm.Get_rank()
+    assert comm.Get_size() == 3, comm.Get_size()
+    h.verify_state()                     # bitwise audit after resize 1
+    rto = "joined" if joined else _rto_us("arrival")
+    print(f"AUTOSCALE-GROW rank {me} world=3 rto={rto}", flush=True)
+
+    tr = h.new_stream(mode="steady")     # warmup/grow excluded
+    h.set_phase("steady")
+    h.serve_until(SPIKE_AT)
+    h.verify_state()
+    assert scaler.mode == "armed", scaler.mode
+    assert tr.violations == 0, tr.violations
+    print(f"AUTOSCALE-STEADY rank {me} p50={tr.p50():.0f}us "
+          f"p99={tr.p99():.0f}us violations={tr.violations}",
+          flush=True)
+
+    h.set_phase("brownout")
+    h.serve_until(CALM_AT)
+    # the step-18 evaluation has not fired yet: the latch is still
+    # fully engaged and BOTH sheddable classes were actually shed
+    assert scaler.mode == "brownout", scaler.mode
+    assert scaler.brownout_cause == "max_world", scaler.brownout_cause
+    assert scaler.ladder.shed == {"bulk", "normal"}, scaler.ladder.shed
+    assert h.gate.comm.Get_size() == 3   # brownout never resized
+    bulk = pv["serve_shed_steps_bulk"].value
+    norm = pv["serve_shed_steps_normal"].value
+    assert bulk >= 1 and norm >= 1, (bulk, norm)
+    assert "latency" not in BrownoutLadder.RUNGS  # structural: no rung
+    print(f"AUTOSCALE-BROWNOUT rank {me} cause=max_world "
+          f"shed_bulk={bulk} shed_normal={norm}", flush=True)
+
+    h.set_phase("recovery")
+    h.serve_until(END)                   # newcomer retires at eval 22
+    comm = h.gate.comm
+    me = comm.Get_rank()
+    assert comm.Get_size() == 2, comm.Get_size()
+    assert scaler.mode == "armed", scaler.mode
+    assert not scaler.ladder.latched
+    h.verify_state()                     # bitwise audit after resize 2
+
+    # the closed-form audit: acc must equal the sum of per-step oracle
+    # sums over the world size each step was ACTUALLY served on
+    acc = float(h.state["acc"][0])
+    want = sum(straffic.step_sum(SEED, i, world_at(i))
+               for i in range(END))
+    assert acc == want, (acc, want)
+    # LATENCY stayed inside its pre-spike band while BULK/NORMAL shed
+    steady_p99 = _class_p99("steady")
+    brown_p99 = _class_p99("brownout")
+    band = max(steady_p99 * 10.0, steady_p99 + 500000.0)
+    assert brown_p99 <= band, (brown_p99, steady_p99)
+    assert pv["forensics_stall_trips"].value == 0
+    assert pv["ft_grows"].value == (0 if joined else 1)
+    print(f"AUTOSCALE-SHRINK rank {me} world=2 rto={_rto_us('idle')}",
+          flush=True)
+    print(f"AUTOSCALE-LAT rank {me} steady_p99={steady_p99:.0f}us "
+          f"brownout_p99={brown_p99:.0f}us", flush=True)
+    print(f"AUTOSCALE-OK rank {me} steps={h.state_step()} "
+          f"world={comm.Get_size()} src={'grown' if joined else 'origin'}",
+          flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+def scenario_mode() -> int:
+    if is_grown():
+        # the newcomer the step-4 grow spawned: merge in as rank 2,
+        # receive the resharded state + the controller's cooldown
+        # clocks, then run the SAME schedule the survivors run
+        comm, state, note = join_grow(replicated=("step", "acc"))
+        assert state is not None, "grown newcomer received no state"
+        h = ServingHarness(comm, seed=SEED, state=state)
+        scaler = _mk_controller(h)
+        scaler.apply_note(note)
+        # collective epoch commit in the grown layout (survivors run
+        # adopt_resize inside the controller's scale-up)
+        h.adopt_resize(comm)
+        h.set_phase("warmup")
+        return run_tail(h, scaler)
+    from ompi_tpu.runtime.state import get_world
+
+    comm = get_world()
+    assert comm.Get_size() == 2, comm.Get_size()
+    h = ServingHarness(comm, seed=SEED)
+    h.commit_baseline()
+    scaler = _mk_controller(h)
+    h.set_phase("warmup")
+    assert h.state_step() == 0
+    return run_tail(h, scaler)
+
+
+def main() -> int:
+    faulthandler.register(_signal.SIGUSR2)
+    mode = sys.argv[1] if len(sys.argv) > 1 else "scenario"
+    if mode == "scenario":
+        return scenario_mode()
+    print(f"unknown mode {mode}", flush=True)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

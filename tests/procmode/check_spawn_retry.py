@@ -1,0 +1,114 @@
+"""dpm.spawn transient-failure retry, selected by argv[1].
+
+``parent`` (1 rank) — the bounded-retry regression the autoscaler's
+grow path depends on:
+
+1. **fail-then-succeed**: Comm_spawn a wrapper that execs ``/bin/false``
+   on its first launch (the child dies before wireup — the transient
+   class: exec errors, crashed interpreters, dead-before-ready) and
+   execs the real child on the next. With ``dpm_spawn_retries`` budget
+   the root must retry with backoff and the spawn must SUCCEED, with
+   the retry accounted in the ``dpm_spawn_retries`` pvar and the child
+   fully functional (intercomm allreduce verified).
+2. **budget exhaustion**: Comm_spawn ``/bin/false`` outright with a
+   1-retry budget — the original contract must hold: ERR_SPAWN raised
+   (on every rank, via the Bcast) after exactly the budgeted retries,
+   partial children reaped by the existing helpers.
+
+``child`` — the spawned side of case 1: bridge to the parent via
+Comm_get_parent and verify a collective across the intercomm.
+"""
+
+import os
+import stat
+import sys
+import tempfile
+
+import numpy as np
+
+import ompi_tpu
+from ompi_tpu import COMM_WORLD, Comm_get_parent
+from ompi_tpu.core.errors import MPIError, ERR_SPAWN
+from ompi_tpu.mca.var import all_pvars, set_var
+import ompi_tpu.runtime.dpm  # noqa: F401 — registers the dpm_* pvars
+
+SELF = os.path.abspath(__file__)
+pv = all_pvars()
+
+
+def _write_wrapper(scratch: str) -> str:
+    """A launcher that fails TRANSIENTLY: /bin/false on the first
+    exec (sentinel absent), the real child on every retry."""
+    sentinel = os.path.join(scratch, "first-launch-burned")
+    path = os.path.join(scratch, "flaky-launcher.sh")
+    with open(path, "w") as f:
+        f.write("#!/bin/sh\n"
+                f'if [ ! -e "{sentinel}" ]; then\n'
+                f'  : > "{sentinel}"\n'
+                "  exec /bin/false\n"
+                "fi\n"
+                f'exec "{sys.executable}" "{SELF}" child\n')
+    os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR)
+    return path
+
+
+def parent_mode() -> int:
+    r = COMM_WORLD.Get_rank()
+    scratch = tempfile.mkdtemp(prefix="ompi-tpu-spawn-retry-")
+    set_var("dpm", "spawn_retries", 3)
+    set_var("dpm", "spawn_retry_backoff_ms", 50.0)
+
+    # 1. transient failure: first launch dies before wireup, the retry
+    # succeeds and the child is a fully functional spawn
+    before = pv["dpm_spawn_retries"].value
+    inter = COMM_WORLD.Spawn(_write_wrapper(scratch), maxprocs=1,
+                             root=0)
+    retried = pv["dpm_spawn_retries"].value - before
+    assert retried == 1, retried
+    red = np.zeros(1, np.float64)
+    inter.Allreduce(np.full(1, 1.0), red)
+    assert red[0] == 100.0, red  # the child contributed its 100
+    print(f"SPAWN-RETRY-RECOVERED rank {r} retried={retried}",
+          flush=True)
+
+    # 2. budget exhaustion: a PERSISTENT failure keeps the existing
+    # error contract after exactly the budgeted retries
+    set_var("dpm", "spawn_retries", 1)
+    before = pv["dpm_spawn_retries"].value
+    try:
+        COMM_WORLD.Spawn("/bin/false", maxprocs=1, root=0)
+        raise AssertionError("spawn of /bin/false succeeded")
+    except MPIError as e:
+        assert e.code == ERR_SPAWN, e
+    retried = pv["dpm_spawn_retries"].value - before
+    assert retried == 1, retried
+    print(f"SPAWN-RETRY-EXHAUSTED rank {r} retried={retried}",
+          flush=True)
+    print(f"SPAWN-RETRY-OK rank {r}", flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+def child_mode() -> int:
+    parent = Comm_get_parent()
+    assert parent is not None
+    red = np.zeros(1, np.float64)
+    parent.Allreduce(np.full(1, 100.0), red)
+    assert red[0] == 1.0, red  # the single parent contributed 1
+    print("SPAWN-RETRY-CHILD-OK", flush=True)
+    ompi_tpu.Finalize()
+    return 0
+
+
+def main() -> int:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "parent"
+    if mode == "parent":
+        return parent_mode()
+    if mode == "child":
+        return child_mode()
+    print(f"unknown mode {mode}", flush=True)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,573 @@
+"""SLO-driven autoscaling with brownout load shedding (serve/autoscale).
+
+Unit coverage for the pure pieces — the hysteretic ScalePolicy, the
+BrownoutLadder shed precedence (BULK first, then NORMAL, never
+LATENCY; latched with staged re-arm) and the Autoscaler's brownout
+causes (spawn budget, RTO budget) against a fake harness — plus the
+admission-gate resize-while-queued contract, the promexport grammar
+check over the new metrics surface, the mpitop WORLD/SHED cells, the
+registration/info surface, and the two procmode proofs:
+
+- check_autoscale.py 'scenario': one run drives closed-form traffic
+  through grow -> steady -> flash-crowd brownout -> shrink with the
+  world size DECIDED by the controller, bitwise-exact state after
+  every resize (the ISSUE 20 acceptance run).
+- check_spawn_retry.py 'parent': dpm.spawn survives a transient child
+  death via the bounded backoff retry and still raises ERR_SPAWN when
+  a persistent failure exhausts the budget.
+"""
+
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ompi_tpu.serve  # noqa: F401  registers the serve_* surface
+from ompi_tpu.core.errors import MPIError, ERR_SPAWN
+from ompi_tpu.mca.var import all_pvars, all_vars, get_var, set_var
+from ompi_tpu.runtime import metrics
+from ompi_tpu.serve import autoscale as sauto
+from ompi_tpu.serve import policy as spolicy
+from ompi_tpu.serve import slo as sslo
+from ompi_tpu.serve import traffic as straffic
+from ompi_tpu.serve.autoscale import (
+    Autoscaler,
+    BrownoutLadder,
+    ScalePolicy,
+    Signals,
+)
+from ompi_tpu.serve.policy import AdmissionGate
+
+from tests.test_process_mode import REPO
+from tests.test_serve import FT_SERVE, _FakeComm, _blame, run_mpi
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import mpitop  # noqa: E402
+import promexport  # noqa: E402
+
+pv = all_pvars()
+
+
+@pytest.fixture(autouse=True)
+def clean_autoscale():
+    yield
+    sauto.reset_for_testing()
+    sslo.reset_for_testing()
+    straffic.reset_for_testing()
+    spolicy.reset_for_testing()
+    metrics.reset_for_testing()
+
+
+@pytest.fixture
+def restore_vars():
+    saved = {}
+
+    def save(fw, name):
+        saved[(fw, name)] = get_var(fw, name)
+
+    yield save
+    for (fw, name), v in saved.items():
+        set_var(fw, name, v)
+
+
+@pytest.fixture
+def no_failures(monkeypatch):
+    from ompi_tpu.ft import detector
+
+    monkeypatch.setattr(detector, "known_failed", lambda: set())
+
+
+def _policy(**kw):
+    kw.setdefault("min_world", 1)
+    kw.setdefault("max_world", 8)
+    kw.setdefault("up_util", 0.8)
+    kw.setdefault("down_util", 0.5)
+    kw.setdefault("up_cooldown", 4)
+    kw.setdefault("down_cooldown", 8)
+    kw.setdefault("max_step", 1)
+    kw.setdefault("queue_high", 4)
+    kw.setdefault("headroom_min", 0.1)
+    return ScalePolicy(**kw)
+
+
+# ------------------------------------------------------------- policy
+def test_policy_asymmetric_band_holds_flat_load():
+    """Demand inside (down, up) thresholds is a hold in BOTH
+    directions — the hysteresis band that keeps flat load from
+    flapping the world size."""
+    p = _policy()
+    # up edge: 3 * 0.8 = 2.4; down edge: (3-1) * 0.5 = 1.0
+    assert p.decide(3, Signals(2.2), 0) == (3, None)
+    assert p.decide(3, Signals(1.0), 0) == (3, None)   # at the edge
+    assert p.decide(3, Signals(2.5), 0) == (4, "arrival")
+    assert _policy().decide(3, Signals(0.9), 100) == (2, "idle")
+
+
+def test_policy_per_direction_cooldowns():
+    p = _policy(up_cooldown=4, down_cooldown=8)
+    assert p.decide(2, Signals(5.0), 0) == (3, "arrival")
+    assert p.decide(3, Signals(5.0), 2) == (3, None)    # up cooling
+    assert p.decide(3, Signals(5.0), 4)[1] == "arrival"
+    q = _policy(down_cooldown=8)
+    assert q.decide(4, Signals(0.1), 0) == (3, "idle")
+    assert q.decide(3, Signals(0.1), 4) == (3, None)    # down cooling
+    assert q.decide(3, Signals(0.1), 8) == (2, "idle")
+    # the cooldowns are per direction: an up right after a down is
+    # legal (load came back — do not sit on the floor for 8 steps)
+    assert q.decide(2, Signals(9.0), 9)[1] == "arrival"
+
+
+def test_policy_min_max_clamps():
+    p = _policy(min_world=2, max_world=3)
+    assert p.decide(3, Signals(9.0), 0) == (3, None)    # at the ceiling
+    assert p.decide(2, Signals(0.0), 0) == (2, None)    # at the floor
+    assert p.overloaded(3, Signals(9.0))
+    assert not p.overloaded(2, Signals(9.0))            # can still grow
+    assert not p.overloaded(3, Signals(1.0))            # no pressure
+    # max_world 0 (the cvar default) means unbounded
+    assert ScalePolicy(max_world=0).max_world() > 1 << 20
+
+
+def test_policy_bounded_step_and_demand_need():
+    # need = ceil(demand / up_util) ranks; the step bound clamps it
+    p = _policy(up_util=1.0, max_step=2)
+    assert p.decide(1, Signals(10.0), 0) == (3, "arrival")
+    q = _policy(up_util=1.0, max_step=16, max_world=32)
+    assert q.decide(1, Signals(10.0), 0) == (10, "arrival")
+    # ...and the world ceiling clamps the need
+    assert _policy(up_util=1.0, max_step=16).decide(
+        1, Signals(10.0), 0) == (8, "arrival")
+    # a non-arrival trigger with no demand magnitude asks for ONE rank
+    r = _policy(queue_high=4)
+    assert r.decide(2, Signals(0.0, queue_depth=9.0), 0) == (3, "queue")
+
+
+def test_policy_scale_down_is_always_one_rank():
+    """Regardless of max_step: retiring a block of top ranks can
+    retire a rank together with every buddy replica of its state."""
+    p = _policy(max_step=4)
+    assert p.decide(5, Signals(0.0), 0) == (4, "idle")
+
+
+def test_policy_trigger_class_precedence():
+    sig = Signals(9.0, queue_depth=9.0, slo_headroom=-1.0)
+    assert _policy().decide(2, sig, 0)[1] == "arrival"
+    sig = Signals(0.0, queue_depth=9.0, slo_headroom=-1.0)
+    assert _policy().decide(2, sig, 0)[1] == "queue"
+    sig = Signals(0.0, queue_depth=0.0, slo_headroom=0.05)
+    assert _policy().decide(2, sig, 0)[1] == "slo"
+
+
+# ------------------------------------------------------------- ladder
+def test_ladder_sheds_bulk_first_then_normal_never_latency():
+    lad = BrownoutLadder(rearm_evals=1)
+    assert lad.note_eval(True) == "shed:bulk"
+    assert lad.shed == {"bulk"} and lad.latched
+    assert not lad.should_shed("normal")
+    assert lad.note_eval(True) == "shed:normal"
+    assert lad.shed == {"bulk", "normal"}
+    assert lad.note_eval(True) is None          # fully escalated
+    # LATENCY is structurally uncheddable: not a rung at all
+    assert "latency" not in BrownoutLadder.RUNGS
+    assert not lad.should_shed("latency")
+
+
+def test_ladder_staged_rearm_restores_normal_before_bulk():
+    lad = BrownoutLadder(rearm_evals=2)
+    lad.note_eval(True)
+    lad.note_eval(True)
+    assert lad.note_eval(False) is None         # calm 1 of 2
+    assert lad.note_eval(False) == "restore:normal"
+    assert lad.shed == {"bulk"} and lad.latched
+    assert lad.note_eval(False) is None
+    assert lad.note_eval(False) == "restore:bulk:disarm"
+    assert lad.shed == set() and not lad.latched
+    assert lad.note_eval(False) is None         # disarmed: inert
+
+
+def test_ladder_overload_resets_the_calm_streak():
+    lad = BrownoutLadder(rearm_evals=2)
+    lad.note_eval(True)
+    assert lad.note_eval(False) is None          # calm 1 of 2
+    assert lad.note_eval(True) == "shed:normal"  # relapse re-escalates
+    assert lad.note_eval(False) is None          # streak restarted
+    assert lad.note_eval(False) == "restore:normal"
+
+
+# --------------------------------------------------------- controller
+class _Harness:
+    """The minimum surface the Autoscaler steers: an admission gate
+    holding the live comm, the traffic seed, and the resize-adoption
+    seam (recorded, not executed)."""
+
+    def __init__(self, ranks=(0, 1, 2), seed=3):
+        self.gate = AdmissionGate(_FakeComm(ranks=ranks))
+        self.seed = seed
+        self.state = {}
+        self.scaler = None
+        self.step = 0
+        self.adopted = []
+
+    def attach_autoscaler(self, scaler):
+        self.scaler = scaler
+
+    def state_step(self):
+        return self.step
+
+    def adopt_resize(self, comm, state=None):
+        self.adopted.append((comm, state))
+        self.gate.install(comm)
+        self.gate.full_size = comm.Get_size()
+
+
+def test_autoscaler_shed_sequence_is_deterministic(restore_vars):
+    """During a full shed the applied arrival is ALWAYS latency-class:
+    the (step, attempt) class walk strides every pattern slot, and the
+    shed counters advance identically on a rebuilt controller."""
+    restore_vars("serve", "autoscale_eval_steps")
+    set_var("serve", "autoscale_eval_steps", 0)   # policy eval off
+
+    def drive(step):
+        h = _Harness(seed=3)
+        sc = Autoscaler(h, lambda s: 0.0)
+        sc.mode = "brownout"
+        sc.ladder.latched = True
+        sc.ladder.shed = {"bulk", "normal"}
+        h.step = step
+        verdicts = []
+        for _ in range(16):
+            ok = sc.before_step(h)
+            verdicts.append((ok, sc.last_class()))
+            if ok:
+                sc.note_step_applied(step)
+                break
+        return verdicts
+
+    b0 = pv["serve_shed_steps_bulk"].value
+    n0 = pv["serve_shed_steps_normal"].value
+    got = drive(14)
+    # seed 3, step 14: the walk hits normal, normal, normal, latency
+    assert [c for _, c in got] == ["normal", "normal", "normal",
+                                  "latency"]
+    assert [ok for ok, _ in got] == [False, False, False, True]
+    assert got[-1] == (True, "latency")           # latency is served
+    assert pv["serve_shed_steps_normal"].value == n0 + 3
+    assert pv["serve_shed_steps_bulk"].value == b0
+    assert drive(14) == got                       # bitwise rerun
+    # a partial shed set passes the first non-shed class straight through
+    h = _Harness(seed=3)
+    sc = Autoscaler(h, lambda s: 0.0)
+    sc.mode = "brownout"
+    sc.ladder.latched = True
+    sc.ladder.shed = {"bulk"}
+    h.step = 14
+    assert sc.before_step(h) and sc.last_class() == "normal"
+
+
+def test_autoscaler_spawn_budget_exhaustion_latches_brownout(
+        restore_vars, monkeypatch):
+    """ERR_SPAWN after dpm's bounded retry must NOT spin the scale-up:
+    the RTO clock is cancelled (no bogus sample) and brownout latches
+    with cause spawn_budget."""
+    from ompi_tpu.ft import recovery as _recovery
+
+    restore_vars("serve", "autoscale_eval_steps")
+    set_var("serve", "autoscale_eval_steps", 2)
+
+    def boom(*a, **kw):
+        raise MPIError(ERR_SPAWN, "child died before wireup")
+
+    monkeypatch.setattr(_recovery, "grow", boom)
+    h = _Harness(ranks=(0, 1))
+    sc = Autoscaler(h, lambda s: 9.0, policy=_policy(max_world=8))
+    before = pv["serve_autoscale_brownouts"].value
+    ups = pv["serve_autoscale_scale_ups"].value
+    assert sc.before_step(h)                     # eval fires at step 0
+    assert sc.mode == "brownout"
+    assert sc.brownout_cause == "spawn_budget"
+    assert sc.ladder.shed == {"bulk"}
+    assert pv["serve_autoscale_brownouts"].value == before + 1
+    assert pv["serve_autoscale_scale_ups"].value == ups + 1
+    assert not sc.rto.running("arrival")         # cancelled, not stopped
+    assert sc._pending_rto is None
+    assert h.adopted == []                       # the world never changed
+    # a real (non-spawn) failure during grow must still propagate
+    monkeypatch.setattr(
+        _recovery, "grow",
+        lambda *a, **kw: (_ for _ in ()).throw(MPIError(1, "other")))
+    h2 = _Harness(ranks=(0, 1))
+    sc2 = Autoscaler(h2, lambda s: 9.0, policy=_policy(max_world=8))
+    with pytest.raises(MPIError):
+        sc2.before_step(h2)
+
+
+def test_autoscaler_rto_budget_blown_latches_brownout(restore_vars):
+    """A measured resize RTO above serve_autoscale_rto_budget_ms
+    journals at completion and latches brownout at the NEXT eval."""
+    restore_vars("serve", "autoscale_eval_steps")
+    restore_vars("serve", "autoscale_rto_budget_ms")
+    set_var("serve", "autoscale_eval_steps", 2)
+    set_var("serve", "autoscale_rto_budget_ms", 0.001)
+    h = _Harness(ranks=(0, 1, 2))
+    # calm signal: no up pressure, no down (demand inside the band)
+    sc = Autoscaler(h, lambda s: 1.5, policy=_policy(max_world=3))
+    sc.mode = "scaling"
+    sc.rto.start("arrival")
+    sc._pending_rto = "arrival"
+    time.sleep(0.001)
+    sc.note_step_applied(1)
+    assert sc.mode == "armed"                    # resize settled...
+    assert sc._rto_blown == "arrival"            # ...but over budget
+    h.step = 2
+    sc.before_step(h)                            # next eval latches
+    assert sc.mode == "brownout"
+    assert sc.brownout_cause == "rto_budget"
+    assert sc._rto_blown is None                 # consumed
+
+
+def test_autoscaler_brownout_rearm_returns_to_armed(restore_vars):
+    restore_vars("serve", "autoscale_eval_steps")
+    set_var("serve", "autoscale_eval_steps", 2)
+    h = _Harness(ranks=(0, 1, 2))
+    demand = {"v": 9.0}
+    sc = Autoscaler(h, lambda s: demand["v"],
+                    policy=_policy(max_world=3),
+                    ladder=BrownoutLadder(rearm_evals=1))
+    h.step = 0
+    sc.before_step(h)                            # overloaded at ceiling
+    assert sc.mode == "brownout"
+    assert sc.brownout_cause == "max_world"
+    h.step = 2
+    sc.before_step(h)                            # still hot: sheds NORMAL
+    assert sc.ladder.shed == {"bulk", "normal"}
+    demand["v"] = 1.5                            # calm, inside the band
+    h.step = 4
+    sc.before_step(h)                            # restore:normal
+    assert sc.mode == "brownout"                 # bulk still shed
+    h.step = 6
+    sc.before_step(h)                            # restore:bulk:disarm
+    assert sc.mode == "armed"
+    assert sc.brownout_cause is None
+    assert not sc.ladder.latched
+
+
+def test_autoscaler_resize_note_roundtrip():
+    h = _Harness()
+    sc = Autoscaler(h, lambda s: 0.0, policy=_policy())
+    sc.policy.last_up = 4
+    sc._last_eval = 4
+    note = sc.resize_note()
+    assert note == {"last_up": 4, "last_down": None, "last_eval": 4}
+    h2 = _Harness()
+    sc2 = Autoscaler(h2, lambda s: 0.0, policy=_policy())
+    sc2.apply_note(note)
+    assert sc2.policy.last_up == 4
+    assert sc2.policy.last_down is None
+    assert sc2._last_eval == 4                   # no re-eval of step 4
+    sc2.apply_note(None)                         # missing note: no-op
+    assert sc2.policy.last_up == 4
+
+
+def test_autoscaler_sampler_rides_the_snapshot():
+    h = _Harness(ranks=(0, 1, 2))
+    sc = Autoscaler(h, lambda s: 0.0)
+    sc.mode = "brownout"
+    row = metrics.snapshot()["samplers"]["serve_autoscale_by_class"]
+    assert row["world"] == 3.0
+    assert row["mode"] == float(sauto.MODES.index("brownout"))
+    assert row["mode_name"] == "brownout"        # JSON-only string
+    for k in ("shed_bulk", "shed_normal", "queue_depth",
+              "oldest_wait_us"):
+        assert isinstance(row[k], float), k
+
+
+# ----------------------------------------- admission gate under resize
+def test_admission_gate_queues_across_a_resize_window(no_failures):
+    """The PR 15 gate contract under an autoscaler resize: a step
+    arriving while the window is open queues (depth + oldest-age
+    telemetry live), then drains onto the NEW communicator once the
+    resize installs it — no collective ever tears across the
+    membership change."""
+    from ompi_tpu.ft import recovery as _recovery
+
+    old = _FakeComm(ranks=(0, 1), name="fake-old")
+    new = _FakeComm(ranks=(0, 1, 2), name="fake-grown")
+    gate = AdmissionGate(old)
+    queued0 = pv["serve_queued_steps"].value
+    got = {}
+    _recovery._recovering[0] += 1
+    try:
+        t = threading.Thread(target=lambda: got.update(
+            comm=gate.admit()))
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while gate.queue_depth() == 0 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert gate.queue_depth() == 1
+        time.sleep(0.005)
+        assert gate.oldest_wait_us() > 0.0
+        gauges = {g["name"]: g["value"]
+                  for g in metrics.snapshot()["gauges"]}
+        assert gauges["serve_admission_queue_depth"] == 1.0
+        assert gauges["serve_admission_oldest_wait_us"] > 0.0
+        # the resize lands: new world installed, THEN the window closes
+        gate.install(new)
+        gate.full_size = new.Get_size()
+    finally:
+        _recovery._recovering[0] -= 1
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert got["comm"] is new                    # re-admitted onto M=3
+    assert pv["serve_queued_steps"].value == queued0 + 1
+    assert gate.queue_depth() == 0
+    gauges = {g["name"]: g["value"]
+              for g in metrics.snapshot()["gauges"]}
+    assert gauges["serve_admission_queue_depth"] == 0.0
+
+
+# -------------------------------------------------- prometheus grammar
+def test_promexport_grammar_over_the_autoscale_surface(no_failures):
+    """The new gauges, the by-class sampler (with its JSON-only string
+    field), the demand EWMA and the RTO histogram must all render as
+    valid Prometheus exposition text."""
+    h = _Harness(ranks=(0, 1, 2))
+    sc = Autoscaler(h, lambda s: 2.0)
+    h.gate._publish_queue()
+    metrics.ewma_update("serve_autoscale_demand", 2.0)
+    metrics.gauge_set("serve_autoscale_world", 3.0)
+    sc.rto.start("arrival")
+    sc.rto.stop("arrival")
+    text = metrics.render_prometheus()
+    assert promexport.validate(text) == []
+    assert "serve_admission_queue_depth" in text
+    assert "serve_autoscale_world" in text
+    assert 'serve_autoscale_rto_us_bucket' in text
+    assert "mode_name" not in text               # strings are JSON-only
+
+
+# ------------------------------------------------------- mpitop cells
+def test_mpitop_world_cell_sampler_and_fallback():
+    snap = {"samplers": {"serve_autoscale_by_class":
+                         {"world": 3.0, "mode_name": "armed"}}}
+    assert mpitop.world_cell(snap) == "3"
+    snap["samplers"]["serve_autoscale_by_class"]["mode_name"] = \
+        "scaling"
+    assert mpitop.world_cell(snap) == "3~"
+    snap["samplers"]["serve_autoscale_by_class"]["mode_name"] = \
+        "brownout"
+    assert mpitop.world_cell(snap) == "3!"
+    # pvar/gauge fallback (snapshot written before the sampler existed)
+    snap = {"pvars": {"serve_autoscale_decisions": 5},
+            "gauges": [{"name": "serve_autoscale_world", "labels": {},
+                        "value": 2.0}]}
+    assert mpitop.world_cell(snap) == "2"
+    assert mpitop.world_cell({"pvars": {}}) == ""   # never attached
+
+
+def test_mpitop_shed_cell_sampler_and_fallback():
+    snap = {"samplers": {"serve_autoscale_by_class":
+                         {"shed_bulk": 4.0, "shed_normal": 2.0}}}
+    assert mpitop.shed_cell(snap) == "4b/2n"
+    snap = {"pvars": {"serve_shed_steps_bulk": 1,
+                      "serve_shed_steps_normal": 0}}
+    assert mpitop.shed_cell(snap) == "1b/0n"
+    assert mpitop.shed_cell({"pvars": {}}) == ""
+    snap = {"samplers": {"serve_autoscale_by_class":
+                         {"shed_bulk": 0.0, "shed_normal": 0.0}}}
+    assert mpitop.shed_cell(snap) == ""          # nothing ever shed
+
+
+# ------------------------------------------------------- registration
+def test_autoscale_cvars_and_pvars_registered():
+    vars_ = all_vars()
+    for name in ("serve_autoscale_eval_steps",
+                 "serve_autoscale_min_world",
+                 "serve_autoscale_max_world",
+                 "serve_autoscale_up_util",
+                 "serve_autoscale_down_util",
+                 "serve_autoscale_up_cooldown_steps",
+                 "serve_autoscale_down_cooldown_steps",
+                 "serve_autoscale_max_step",
+                 "serve_autoscale_queue_high",
+                 "serve_autoscale_headroom_min",
+                 "serve_autoscale_rearm_evals",
+                 "serve_autoscale_rto_budget_ms",
+                 "dpm_spawn_retries", "dpm_spawn_retry_backoff_ms"):
+        assert name in vars_, name
+    for name in ("serve_autoscale_decisions", "serve_autoscale_scale_ups",
+                 "serve_autoscale_scale_downs",
+                 "serve_autoscale_brownouts", "serve_shed_steps_bulk",
+                 "serve_shed_steps_normal"):
+        assert name in pv, name
+
+
+def test_info_cli_lists_autoscale_surface(capsys):
+    from ompi_tpu.tools.info import main as info_main
+
+    info_main(["--level", "9", "--param", "serve", "--pvars"])
+    out = capsys.readouterr().out
+    assert "serve_autoscale_max_world" in out
+    assert "serve_autoscale_rto_budget_ms" in out
+    assert "serve_shed_steps_bulk" in out
+
+
+# ----------------------------------------------------------- procmode
+def test_autoscale_scenario_procmode(tmp_path):
+    """The ISSUE 20 acceptance proof: closed-form traffic drives
+    grow -> steady -> flash-crowd brownout -> shrink in ONE run, the
+    world size decided by the controller, state bitwise-exact after
+    every resize, RTO per trigger class from the metrics plane, zero
+    steady-state SLO violations, LATENCY p99 inside its pre-spike band
+    while BULK/NORMAL shed."""
+    dumps = str(tmp_path / "dumps")
+    os.makedirs(dumps, exist_ok=True)
+    try:
+        r = run_mpi(
+            2, os.path.join("tests", "procmode", "check_autoscale.py"),
+            "scenario", timeout=220,
+            # a 1s SLO: 'zero violations in steady state' must hold
+            # under tier-1 parallel load, not just on an idle host
+            mca=FT_SERVE + (("serve_slo_us", "1000000.0"),),
+            env_extra=(("OMPI_TPU_MCA_metrics_dir", dumps),))
+    except subprocess.TimeoutExpired:
+        raise AssertionError(
+            "autoscale scenario hung; blame:\n" + _blame(dumps))
+    out = r.stdout
+    assert r.returncode == 0, out + r.stderr + _blame(dumps)
+    # 2 origin ranks + 1 grown newcomer run the shared tail; the
+    # newcomer retires at the shrink, so only 2 ranks reach OK
+    assert out.count("AUTOSCALE-GROW") == 3, out
+    assert out.count("AUTOSCALE-STEADY") == 3, out
+    assert out.count("AUTOSCALE-BROWNOUT") == 3, out
+    assert out.count("AUTOSCALE-SHRINK") == 2, out
+    assert out.count("AUTOSCALE-LAT") == 2, out
+    assert out.count("AUTOSCALE-OK") == 2, out
+    assert re.search(r"AUTOSCALE-GROW rank \d world=3", out)
+    assert re.search(r"AUTOSCALE-SHRINK rank \d world=2", out)
+    assert re.search(r"AUTOSCALE-STEADY rank \d .*violations=0", out)
+    assert re.search(r"shed_bulk=[1-9]", out)
+    assert re.search(r"shed_normal=[1-9]", out)
+    # the newcomer joins mid-stream (its GROW line reads rto=joined)
+    # and is deterministically the shrink victim, so OK is origin-only
+    assert "rto=joined" in out
+    assert out.count("src=origin") == 2 and "src=grown" not in out
+
+
+def test_spawn_retry_procmode():
+    """dpm.spawn transient-failure retry: a child that dies before
+    wireup is retried on a bounded backoff budget (satellite 1); a
+    persistent failure still raises ERR_SPAWN once the budget burns."""
+    r = run_mpi(
+        1, os.path.join("tests", "procmode", "check_spawn_retry.py"),
+        "parent", timeout=180, mca=(("coll_sm_enable", "0"),))
+    out = r.stdout
+    assert r.returncode == 0, out + r.stderr
+    assert "SPAWN-RETRY-RECOVERED rank 0 retried=1" in out
+    assert "SPAWN-RETRY-CHILD-OK" in out
+    assert "SPAWN-RETRY-EXHAUSTED rank 0 retried=1" in out
+    assert "SPAWN-RETRY-OK rank 0" in out

@@ -15,10 +15,13 @@ reconnect-and-replay is in flight; recoveries/CRC rejects once
 healthy) from the ``btl_tcp_link`` sampler, the RTT-MS / GBPS fabric
 cells (worst-edge smoothed RTT and summed delivered goodput from the
 ``btl_tcp_linkmodel`` sampler — tools/mpinet.py renders the full N×N
-weathermap), and
+weathermap),
 the BOUND cell (``<category>@<rank>``: the latest step's critical-path
 category and bound rank from the critpath sampler —
-tools/mpicrit.py is the offline ground truth).
+tools/mpicrit.py is the offline ground truth), and the WORLD / SHED
+autoscaler cells (live world size with a mode flag — ``~`` resize in
+flight, ``!`` brownout — and lifetime shed counts by SLO class, from
+the ``serve_autoscale_by_class`` sampler serve/autoscale.py exports).
 
 Usage::
 
@@ -260,6 +263,59 @@ def gbps_cell(snap: dict) -> str:
     return f"{v / 1e9:.2f}" if v > 0 else ""
 
 
+def world_cell(snap: dict) -> str:
+    """Autoscaler world cell ``<size><mode-flag>`` from the
+    serve_autoscale_by_class sampler (`3` = 3 ranks armed, `3~` = a
+    resize in flight, `3!` = brownout shedding); pvar/gauge fallback
+    for snapshots written before the sampler existed — the QKB-L/N/B
+    pattern (the fallback carries no mode, so it renders the bare
+    size). Empty when no controller ever attached."""
+    row = snap.get("samplers", {}).get("serve_autoscale_by_class")
+    if not isinstance(row, dict):
+        pv = snap.get("pvars", {})
+        if "serve_autoscale_decisions" not in pv:
+            return ""
+        for g in snap.get("gauges", []):
+            if g.get("name") == "serve_autoscale_world":
+                try:
+                    return str(int(float(g.get("value"))))
+                except (TypeError, ValueError):
+                    return ""
+        return ""
+    try:
+        world = int(float(row.get("world") or 0))
+    except (TypeError, ValueError):
+        return ""
+    if not world:
+        return ""
+    mode = str(row.get("mode_name") or "")
+    flag = {"scaling": "~", "brownout": "!"}.get(mode, "")
+    return f"{world}{flag}"
+
+
+def shed_cell(snap: dict) -> str:
+    """Brownout shed cell ``<bulk>b/<normal>n`` (lifetime shed arrival
+    counts by SLO class — LATENCY has no slot because the ladder can
+    never shed it) from the serve_autoscale_by_class sampler; pvar
+    fallback (serve_shed_steps_*) — the QKB-L/N/B pattern. Empty when
+    nothing was ever shed."""
+    row = snap.get("samplers", {}).get("serve_autoscale_by_class")
+    if not isinstance(row, dict):
+        pv = snap.get("pvars", {})
+        row = {"shed_bulk": pv.get("serve_shed_steps_bulk"),
+               "shed_normal": pv.get("serve_shed_steps_normal")}
+        if all(v is None for v in row.values()):
+            return ""
+    try:
+        bulk = int(float(row.get("shed_bulk") or 0))
+        norm = int(float(row.get("shed_normal") or 0))
+    except (TypeError, ValueError):
+        return ""
+    if not bulk and not norm:
+        return ""
+    return f"{bulk}b/{norm}n"
+
+
 def skew_by_rank(snaps: Dict[int, dict]) -> Dict[int, float]:
     """Worst coll_entry_skew_us EWMA per rank, pulled from every
     snapshot (comm roots hold the values for their members)."""
@@ -286,7 +342,7 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
              f"{'TX-MB':>9} {'RX-MB':>9} {'SKEW-US':>8} {'TRIPS':>5} "
              f"{'P50-US':>7} {'P99-US':>8} {'QKB-L/N/B':>10} "
              f"{'STALL':>6} {'LNK':>8} {'RTT-MS':>7} {'GBPS':>6} "
-             f"{'BOUND':>8}"]
+             f"{'BOUND':>8} {'WORLD':>5} {'SHED':>9}"]
     for rank in sorted(snaps):
         snap = snaps[rank]
         pv = snap.get("pvars", {})
@@ -312,7 +368,8 @@ def render(snaps: Dict[int, dict], prev: Dict[int, dict],
             f"{'' if p99 is None else format(p99, '.0f'):>8} "
             f"{qos_queued(snap):>10} {stall_cell(snap):>6} "
             f"{lnk_cell(snap):>8} {rtt_cell(snap):>7} "
-            f"{gbps_cell(snap):>6} {bound_cell(snap):>8}")
+            f"{gbps_cell(snap):>6} {bound_cell(snap):>8} "
+            f"{world_cell(snap):>5} {shed_cell(snap):>9}")
     trips = sum(int(s.get("pvars", {}).get("metrics_straggler_trips", 0))
                 for s in snaps.values())
     lines.append(f"-- {len(snaps)} rank(s), {trips} straggler trip(s), "
